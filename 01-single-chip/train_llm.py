@@ -1,0 +1,34 @@
+"""Chapter 1 — causal-LM training on a single TPU chip.
+
+TPU-native counterpart of the reference's ``01-single-gpu/train_llm.py``:
+same CLI, same host-state/checkpoint/logging contract, but the mechanism is a
+single jitted train step (forward+backward+AdamW update in one XLA program,
+bf16 compute / fp32 params) instead of eager torch phases. There is no
+``torch.compile`` switch to flip (``01-single-gpu/train_llm.py:54``) — jit IS
+the execution model.
+
+Smoke run (hermetic, no network):
+    python train_llm.py -m gpt2-debug -d synthetic:200000 -s 256 -b 8 \
+        --num-epochs 1 --log-freq 5
+Reference-style run (needs HF cache):
+    python train_llm.py -e gpt2-alpaca -m gpt2 -d tatsu-lab/alpaca -b 8
+"""
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).parent.parent))
+
+import jax
+
+from distributed_training_guide_tpu.parallel import make_mesh, make_plan
+from distributed_training_guide_tpu.train.cli import get_parser, run_training
+
+
+def main():
+    args = get_parser().parse_args()
+    plan_factory = lambda: make_plan("single", make_mesh(devices=jax.devices()[:1]))
+    run_training(args, plan_factory)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,53 @@
+"""Chapter 6 — tensor parallelism + sequence parallelism.
+
+TPU-native counterpart of ``06-tensor-parallel/train_llm.py``. The reference
+builds a DTensor layout plan by hand (``06:79-121``): Colwise q/k/v/gate/up,
+Rowwise o/down, SequenceParallel norms, ``PrepareModuleInput`` re-layouts,
+explicit position_ids. Here the same layout is the "tp" rules table
+(``parallel/plans.py``): head/kv/mlp dims on the tp mesh axis, vocab-sharded
+embedding+head, and the residual stream constrained to ``P(dp, tp, None)``
+(sequence dim sharded) between blocks. XLA emits exactly the collective walk
+of the reference's forward (SURVEY.md section 3.3): all-gather of the
+seq-sharded activations before attention/MLP, reduce-scatter after o/down.
+
+The mesh maps tp to the innermost ICI axis (``parallel/mesh.py``), the TP
+group reads identical batches automatically (batch sharded only on data axes
+— the reference needs a dp-coord-keyed sampler, ``06:141-147``), and rope
+gets explicit positions (``ops/rope.py``, reference's ``06:210-212``).
+
+Smoke run:
+    JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+        python train_llm.py -m llama-debug -d synthetic:200000 -s 128 -b 8 \
+        --tensor-parallel 4 --num-epochs 1 --log-freq 5
+"""
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).parent.parent))
+
+import jax
+
+from distributed_training_guide_tpu.launch import maybe_initialize_distributed
+from distributed_training_guide_tpu.parallel import make_mesh, make_plan
+from distributed_training_guide_tpu.train.cli import get_parser, run_training
+
+
+def main():
+    parser = get_parser()
+    parser.add_argument("--tensor-parallel", type=int, default=None,
+                        help="tp size (default: all devices)")
+    parser.add_argument("--no-sequence-parallel", action="store_true",
+                        help="disable seq-dim sharding of the residual stream")
+    args = parser.parse_args()
+    maybe_initialize_distributed()
+
+    def plan_factory():
+        tp = args.tensor_parallel or len(jax.devices())
+        return make_plan("tp", make_mesh(tp=tp),
+                         sequence_sharded=not args.no_sequence_parallel)
+
+    run_training(args, plan_factory)
+
+
+if __name__ == "__main__":
+    main()

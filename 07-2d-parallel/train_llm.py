@@ -1,0 +1,43 @@
+"""Chapter 7 — 2-D parallelism: FSDP x TP on one mesh.
+
+TPU-native counterpart of ``07-2d-parallel/train_llm.py``. The reference
+composes two wrapper systems — the TP plan first, then ``fully_shard(...,
+mesh=mesh["dp"])`` over the orthogonal axis (``07:77-123``). Here 2-D is one
+rules table ("tp_fsdp"): head/kv/mlp/vocab dims on tp, embed dims on fsdp —
+two entries in the same NamedSharding. This is the payoff of the design: the
+chapter diff vs chapter 6 is one flag, exactly the pedagogical point the
+reference makes by keeping its loop identical.
+
+Smoke run:
+    JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+        python train_llm.py -m llama-debug -d synthetic:200000 -s 128 -b 2 \
+        --tensor-parallel 2 --num-epochs 1 --log-freq 5
+"""
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).parent.parent))
+
+import jax
+
+from distributed_training_guide_tpu.launch import maybe_initialize_distributed
+from distributed_training_guide_tpu.parallel import make_mesh, make_plan
+from distributed_training_guide_tpu.train.cli import get_parser, run_training
+
+
+def main():
+    parser = get_parser()
+    parser.add_argument("--tensor-parallel", type=int, default=1)
+    args = parser.parse_args()
+    maybe_initialize_distributed()
+
+    def plan_factory():
+        n = len(jax.devices())
+        tp = args.tensor_parallel
+        return make_plan("tp_fsdp", make_mesh(tp=tp, fsdp=n // tp))
+
+    run_training(args, plan_factory)
+
+
+if __name__ == "__main__":
+    main()

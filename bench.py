@@ -1,0 +1,110 @@
+"""Benchmark: train-step throughput + MFU on the local device(s).
+
+Prints ONE JSON line: {"metric","value","unit","vs_baseline"}.
+
+Baseline anchor: the reference's headline number is the Llama-405B run,
+~30 s/step on 64xH100 (BASELINE.md) = 6*405e9*(4096*64) FLOP / 30 s / 64 GPUs
+~= 332 TFLOP/s/GPU ~= 33.5% MFU on H100 bf16 peak (989 TFLOP/s).
+vs_baseline = achieved_mfu / 0.335 — MFU-vs-MFU is the only fair
+cross-hardware comparison.
+"""
+
+BASELINE_MFU = 0.335
+from __future__ import annotations
+
+import argparse
+import json
+import time
+
+import numpy as np
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--model", default=None, help="model preset (default: by device memory)")
+    parser.add_argument("--batch", type=int, default=None)
+    parser.add_argument("--seq", type=int, default=None)
+    parser.add_argument("--steps", type=int, default=10)
+    parser.add_argument("--warmup", type=int, default=3)
+    parser.add_argument("--remat", action="store_true", default=None)
+    parser.add_argument("--attn-impl", default="auto")
+    args = parser.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+
+    from distributed_training_guide_tpu.models import get_model
+    from distributed_training_guide_tpu.parallel import make_mesh, make_plan
+    from distributed_training_guide_tpu.train import Trainer, adamw_cosine
+    from distributed_training_guide_tpu.utils import (
+        compute_mfu, device_peak_flops, transformer_flops_per_token)
+
+    devices = jax.devices()
+    on_tpu = devices[0].platform == "tpu"
+    mem_gb = 1e-9 * (devices[0].memory_stats() or {}).get("bytes_limit", 0) if on_tpu else 0
+
+    if args.model is None:
+        if not on_tpu:
+            args.model = "llama-debug"
+        elif mem_gb >= 90:
+            args.model = "llama-3.1-8b"
+        else:
+            args.model = "llama-3.2-1b"
+    bundle = get_model(args.model)
+    cfg = bundle.config
+
+    seq = args.seq or (2048 if on_tpu else 128)
+    seq = min(seq, cfg.max_position_embeddings)
+    batch = args.batch or (8 if on_tpu else 2)
+    remat = args.remat if args.remat is not None else on_tpu
+
+    n = len(devices)
+    if n > 1:
+        mesh = make_mesh(fsdp=n, devices=devices)
+        plan = make_plan("fsdp", mesh)
+    else:
+        plan = make_plan("single", make_mesh(devices=devices[:1]))
+
+    trainer = Trainer(bundle=bundle, optimizer=adamw_cosine(3e-4), plan=plan,
+                      remat=remat, attn_impl=args.attn_impl)
+    state = trainer.init_state(0)
+
+    global_batch = batch * plan.data_parallel_size
+    ids = np.random.RandomState(0).randint(0, cfg.vocab_size, (global_batch, seq))
+    shardings = trainer.batch_shardings()
+    batch_arrays = {k: jax.device_put(jnp.asarray(ids), shardings[k])
+                    for k in ("input_ids", "labels")}
+
+    for _ in range(args.warmup):
+        state, metrics = trainer.step_fn(state, batch_arrays)
+    jax.block_until_ready(metrics["loss"])
+
+    t0 = time.perf_counter()
+    for _ in range(args.steps):
+        state, metrics = trainer.step_fn(state, batch_arrays)
+    jax.block_until_ready(metrics["loss"])
+    dt = (time.perf_counter() - t0) / args.steps
+
+    tokens_per_s = global_batch * seq / dt
+    fpt = transformer_flops_per_token(bundle.num_params(), cfg.num_layers,
+                                      cfg.hidden_size, seq, vocab_size=cfg.vocab_size)
+    mfu = compute_mfu(tokens_per_s, fpt, n_chips=n,
+                      peak_flops_per_chip=device_peak_flops(devices[0]))
+
+    print(json.dumps({
+        "metric": "mfu",
+        "value": round(mfu, 4),
+        "unit": "fraction_of_peak_bf16",
+        "vs_baseline": round(mfu / BASELINE_MFU, 3),
+        "detail": {
+            "model": args.model, "seq": seq, "global_batch": global_batch,
+            "tokens_per_s_per_chip": round(tokens_per_s / n, 1),
+            "step_ms": round(1000 * dt, 2), "n_chips": n,
+            "device": getattr(devices[0], "device_kind", devices[0].platform),
+            "remat": remat, "loss": round(float(metrics["loss"]), 4),
+        },
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,46 @@
+"""distributed_training_guide_tpu — a TPU-native distributed-training framework.
+
+A ground-up JAX/XLA/Pallas re-design of the capabilities of
+LambdaLabsML/distributed-training-guide (mounted read-only at /root/reference).
+The reference is a chapter-per-directory pedagogical guide built on
+torch + NCCL; this package provides the same capability surface the
+TPU-native way:
+
+- one ``jax.sharding.Mesh`` + NamedSharding plans instead of wrapper classes
+  (DDP / ZeRO-1 / FSDP / TP / SP / 2D are *sharding plans*, not engines)
+- a single jitted train step instead of eager autograd hooks
+- XLA collectives over ICI/DCN instead of NCCL (reference C11,
+  SURVEY.md section 2)
+- Orbax/TensorStore sharded checkpoints instead of torch DCP
+- a Pallas flash-attention kernel instead of the flash-attn CUDA wheel
+
+Package layout:
+    models/      pure-JAX model zoo (GPT-2, Llama) with logical-axis metadata
+    ops/         compute kernels: XLA reference attention + Pallas flash attention
+    parallel/    mesh construction + sharding plans + grad accumulation + remat
+    data/        data pipeline (HF-compatible + hermetic synthetic), per-host sharding
+    train/       train-state, optimizer, jitted step builder, config-driven engine
+    checkpoint/  Orbax sharded checkpoint + state.json + RNG persistence
+    utils/       timers, memory stats, MFU, rank-ordered guards, logging
+    launch/      pod launchers, elastic supervisor, error capture
+    monitor/     cluster monitor (top-cluster equivalent)
+    csrc/        native C++ components (token-shard data loader)
+"""
+
+__version__ = "0.1.0"
+
+# Some TPU images pre-import jax at interpreter startup with a plugin platform
+# that wins over the JAX_PLATFORMS env var. Re-assert the user's choice here,
+# before any backend is initialized, so
+# ``JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8``
+# (the documented multi-chip simulation recipe) works everywhere.
+import os as _os
+
+if _os.environ.get("JAX_PLATFORMS"):
+    import jax as _jax
+
+    try:
+        _jax.config.update("jax_platforms", _os.environ["JAX_PLATFORMS"])
+    except Exception:
+        pass  # backend already initialized; too late to switch
+

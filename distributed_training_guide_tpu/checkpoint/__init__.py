@@ -1,0 +1,3 @@
+from .orbax_io import CheckpointIO, abstract_train_state
+
+__all__ = ["CheckpointIO", "abstract_train_state"]

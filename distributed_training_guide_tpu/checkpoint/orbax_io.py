@@ -1,0 +1,89 @@
+"""Sharded checkpoint + resume via Orbax/TensorStore.
+
+Covers all three reference checkpoint formats (C15, SURVEY.md section 2) with
+one mechanism:
+
+- whole-tensor ``torch.save`` (``01-single-gpu/train_llm.py:181-187``),
+- sharded DCP save on all ranks (``04-fully-sharded-data-parallel/train_llm.py:241-255``),
+- stateful DCP (``06-tensor-parallel/train_llm.py:261-273``)
+
+are all "write the sharded TrainState pytree": every host writes only its
+shards (parallel filesystem I/O), restore reads directly into the target
+shardings — so there is no rank-0 broadcast on load (the reference needs one
+for pretrained weights, ``05:118-139``). Resume trigger stays the reference's
+``state.json`` contract (``01:94``): resumable iff ``<exp_dir>/state.json``
+exists. RNG state persists inside the TrainState (determinism recipe,
+``related-topics/determinism/README.md:46-68``).
+"""
+from __future__ import annotations
+
+import json
+from pathlib import Path
+from typing import Any, Optional
+
+import jax
+
+from ..utils.procguards import is_process0, sync_processes
+
+
+class CheckpointIO:
+    def __init__(self, exp_dir: str | Path):
+        self.exp_dir = Path(exp_dir)
+        import orbax.checkpoint as ocp
+
+        self._ocp = ocp
+        self._checkpointer = ocp.StandardCheckpointer()
+
+    # ---- paths -------------------------------------------------------------
+    @property
+    def state_json(self) -> Path:
+        return self.exp_dir / "state.json"
+
+    def ckpt_path(self) -> Path:
+        return (self.exp_dir / "checkpoint").absolute()
+
+    def can_resume(self) -> bool:
+        return self.state_json.exists() and self.ckpt_path().exists()
+
+    # ---- save --------------------------------------------------------------
+    def save(self, train_state: Any, host_state: dict) -> None:
+        """All hosts participate (each writes its own shards); state.json is
+        written by process 0 last so a partial save never looks resumable."""
+        self.exp_dir.mkdir(parents=True, exist_ok=True)
+        path = self.ckpt_path()
+        tmp_ok = True
+        self._checkpointer.save(path, train_state, force=True)
+        self._checkpointer.wait_until_finished()
+        sync_processes("ckpt_saved")
+        if is_process0() and tmp_ok:
+            with open(self.state_json, "w") as fp:
+                json.dump(host_state, fp)
+        sync_processes("ckpt_state_json")
+
+    # ---- restore -----------------------------------------------------------
+    def restore(self, abstract_state: Any) -> tuple[Any, dict]:
+        """abstract_state: pytree of jax.ShapeDtypeStruct *with shardings* —
+        each host reads exactly its shards from TensorStore."""
+        train_state = self._checkpointer.restore(self.ckpt_path(), abstract_state)
+        with open(self.state_json) as fp:
+            host_state = json.load(fp)
+        return train_state, host_state
+
+
+def abstract_train_state(trainer):
+    """Sharded abstract TrainState (restore target) for a Trainer."""
+    import jax.numpy as jnp
+
+    from ..train.state import TrainState
+
+    def shape_fn(seed):
+        init_rng, train_rng = jax.random.split(jax.random.key(seed))
+        params = trainer.bundle.init(trainer.bundle.config, init_rng)
+        opt_state = trainer.optimizer.init(params)
+        return TrainState(step=jnp.zeros((), jnp.int32), params=params,
+                          opt_state=opt_state, rng=jax.random.key_data(train_rng))
+
+    state_shapes = jax.eval_shape(shape_fn, jnp.zeros((), jnp.uint32))
+    return jax.tree.map(
+        lambda sd, sh: jax.ShapeDtypeStruct(sd.shape, sd.dtype, sharding=sh),
+        state_shapes, trainer.state_shardings)

@@ -1,0 +1,5 @@
+from .pipeline import load_and_preprocess_data
+from .loader import ShardedBatchLoader
+from .tokenizer import get_tokenizer, ByteTokenizer
+
+__all__ = ["load_and_preprocess_data", "ShardedBatchLoader", "get_tokenizer", "ByteTokenizer"]

@@ -1,0 +1,83 @@
+"""Distributed batch loader.
+
+Parity with the reference's ``DataLoader + DistributedSampler``
+(``02-distributed-data-parallel/train_llm.py:76-84``):
+
+- deterministic per-epoch shuffle keyed by (seed, epoch) — ``set_epoch``
+  (``02:137``);
+- ``drop_last`` partitioning into global batches;
+- each process only materializes the shards its local devices own, assembled
+  into one global ``jax.Array`` via ``make_array_from_callback`` (the JAX
+  analogue of per-rank sampler index partitioning — under a (dp, tp) mesh the
+  tp group automatically reads identical data because the batch dim is only
+  sharded over the data axes, which the reference has to hand-arrange with a
+  mesh-aware sampler, ``06-tensor-parallel/train_llm.py:141-147``);
+- epoch fast-forward for resume (``01:133-135``) via ``start_step``.
+
+Double-buffered host->device prefetch hides dispatch latency (reference C26,
+``related-topics/optimizing-data-loading``).
+"""
+from __future__ import annotations
+
+from typing import Iterator, Optional
+
+import jax
+import numpy as np
+
+
+class ShardedBatchLoader:
+    def __init__(
+        self,
+        dataset: np.ndarray,          # [num_seqs, seq_len] int32
+        global_batch_size: int,
+        sharding,                      # NamedSharding for [B, S] (or [A, B, S])
+        *,
+        grad_accum: int = 1,
+        seed: int = 0,
+        shuffle: bool = True,
+        prefetch: int = 2,
+    ):
+        if global_batch_size % max(grad_accum, 1) != 0:
+            raise ValueError("global_batch_size must be divisible by grad_accum")
+        self.dataset = dataset
+        self.global_batch_size = global_batch_size
+        self.sharding = sharding
+        self.grad_accum = grad_accum
+        self.seed = seed
+        self.shuffle = shuffle
+        self.prefetch = prefetch
+        self.epoch = 0
+
+    def set_epoch(self, epoch: int) -> None:
+        self.epoch = epoch
+
+    def __len__(self) -> int:
+        return len(self.dataset) // self.global_batch_size
+
+    def _epoch_order(self) -> np.ndarray:
+        order = np.arange(len(self.dataset))
+        if self.shuffle:
+            np.random.RandomState(self.seed + 1000003 * self.epoch).shuffle(order)
+        return order
+
+    def _make_global_array(self, np_batch: np.ndarray) -> jax.Array:
+        if self.grad_accum > 1:
+            b = self.global_batch_size // self.grad_accum
+            np_batch = np_batch.reshape(self.grad_accum, b, np_batch.shape[-1])
+        return jax.make_array_from_callback(
+            np_batch.shape, self.sharding, lambda idx: np_batch[idx])
+
+    def epoch_batches(self, start_step: int = 0) -> Iterator[dict]:
+        """Yields {'input_ids', 'labels'} global jax.Arrays; skips the first
+        ``start_step`` batches while preserving data order (resume)."""
+        order = self._epoch_order()
+        n = len(self)
+        pending: list[dict] = []
+        for step in range(start_step, n):
+            idx = order[step * self.global_batch_size:(step + 1) * self.global_batch_size]
+            np_batch = self.dataset[np.sort(idx)]
+            ids = self._make_global_array(np_batch)
+            pending.append({"input_ids": ids, "labels": ids})
+            if len(pending) > self.prefetch:
+                yield pending.pop(0)
+        yield from pending

@@ -1,0 +1,95 @@
+"""Data pipeline: text -> fixed-length token sequences.
+
+Mirrors the reference's pipeline semantics (``01-single-gpu/train_llm.py:192-245``):
+tokenize the corpus, concatenate everything, chunk into ``seq_length`` blocks,
+``labels = input_ids`` (the loss shifts). Three sources:
+
+1. ``synthetic[:n_tokens]`` — deterministic random tokens, zero-egress (tests,
+   benchmarks; the analogue of the reference's tiny smoke configs).
+2. a local ``.txt``/``.jsonl`` file path — tokenized + chunked.
+3. an HF ``datasets`` name — the reference's exact surface
+   (``--dataset-name tatsu-lab/alpaca``), used when the hub/cache is reachable.
+
+Output is a single int32 array [num_sequences, seq_length]: TPU-friendly
+(static shapes, zero-copy mmap-able) instead of a Python dataset of dicts.
+"""
+from __future__ import annotations
+
+import json
+import logging
+from pathlib import Path
+
+import numpy as np
+
+LOGGER = logging.getLogger(__name__)
+
+
+def _chunk(token_stream: np.ndarray, seq_length: int) -> np.ndarray:
+    n = (len(token_stream) // seq_length) * seq_length
+    if n == 0:
+        raise ValueError(f"corpus too small: {len(token_stream)} tokens < seq_length={seq_length}")
+    return token_stream[:n].astype(np.int32).reshape(-1, seq_length)
+
+
+def synthetic_dataset(n_tokens: int, vocab_size: int, seq_length: int, seed: int = 0) -> np.ndarray:
+    rng = np.random.RandomState(seed)
+    # Markov-ish structure so the loss actually decreases during smoke runs
+    base = rng.randint(0, vocab_size, size=n_tokens, dtype=np.int64)
+    repeat_mask = rng.rand(n_tokens) < 0.5
+    stream = np.where(repeat_mask, np.roll(base, 1), base)
+    return _chunk(stream, seq_length)
+
+
+def _from_local_file(path: Path, tokenizer, seq_length: int) -> np.ndarray:
+    if path.suffix == ".jsonl":
+        texts = [json.loads(line).get("text", "") for line in path.read_text().splitlines() if line]
+    else:
+        texts = [path.read_text()]
+    ids = []
+    for t in texts:
+        ids.extend(tokenizer(t)["input_ids"][0] if hasattr(tokenizer, "__call__") else [])
+    return _chunk(np.asarray(ids, dtype=np.int64), seq_length)
+
+
+def _from_hf(dataset_name: str, subset, tokenizer, seq_length: int) -> np.ndarray:
+    import datasets  # HF
+
+    data = datasets.load_dataset(dataset_name, subset)
+    split = data["train"]
+    column = "text" if "text" in split.column_names else split.column_names[0]
+
+    def tokenize_fn(examples):
+        return tokenizer(examples[column])
+
+    tokenized = split.map(tokenize_fn, batched=True, remove_columns=split.column_names,
+                          desc="tokenizing")
+    stream = np.concatenate([np.asarray(x, dtype=np.int64) for x in tokenized["input_ids"]])
+    return _chunk(stream, seq_length)
+
+
+def load_and_preprocess_data(
+    dataset_name: str,
+    tokenizer,
+    seq_length: int,
+    *,
+    dataset_subset: str | None = None,
+    max_position_embeddings: int | None = None,
+    seed: int = 0,
+) -> np.ndarray:
+    """Returns [num_sequences, seq_length] int32."""
+    if max_position_embeddings and seq_length > max_position_embeddings:
+        # reference clamp: 01-single-gpu/train_llm.py:216-218
+        seq_length = min(1024, max_position_embeddings)
+
+    if dataset_name.startswith("synthetic"):
+        n_tokens = 1_000_000
+        if ":" in dataset_name:
+            n_tokens = int(dataset_name.split(":", 1)[1])
+        vocab = getattr(tokenizer, "vocab_size", 259)
+        return synthetic_dataset(n_tokens, vocab, seq_length, seed)
+
+    path = Path(dataset_name)
+    if path.exists():
+        return _from_local_file(path, tokenizer, seq_length)
+
+    return _from_hf(dataset_name, dataset_subset, tokenizer, seq_length)

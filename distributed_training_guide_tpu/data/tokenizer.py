@@ -1,0 +1,39 @@
+"""Tokenizer resolution.
+
+The reference uses HF ``AutoTokenizer`` (``01-single-gpu/train_llm.py:197``).
+We keep that surface when the HF cache/network is available, and add a
+hermetic byte-level fallback so the framework (and its tests) run with zero
+egress — the TPU testbeds this targets are often airgapped.
+"""
+from __future__ import annotations
+
+
+class ByteTokenizer:
+    """UTF-8 byte tokenizer: vocab = 256 bytes + BOS/EOS/PAD."""
+
+    vocab_size = 259
+    bos_token_id = 256
+    eos_token_id = 257
+    pad_token_id = 258
+    model_max_length = 1 << 30
+
+    def __call__(self, texts):
+        if isinstance(texts, str):
+            texts = [texts]
+        return {"input_ids": [list(t.encode("utf-8")) + [self.eos_token_id] for t in texts]}
+
+    def encode(self, text: str) -> list[int]:
+        return list(text.encode("utf-8"))
+
+    def decode(self, ids) -> str:
+        return bytes(i for i in ids if i < 256).decode("utf-8", errors="replace")
+
+
+def get_tokenizer(model_name: str):
+    """HF tokenizer if locally cached, else the byte fallback."""
+    try:
+        from transformers import AutoTokenizer
+
+        return AutoTokenizer.from_pretrained(model_name, local_files_only=True)
+    except Exception:
+        return ByteTokenizer()

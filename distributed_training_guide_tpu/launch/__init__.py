@@ -1,0 +1,3 @@
+from .distributed import maybe_initialize_distributed
+
+__all__ = ["maybe_initialize_distributed"]

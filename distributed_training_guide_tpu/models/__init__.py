@@ -1,0 +1,4 @@
+from .registry import get_model, list_models, ModelBundle
+from . import llama, gpt2
+
+__all__ = ["get_model", "list_models", "ModelBundle", "llama", "gpt2"]

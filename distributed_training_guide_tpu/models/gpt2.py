@@ -1,0 +1,179 @@
+"""GPT-2 decoder, TPU-first.
+
+The reference's chapter-1 smoke model is HF ``gpt2`` (124M)
+(``01-single-gpu/README.md:11``). Same scan-over-layers / logical-axes design
+as ``llama.py``; differences: learned position embeddings, LayerNorm with
+bias, fused-QKV projection, gelu MLP, tied LM head.
+"""
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..ops.attention import multihead_attention
+
+
+@dataclasses.dataclass(frozen=True)
+class GPT2Config:
+    vocab_size: int = 50257
+    hidden_size: int = 768
+    num_layers: int = 12
+    num_heads: int = 12
+    max_position_embeddings: int = 1024
+    layer_norm_eps: float = 1e-5
+    dtype: Any = jnp.bfloat16
+    param_dtype: Any = jnp.float32
+
+    @property
+    def head_size(self) -> int:
+        return self.hidden_size // self.num_heads
+
+    def num_params(self) -> int:
+        e, v, p, l = (self.hidden_size, self.vocab_size,
+                      self.max_position_embeddings, self.num_layers)
+        per_layer = 3 * e * e + 3 * e + e * e + e + 8 * e * e + 5 * e + 4 * e
+        return v * e + p * e + l * per_layer + 2 * e
+
+
+def init(config: GPT2Config, rng: jax.Array) -> dict:
+    e, v, p, l = (config.hidden_size, config.vocab_size,
+                  config.max_position_embeddings, config.num_layers)
+    keys = iter(jax.random.split(rng, 8))
+
+    def dense(key, shape):
+        return (0.02 * jax.random.normal(key, shape, jnp.float32)).astype(config.param_dtype)
+
+    def ln(shape):
+        return {"scale": jnp.ones(shape, config.param_dtype),
+                "bias": jnp.zeros(shape, config.param_dtype)}
+
+    return {
+        "wte": dense(next(keys), (v, e)),
+        "wpe": dense(next(keys), (p, e)),
+        "layers": {
+            "ln1": ln((l, e)),
+            "attn": {
+                "wqkv": dense(next(keys), (l, e, 3 * e)),
+                "bqkv": jnp.zeros((l, 3 * e), config.param_dtype),
+                "wo": dense(next(keys), (l, e, e)),
+                "bo": jnp.zeros((l, e), config.param_dtype),
+            },
+            "ln2": ln((l, e)),
+            "mlp": {
+                "wi": dense(next(keys), (l, e, 4 * e)),
+                "bi": jnp.zeros((l, 4 * e), config.param_dtype),
+                "wo": dense(next(keys), (l, 4 * e, e)),
+                "bo": jnp.zeros((l, e), config.param_dtype),
+            },
+        },
+        "lnf": ln((e,)),
+    }
+
+
+def param_logical_axes(config: GPT2Config) -> dict:
+    del config
+    ln_l = {"scale": ("layers", "embed_vector"), "bias": ("layers", "embed_vector")}
+    return {
+        "wte": ("vocab", "embed"),
+        "wpe": ("pos", "embed"),
+        "layers": {
+            "ln1": ln_l,
+            "attn": {
+                "wqkv": ("layers", "embed", "heads"),
+                "bqkv": ("layers", "heads_vector"),
+                "wo": ("layers", "heads", "embed"),
+                "bo": ("layers", "embed_vector"),
+            },
+            "ln2": ln_l,
+            "mlp": {
+                "wi": ("layers", "embed", "mlp"),
+                "bi": ("layers", "mlp_vector"),
+                "wo": ("layers", "mlp", "embed"),
+                "bo": ("layers", "embed_vector"),
+            },
+        },
+        "lnf": {"scale": ("embed_vector",), "bias": ("embed_vector",)},
+    }
+
+
+def _layernorm(x, p, eps):
+    dtype = x.dtype
+    x = x.astype(jnp.float32)
+    mean = x.mean(axis=-1, keepdims=True)
+    var = ((x - mean) ** 2).mean(axis=-1, keepdims=True)
+    y = (x - mean) * jax.lax.rsqrt(var + eps)
+    return (y * p["scale"].astype(jnp.float32) + p["bias"].astype(jnp.float32)).astype(dtype)
+
+
+def _block(config: GPT2Config, x, layer, positions, attn_impl):
+    b, s, e = x.shape
+    h, d = config.num_heads, config.head_size
+    cdt = config.dtype
+
+    y = _layernorm(x, {"scale": layer["ln1"]["scale"], "bias": layer["ln1"]["bias"]},
+                   config.layer_norm_eps)
+    qkv = y @ layer["attn"]["wqkv"].astype(cdt) + layer["attn"]["bqkv"].astype(cdt)
+    q, k, v = jnp.split(qkv, 3, axis=-1)
+    q = q.reshape(b, s, h, d)
+    k = k.reshape(b, s, h, d)
+    v = v.reshape(b, s, h, d)
+    attn = multihead_attention(q, k, v, causal=True, positions=positions,
+                               kv_positions=positions, impl=attn_impl)
+    attn = attn.reshape(b, s, e) @ layer["attn"]["wo"].astype(cdt) + layer["attn"]["bo"].astype(cdt)
+    x = x + attn
+
+    y = _layernorm(x, {"scale": layer["ln2"]["scale"], "bias": layer["ln2"]["bias"]},
+                   config.layer_norm_eps)
+    y = jax.nn.gelu(y @ layer["mlp"]["wi"].astype(cdt) + layer["mlp"]["bi"].astype(cdt),
+                    approximate=True)
+    y = y @ layer["mlp"]["wo"].astype(cdt) + layer["mlp"]["bo"].astype(cdt)
+    return x + y
+
+
+def apply(
+    config: GPT2Config,
+    params: dict,
+    input_ids: jnp.ndarray,
+    positions: Optional[jnp.ndarray] = None,
+    *,
+    remat: bool = False,
+    remat_policy: Optional[Any] = None,
+    attn_impl: str = "auto",
+    activation_sharding: Optional[Any] = None,
+) -> jnp.ndarray:
+    del activation_sharding  # gpt2 path is small; SP constraint not needed
+    if positions is None:
+        positions = jnp.arange(input_ids.shape[1])[None, :]
+    positions = jnp.broadcast_to(positions, input_ids.shape)
+
+    tok = jnp.take(params["wte"], input_ids, axis=0)
+    pos = jnp.take(params["wpe"], positions, axis=0)
+    x = (tok + pos).astype(config.dtype)
+
+    block = partial(_block, config, positions=positions, attn_impl=attn_impl)
+
+    def scan_body(carry, layer_params):
+        return block(carry, layer_params), None
+
+    if remat:
+        policy = remat_policy or jax.checkpoint_policies.nothing_saveable
+        scan_body = jax.checkpoint(scan_body, policy=policy, prevent_cse=False)
+
+    x, _ = jax.lax.scan(scan_body, x, params["layers"])
+    x = _layernorm(x, params["lnf"], config.layer_norm_eps)
+    return jnp.dot(x, params["wte"].T.astype(config.dtype),
+                   preferred_element_type=jnp.float32)
+
+
+PRESETS = {
+    "gpt2-debug": GPT2Config(vocab_size=512, hidden_size=64, num_layers=2, num_heads=4,
+                             max_position_embeddings=256),
+    "gpt2": GPT2Config(),
+    "gpt2-medium": GPT2Config(hidden_size=1024, num_layers=24, num_heads=16),
+    "gpt2-large": GPT2Config(hidden_size=1280, num_layers=36, num_heads=20),
+    "gpt2-xl": GPT2Config(hidden_size=1600, num_layers=48, num_heads=25),
+}

@@ -1,0 +1,242 @@
+"""Llama-family decoder, TPU-first.
+
+Capability parity with the reference's use of HF ``LlamaForCausalLM``
+(``05-training-llama-405b/train_llm.py``, ``06-tensor-parallel/train_llm.py``)
+but designed for XLA rather than translated from torch:
+
+- parameters are a plain pytree with layers *stacked* on a leading axis and the
+  forward is a ``lax.scan`` over layers — one compiled block body instead of L
+  unrolled copies (compile time and HLO size stay flat as L grows to 126 for
+  405B);
+- every leaf carries *logical axis names* (``param_logical_axes``); the
+  parallel layer maps logical axes -> mesh axes to produce NamedShardings, so
+  DDP/FSDP/TP/2D are pure sharding-plan changes (the torch reference needs a
+  different wrapper API per chapter);
+- activation checkpointing is ``jax.checkpoint`` around the scanned block
+  (reference C20, ``05:163-178``);
+- attention dispatches to the Pallas flash kernel on TPU (reference uses the
+  flash-attn CUDA wheel, ``05:93``).
+
+Weights are kept 2-D ([in, out]) with fused head dims so TP shardings are a
+single named axis on one dimension.
+"""
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..ops.attention import multihead_attention
+from ..ops.rope import apply_rope
+
+
+@dataclasses.dataclass(frozen=True)
+class LlamaConfig:
+    vocab_size: int = 32000
+    hidden_size: int = 2048
+    intermediate_size: int = 5632
+    num_layers: int = 22
+    num_heads: int = 32
+    num_kv_heads: int = 4
+    head_dim: Optional[int] = None
+    max_position_embeddings: int = 4096
+    rope_theta: float = 10000.0
+    rms_norm_eps: float = 1e-5
+    tie_word_embeddings: bool = False
+    dtype: Any = jnp.bfloat16       # activation/compute dtype
+    param_dtype: Any = jnp.float32  # storage dtype
+
+    @property
+    def head_size(self) -> int:
+        return self.head_dim or self.hidden_size // self.num_heads
+
+    def num_params(self) -> int:
+        e, f, v = self.hidden_size, self.intermediate_size, self.vocab_size
+        hq = self.num_heads * self.head_size
+        hkv = self.num_kv_heads * self.head_size
+        per_layer = e * hq + 2 * e * hkv + hq * e + 3 * e * f + 2 * e
+        head = 0 if self.tie_word_embeddings else e * v
+        return v * e + self.num_layers * per_layer + e + head
+
+
+def init(config: LlamaConfig, rng: jax.Array) -> dict:
+    """Random init (normal(0.02), zeros-free — matches HF from_config init scale)."""
+    e, f, v, l = (config.hidden_size, config.intermediate_size,
+                  config.vocab_size, config.num_layers)
+    d = config.head_size
+    hq, hkv = config.num_heads * d, config.num_kv_heads * d
+    keys = iter(jax.random.split(rng, 16))
+
+    def dense(key, shape):
+        return (0.02 * jax.random.normal(key, shape, jnp.float32)).astype(config.param_dtype)
+
+    params = {
+        "embed": {"embedding": dense(next(keys), (v, e))},
+        "layers": {
+            "attn": {
+                "wq": dense(next(keys), (l, e, hq)),
+                "wk": dense(next(keys), (l, e, hkv)),
+                "wv": dense(next(keys), (l, e, hkv)),
+                "wo": dense(next(keys), (l, hq, e)),
+            },
+            "mlp": {
+                "gate": dense(next(keys), (l, e, f)),
+                "up": dense(next(keys), (l, e, f)),
+                "down": dense(next(keys), (l, f, e)),
+            },
+            "input_norm": jnp.ones((l, e), config.param_dtype),
+            "post_attn_norm": jnp.ones((l, e), config.param_dtype),
+        },
+        "final_norm": jnp.ones((e,), config.param_dtype),
+    }
+    if not config.tie_word_embeddings:
+        params["lm_head"] = dense(next(keys), (e, v))
+    return params
+
+
+def param_logical_axes(config: LlamaConfig) -> dict:
+    """Logical axis names for every leaf, mirroring ``init``'s structure.
+
+    Names: vocab, embed, heads (fused q-heads x head_dim), kv (fused kv-heads),
+    mlp, layers (the scan axis). ``None`` = never sharded on that dim.
+    """
+    axes = {
+        "embed": {"embedding": ("vocab", "embed")},
+        "layers": {
+            "attn": {
+                "wq": ("layers", "embed", "heads"),
+                "wk": ("layers", "embed", "kv"),
+                "wv": ("layers", "embed", "kv"),
+                "wo": ("layers", "heads", "embed"),
+            },
+            "mlp": {
+                "gate": ("layers", "embed", "mlp"),
+                "up": ("layers", "embed", "mlp"),
+                "down": ("layers", "mlp", "embed"),
+            },
+            "input_norm": ("layers", "embed_vector"),
+            "post_attn_norm": ("layers", "embed_vector"),
+        },
+        "final_norm": ("embed_vector",),
+    }
+    if not config.tie_word_embeddings:
+        axes["lm_head"] = ("embed", "vocab")
+    return axes
+
+
+def _rmsnorm(x: jnp.ndarray, scale: jnp.ndarray, eps: float) -> jnp.ndarray:
+    dtype = x.dtype
+    x = x.astype(jnp.float32)
+    var = jnp.mean(x * x, axis=-1, keepdims=True)
+    x = x * jax.lax.rsqrt(var + eps)
+    return (x * scale.astype(jnp.float32)).astype(dtype)
+
+
+def _block(config: LlamaConfig, x: jnp.ndarray, layer: dict,
+           positions: jnp.ndarray, attn_impl: str,
+           activation_sharding: Optional[Any] = None) -> jnp.ndarray:
+    b, s, e = x.shape
+    d = config.head_size
+    cdt = config.dtype
+
+    def constrain(y):
+        if activation_sharding is not None:
+            return jax.lax.with_sharding_constraint(y, activation_sharding)
+        return y
+
+    h = _rmsnorm(x, layer["input_norm"], config.rms_norm_eps)
+    q = (h @ layer["attn"]["wq"].astype(cdt)).reshape(b, s, config.num_heads, d)
+    k = (h @ layer["attn"]["wk"].astype(cdt)).reshape(b, s, config.num_kv_heads, d)
+    v = (h @ layer["attn"]["wv"].astype(cdt)).reshape(b, s, config.num_kv_heads, d)
+    q = apply_rope(q, positions, config.rope_theta)
+    k = apply_rope(k, positions, config.rope_theta)
+    attn = multihead_attention(q, k, v, causal=True, positions=positions,
+                               kv_positions=positions, impl=attn_impl)
+    attn = attn.reshape(b, s, config.num_heads * d) @ layer["attn"]["wo"].astype(cdt)
+    x = constrain(x + attn)
+
+    h = _rmsnorm(x, layer["post_attn_norm"], config.rms_norm_eps)
+    gate = h @ layer["mlp"]["gate"].astype(cdt)
+    up = h @ layer["mlp"]["up"].astype(cdt)
+    down = (jax.nn.silu(gate) * up) @ layer["mlp"]["down"].astype(cdt)
+    return constrain(x + down)
+
+
+def apply(
+    config: LlamaConfig,
+    params: dict,
+    input_ids: jnp.ndarray,
+    positions: Optional[jnp.ndarray] = None,
+    *,
+    remat: bool = False,
+    remat_policy: Optional[Any] = None,
+    attn_impl: str = "auto",
+    activation_sharding: Optional[Any] = None,
+) -> jnp.ndarray:
+    """Forward pass -> logits [B, S, V] in float32.
+
+    ``positions`` must be passed explicitly when the sequence dim is sharded
+    (sequence/context parallelism) — same constraint the reference hits at
+    ``06-tensor-parallel/train_llm.py:210-212``.
+    ``activation_sharding`` optionally constrains the inter-block residual
+    stream (e.g. P('dp', 'tp', None) for sequence parallelism).
+    """
+    if positions is None:
+        positions = jnp.arange(input_ids.shape[1])[None, :]
+    positions = jnp.broadcast_to(positions, input_ids.shape)
+
+    x = jnp.take(params["embed"]["embedding"], input_ids, axis=0).astype(config.dtype)
+
+    block = partial(_block, config, positions=positions, attn_impl=attn_impl,
+                    activation_sharding=activation_sharding)
+
+    def scan_body(carry, layer_params):
+        return block(carry, layer_params), None
+
+    if remat:
+        policy = remat_policy or jax.checkpoint_policies.nothing_saveable
+        scan_body = jax.checkpoint(scan_body, policy=policy, prevent_cse=False)
+
+    x, _ = jax.lax.scan(scan_body, x, params["layers"])
+
+    x = _rmsnorm(x, params["final_norm"], config.rms_norm_eps)
+    if config.tie_word_embeddings:
+        w_out = params["embed"]["embedding"].T
+    else:
+        w_out = params["lm_head"]
+    return jnp.dot(x, w_out.astype(config.dtype),
+                   preferred_element_type=jnp.float32)
+
+
+# ---------------------------------------------------------------------------
+# Presets (shapes from the public model cards; the reference trains these via
+# HF checkpoints — `05-training-llama-405b/README.md`, `06/README.md`).
+# ---------------------------------------------------------------------------
+
+PRESETS = {
+    "llama-debug": LlamaConfig(vocab_size=512, hidden_size=64, intermediate_size=128,
+                               num_layers=2, num_heads=4, num_kv_heads=2,
+                               max_position_embeddings=256),
+    "tinyllama-1.1b": LlamaConfig(vocab_size=32000, hidden_size=2048, intermediate_size=5632,
+                                  num_layers=22, num_heads=32, num_kv_heads=4),
+    "llama-3.2-1b": LlamaConfig(vocab_size=128256, hidden_size=2048, intermediate_size=8192,
+                                num_layers=16, num_heads=32, num_kv_heads=8,
+                                rope_theta=500000.0, max_position_embeddings=8192,
+                                tie_word_embeddings=True),
+    "llama-3.2-3b": LlamaConfig(vocab_size=128256, hidden_size=3072, intermediate_size=8192,
+                                num_layers=28, num_heads=24, num_kv_heads=8,
+                                rope_theta=500000.0, max_position_embeddings=8192,
+                                tie_word_embeddings=True),
+    "llama-3.1-8b": LlamaConfig(vocab_size=128256, hidden_size=4096, intermediate_size=14336,
+                                num_layers=32, num_heads=32, num_kv_heads=8,
+                                rope_theta=500000.0, max_position_embeddings=8192),
+    "llama-3.1-70b": LlamaConfig(vocab_size=128256, hidden_size=8192, intermediate_size=28672,
+                                 num_layers=80, num_heads=64, num_kv_heads=8,
+                                 rope_theta=500000.0, max_position_embeddings=8192),
+    "llama-3.1-405b": LlamaConfig(vocab_size=128256, hidden_size=16384, intermediate_size=53248,
+                                  num_layers=126, num_heads=128, num_kv_heads=8,
+                                  rope_theta=500000.0, max_position_embeddings=8192),
+}

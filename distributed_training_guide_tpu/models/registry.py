@@ -1,0 +1,65 @@
+"""Model registry: name -> (config, init, apply, logical axes).
+
+The reference instantiates models by HF hub name through
+``AutoModelForCausalLM.from_config`` (``01-single-gpu/train_llm.py:48-49``).
+The TPU build keeps the by-name surface but resolves to the in-repo pure-JAX
+zoo; HF hub names alias to the matching preset so reference commands port
+unchanged (e.g. ``--model-name gpt2`` or ``meta-llama/Llama-3.1-405B``).
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable
+
+from . import gpt2, llama
+
+
+@dataclasses.dataclass(frozen=True)
+class ModelBundle:
+    name: str
+    config: Any
+    init: Callable          # (config, rng) -> params
+    apply: Callable         # (config, params, input_ids, ...) -> logits
+    param_logical_axes: Callable  # (config,) -> axes pytree
+    family: str
+
+    def num_params(self) -> int:
+        return self.config.num_params()
+
+
+_HF_ALIASES = {
+    "openai-community/gpt2": "gpt2",
+    "tinyllama/tinyllama-1.1b-chat-v1.0": "tinyllama-1.1b",
+    "tinyllama/tinyllama_v1.1": "tinyllama-1.1b",
+    "meta-llama/llama-3.2-1b": "llama-3.2-1b",
+    "meta-llama/llama-3.2-3b": "llama-3.2-3b",
+    "meta-llama/llama-3.1-8b": "llama-3.1-8b",
+    "meta-llama/meta-llama-3.1-8b": "llama-3.1-8b",
+    "meta-llama/llama-3.1-70b": "llama-3.1-70b",
+    "meta-llama/llama-3.1-405b": "llama-3.1-405b",
+    "meta-llama/meta-llama-3.1-405b": "llama-3.1-405b",
+}
+
+
+def list_models() -> list[str]:
+    return sorted(gpt2.PRESETS) + sorted(llama.PRESETS)
+
+
+def get_model(name: str, **overrides) -> ModelBundle:
+    key = _HF_ALIASES.get(name.lower(), name.lower())
+    if key in gpt2.PRESETS:
+        config = gpt2.PRESETS[key]
+        if overrides:
+            config = dataclasses.replace(config, **overrides)
+        return ModelBundle(key, config, gpt2.init, gpt2.apply,
+                           gpt2.param_logical_axes, family="gpt2")
+    if key in llama.PRESETS:
+        config = llama.PRESETS[key]
+        if overrides:
+            config = dataclasses.replace(config, **overrides)
+        return ModelBundle(key, config, llama.init, llama.apply,
+                           llama.param_logical_axes, family="llama")
+    raise ValueError(
+        f"Unknown model {name!r}. Available: {', '.join(list_models())} "
+        f"(HF aliases: {', '.join(sorted(_HF_ALIASES))})"
+    )

@@ -1,0 +1,5 @@
+from .attention import multihead_attention
+from .rope import apply_rope, rope_frequencies
+from .cross_entropy import causal_lm_loss
+
+__all__ = ["multihead_attention", "apply_rope", "rope_frequencies", "causal_lm_loss"]

@@ -1,0 +1,76 @@
+"""Attention dispatch: XLA reference path + Pallas flash path.
+
+The reference gets fused attention from the external ``flash-attn`` CUDA wheel
+(``05-training-llama-405b/train_llm.py:93``); the TPU-native equivalent is a
+Pallas kernel (``ops/flash_attention.py``). This module is the dispatcher: the
+XLA einsum path is the numerics reference and the fallback for platforms where
+the Mosaic kernel is unavailable; the flash path is the production TPU kernel.
+
+Shapes follow the JAX convention: q [B, S, Hq, D], k/v [B, S, Hkv, D] with
+grouped-query attention when Hkv < Hq.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+
+def _xla_attention(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    causal: bool,
+    positions: Optional[jnp.ndarray],
+    kv_positions: Optional[jnp.ndarray],
+) -> jnp.ndarray:
+    b, sq, hq, d = q.shape
+    _, sk, hkv, _ = k.shape
+    groups = hq // hkv
+    scale = 1.0 / jnp.sqrt(jnp.asarray(d, dtype=jnp.float32))
+
+    qg = q.reshape(b, sq, hkv, groups, d)
+    # scores in fp32: softmax in bf16 is numerically unacceptable at long seq
+    scores = jnp.einsum("bqhgd,bkhd->bhgqk", qg, k, preferred_element_type=jnp.float32)
+    scores = scores * scale
+
+    if causal:
+        if positions is None:
+            positions = jnp.arange(sq)[None, :]
+        if kv_positions is None:
+            kv_positions = jnp.arange(sk)[None, :]
+        mask = positions[:, None, None, :, None] >= kv_positions[:, None, None, None, :]
+        scores = jnp.where(mask, scores, jnp.finfo(jnp.float32).min)
+
+    probs = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum("bhgqk,bkhd->bqhgd", probs.astype(v.dtype), v,
+                     preferred_element_type=jnp.float32)
+    return out.reshape(b, sq, hq, d).astype(q.dtype)
+
+
+def multihead_attention(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    *,
+    causal: bool = True,
+    positions: Optional[jnp.ndarray] = None,
+    kv_positions: Optional[jnp.ndarray] = None,
+    impl: str = "auto",
+) -> jnp.ndarray:
+    """Scaled-dot-product attention with GQA.
+
+    impl: "xla" (einsum reference), "flash" (Pallas kernel), or "auto"
+    (flash on TPU when shapes are tile-aligned and no custom positions are in
+    play, else xla).
+    """
+    if impl == "auto":
+        on_tpu = jax.default_backend() == "tpu"
+        aligned = q.shape[1] % 128 == 0 and k.shape[1] % 128 == 0 and q.shape[-1] % 128 == 0
+        impl = "flash" if (on_tpu and aligned and positions is None and causal) else "xla"
+    if impl == "flash":
+        from .flash_attention import flash_attention
+
+        return flash_attention(q, k, v, causal=causal)
+    return _xla_attention(q, k, v, causal, positions, kv_positions)

@@ -1,0 +1,31 @@
+"""Causal-LM loss.
+
+The reference relies on HF's internal loss (labels = input_ids, shift done by
+the model — see data pipeline ``01-single-gpu/train_llm.py:234`` where
+``labels = input_ids.copy()``). Here the shift lives in the loss so the model
+stays a pure logits function. Log-softmax is computed in float32.
+
+Padding/ignored positions use the HF convention: ``label == -100`` masks the
+position out of the mean.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import jax
+
+IGNORE_INDEX = -100
+
+
+def causal_lm_loss(logits: jnp.ndarray, labels: jnp.ndarray) -> jnp.ndarray:
+    """Mean next-token cross-entropy.
+
+    logits: [B, S, V]; labels: [B, S] (same tokens as inputs, shifted here).
+    """
+    logits = logits[:, :-1, :].astype(jnp.float32)
+    targets = labels[:, 1:]
+    valid = targets != IGNORE_INDEX
+    safe_targets = jnp.where(valid, targets, 0)
+    logz = jax.nn.logsumexp(logits, axis=-1)
+    picked = jnp.take_along_axis(logits, safe_targets[..., None], axis=-1)[..., 0]
+    nll = (logz - picked) * valid
+    return nll.sum() / jnp.maximum(valid.sum(), 1)

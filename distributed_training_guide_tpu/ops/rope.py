@@ -1,0 +1,34 @@
+"""Rotary position embeddings (half-rotation convention, Llama-style).
+
+The reference consumes RoPE through HF ``LlamaRotaryEmbedding`` (it only has to
+shim its ``reset_parameters``, ``04-fully-sharded-data-parallel/train_llm.py:32-44``).
+Here it is a pure function: compute cos/sin from explicit ``positions`` — the
+explicit-positions requirement is load-bearing for sequence parallelism, where
+each shard sees a slice of the sequence (reference passes explicit
+``position_ids`` for the same reason, ``06-tensor-parallel/train_llm.py:210-212``).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def rope_frequencies(head_dim: int, theta: float = 10000.0) -> jnp.ndarray:
+    """Inverse frequencies, shape [head_dim // 2], float32."""
+    exponent = jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim
+    return 1.0 / (theta ** exponent)
+
+
+def apply_rope(x: jnp.ndarray, positions: jnp.ndarray, theta: float = 10000.0) -> jnp.ndarray:
+    """Rotate ``x`` [..., seq, heads, head_dim] by position-dependent angles.
+
+    ``positions`` is [..., seq] (int). Computation in float32, result cast back
+    to ``x.dtype`` — rope in bf16 loses position resolution at long context.
+    """
+    head_dim = x.shape[-1]
+    inv_freq = rope_frequencies(head_dim, theta)  # [D/2]
+    angles = positions[..., None].astype(jnp.float32) * inv_freq  # [..., S, D/2]
+    cos = jnp.cos(angles)[..., None, :]  # [..., S, 1, D/2]
+    sin = jnp.sin(angles)[..., None, :]
+    x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
+    rotated = jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1)
+    return rotated.astype(x.dtype)

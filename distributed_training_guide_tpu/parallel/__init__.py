@@ -1,0 +1,4 @@
+from .mesh import make_mesh, mesh_shape_for
+from .plans import ShardingPlan, make_plan, STRATEGIES
+
+__all__ = ["make_mesh", "mesh_shape_for", "ShardingPlan", "make_plan", "STRATEGIES"]

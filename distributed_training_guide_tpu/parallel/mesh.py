@@ -1,0 +1,56 @@
+"""Device-mesh construction.
+
+Parity with the reference's ``init_device_mesh("cuda", (dp, tp),
+mesh_dim_names=("dp","tp"))`` (``06-tensor-parallel/train_llm.py:51-55``,
+``07-2d-parallel/train_llm.py:49-53``), generalized: one mesh with four named
+axes is the single abstraction behind every chapter —
+
+    dp    pure data parallelism (replica groups; multi-slice runs put DCN here)
+    fsdp  parameter-sharded data parallelism (ZeRO-3 / FULL_SHARD axis)
+    tp    tensor parallelism (fastest ICI axis — collectives per layer)
+    cp    context parallelism (sequence-dim sharding for long context)
+
+Axes of size 1 cost nothing, so every plan runs on the same mesh type.
+``mesh_utils.create_device_mesh`` maps the logical mesh onto the physical ICI
+torus so that the innermost (tp) axis lands on nearest-neighbor links.
+"""
+from __future__ import annotations
+
+import math
+from typing import Optional, Sequence
+
+import jax
+from jax.experimental import mesh_utils
+from jax.sharding import Mesh
+
+AXIS_NAMES = ("dp", "fsdp", "tp", "cp")
+
+
+def mesh_shape_for(n_devices: int, *, fsdp: int = 1, tp: int = 1, cp: int = 1,
+                   dp: Optional[int] = None) -> tuple[int, int, int, int]:
+    """Fill in the dp axis so dp*fsdp*tp*cp == n_devices."""
+    denom = fsdp * tp * cp
+    if n_devices % denom != 0:
+        raise ValueError(f"{n_devices} devices not divisible by fsdp*tp*cp={denom}")
+    inferred_dp = n_devices // denom
+    if dp is not None and dp != inferred_dp:
+        raise ValueError(f"dp={dp} inconsistent: need {inferred_dp}")
+    return (inferred_dp, fsdp, tp, cp)
+
+
+def make_mesh(*, fsdp: int = 1, tp: int = 1, cp: int = 1, dp: Optional[int] = None,
+              devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
+    devices = list(devices) if devices is not None else jax.devices()
+    shape = mesh_shape_for(len(devices), fsdp=fsdp, tp=tp, cp=cp, dp=dp)
+    if math.prod(shape) == 1:
+        import numpy as np
+
+        return Mesh(np.asarray(devices).reshape(shape), AXIS_NAMES)
+    try:
+        device_array = mesh_utils.create_device_mesh(shape, devices=devices)
+    except Exception:
+        # CPU/virtual-device fallback: topology-unaware reshape
+        import numpy as np
+
+        device_array = np.asarray(devices).reshape(shape)
+    return Mesh(device_array, AXIS_NAMES)

@@ -1,0 +1,5 @@
+from .state import TrainState
+from .optimizer import adamw_cosine
+from .step import Trainer
+
+__all__ = ["TrainState", "adamw_cosine", "Trainer"]

@@ -1,0 +1,202 @@
+"""Shared chapter CLI + training loop.
+
+The reference duplicates ~300 lines of loop/parser/data code into every
+chapter's ``train_llm.py`` so each chapter's *diff* is the lesson
+(``02-distributed-data-parallel/README.md:3``). The TPU build keeps the same
+CLI surface (flags from ``01-single-gpu/train_llm.py:289-303``) and the same
+host-state/logging/checkpoint contract, but factors the loop here; a chapter
+script is then just "build a mesh + plan, call ``run_training``" — the diff
+between chapters is the *sharding plan*, which is the lesson on TPU.
+
+Phase timing note: the reference times data/forward/backward/update separately
+(``01:113``, eager phases). Under XLA forward+backward+update is one fused
+program by design, so the honest split is data / step; per-op attribution
+lives in the profiler (``jax.profiler.trace``, chapter "diagnosing-errors").
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import logging
+import os
+import time
+from pathlib import Path
+from typing import Callable, Optional
+
+import jax
+import numpy as np
+
+LOGGER = logging.getLogger(__name__)
+
+
+def get_parser() -> argparse.ArgumentParser:
+    """Flag surface of the reference parser (``01-single-gpu/train_llm.py:289-303``)."""
+    parser = argparse.ArgumentParser()
+    parser.add_argument("-e", "--experiment-name", default=None)
+    parser.add_argument("-d", "--dataset-name", default="synthetic", required=False)
+    parser.add_argument("--dataset-subset", default=None)
+    parser.add_argument("-m", "--model-name", default=None, required=True)
+    parser.add_argument("--save-dir", default="../outputs")
+    parser.add_argument("--seed", default=0, type=int)
+    parser.add_argument("--num-epochs", default=100, type=int)
+    parser.add_argument("--lr", default=3e-5, type=float)
+    parser.add_argument("-b", "--batch-size", default=1, type=int,
+                        help="per-data-parallel-replica batch size (reference semantics)")
+    parser.add_argument("--log-freq", default=10, type=int)
+    parser.add_argument("--ckpt-freq", default=500, type=int)
+    parser.add_argument("-s", "--seq-length", default=1024, type=int)
+    parser.add_argument("--steps-per-epoch", default=None, type=int,
+                        help="cap steps per epoch (smoke runs)")
+    parser.add_argument("--grad-accum", default=1, type=int)
+    parser.add_argument("--checkpoint-activations", action="store_true",
+                        help="remat decoder layers (reference 05:163-178)")
+    parser.add_argument("--attn-impl", default="auto", choices=["auto", "xla", "flash"])
+    parser.add_argument("--max-steps", default=None, type=int)
+    return parser
+
+
+def run_training(args, plan_factory: Callable, *, extra_log: Optional[dict] = None) -> dict:
+    """The chapter-invariant training loop. Returns final metrics (for tests).
+
+    ``plan_factory() -> ShardingPlan`` is the one thing chapters customize.
+    """
+    from ..checkpoint import CheckpointIO, abstract_train_state
+    from ..data import ShardedBatchLoader, get_tokenizer, load_and_preprocess_data
+    from ..models import get_model
+    from ..train import Trainer, adamw_cosine
+    from ..train.optimizer import lr_at_step
+    from ..train.state import host_state_dict
+    from ..utils import (LocalTimer, compute_mfu, get_mem_stats, init_logging,
+                         is_process0, transformer_flops_per_token)
+
+    init_logging(jax.process_index(), jax.process_count())
+    LOGGER.info({k: v for k, v in os.environ.items() if k.startswith(("JAX", "XLA", "TPU"))})
+    LOGGER.info(vars(args))
+
+    plan = plan_factory()
+    bundle = get_model(args.model_name)
+    cfg = bundle.config
+    LOGGER.info(f"Training {bundle.num_params():,} model parameters "
+                f"on mesh {dict(plan.mesh.shape)} strategy={plan.strategy}")
+
+    tokenizer = get_tokenizer(args.model_name)
+    seq_length = min(args.seq_length, cfg.max_position_embeddings)
+    dataset = load_and_preprocess_data(
+        args.dataset_name, tokenizer, seq_length,
+        dataset_subset=args.dataset_subset,
+        max_position_embeddings=cfg.max_position_embeddings, seed=args.seed)
+    LOGGER.info(f"{len(dataset)} training sequences of length {seq_length}")
+
+    trainer = Trainer(
+        bundle=bundle,
+        optimizer=adamw_cosine(args.lr),
+        plan=plan,
+        grad_accum=args.grad_accum,
+        remat=args.checkpoint_activations,
+        attn_impl=args.attn_impl,
+    )
+
+    global_batch = args.batch_size * plan.data_parallel_size * args.grad_accum
+    loader = ShardedBatchLoader(
+        dataset, global_batch,
+        trainer.batch_shardings()["input_ids"],
+        grad_accum=args.grad_accum, seed=args.seed)
+    steps_per_epoch = len(loader)
+    if args.steps_per_epoch:
+        steps_per_epoch = min(steps_per_epoch, args.steps_per_epoch)
+    LOGGER.info(f"{steps_per_epoch} batches per epoch (global batch {global_batch})")
+
+    # ---- experiment dir + resume (reference 01:80-110) ----------------------
+    exp_dir = Path(args.save_dir)
+    is_experiment = args.experiment_name is not None
+    if is_experiment:
+        exp_dir = exp_dir / args.experiment_name
+    io = CheckpointIO(exp_dir) if is_experiment else None
+
+    host_state = host_state_dict()
+    if io is not None and io.can_resume():
+        state, host_state = io.restore(abstract_train_state(trainer))
+        LOGGER.info(f"Resumed=True | {host_state}")
+    else:
+        state = trainer.init_state(args.seed)
+        if is_experiment:
+            LOGGER.info(f"Resumed=False | {host_state}")
+    if is_experiment:
+        exp_dir.mkdir(parents=True, exist_ok=True)
+
+    timers = {k: LocalTimer() for k in ["data", "step"]}
+    flops_per_token = transformer_flops_per_token(
+        bundle.num_params(), cfg.num_layers, cfg.hidden_size, seq_length,
+        vocab_size=cfg.vocab_size)
+    n_chips = plan.mesh.size
+    tok_per_step = trainer.tokens_per_step(args.batch_size, seq_length)
+    last_info: dict = {}
+
+    progress = None
+    if is_process0():
+        try:
+            import tqdm
+
+            progress = tqdm.tqdm(total=steps_per_epoch * args.num_epochs, disable=None)
+        except ImportError:
+            pass
+
+    done = False
+    for epoch in range(host_state["epoch"], args.num_epochs):
+        host_state["epoch"] = epoch
+        loader.set_epoch(epoch)
+        LOGGER.info(f"Begin epoch {epoch} at step {host_state['epoch_step']}")
+        batches = loader.epoch_batches(start_step=host_state["epoch_step"])
+
+        for i_step in range(host_state["epoch_step"], steps_per_epoch):
+            with timers["data"]:
+                batch = next(batches)
+            with timers["step"]:
+                state, metrics = trainer.step_fn(state, batch)
+                loss = float(metrics["loss"])  # forces sync, like 01:163
+
+            host_state["global_step"] += 1
+            host_state["epoch_step"] += 1
+            host_state["running_loss"] += loss
+            if progress:
+                progress.update(1)
+
+            if host_state["global_step"] % args.log_freq == 0:
+                ms_per_step = sum(t.avg_elapsed_ms() for t in timers.values())
+                tokens_per_s = 1000 * tok_per_step / max(ms_per_step, 1e-9)
+                info = {
+                    "global_step": host_state["global_step"],
+                    "lr": lr_at_step(host_state["global_step"], args.lr),
+                    "running_loss": host_state["running_loss"] / args.log_freq,
+                    "grad_norm": float(metrics["grad_norm"]),
+                    "epoch": epoch,
+                    "epoch_progress": host_state["epoch_step"] / steps_per_epoch,
+                    "num_batches_remaining": steps_per_epoch - i_step,
+                    **get_mem_stats(),
+                    "tokens_per_s": tokens_per_s,
+                    "mfu": compute_mfu(tokens_per_s, flops_per_token, n_chips),
+                    "time/total": ms_per_step,
+                    **{f"time/{k}": t.avg_elapsed_ms() for k, t in timers.items()},
+                    **(extra_log or {}),
+                }
+                LOGGER.info(info)
+                last_info = info
+                host_state["running_loss"] = 0.0
+                for t in timers.values():
+                    t.reset()
+
+            if io is not None and host_state["global_step"] % args.ckpt_freq == 0:
+                LOGGER.info("Saving checkpoint.")
+                io.save(state, host_state)
+
+            if args.max_steps and host_state["global_step"] >= args.max_steps:
+                done = True
+                break
+
+        host_state["epoch_step"] = 0
+        if done:
+            break
+
+    if progress:
+        progress.close()
+    return {"host_state": host_state, "last_info": last_info, "state": state}

@@ -1,0 +1,64 @@
+"""Optimizer + LR schedule.
+
+Parity with the reference's fused AdamW + CosineAnnealingLR
+(``01-single-gpu/train_llm.py:73-78``): ``optax.adamw`` under jit compiles to
+fully fused XLA update kernels (the reference needs torch's hand-written fused
+CUDA kernels and even ``torch.compile(optimizer.step)``,
+``05-training-llama-405b/train_llm.py:202-204`` — under XLA this is free).
+
+Schedule matches CosineAnnealingLR(T_max=1000, eta_min=lr*1e-2) semantics:
+cosine from lr to lr/100 over t_max steps, then flat. Optional linear warmup
+(the LR-scaling recipes in ``related-topics/effective-batch-size-and-lr``).
+"""
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+import optax
+
+
+def cosine_schedule(lr: float, t_max: int = 1000, eta_min_ratio: float = 0.01,
+                    warmup_steps: int = 0) -> optax.Schedule:
+    eta_min = lr * eta_min_ratio
+
+    def schedule(step):
+        import jax.numpy as jnp
+
+        warm = jnp.minimum(step / max(warmup_steps, 1), 1.0) if warmup_steps else 1.0
+        t = jnp.clip(step - warmup_steps, 0, t_max)
+        cos = eta_min + (lr - eta_min) * 0.5 * (1 + jnp.cos(jnp.pi * t / t_max))
+        return warm * cos
+
+    return schedule
+
+
+def adamw_cosine(
+    lr: float,
+    *,
+    t_max: int = 1000,
+    eta_min_ratio: float = 0.01,
+    warmup_steps: int = 0,
+    weight_decay: float = 0.01,
+    b1: float = 0.9,
+    b2: float = 0.999,
+    eps: float = 1e-8,
+    grad_clip: Optional[float] = None,
+) -> optax.GradientTransformation:
+    tx = optax.adamw(
+        learning_rate=cosine_schedule(lr, t_max, eta_min_ratio, warmup_steps),
+        b1=b1, b2=b2, eps=eps, weight_decay=weight_decay,
+    )
+    if grad_clip:
+        tx = optax.chain(optax.clip_by_global_norm(grad_clip), tx)
+    return tx
+
+
+def lr_at_step(step: int, lr: float, t_max: int = 1000, eta_min_ratio: float = 0.01,
+               warmup_steps: int = 0) -> float:
+    """Host-side mirror of the schedule for logging (reference logs
+    ``lr_scheduler.get_last_lr()``, ``01:160``)."""
+    eta_min = lr * eta_min_ratio
+    warm = min(step / max(warmup_steps, 1), 1.0) if warmup_steps else 1.0
+    t = min(max(step - warmup_steps, 0), t_max)
+    return warm * (eta_min + (lr - eta_min) * 0.5 * (1 + math.cos(math.pi * t / t_max)))

@@ -1,0 +1,35 @@
+"""Training state pytree.
+
+The reference keeps three separately-checkpointed stateful objects (model,
+optimizer, lr_scheduler — ``01-single-gpu/train_llm.py:183-185``) plus a
+``state.json`` dict. Here the device-resident state is one pytree: params,
+optimizer state, step counter, and the data/dropout RNG key (RNG persistence is
+the reference's determinism recipe, ``related-topics/determinism/README.md:46-68``).
+The LR schedule is a pure function of ``step``, so it needs no state at all.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import jax
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class TrainState:
+    step: jax.Array        # int32 scalar
+    params: Any
+    opt_state: Any
+    rng: jax.Array         # jax.random key
+
+
+def host_state_dict(epoch: int = 0, epoch_step: int = 0, running_loss: float = 0.0) -> dict:
+    """The host-side loop state, mirroring the reference's ``state`` dict
+    (``01-single-gpu/train_llm.py:87-92``); serialized to state.json."""
+    return {
+        "epoch": epoch,
+        "global_step": 0,
+        "epoch_step": epoch_step,
+        "running_loss": running_loss,
+    }

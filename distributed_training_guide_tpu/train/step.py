@@ -1,0 +1,202 @@
+"""The jitted train step: one compiled program per strategy.
+
+This replaces the reference's eager hot loop (forward / backward / optimizer
+step as separate host-driven phases with hook-driven NCCL collectives,
+``02-distributed-data-parallel/train_llm.py:140-159``). Under XLA the whole
+step — forward, backward, grad all-reduce, optimizer update — is a single
+compiled program; GSPMD inserts collectives from the in/out shardings and the
+latency-hiding scheduler overlaps them with compute (the reference needs
+manual bucketing / ``set_modules_to_forward_prefetch`` for the same effect,
+``05-training-llama-405b/train_llm.py:148-161``).
+
+Gradient accumulation (reference C24, ``related-topics/gradient-accumulation``)
+is a ``lax.scan`` over a leading microbatch axis — the analogue of ``no_sync``:
+the grad psum happens once, at the optimizer boundary, because that is simply
+where the sharded->replicated transition sits in the compiled program.
+"""
+from __future__ import annotations
+
+import dataclasses
+from functools import cached_property
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ..models.registry import ModelBundle
+from ..ops.cross_entropy import causal_lm_loss
+from ..parallel.mesh import make_mesh
+from ..parallel.plans import ShardingPlan, make_plan, spec_for_leaf
+from .state import TrainState
+
+
+def _is_axes_leaf(x):
+    return isinstance(x, tuple) and all(isinstance(e, (str, type(None))) for e in x)
+
+
+def _keystr(path) -> tuple:
+    return tuple(str(k) for k in path)
+
+
+def _opt_state_shardings(plan: ShardingPlan, opt_shape_tree, axes_tree, param_shape_tree):
+    """Shardings for optimizer state by structural match against params.
+
+    optax state (mu/nu for adamw) mirrors the params pytree, so each opt leaf
+    whose key-path suffix + shape matches a param gets that param's sharding —
+    computed with the plan's *optimizer-state* rules, which for ZeRO-1 shard
+    states across (dp, fsdp) even though params stay replicated (reference C3,
+    ``02:87-89``). Scalars (step counts) replicate.
+    """
+    rules = plan.optimizer_state_rules()
+    p_leaves = jax.tree_util.tree_flatten_with_path(
+        axes_tree, is_leaf=_is_axes_leaf)[0]
+    shape_leaves = jax.tree.leaves(param_shape_tree)
+    by_path = [
+        (_keystr(path), ax, sd.shape)
+        for (path, ax), sd in zip(p_leaves, shape_leaves)
+    ]
+
+    def leaf_sharding(path, leaf):
+        ks = _keystr(path)
+        if leaf.ndim == 0:
+            return NamedSharding(plan.mesh, P())
+        for ppath, ax, shape in by_path:
+            if len(ks) >= len(ppath) and ks[-len(ppath):] == ppath and tuple(leaf.shape) == tuple(shape):
+                return NamedSharding(plan.mesh, spec_for_leaf(plan.mesh, ax, leaf.shape, rules))
+        return NamedSharding(plan.mesh, P())
+
+    flat, treedef = jax.tree_util.tree_flatten_with_path(opt_shape_tree)
+    return jax.tree_util.tree_unflatten(treedef, [leaf_sharding(p, l) for p, l in flat])
+
+
+@dataclasses.dataclass
+class Trainer:
+    """Builds sharded init + train-step functions for a (model, plan) pair.
+
+    Chapters construct one of these and then run the same loop — matching the
+    reference's core design property that the loop body never changes between
+    chapters (SURVEY.md section 1, L3).
+    """
+
+    bundle: ModelBundle
+    optimizer: optax.GradientTransformation
+    plan: Optional[ShardingPlan] = None
+    grad_accum: int = 1
+    remat: bool = False
+    attn_impl: str = "auto"
+    loss_fn: Callable = causal_lm_loss
+    donate: bool = True
+
+    def __post_init__(self):
+        if self.plan is None:
+            self.plan = make_plan("single", make_mesh(devices=jax.devices()[:1]))
+
+    # ---- shapes & shardings ------------------------------------------------
+    @cached_property
+    def param_shapes(self):
+        return jax.eval_shape(lambda: self.bundle.init(self.bundle.config, jax.random.key(0)))
+
+    @cached_property
+    def logical_axes(self):
+        return self.bundle.param_logical_axes(self.bundle.config)
+
+    @cached_property
+    def param_shardings(self):
+        return self.plan.param_shardings(self.logical_axes, self.param_shapes)
+
+    @cached_property
+    def state_shardings(self) -> TrainState:
+        opt_shapes = jax.eval_shape(self.optimizer.init, self.param_shapes)
+        return TrainState(
+            step=NamedSharding(self.plan.mesh, P()),
+            params=self.param_shardings,
+            opt_state=_opt_state_shardings(self.plan, opt_shapes, self.logical_axes,
+                                           self.param_shapes),
+            rng=NamedSharding(self.plan.mesh, P()),
+        )
+
+    def batch_shardings(self, batch_ndim: int = 2):
+        ndim = batch_ndim + (1 if self.grad_accum > 1 else 0)
+        if self.grad_accum > 1:
+            spec = self.plan.batch_spec(batch_ndim)
+            spec = P(None, *spec)  # leading microbatch axis is scanned, unsharded
+            sharding = NamedSharding(self.plan.mesh, spec)
+        else:
+            sharding = self.plan.batch_sharding(batch_ndim)
+        return {"input_ids": sharding, "labels": sharding}
+
+    # ---- init --------------------------------------------------------------
+    @cached_property
+    def init_state(self) -> Callable[[jax.Array], TrainState]:
+        """Returns jitted (rng) -> TrainState, materialized *sharded* — big
+        models never exist unsharded anywhere (the reference needs meta-device
+        init + per-rank materialization for this, ``04:76-95``)."""
+
+        def make(seed):
+            init_rng, train_rng = jax.random.split(jax.random.key(seed))
+            params = self.bundle.init(self.bundle.config, init_rng)
+            opt_state = self.optimizer.init(params)
+            return TrainState(step=jnp.zeros((), jnp.int32), params=params,
+                              opt_state=opt_state,
+                              rng=jax.random.key_data(train_rng))
+
+        jitted = jax.jit(make, out_shardings=self.state_shardings)
+        return lambda seed: jitted(jnp.asarray(seed, jnp.uint32))
+
+    # ---- the step ----------------------------------------------------------
+    @cached_property
+    def step_fn(self) -> Callable:
+        cfg = self.bundle.config
+        apply = self.bundle.apply
+        act_sharding = self.plan.activation_sharding()
+
+        def loss_on_microbatch(params, mb):
+            logits = apply(cfg, params, mb["input_ids"],
+                           positions=mb.get("positions"),
+                           remat=self.remat, attn_impl=self.attn_impl,
+                           activation_sharding=act_sharding)
+            return self.loss_fn(logits, mb["labels"])
+
+        grad_fn = jax.value_and_grad(loss_on_microbatch)
+
+        def train_step(state: TrainState, batch: dict):
+            if self.grad_accum > 1:
+                def accum(carry, mb):
+                    loss_sum, grads_sum = carry
+                    loss, grads = grad_fn(state.params, mb)
+                    return (loss_sum + loss,
+                            jax.tree.map(jnp.add, grads_sum, grads)), None
+
+                zeros = jax.tree.map(lambda p: jnp.zeros(p.shape, jnp.float32),
+                                     state.params)
+                (loss_sum, grads), _ = jax.lax.scan(accum, (jnp.zeros((), jnp.float32), zeros), batch)
+                loss = loss_sum / self.grad_accum
+                grads = jax.tree.map(lambda g: (g / self.grad_accum).astype(jnp.float32), grads)
+            else:
+                loss, grads = grad_fn(state.params, batch)
+
+            updates, new_opt = self.optimizer.update(grads, state.opt_state, state.params)
+            new_params = optax.apply_updates(state.params, updates)
+            metrics = {
+                "loss": loss.astype(jnp.float32),
+                "grad_norm": optax.global_norm(grads).astype(jnp.float32),
+            }
+            new_state = TrainState(step=state.step + 1, params=new_params,
+                                   opt_state=new_opt, rng=state.rng)
+            return new_state, metrics
+
+        metric_sharding = {"loss": self.plan.replicated(), "grad_norm": self.plan.replicated()}
+        return jax.jit(
+            train_step,
+            in_shardings=(self.state_shardings, self.batch_shardings()),
+            out_shardings=(self.state_shardings, metric_sharding),
+            donate_argnums=(0,) if self.donate else (),
+        )
+
+    # ---- accounting --------------------------------------------------------
+    def tokens_per_step(self, per_device_batch: int, seq_len: int) -> int:
+        """Global tokens per optimizer step (reference's ``tok_per_step``,
+        ``02:167`` — world_size*batch*seq; here data-parallel size*batch*seq)."""
+        return self.plan.data_parallel_size * per_device_batch * seq_len * self.grad_accum

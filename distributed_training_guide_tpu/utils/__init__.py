@@ -1,0 +1,19 @@
+from .timers import LocalTimer
+from .memory import get_mem_stats
+from .logging import init_logging, log_dict
+from .procguards import process0_first, process_ordered, is_process0, sync_processes
+from .mfu import transformer_flops_per_token, device_peak_flops, compute_mfu
+
+__all__ = [
+    "LocalTimer",
+    "get_mem_stats",
+    "init_logging",
+    "log_dict",
+    "process0_first",
+    "process_ordered",
+    "is_process0",
+    "sync_processes",
+    "transformer_flops_per_token",
+    "device_peak_flops",
+    "compute_mfu",
+]

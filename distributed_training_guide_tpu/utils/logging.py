@@ -1,0 +1,21 @@
+"""Process-prefixed logging.
+
+Parity with the reference's rank-prefixed stdlib logging
+(``02-distributed-data-parallel/train_llm.py:43-46``). JAX is one process per
+*host* (not per chip), so the prefix is ``jax.process_index()``.
+"""
+from __future__ import annotations
+
+import logging
+
+
+def init_logging(process_index: int = 0, process_count: int = 1, level=logging.INFO) -> None:
+    logging.basicConfig(
+        format=f"[%(asctime)s] [proc {process_index}/{process_count}] %(levelname)s:%(message)s",
+        level=level,
+        force=True,
+    )
+
+
+def log_dict(logger: logging.Logger, info: dict) -> None:
+    logger.info({k: (round(v, 6) if isinstance(v, float) else v) for k, v in info.items()})

@@ -1,0 +1,28 @@
+"""Device memory statistics.
+
+Parity with the reference's ``get_mem_stats`` (``01-single-gpu/train_llm.py:248-257``),
+which reports current/peak allocated+reserved GB from the CUDA caching
+allocator. On TPU the runtime exposes ``Device.memory_stats()``; CPU backends
+may expose nothing, in which case we report zeros so the log dict stays stable.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+
+
+def get_mem_stats(device: Optional[jax.Device] = None) -> dict:
+    device = device or jax.local_devices()[0]
+    try:
+        stats = device.memory_stats() or {}
+    except Exception:
+        stats = {}
+    gb = 1e-9
+    return {
+        "total_gb": gb * stats.get("bytes_limit", 0),
+        "curr_alloc_gb": gb * stats.get("bytes_in_use", 0),
+        "peak_alloc_gb": gb * stats.get("peak_bytes_in_use", stats.get("bytes_in_use", 0)),
+        "curr_resv_gb": gb * stats.get("bytes_reserved", 0),
+        "peak_resv_gb": gb * stats.get("peak_bytes_reserved", 0),
+    }

@@ -1,0 +1,60 @@
+"""Model-FLOPs-utilization accounting.
+
+The reference only reports tokens/s (``01-single-gpu/train_llm.py:166``); the
+TPU build's north-star metric is MFU, so we add the standard accounting:
+``6 * n_params`` matmul FLOPs per token for fwd+bwd, plus the attention
+quadratic term ``12 * n_layers * hidden * seq`` (fwd+bwd, causal halves the
+scores but flash kernels still compute block-wise — we use the conventional
+dense count so numbers are comparable with published MFU figures).
+"""
+from __future__ import annotations
+
+import jax
+
+
+def transformer_flops_per_token(
+    n_params: int,
+    n_layers: int,
+    hidden_size: int,
+    seq_len: int,
+    include_embedding: bool = False,
+    vocab_size: int = 0,
+) -> float:
+    """Training FLOPs (fwd+bwd) per token."""
+    params = n_params
+    if not include_embedding and vocab_size:
+        params = n_params - vocab_size * hidden_size
+    matmul = 6.0 * params
+    attention = 12.0 * n_layers * hidden_size * seq_len
+    return matmul + attention
+
+
+# Peak bf16 dense FLOP/s per chip by device kind substring.
+_PEAK_FLOPS = [
+    ("v6e", 918e12),
+    ("v6", 918e12),
+    ("v5p", 459e12),
+    ("v5e", 197e12),
+    ("v5 lite", 197e12),
+    ("v5litepod", 197e12),
+    ("v4", 275e12),
+    ("v3", 123e12),
+    ("v2", 45e12),
+]
+
+
+def device_peak_flops(device: "jax.Device | None" = None) -> float:
+    device = device or jax.local_devices()[0]
+    kind = getattr(device, "device_kind", "").lower()
+    for key, flops in _PEAK_FLOPS:
+        if key in kind:
+            return flops
+    if device.platform == "cpu":
+        return 1e12  # nominal, so CPU tests produce finite MFU
+    return 197e12
+
+
+def compute_mfu(tokens_per_s: float, flops_per_token: float, n_chips: int = 1,
+                peak_flops_per_chip: float | None = None) -> float:
+    peak = peak_flops_per_chip or device_peak_flops()
+    return (tokens_per_s * flops_per_token) / (peak * n_chips)

@@ -1,0 +1,48 @@
+"""Process-ordered I/O guards.
+
+Parity with the reference's ``rank0_first`` / ``rank_ordered`` context managers
+(``02-distributed-data-parallel/train_llm.py:272-280``,
+``06-tensor-parallel/train_llm.py:346-353``) used so only one worker downloads
+a dataset/model while the others wait, then read the warm cache.
+
+JAX runs one process per host, so "rank" collapses to ``jax.process_index()``
+and the barrier is a global-device sync. In single-process mode (including the
+pytest CPU mesh) the guards are no-ops.
+"""
+from __future__ import annotations
+
+from contextlib import contextmanager
+
+import jax
+
+
+def is_process0() -> bool:
+    return jax.process_index() == 0
+
+
+def sync_processes(name: str = "barrier") -> None:
+    """Barrier across all hosts (no-op single-process)."""
+    if jax.process_count() == 1:
+        return
+    from jax.experimental import multihost_utils
+
+    multihost_utils.sync_global_devices(name)
+
+
+@contextmanager
+def process_ordered(should_go_first: bool):
+    """First the processes with ``should_go_first``, then the rest."""
+    if should_go_first:
+        yield
+        sync_processes("process_ordered_first")
+        sync_processes("process_ordered_second")
+    else:
+        sync_processes("process_ordered_first")
+        yield
+        sync_processes("process_ordered_second")
+
+
+@contextmanager
+def process0_first():
+    with process_ordered(is_process0()):
+        yield

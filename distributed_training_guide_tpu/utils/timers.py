@@ -1,0 +1,64 @@
+"""Phase timers with honest device synchronization.
+
+Capability parity with the reference's ``LocalTimer``
+(``01-single-gpu/train_llm.py:260-286``): a context manager that measures
+wall-time of a phase, forcing a device sync on entry and exit so the
+measurement is not polluted by async dispatch. On TPU the sync primitive is
+``jax.block_until_ready`` on the arrays the phase produced (CUDA's
+``torch.cuda.synchronize`` has no direct analogue — JAX dispatch is async per
+array, so we block on outputs rather than a global device fence).
+"""
+from __future__ import annotations
+
+import time
+from typing import Callable, Optional
+
+import jax
+
+
+def _default_sync() -> None:
+    # Block until everything previously dispatched to the default device is
+    # done. ``jax.effects_barrier()`` waits for side-effecting computations;
+    # for data-dependency-only programs a tiny round-trip works on all
+    # platforms and is cheap relative to a training step.
+    jax.block_until_ready(jax.device_put(0))
+
+
+class LocalTimer:
+    """Measures average wall-time of a repeated phase (data/forward/step/...).
+
+    Usage::
+
+        timers = {k: LocalTimer() for k in ["data", "step"]}
+        with timers["step"]:
+            loss = train_step(state, batch)   # async dispatch
+            # sync happens on __exit__
+    """
+
+    def __init__(self, sync_fn: Optional[Callable[[], None]] = None):
+        self.synchronize = sync_fn or _default_sync
+        self.measurements: list[float] = []
+        self.start_time: Optional[float] = None
+
+    def __enter__(self) -> "LocalTimer":
+        self.synchronize()
+        self.start_time = time.perf_counter()
+        return self
+
+    def __exit__(self, exc_type, value, traceback) -> None:
+        if traceback is None:
+            self.synchronize()
+            self.measurements.append(time.perf_counter() - self.start_time)
+        self.start_time = None
+
+    def avg_elapsed_ms(self) -> float:
+        if not self.measurements:
+            return 0.0
+        return 1000.0 * (sum(self.measurements) / len(self.measurements))
+
+    def total_elapsed_ms(self) -> float:
+        return 1000.0 * sum(self.measurements)
+
+    def reset(self) -> None:
+        self.measurements = []
+        self.start_time = None

@@ -1,0 +1,32 @@
+"""Test harness: force an 8-device virtual CPU platform.
+
+The reference has no test suite (SURVEY.md section 4); its closest analogue is
+"torchrun --standalone --nproc-per-node N" smoke runs. The TPU build tests all
+mesh/sharding/checkpoint logic hermetically on a virtual 8-device CPU mesh via
+``--xla_force_host_platform_device_count`` — must be set before jax imports.
+"""
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = flags + " --xla_force_host_platform_device_count=8"
+os.environ.setdefault("JAX_ENABLE_X64", "0")
+
+# This image pre-imports jax at interpreter startup (sitecustomize), so the
+# env var alone can be too late; the config update below works as long as no
+# backend has been initialized yet.
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def eight_devices():
+    import jax
+
+    devices = jax.devices()
+    assert len(devices) == 8, f"expected 8 virtual devices, got {len(devices)}"
+    return devices

@@ -1,0 +1,83 @@
+"""Data loader determinism/resume + checkpoint round-trip tests."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from distributed_training_guide_tpu.data import ShardedBatchLoader
+from distributed_training_guide_tpu.data.pipeline import synthetic_dataset
+from distributed_training_guide_tpu.models import get_model
+from distributed_training_guide_tpu.parallel import make_mesh, make_plan
+from distributed_training_guide_tpu.train import Trainer, adamw_cosine
+from distributed_training_guide_tpu.checkpoint import CheckpointIO, abstract_train_state
+from distributed_training_guide_tpu.train.state import host_state_dict
+
+
+def _loader(plan, gb=8, accum=1):
+    data = synthetic_dataset(10_000, 512, 16, seed=3)
+    ndim = 3 if accum > 1 else 2
+    if accum > 1:
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        sharding = NamedSharding(plan.mesh, P(None, *plan.batch_spec(2)))
+    else:
+        sharding = plan.batch_sharding(2)
+    return ShardedBatchLoader(data, gb, sharding, grad_accum=accum, seed=0)
+
+
+def test_loader_deterministic_and_resume(eight_devices):
+    plan = make_plan("ddp", make_mesh())
+    loader = _loader(plan)
+    a = [np.asarray(b["input_ids"]) for b in loader.epoch_batches()]
+    b = [np.asarray(b["input_ids"]) for b in loader.epoch_batches()]
+    for x, y in zip(a, b):
+        np.testing.assert_array_equal(x, y)
+    # resume from step 3 reproduces the tail exactly (reference 01:133-135)
+    c = [np.asarray(b["input_ids"]) for b in loader.epoch_batches(start_step=3)]
+    for x, y in zip(a[3:], c):
+        np.testing.assert_array_equal(x, y)
+    # different epoch reshuffles
+    loader.set_epoch(1)
+    d = next(iter(loader.epoch_batches()))
+    assert not np.array_equal(a[0], np.asarray(d["input_ids"]))
+
+
+def test_loader_sharded_batch(eight_devices):
+    plan = make_plan("ddp", make_mesh())
+    loader = _loader(plan)
+    batch = next(iter(loader.epoch_batches()))
+    ids = batch["input_ids"]
+    assert ids.shape == (8, 16)
+    assert ids.addressable_shards[0].data.shape == (1, 16)  # 8-way batch shard
+
+
+def test_checkpoint_roundtrip_resharded(tmp_path, eight_devices):
+    """Save under fsdp sharding, restore under tp sharding — covers the
+    reference's sharded-DCP format plus elastic re-sharding on resume."""
+    bundle = get_model("llama-debug", dtype=jnp.float32)
+    opt = adamw_cosine(1e-3)
+    t1 = Trainer(bundle=bundle, optimizer=opt,
+                 plan=make_plan("fsdp", make_mesh(fsdp=8)), donate=False)
+    state = t1.init_state(0)
+    batch_sh = t1.batch_shardings()
+    ids = jnp.asarray(np.random.RandomState(0).randint(0, 512, (8, 16)))
+    batch = {k: jax.device_put(ids, batch_sh[k]) for k in ("input_ids", "labels")}
+    state, _ = t1.step_fn(state, batch)
+
+    io = CheckpointIO(tmp_path / "exp")
+    host = host_state_dict()
+    host["global_step"] = 1
+    io.save(state, host)
+    assert io.can_resume()
+
+    t2 = Trainer(bundle=bundle, optimizer=opt,
+                 plan=make_plan("tp", make_mesh(tp=4)), donate=False)
+    restored, host2 = io.restore(abstract_train_state(t2))
+    assert host2["global_step"] == 1
+    assert int(restored.step) == 1
+    for a, b in zip(jax.tree.leaves(jax.device_get(state.params)),
+                    jax.tree.leaves(jax.device_get(restored.params))):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    # restored state must be immediately trainable under the new plan
+    batch2 = {k: jax.device_put(ids, t2.batch_shardings()[k]) for k in ("input_ids", "labels")}
+    _, metrics = t2.step_fn(restored, batch2)
+    assert np.isfinite(float(metrics["loss"]))

@@ -1,0 +1,85 @@
+"""Model zoo unit tests: shapes, determinism, gradient flow, param counts."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_training_guide_tpu.models import get_model
+from distributed_training_guide_tpu.ops import causal_lm_loss
+
+
+@pytest.mark.parametrize("name", ["gpt2-debug", "llama-debug"])
+def test_forward_shapes_and_determinism(name):
+    bundle = get_model(name)
+    params = bundle.init(bundle.config, jax.random.key(0))
+    ids = jax.random.randint(jax.random.key(1), (2, 16), 0, bundle.config.vocab_size)
+    logits = bundle.apply(bundle.config, params, ids)
+    assert logits.shape == (2, 16, bundle.config.vocab_size)
+    assert logits.dtype == jnp.float32
+    logits2 = bundle.apply(bundle.config, params, ids)
+    np.testing.assert_array_equal(np.asarray(logits), np.asarray(logits2))
+
+
+@pytest.mark.parametrize("name", ["gpt2-debug", "llama-debug"])
+def test_causality(name):
+    """Changing a future token must not affect past logits."""
+    bundle = get_model(name)
+    params = bundle.init(bundle.config, jax.random.key(0))
+    ids = jax.random.randint(jax.random.key(1), (1, 12), 0, bundle.config.vocab_size)
+    ids2 = ids.at[0, -1].set((ids[0, -1] + 1) % bundle.config.vocab_size)
+    a = bundle.apply(bundle.config, params, ids)
+    b = bundle.apply(bundle.config, params, ids2)
+    np.testing.assert_allclose(np.asarray(a[:, :-1]), np.asarray(b[:, :-1]), atol=2e-2)
+
+
+@pytest.mark.parametrize("name", ["gpt2-debug", "llama-debug"])
+def test_grads_nonzero(name):
+    bundle = get_model(name)
+    params = bundle.init(bundle.config, jax.random.key(0))
+    ids = jax.random.randint(jax.random.key(1), (2, 16), 0, bundle.config.vocab_size)
+
+    def loss_fn(p):
+        return causal_lm_loss(bundle.apply(bundle.config, p, ids), ids)
+
+    loss, grads = jax.value_and_grad(loss_fn)(params)
+    assert np.isfinite(float(loss))
+    norms = [float(jnp.linalg.norm(g)) for g in jax.tree.leaves(grads)]
+    assert all(np.isfinite(n) for n in norms)
+    assert sum(n > 0 for n in norms) >= len(norms) - 2  # norms may be ~0 early
+
+
+@pytest.mark.parametrize("name", ["gpt2", "llama-3.1-8b", "llama-3.1-405b"])
+def test_param_count_formula(name):
+    """num_params() formula matches the known public sizes within 1%."""
+    known = {"gpt2": 124e6, "llama-3.1-8b": 8.03e9, "llama-3.1-405b": 405.8e9}
+    bundle = get_model(name)
+    assert abs(bundle.num_params() - known[name]) / known[name] < 0.01
+
+
+def test_remat_matches_no_remat():
+    bundle = get_model("llama-debug")
+    params = bundle.init(bundle.config, jax.random.key(0))
+    ids = jax.random.randint(jax.random.key(1), (1, 16), 0, bundle.config.vocab_size)
+
+    def loss_fn(p, remat):
+        return causal_lm_loss(bundle.apply(bundle.config, p, ids, remat=remat), ids)
+
+    g1 = jax.grad(lambda p: loss_fn(p, False))(params)
+    g2 = jax.grad(lambda p: loss_fn(p, True))(params)
+    for a, b in zip(jax.tree.leaves(g1), jax.tree.leaves(g2)):
+        # bf16 activations: recompute order differs under remat, so allow
+        # one-bf16-ulp noise.
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-2, atol=5e-3)
+
+
+def test_logical_axes_mirror_params():
+    for name in ["gpt2-debug", "llama-debug"]:
+        bundle = get_model(name)
+        params = bundle.init(bundle.config, jax.random.key(0))
+        axes = bundle.param_logical_axes(bundle.config)
+        p_struct = jax.tree.structure(params)
+        a_struct = jax.tree.structure(axes, is_leaf=lambda x: isinstance(x, tuple))
+        assert p_struct == a_struct
+        for leaf, ax in zip(jax.tree.leaves(params),
+                            jax.tree.leaves(axes, is_leaf=lambda x: isinstance(x, tuple))):
+            assert leaf.ndim == len(ax), f"{name}: {leaf.shape} vs {ax}"
