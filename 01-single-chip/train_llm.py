@@ -20,10 +20,12 @@ sys.path.insert(0, str(Path(__file__).parent.parent))
 
 import jax
 
+from distributed_training_guide_tpu.launch.errors import record
 from distributed_training_guide_tpu.parallel import make_mesh, make_plan
 from distributed_training_guide_tpu.train.cli import get_parser, run_training
 
 
+@record
 def main():
     args = get_parser().parse_args()
     plan_factory = lambda: make_plan("single", make_mesh(devices=jax.devices()[:1]))

@@ -1,0 +1,34 @@
+#!/usr/bin/env bash
+# TPU-pod launcher — counterpart of the reference's ssh/tmux fan-out
+# (05-training-llama-405b/launch.sh) and torchrun rendezvous.
+#
+# On a Cloud TPU pod slice there is no torchrun: every host runs ONE copy of
+# the script, and jax.distributed.initialize() discovers coordinator/process
+# id from the TPU metadata. Launch = "run the same command on all workers":
+#
+#   ./tpu_pod_launch.sh <tpu-name> <zone> <command...>
+#
+# Example:
+#   ./tpu_pod_launch.sh my-v5p-512 us-east5-a \
+#       python 05-training-llama-405b/train_llm.py -e run1 -d synthetic -m llama-3.1-405b
+#
+# The command is wrapped in the elastic supervisor (error files + restarts,
+# chapter "related-topics/elastic-training") and a tmux session per host so
+# you can attach (reference 05/launch.sh:21-28 does the same with tmux).
+set -euo pipefail
+
+TPU_NAME=${1:?usage: tpu_pod_launch.sh <tpu-name> <zone> <cmd...>}
+ZONE=${2:?missing zone}
+shift 2
+CMD="$*"
+SESSION=dtg-train
+
+gcloud compute tpus tpu-vm ssh "$TPU_NAME" --zone "$ZONE" --worker=all --command "
+  tmux kill-session -t $SESSION 2>/dev/null || true
+  tmux new-session -d -s $SESSION \
+    'python -m distributed_training_guide_tpu.launch.supervisor \
+       --max-restarts 3 --log-dir ~/dtg-logs -- $CMD'
+"
+echo "launched '$CMD' on all workers of $TPU_NAME (tmux session: $SESSION)"
+echo "tail logs:   gcloud compute tpus tpu-vm ssh $TPU_NAME --zone $ZONE --worker=all --command 'tail -n5 ~/dtg-logs/attempt_*/stdout.log'"
+echo "teardown:    gcloud compute tpus tpu-vm ssh $TPU_NAME --zone $ZONE --worker=all --command 'tmux kill-session -t $SESSION'"

@@ -28,10 +28,12 @@ sys.path.insert(0, str(Path(__file__).parent.parent))
 import jax
 
 from distributed_training_guide_tpu.launch import maybe_initialize_distributed
+from distributed_training_guide_tpu.launch.errors import record
 from distributed_training_guide_tpu.parallel import make_mesh, make_plan
 from distributed_training_guide_tpu.train.cli import get_parser, run_training
 
 
+@record
 def main():
     parser = get_parser()
     parser.add_argument("--tensor-parallel", type=int, default=None,

@@ -8,8 +8,6 @@ Baseline anchor: the reference's headline number is the Llama-405B run,
 vs_baseline = achieved_mfu / 0.335 — MFU-vs-MFU is the only fair
 cross-hardware comparison.
 """
-
-BASELINE_MFU = 0.335
 from __future__ import annotations
 
 import argparse
@@ -18,7 +16,7 @@ import time
 
 import numpy as np
 
-
+BASELINE_MFU = 0.335
 def main():
     parser = argparse.ArgumentParser()
     parser.add_argument("--model", default=None, help="model preset (default: by device memory)")

@@ -39,34 +39,61 @@ class CheckpointIO:
     def state_json(self) -> Path:
         return self.exp_dir / "state.json"
 
-    def ckpt_path(self) -> Path:
-        return (self.exp_dir / "checkpoint").absolute()
+    def _ckpt_dir(self, step: int) -> Path:
+        return (self.exp_dir / f"checkpoint-{step}").absolute()
+
+    def _current_ckpt_dir(self) -> Optional[Path]:
+        if not self.state_json.exists():
+            return None
+        try:
+            with open(self.state_json) as fp:
+                name = json.load(fp).get("checkpoint")
+        except (json.JSONDecodeError, OSError):
+            return None
+        if not name:
+            return None
+        path = (self.exp_dir / name).absolute()
+        return path if path.exists() else None
 
     def can_resume(self) -> bool:
-        return self.state_json.exists() and self.ckpt_path().exists()
+        return self._current_ckpt_dir() is not None
 
     # ---- save --------------------------------------------------------------
     def save(self, train_state: Any, host_state: dict) -> None:
-        """All hosts participate (each writes its own shards); state.json is
-        written by process 0 last so a partial save never looks resumable."""
+        """Crash-safe save: each step writes a fresh ``checkpoint-<step>`` dir
+        (all hosts write their own shards in parallel; Orbax finalizes the dir
+        atomically), then process 0 atomically swings state.json to it, then
+        older checkpoints are pruned. A crash at any point leaves the previous
+        checkpoint referenced by a valid state.json."""
         self.exp_dir.mkdir(parents=True, exist_ok=True)
-        path = self.ckpt_path()
-        tmp_ok = True
+        step = int(host_state.get("global_step", 0))
+        path = self._ckpt_dir(step)
+        old = self._current_ckpt_dir()
         self._checkpointer.save(path, train_state, force=True)
         self._checkpointer.wait_until_finished()
         sync_processes("ckpt_saved")
-        if is_process0() and tmp_ok:
-            with open(self.state_json, "w") as fp:
-                json.dump(host_state, fp)
+        if is_process0():
+            tmp = self.state_json.with_suffix(".json.tmp")
+            with open(tmp, "w") as fp:
+                json.dump({**host_state, "checkpoint": path.name}, fp)
+            tmp.replace(self.state_json)  # atomic on POSIX
+            if old is not None and old != path:
+                import shutil
+
+                shutil.rmtree(old, ignore_errors=True)
         sync_processes("ckpt_state_json")
 
     # ---- restore -----------------------------------------------------------
     def restore(self, abstract_state: Any) -> tuple[Any, dict]:
         """abstract_state: pytree of jax.ShapeDtypeStruct *with shardings* —
         each host reads exactly its shards from TensorStore."""
-        train_state = self._checkpointer.restore(self.ckpt_path(), abstract_state)
+        path = self._current_ckpt_dir()
+        if path is None:
+            raise FileNotFoundError(f"no resumable checkpoint in {self.exp_dir}")
+        train_state = self._checkpointer.restore(path, abstract_state)
         with open(self.state_json) as fp:
             host_state = json.load(fp)
+        host_state.pop("checkpoint", None)
         return train_state, host_state
 
 

@@ -40,14 +40,23 @@ def synthetic_dataset(n_tokens: int, vocab_size: int, seq_length: int, seed: int
     return _chunk(stream, seq_length)
 
 
+def _tokenize_batch(tokenizer, texts: list[str]) -> list[list[int]]:
+    """Normalize HF (flat list for a single string) vs batch conventions by
+    always tokenizing a list of strings -> list of id-lists."""
+    out = tokenizer(texts)["input_ids"]
+    if out and isinstance(out[0], int):  # defensive: flat list
+        out = [out]
+    return out
+
+
 def _from_local_file(path: Path, tokenizer, seq_length: int) -> np.ndarray:
     if path.suffix == ".jsonl":
         texts = [json.loads(line).get("text", "") for line in path.read_text().splitlines() if line]
     else:
         texts = [path.read_text()]
-    ids = []
-    for t in texts:
-        ids.extend(tokenizer(t)["input_ids"][0] if hasattr(tokenizer, "__call__") else [])
+    ids: list[int] = []
+    for id_list in _tokenize_batch(tokenizer, texts):
+        ids.extend(id_list)
     return _chunk(np.asarray(ids, dtype=np.int64), seq_length)
 
 
@@ -77,9 +86,9 @@ def load_and_preprocess_data(
     seed: int = 0,
 ) -> np.ndarray:
     """Returns [num_sequences, seq_length] int32."""
-    if max_position_embeddings and seq_length > max_position_embeddings:
-        # reference clamp: 01-single-gpu/train_llm.py:216-218
-        seq_length = min(1024, max_position_embeddings)
+    if max_position_embeddings:
+        # clamp to what the model can attend to (cf. 01-single-gpu/train_llm.py:216-218)
+        seq_length = min(seq_length, max_position_embeddings)
 
     if dataset_name.startswith("synthetic"):
         n_tokens = 1_000_000

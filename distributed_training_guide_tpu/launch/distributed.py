@@ -24,9 +24,20 @@ LOGGER = logging.getLogger(__name__)
 
 
 def maybe_initialize_distributed() -> None:
-    """Idempotent; no-op for single-process runs."""
-    if jax.process_count() > 1:
-        return  # already initialized
+    """Idempotent; no-op for single-process runs.
+
+    NB: must not touch ``jax.devices()``/``jax.process_count()`` before
+    deciding — querying them initializes the local backend, after which
+    ``jax.distributed.initialize`` raises.
+    """
+    try:
+        if jax.distributed.is_initialized():
+            return
+    except AttributeError:  # older jax
+        from jax._src import distributed as _dist
+
+        if _dist.global_state.client is not None:
+            return
 
     coord = os.environ.get("COORDINATOR_ADDRESS")
     if coord is None and os.environ.get("MASTER_ADDR"):

@@ -1,0 +1,66 @@
+"""Per-worker error capture.
+
+Parity with torchelastic's ``@record`` decorator + ``TORCHELASTIC_ERROR_FILE``
+(reference ``02-distributed-data-parallel/train_llm.py:16,31``,
+``diagnosing-errors/README.md:53-66``): on an uncaught exception, write a
+machine-readable error file (timestamp, process index, exception, traceback)
+before re-raising, so the supervisor on any host can surface *which* worker
+failed and why without grepping N logs.
+
+Env: ``ERROR_FILE`` (falls back to ``TORCHELASTIC_ERROR_FILE`` so reference
+launch commands port unchanged).
+"""
+from __future__ import annotations
+
+import functools
+import json
+import os
+import time
+import traceback
+
+
+def error_file_path() -> str | None:
+    return os.environ.get("ERROR_FILE") or os.environ.get("TORCHELASTIC_ERROR_FILE")
+
+
+def write_error_file(exc: BaseException, path: str | None = None) -> None:
+    path = path or error_file_path()
+    if not path:
+        return
+    try:
+        import jax
+
+        proc = jax.process_index()
+    except Exception:
+        proc = int(os.environ.get("PROCESS_ID", os.environ.get("RANK", 0)))
+    payload = {
+        "message": {
+            "error": repr(exc),
+            "traceback": traceback.format_exc(),
+            "process_index": proc,
+            "timestamp": int(time.time()),
+            "hostname": os.uname().nodename,
+            "pid": os.getpid(),
+        }
+    }
+    try:
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        with open(path, "w") as fp:
+            json.dump(payload, fp, indent=2)
+    except OSError:
+        pass
+
+
+def record(fn):
+    """Decorator: write the error file on any uncaught exception (the
+    reference's ``@record``)."""
+
+    @functools.wraps(fn)
+    def wrapper(*args, **kwargs):
+        try:
+            return fn(*args, **kwargs)
+        except Exception as exc:  # noqa: BLE001 — deliberately broad
+            write_error_file(exc)
+            raise
+
+    return wrapper

@@ -1,0 +1,110 @@
+"""Elastic supervisor: restart-on-failure for training workers.
+
+Parity with torchrun's elasticity (reference ``related-topics/elastic-training/
+README.md:5-16``): ``--max-restarts N`` restarts the worker when it fails, and
+— like torchrun — recovery correctness comes from the normal resume path
+(state.json + checkpoints + sampler fast-forward), not from preserving any
+in-process state. Per-attempt logs and error files are kept under
+``<log_dir>/attempt_<n>/`` (torchrun's ``--redirects 3 --log-dir``,
+``02-distributed-data-parallel/README.md:99-100``).
+
+On a TPU pod every host runs this supervisor; when any host's worker dies the
+others' collectives stall, so each supervisor also kills its worker when the
+coordinator declares a restart (here: worker exit or ``--heartbeat-timeout``
+with no log progress — the power-draw-drop hang heuristic of
+``diagnosing-errors/README.md:7-19`` in process form).
+
+Usage:
+    python -m distributed_training_guide_tpu.launch.supervisor \
+        --max-restarts 3 --log-dir ./logs -- python train_llm.py ...
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import subprocess
+import sys
+import time
+from pathlib import Path
+
+
+def run_supervised(cmd: list[str], max_restarts: int, log_dir: Path,
+                   heartbeat_timeout: float | None = None) -> int:
+    attempt = 0
+    while True:
+        attempt_dir = log_dir / f"attempt_{attempt}"
+        attempt_dir.mkdir(parents=True, exist_ok=True)
+        env = dict(os.environ)
+        env.setdefault("ERROR_FILE", str(attempt_dir / "error.json"))
+        stdout = open(attempt_dir / "stdout.log", "ab")
+        stderr = open(attempt_dir / "stderr.log", "ab")
+        print(f"[supervisor] attempt {attempt}: {' '.join(cmd)} -> {attempt_dir}",
+              flush=True)
+        proc = subprocess.Popen(cmd, env=env, stdout=stdout, stderr=stderr)
+
+        try:
+            if heartbeat_timeout:
+                rc = _wait_with_heartbeat(proc, attempt_dir, heartbeat_timeout)
+            else:
+                rc = proc.wait()
+        except KeyboardInterrupt:
+            proc.send_signal(signal.SIGTERM)
+            proc.wait()
+            return 130
+        finally:
+            stdout.close()
+            stderr.close()
+
+        if rc == 0:
+            print(f"[supervisor] attempt {attempt} exited cleanly", flush=True)
+            return 0
+        print(f"[supervisor] attempt {attempt} failed rc={rc} "
+              f"(error file: {env['ERROR_FILE']})", flush=True)
+        if attempt >= max_restarts:
+            print(f"[supervisor] max restarts ({max_restarts}) exhausted", flush=True)
+            return rc
+        attempt += 1
+
+
+def _wait_with_heartbeat(proc: subprocess.Popen, attempt_dir: Path,
+                         timeout: float) -> int:
+    """Kill the worker if its logs stop growing for `timeout` seconds (hang
+    detection — the collective-stall case where the process never exits)."""
+    logs = [attempt_dir / "stdout.log", attempt_dir / "stderr.log"]
+    last_size = -1
+    last_change = time.time()
+    while True:
+        rc = proc.poll()
+        if rc is not None:
+            return rc
+        size = sum(p.stat().st_size for p in logs if p.exists())
+        now = time.time()
+        if size != last_size:
+            last_size, last_change = size, now
+        elif now - last_change > timeout:
+            print(f"[supervisor] no log progress for {timeout}s -> SIGKILL (hang)",
+                  flush=True)
+            proc.kill()
+            return proc.wait() or -9
+        time.sleep(min(5.0, timeout / 4))
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--max-restarts", type=int, default=3)
+    parser.add_argument("--log-dir", default="./supervisor-logs")
+    parser.add_argument("--heartbeat-timeout", type=float, default=None,
+                        help="seconds of log silence before declaring a hang")
+    parser.add_argument("cmd", nargs=argparse.REMAINDER,
+                        help="-- followed by the worker command")
+    args = parser.parse_args()
+    cmd = args.cmd[1:] if args.cmd and args.cmd[0] == "--" else args.cmd
+    if not cmd:
+        parser.error("no worker command given (use: supervisor [opts] -- cmd ...)")
+    sys.exit(run_supervised(cmd, args.max_restarts, Path(args.log_dir),
+                            args.heartbeat_timeout))
+
+
+if __name__ == "__main__":
+    main()

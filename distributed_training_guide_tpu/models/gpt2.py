@@ -109,7 +109,7 @@ def _layernorm(x, p, eps):
     return (y * p["scale"].astype(jnp.float32) + p["bias"].astype(jnp.float32)).astype(dtype)
 
 
-def _block(config: GPT2Config, x, layer, positions, attn_impl):
+def _block(config: GPT2Config, x, layer, positions, attn_impl, standard_layout=True):
     b, s, e = x.shape
     h, d = config.num_heads, config.head_size
     cdt = config.dtype
@@ -122,7 +122,8 @@ def _block(config: GPT2Config, x, layer, positions, attn_impl):
     k = k.reshape(b, s, h, d)
     v = v.reshape(b, s, h, d)
     attn = multihead_attention(q, k, v, causal=True, positions=positions,
-                               kv_positions=positions, impl=attn_impl)
+                               kv_positions=positions, impl=attn_impl,
+                               standard_layout=standard_layout)
     attn = attn.reshape(b, s, e) @ layer["attn"]["wo"].astype(cdt) + layer["attn"]["bo"].astype(cdt)
     x = x + attn
 
@@ -146,6 +147,7 @@ def apply(
     activation_sharding: Optional[Any] = None,
 ) -> jnp.ndarray:
     del activation_sharding  # gpt2 path is small; SP constraint not needed
+    standard_layout = positions is None
     if positions is None:
         positions = jnp.arange(input_ids.shape[1])[None, :]
     positions = jnp.broadcast_to(positions, input_ids.shape)
@@ -154,7 +156,8 @@ def apply(
     pos = jnp.take(params["wpe"], positions, axis=0)
     x = (tok + pos).astype(config.dtype)
 
-    block = partial(_block, config, positions=positions, attn_impl=attn_impl)
+    block = partial(_block, config, positions=positions, attn_impl=attn_impl,
+                    standard_layout=standard_layout)
 
     def scan_body(carry, layer_params):
         return block(carry, layer_params), None

@@ -137,7 +137,8 @@ def _rmsnorm(x: jnp.ndarray, scale: jnp.ndarray, eps: float) -> jnp.ndarray:
 
 def _block(config: LlamaConfig, x: jnp.ndarray, layer: dict,
            positions: jnp.ndarray, attn_impl: str,
-           activation_sharding: Optional[Any] = None) -> jnp.ndarray:
+           activation_sharding: Optional[Any] = None,
+           standard_layout: bool = True) -> jnp.ndarray:
     b, s, e = x.shape
     d = config.head_size
     cdt = config.dtype
@@ -154,7 +155,8 @@ def _block(config: LlamaConfig, x: jnp.ndarray, layer: dict,
     q = apply_rope(q, positions, config.rope_theta)
     k = apply_rope(k, positions, config.rope_theta)
     attn = multihead_attention(q, k, v, causal=True, positions=positions,
-                               kv_positions=positions, impl=attn_impl)
+                               kv_positions=positions, impl=attn_impl,
+                               standard_layout=standard_layout)
     attn = attn.reshape(b, s, config.num_heads * d) @ layer["attn"]["wo"].astype(cdt)
     x = constrain(x + attn)
 
@@ -184,6 +186,7 @@ def apply(
     ``activation_sharding`` optionally constrains the inter-block residual
     stream (e.g. P('dp', 'tp', None) for sequence parallelism).
     """
+    standard_layout = positions is None
     if positions is None:
         positions = jnp.arange(input_ids.shape[1])[None, :]
     positions = jnp.broadcast_to(positions, input_ids.shape)
@@ -191,7 +194,8 @@ def apply(
     x = jnp.take(params["embed"]["embedding"], input_ids, axis=0).astype(config.dtype)
 
     block = partial(_block, config, positions=positions, attn_impl=attn_impl,
-                    activation_sharding=activation_sharding)
+                    activation_sharding=activation_sharding,
+                    standard_layout=standard_layout)
 
     def scan_body(carry, layer_params):
         return block(carry, layer_params), None

@@ -1,0 +1,83 @@
+"""Cluster-wide device monitor.
+
+Parity with the reference's ``top-cluster.py`` (ssh + nvidia-smi poll,
+``top-cluster.py:16-94``; hang heuristic = power-draw drop,
+``diagnosing-errors/README.md:7-19``): poll every host for per-chip HBM usage
+and an activity proxy, aggregate per node + cluster. TPU runtimes don't expose
+power per chip the way nvidia-smi does; the analogous stall signal is
+duty-cycle / HBM churn — we report bytes_in_use and peak since last poll from
+``jax.local_devices()[i].memory_stats()``.
+
+Modes:
+  --local            one-shot stats for this host (also the ssh payload)
+  --hosts FILE       poll each host over ssh every --interval seconds
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import subprocess
+import time
+
+
+def local_stats() -> dict:
+    import jax
+
+    devs = []
+    for d in jax.local_devices():
+        s = d.memory_stats() or {}
+        devs.append({
+            "id": d.id,
+            "kind": getattr(d, "device_kind", d.platform),
+            "hbm_gb": round(1e-9 * s.get("bytes_in_use", 0), 2),
+            "hbm_peak_gb": round(1e-9 * s.get("peak_bytes_in_use", 0), 2),
+            "hbm_limit_gb": round(1e-9 * s.get("bytes_limit", 0), 2),
+        })
+    return {"host": __import__("os").uname().nodename, "devices": devs}
+
+
+def poll_host(host: str, timeout: float = 20.0) -> dict:
+    cmd = ["ssh", "-o", "ConnectTimeout=5", host,
+           "python -m distributed_training_guide_tpu.monitor.top_cluster --local"]
+    try:
+        out = subprocess.run(cmd, capture_output=True, timeout=timeout, text=True)
+        return json.loads(out.stdout.strip().splitlines()[-1])
+    except Exception as e:  # noqa: BLE001
+        return {"host": host, "error": str(e)}
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--local", action="store_true")
+    parser.add_argument("--hosts", default=None, help="file with one host per line")
+    parser.add_argument("--interval", type=float, default=10.0)
+    args = parser.parse_args()
+
+    if args.local or not args.hosts:
+        print(json.dumps(local_stats()))
+        return
+
+    hosts = [h.strip() for h in open(args.hosts) if h.strip()]
+    while True:
+        t0 = time.time()
+        total_used = total_limit = n_dev = n_err = 0
+        for host in hosts:
+            stats = poll_host(host)
+            if "error" in stats:
+                n_err += 1
+                print(f"{host:<24} ERROR {stats['error']}")
+                continue
+            used = sum(d["hbm_gb"] for d in stats["devices"])
+            limit = sum(d["hbm_limit_gb"] for d in stats["devices"])
+            total_used += used
+            total_limit += limit
+            n_dev += len(stats["devices"])
+            print(f"{host:<24} {len(stats['devices'])} chips  "
+                  f"hbm {used:7.1f}/{limit:7.1f} GB")
+        print(f"{'CLUSTER':<24} {n_dev} chips  hbm {total_used:7.1f}/"
+              f"{total_limit:7.1f} GB  unreachable={n_err}\n")
+        time.sleep(max(0.0, args.interval - (time.time() - t0)))
+
+
+if __name__ == "__main__":
+    main()

@@ -58,17 +58,20 @@ def multihead_attention(
     positions: Optional[jnp.ndarray] = None,
     kv_positions: Optional[jnp.ndarray] = None,
     impl: str = "auto",
+    standard_layout: bool = True,
 ) -> jnp.ndarray:
     """Scaled-dot-product attention with GQA.
 
     impl: "xla" (einsum reference), "flash" (Pallas kernel), or "auto"
-    (flash on TPU when shapes are tile-aligned and no custom positions are in
-    play, else xla).
+    (flash on TPU when causal, tile-aligned, and the caller confirms the
+    standard contiguous position layout via ``standard_layout`` — sequence-
+    sharded/CP callers pass False and get the mask-aware xla path).
     """
     if impl == "auto":
         on_tpu = jax.default_backend() == "tpu"
-        aligned = q.shape[1] % 128 == 0 and k.shape[1] % 128 == 0 and q.shape[-1] % 128 == 0
-        impl = "flash" if (on_tpu and aligned and positions is None and causal) else "xla"
+        aligned = (q.shape[1] % 128 == 0 and k.shape[1] % 128 == 0
+                   and q.shape[-1] % 64 == 0)
+        impl = "flash" if (on_tpu and aligned and causal and standard_layout) else "xla"
     if impl == "flash":
         from .flash_attention import flash_attention
 

@@ -1,0 +1,54 @@
+"""Fault-injection toy for the elastic supervisor.
+
+Counterpart of the reference's ``related-topics/elastic-training/toy.py``
+(random crashes exercising torchrun's restart machinery — "No GPU required").
+Here: a fake training loop that checkpoints to a state file, randomly raises,
+and resumes from the state file when the supervisor restarts it. Verification
+is the same as the reference's: inspect ``attempt_*/error.json`` and the logs.
+
+Run:
+    python -m distributed_training_guide_tpu.launch.supervisor \
+        --max-restarts 5 --log-dir /tmp/elastic-toy -- \
+        python related-topics/elastic-training/toy.py --state /tmp/elastic-toy/state.json
+"""
+import argparse
+import json
+import os
+import random
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).parent.parent.parent))
+
+from distributed_training_guide_tpu.launch.errors import record
+
+
+@record
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--state", default="/tmp/elastic-toy-state.json")
+    parser.add_argument("--total-steps", type=int, default=50)
+    parser.add_argument("--crash-prob", type=float, default=0.08)
+    args = parser.parse_args()
+
+    step = 0
+    if os.path.exists(args.state):
+        with open(args.state) as fp:
+            step = json.load(fp)["step"]
+        print(f"resumed at step {step}", flush=True)
+
+    random.seed(os.getpid())
+    while step < args.total_steps:
+        time.sleep(0.05)
+        step += 1
+        print(f"step {step}", flush=True)
+        with open(args.state, "w") as fp:
+            json.dump({"step": step}, fp)
+        if random.random() < args.crash_prob:
+            raise ValueError(f"injected fault at step {step}")
+    print("training complete", flush=True)
+
+
+if __name__ == "__main__":
+    main()
