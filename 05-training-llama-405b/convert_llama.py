@@ -1,0 +1,22 @@
+"""One-time safetensors -> memmap conversion for pretrained Llama weights.
+
+Counterpart of the reference's ``download.py`` + rank-0 load + broadcast
+(``05-training-llama-405b/train_llm.py:74-146``). Streams tensor-by-tensor:
+peak host RAM is one tensor (the reference needs the full 764 GB state dict
+on rank 0's CPU).
+
+Usage:
+    python convert_llama.py <hf_checkpoint_dir> <out_dir> <model-name>
+"""
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).parent.parent))
+
+from distributed_training_guide_tpu.models.hf_convert import convert_hf_checkpoint
+
+if __name__ == "__main__":
+    if len(sys.argv) != 4:
+        raise SystemExit(__doc__)
+    convert_hf_checkpoint(sys.argv[1], sys.argv[2], sys.argv[3])
+    print(f"converted -> {sys.argv[2]}")

@@ -1,0 +1,69 @@
+"""Chapter 5 — training Llama-3.1-405B.
+
+TPU-native counterpart of ``05-training-llama-405b/train_llm.py``. The
+reference's recipe on 64xH100 (~33% MFU, BASELINE.md) needs five special
+mechanisms; here each is either already free or one flag:
+
+- rank-0 CPU weight load + NCCL broadcast (``05:74-146``) -> one-time
+  ``convert_llama.py`` safetensors->memmap conversion, then every host loads
+  exactly its shards directly into the training shardings (no broadcast, no
+  764 GB host RAM; cf. ``models/hf_convert.py``);
+- activation checkpointing (``05:163-178``) -> ``--checkpoint-activations``
+  (jax.checkpoint around the scanned decoder block);
+- explicit fwd/bwd prefetch (``05:148-161``) -> XLA's latency-hiding
+  scheduler overlaps the FSDP all-gathers with compute;
+- CPU optimizer offload (``05:69-72``) -> ``--offload-opt-state`` puts Adam
+  state in pinned host memory (only needed below ~v5p-256 scale; the default
+  keeps it in HBM, which is why this config targets speed, not just fitting);
+- torch.compile of model/loss/optimizer (``05:202-204``) -> the whole step is
+  one XLA program by construction.
+
+Default sharding: 2-D fsdp x tp. On a v5p-512 slice (256 chips visible per
+host group): --tensor-parallel 8 gives fsdp=32 x tp=8.
+
+Smoke (tiny stand-in model, 8 virtual devices):
+    JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+        python train_llm.py -m llama-debug -d synthetic:100000 -s 128 -b 1 \
+        --tensor-parallel 2 --num-epochs 1 --log-freq 2 --max-steps 4
+Real run:
+    python convert_llama.py <hf-dir> <converted-dir> llama-3.1-405b
+    python train_llm.py -m llama-3.1-405b -d <data> -e 405b-run \
+        --pretrained <converted-dir> --tensor-parallel 8 --checkpoint-activations
+"""
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).parent.parent))
+
+import jax
+
+from distributed_training_guide_tpu.launch import maybe_initialize_distributed
+from distributed_training_guide_tpu.launch.errors import record
+from distributed_training_guide_tpu.parallel import make_mesh, make_plan
+from distributed_training_guide_tpu.train.cli import get_parser, run_training
+
+
+@record
+def main():
+    parser = get_parser()
+    parser.add_argument("--tensor-parallel", type=int, default=1)
+    parser.add_argument("--pretrained", default=None,
+                        help="directory produced by convert_llama.py")
+    parser.add_argument("--offload-opt-state", action="store_true",
+                        help="Adam state in pinned host memory (reference 05:69-72)")
+    parser.set_defaults(checkpoint_activations=True)
+    args = parser.parse_args()
+    maybe_initialize_distributed()
+
+    def plan_factory():
+        n = len(jax.devices())
+        tp = args.tensor_parallel
+        strategy = "tp_fsdp" if tp > 1 else "fsdp"
+        return make_plan(strategy, make_mesh(tp=tp, fsdp=n // tp))
+
+    run_training(args, plan_factory, pretrained_dir=args.pretrained,
+                 offload_opt_state=args.offload_opt_state)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,56 @@
+"""Chapter 8 — context parallelism for long sequences (beyond the reference).
+
+The reference stops at "Context parallel (For long context lengths)" as a
+name-check (``06-tensor-parallel/README.md:7``); its longest trainable context
+is whatever one GPU's activations can hold after flash-attn + remat. This
+chapter shards the *sequence dimension itself* over the ``cp`` mesh axis:
+
+- batch/activations: seq dim sharded (GSPMD handles every elementwise op,
+  norm, and matmul — they're position-local);
+- attention: ring attention (``ops/ring_attention.py``) — K/V blocks rotate
+  over ICI neighbor links via ppermute while each rank attends its resident
+  Q block, merging with online softmax. Causality uses absolute positions, so
+  the result is bit-for-bit the same math as dense causal attention;
+- composes with fsdp/tp: mesh (dp, fsdp, tp, cp).
+
+Max context scales linearly with cp: seq 128k on a 16-chip cp group costs
+each chip the activations of seq 8k.
+
+Smoke:
+    JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+        python train_llm.py -m llama-debug -d synthetic:200000 -s 256 -b 4 \
+        --context-parallel 4 --num-epochs 1 --log-freq 2 --max-steps 4
+"""
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).parent.parent))
+
+import jax
+
+from distributed_training_guide_tpu.launch import maybe_initialize_distributed
+from distributed_training_guide_tpu.launch.errors import record
+from distributed_training_guide_tpu.parallel import make_mesh, make_plan
+from distributed_training_guide_tpu.train.cli import get_parser, run_training
+
+
+@record
+def main():
+    parser = get_parser()
+    parser.add_argument("--context-parallel", type=int, default=None,
+                        help="cp size (default: all devices)")
+    parser.add_argument("--fsdp", type=int, default=1,
+                        help="fsdp size alongside cp")
+    args = parser.parse_args()
+    maybe_initialize_distributed()
+
+    def plan_factory():
+        cp = args.context_parallel or len(jax.devices())
+        strategy = "fsdp" if args.fsdp > 1 else "ddp"
+        return make_plan(strategy, make_mesh(cp=cp, fsdp=args.fsdp))
+
+    run_training(args, plan_factory)
+
+
+if __name__ == "__main__":
+    main()

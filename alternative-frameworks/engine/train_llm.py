@@ -1,0 +1,81 @@
+"""Config-driven engine training — counterpart of the reference's
+``alternative-frameworks/deepspeed/train_llm.py``.
+
+Where the reference hands the loop to ``deepspeed.initialize`` + engine
+backward/step driven by ``ds_config.json``, this uses the TPU-native
+``TrainingEngine`` (``train/engine.py``): same JSON-config surface, sharding
+stage mapped to a mesh plan, one fused step instead of backward()+step().
+
+Smoke:
+    JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+        python train_llm.py --config config.json -d synthetic:100000 \
+        -s 128 --max-steps 5
+"""
+import argparse
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).parent.parent.parent))
+
+from distributed_training_guide_tpu.data import get_tokenizer, load_and_preprocess_data
+from distributed_training_guide_tpu.data.loader import ShardedBatchLoader
+from distributed_training_guide_tpu.launch import maybe_initialize_distributed
+from distributed_training_guide_tpu.launch.errors import record
+from distributed_training_guide_tpu.train.engine import initialize
+from distributed_training_guide_tpu.utils import init_logging
+
+import jax
+import logging
+
+LOGGER = logging.getLogger(__name__)
+
+
+@record
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--config", default=str(Path(__file__).parent / "config.json"))
+    parser.add_argument("-d", "--dataset-name", default="synthetic")
+    parser.add_argument("--dataset-subset", default=None)
+    parser.add_argument("-s", "--seq-length", type=int, default=1024)
+    parser.add_argument("--max-steps", type=int, default=None)
+    parser.add_argument("--log-freq", type=int, default=10)
+    parser.add_argument("--save-dir", default=None)
+    parser.add_argument("--ckpt-freq", type=int, default=500)
+    args = parser.parse_args()
+
+    maybe_initialize_distributed()
+    init_logging(jax.process_index(), jax.process_count())
+
+    engine = initialize(args.config)
+    cfg = engine.trainer.bundle.config
+    seq = min(args.seq_length, cfg.max_position_embeddings)
+    tokenizer = get_tokenizer(engine.config["model"])
+    data = load_and_preprocess_data(args.dataset_name, tokenizer, seq,
+                                    dataset_subset=args.dataset_subset,
+                                    max_position_embeddings=cfg.max_position_embeddings)
+    loader = ShardedBatchLoader(
+        data, engine.global_batch_size,
+        engine.trainer.batch_shardings()["input_ids"],
+        grad_accum=engine.trainer.grad_accum)
+    LOGGER.info(f"engine: {engine.trainer.plan.strategy} on "
+                f"{dict(engine.trainer.plan.mesh.shape)}, "
+                f"global batch {engine.global_batch_size}")
+
+    t0 = time.perf_counter()
+    for step, batch in enumerate(loader.epoch_batches(), start=1):
+        metrics = engine.train_batch(batch)
+        if step % args.log_freq == 0:
+            dt = (time.perf_counter() - t0) / args.log_freq
+            LOGGER.info({"step": step, **metrics,
+                         "tokens_per_s": engine.global_batch_size * seq / dt})
+            t0 = time.perf_counter()
+        if args.save_dir and step % args.ckpt_freq == 0:
+            engine.save_checkpoint(args.save_dir)
+        if args.max_steps and step >= args.max_steps:
+            break
+    LOGGER.info("done")
+
+
+if __name__ == "__main__":
+    main()

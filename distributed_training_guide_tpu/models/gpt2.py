@@ -121,9 +121,12 @@ def _block(config: GPT2Config, x, layer, positions, attn_impl, standard_layout=T
     q = q.reshape(b, s, h, d)
     k = k.reshape(b, s, h, d)
     v = v.reshape(b, s, h, d)
-    attn = multihead_attention(q, k, v, causal=True, positions=positions,
-                               kv_positions=positions, impl=attn_impl,
-                               standard_layout=standard_layout)
+    if callable(attn_impl):  # e.g. ring attention under context parallelism
+        attn = attn_impl(q, k, v, standard_layout=standard_layout)
+    else:
+        attn = multihead_attention(q, k, v, causal=True, positions=positions,
+                                   kv_positions=positions, impl=attn_impl,
+                                   standard_layout=standard_layout)
     attn = attn.reshape(b, s, e) @ layer["attn"]["wo"].astype(cdt) + layer["attn"]["bo"].astype(cdt)
     x = x + attn
 

@@ -1,0 +1,234 @@
+"""Pretrained-weight logistics: HF safetensors -> stream-convert -> sharded load.
+
+The reference's 405B recipe needs a 764 GB download, a rank-0 full CPU state
+dict, and an NCCL broadcast to all ranks (``05-training-llama-405b/
+train_llm.py:74-146``, ``download.py``; init cost 50 min on a shared drive,
+``05/README.md:55``). The TPU-native pipeline removes both the full-RAM
+materialization and the broadcast:
+
+1. ``convert_hf_checkpoint`` streams tensor-by-tensor out of the safetensors
+   shards into one ``.npy`` memmap per parameter leaf (stacked [L, ...] layer
+   arrays are filled slice-by-slice), so peak host RAM is one tensor, not one
+   model. Run once, anywhere.
+2. ``load_pretrained`` memmaps each leaf and materializes it directly into
+   the training shardings via ``jax.make_array_from_callback`` — every host
+   reads only the bytes its devices own. No rank-0, no broadcast, no
+   all-buffer special case (the reference must hand-broadcast non-persistent
+   buffers, ``05:131-139``; we have no buffers outside the pytree).
+
+Name mapping covers the Llama and GPT-2 families (HF ``LlamaForCausalLM`` /
+``GPT2LMHeadModel`` conventions; torch Linear stores [out, in] so most leaves
+transpose, GPT-2's Conv1D stores [in, out] so they don't).
+"""
+from __future__ import annotations
+
+import json
+import logging
+import re
+from pathlib import Path
+from typing import Callable, Optional
+
+import numpy as np
+
+LOGGER = logging.getLogger(__name__)
+
+LEAF_SEP = "."
+
+
+# ---------------------------------------------------------------------------
+# family-specific name maps: HF tensor name -> (leaf_path, layer_idx|None, transpose)
+# ---------------------------------------------------------------------------
+
+def _map_llama(name: str):
+    name = name.removeprefix("model.")
+    m = re.match(r"layers\.(\d+)\.(.+)", name)
+    if m:
+        idx, rest = int(m.group(1)), m.group(2)
+        table = {
+            "self_attn.q_proj.weight": ("layers.attn.wq", True),
+            "self_attn.k_proj.weight": ("layers.attn.wk", True),
+            "self_attn.v_proj.weight": ("layers.attn.wv", True),
+            "self_attn.o_proj.weight": ("layers.attn.wo", True),
+            "mlp.gate_proj.weight": ("layers.mlp.gate", True),
+            "mlp.up_proj.weight": ("layers.mlp.up", True),
+            "mlp.down_proj.weight": ("layers.mlp.down", True),
+            "input_layernorm.weight": ("layers.input_norm", False),
+            "post_attention_layernorm.weight": ("layers.post_attn_norm", False),
+        }
+        if rest in table:
+            leaf, t = table[rest]
+            return leaf, idx, t
+        return None
+    table = {
+        "embed_tokens.weight": ("embed.embedding", False),
+        "norm.weight": ("final_norm", False),
+        "lm_head.weight": ("lm_head", True),
+    }
+    if name in table:
+        leaf, t = table[name]
+        return leaf, None, t
+    return None
+
+
+def _map_gpt2(name: str):
+    name = name.removeprefix("transformer.")
+    m = re.match(r"h\.(\d+)\.(.+)", name)
+    if m:
+        idx, rest = int(m.group(1)), m.group(2)
+        table = {  # Conv1D stores [in, out] -> no transpose
+            "ln_1.weight": ("layers.ln1.scale", False),
+            "ln_1.bias": ("layers.ln1.bias", False),
+            "attn.c_attn.weight": ("layers.attn.wqkv", False),
+            "attn.c_attn.bias": ("layers.attn.bqkv", False),
+            "attn.c_proj.weight": ("layers.attn.wo", False),
+            "attn.c_proj.bias": ("layers.attn.bo", False),
+            "ln_2.weight": ("layers.ln2.scale", False),
+            "ln_2.bias": ("layers.ln2.bias", False),
+            "mlp.c_fc.weight": ("layers.mlp.wi", False),
+            "mlp.c_fc.bias": ("layers.mlp.bi", False),
+            "mlp.c_proj.weight": ("layers.mlp.wo", False),
+            "mlp.c_proj.bias": ("layers.mlp.bo", False),
+        }
+        if rest in table:
+            leaf, t = table[rest]
+            return leaf, idx, t
+        return None
+    table = {
+        "wte.weight": ("wte", False),
+        "wpe.weight": ("wpe", False),
+        "ln_f.weight": ("lnf.scale", False),
+        "ln_f.bias": ("lnf.bias", False),
+    }
+    if name in table:
+        leaf, t = table[name]
+        return leaf, None, t
+    return None
+
+
+_FAMILY_MAPS: dict[str, Callable] = {"llama": _map_llama, "gpt2": _map_gpt2}
+
+
+# ---------------------------------------------------------------------------
+# conversion (streaming)
+# ---------------------------------------------------------------------------
+
+def _flatten_with_paths(tree, prefix=""):
+    out = {}
+    if isinstance(tree, dict):
+        for k, v in tree.items():
+            out.update(_flatten_with_paths(v, f"{prefix}{k}{LEAF_SEP}"))
+    else:
+        out[prefix.rstrip(LEAF_SEP)] = tree
+    return out
+
+
+def convert_hf_checkpoint(hf_dir: str | Path, out_dir: str | Path,
+                          model_name: Optional[str] = None, *, bundle=None,
+                          dtype: str = "float32") -> Path:
+    """Stream every safetensors shard in ``hf_dir`` into per-leaf ``.npy``
+    memmaps under ``out_dir``. Peak RAM = one tensor. Pass either a registry
+    ``model_name`` or an explicit ``bundle`` (for config overrides)."""
+    from safetensors import safe_open
+
+    from .registry import get_model
+
+    if bundle is None:
+        bundle = get_model(model_name)
+    model_name = model_name or bundle.name
+    mapper = _FAMILY_MAPS[bundle.family]
+    shapes = _flatten_with_paths(
+        __import__("jax").eval_shape(lambda: bundle.init(bundle.config,
+                                                         __import__("jax").random.key(0))))
+    hf_dir, out_dir = Path(hf_dir), Path(out_dir)
+    out_dir.mkdir(parents=True, exist_ok=True)
+
+    memmaps: dict[str, np.memmap] = {}
+
+    def leaf_mm(leaf: str) -> np.memmap:
+        if leaf not in memmaps:
+            shape = tuple(shapes[leaf].shape)
+            memmaps[leaf] = np.lib.format.open_memmap(
+                out_dir / f"{leaf}.npy", mode="w+", dtype=np.dtype(dtype), shape=shape)
+        return memmaps[leaf]
+
+    seen = set()
+    files = sorted(hf_dir.glob("*.safetensors"))
+    if not files:
+        raise FileNotFoundError(f"no .safetensors files in {hf_dir}")
+    for f in files:
+        with safe_open(str(f), framework="numpy") as sf:
+            for name in sf.keys():
+                mapped = mapper(name)
+                if mapped is None:
+                    LOGGER.info(f"skipping unmapped tensor {name}")
+                    continue
+                leaf, layer, transpose = mapped
+                if leaf not in shapes:
+                    continue  # e.g. lm_head when tied
+                tensor = sf.get_tensor(name)
+                if tensor.dtype == np.dtype("uint16"):  # bf16 via numpy view
+                    tensor = _bf16_to_f32(tensor)
+                if transpose:
+                    tensor = tensor.T
+                mm = leaf_mm(leaf)
+                if layer is None:
+                    mm[...] = tensor.astype(mm.dtype)
+                else:
+                    mm[layer] = tensor.astype(mm.dtype)
+                seen.add((leaf, layer))
+                del tensor
+    for mm in memmaps.values():
+        mm.flush()
+    with open(out_dir / "manifest.json", "w") as fp:
+        json.dump({"model_name": model_name, "dtype": dtype,
+                   "leaves": sorted(memmaps)}, fp, indent=2)
+    LOGGER.info(f"converted {len(seen)} tensors -> {out_dir}")
+    return out_dir
+
+
+def _bf16_to_f32(arr: np.ndarray) -> np.ndarray:
+    out = np.zeros(arr.shape, dtype=np.uint32)
+    out[...] = arr.astype(np.uint32) << 16
+    return out.view(np.float32)
+
+
+# ---------------------------------------------------------------------------
+# sharded load
+# ---------------------------------------------------------------------------
+
+def load_pretrained(bundle, param_shardings, out_dir: str | Path,
+                    param_dtype: Optional[str] = None):
+    """Materialize a converted checkpoint directly into ``param_shardings``.
+
+    Each host/device reads only its shard's slice of the leaf memmap."""
+    import jax
+
+    out_dir = Path(out_dir)
+    shapes = _flatten_with_paths(
+        jax.eval_shape(lambda: bundle.init(bundle.config, jax.random.key(0))))
+    flat_shardings = _flatten_with_paths(param_shardings)
+
+    leaves = {}
+    for leaf, sd in shapes.items():
+        path = out_dir / f"{leaf}.npy"
+        if not path.exists():
+            raise FileNotFoundError(f"missing converted leaf {path}")
+        mm = np.load(path, mmap_mode="r")
+        if tuple(mm.shape) != tuple(sd.shape):
+            raise ValueError(f"{leaf}: converted shape {mm.shape} != model {sd.shape}")
+        dtype = np.dtype(param_dtype) if param_dtype else sd.dtype
+        leaves[leaf] = jax.make_array_from_callback(
+            tuple(sd.shape), flat_shardings[leaf],
+            lambda idx, mm=mm, dtype=dtype: np.asarray(mm[idx], dtype=dtype))
+
+    def unflatten(flat):
+        tree: dict = {}
+        for path, v in flat.items():
+            parts = path.split(LEAF_SEP)
+            node = tree
+            for p in parts[:-1]:
+                node = node.setdefault(p, {})
+            node[parts[-1]] = v
+        return tree
+
+    return unflatten(leaves)

@@ -154,9 +154,12 @@ def _block(config: LlamaConfig, x: jnp.ndarray, layer: dict,
     v = (h @ layer["attn"]["wv"].astype(cdt)).reshape(b, s, config.num_kv_heads, d)
     q = apply_rope(q, positions, config.rope_theta)
     k = apply_rope(k, positions, config.rope_theta)
-    attn = multihead_attention(q, k, v, causal=True, positions=positions,
-                               kv_positions=positions, impl=attn_impl,
-                               standard_layout=standard_layout)
+    if callable(attn_impl):  # e.g. ring attention under context parallelism
+        attn = attn_impl(q, k, v, standard_layout=standard_layout)
+    else:
+        attn = multihead_attention(q, k, v, causal=True, positions=positions,
+                                   kv_positions=positions, impl=attn_impl,
+                                   standard_layout=standard_layout)
     attn = attn.reshape(b, s, config.num_heads * d) @ layer["attn"]["wo"].astype(cdt)
     x = constrain(x + attn)
 

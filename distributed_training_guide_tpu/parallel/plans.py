@@ -130,6 +130,10 @@ class ShardingPlan:
         tokens; XLA inserts the same all-gather before attention/mlp and
         reduce-scatter after that DTensor does.
         """
+        if self.mesh.shape["cp"] > 1:
+            # context parallelism: seq dim lives on cp everywhere; attention
+            # crosses shards via the ring (ops/ring_attention.py)
+            return NamedSharding(self.mesh, P(self.data_axes, "cp", None))
         if self.sequence_sharded and self.mesh.shape["tp"] > 1:
             return NamedSharding(self.mesh, P(self.data_axes, "tp", None))
         if self.strategy == "single":

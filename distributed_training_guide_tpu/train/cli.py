@@ -55,7 +55,9 @@ def get_parser() -> argparse.ArgumentParser:
     return parser
 
 
-def run_training(args, plan_factory: Callable, *, extra_log: Optional[dict] = None) -> dict:
+def run_training(args, plan_factory: Callable, *, extra_log: Optional[dict] = None,
+                 pretrained_dir: Optional[str] = None,
+                 offload_opt_state: bool = False) -> dict:
     """The chapter-invariant training loop. Returns final metrics (for tests).
 
     ``plan_factory() -> ShardingPlan`` is the one thing chapters customize.
@@ -94,6 +96,7 @@ def run_training(args, plan_factory: Callable, *, extra_log: Optional[dict] = No
         grad_accum=args.grad_accum,
         remat=args.checkpoint_activations,
         attn_impl=args.attn_impl,
+        offload_opt_state=offload_opt_state,
     )
 
     global_batch = args.batch_size * plan.data_parallel_size * args.grad_accum
@@ -117,6 +120,14 @@ def run_training(args, plan_factory: Callable, *, extra_log: Optional[dict] = No
     if io is not None and io.can_resume():
         state, host_state = io.restore(abstract_train_state(trainer))
         LOGGER.info(f"Resumed=True | {host_state}")
+    elif pretrained_dir:
+        from ..models.hf_convert import load_pretrained
+
+        LOGGER.info(f"Loading pretrained weights from {pretrained_dir}")
+        params = load_pretrained(bundle, trainer.param_shardings, pretrained_dir)
+        state = trainer.init_state_from_params(params, args.seed)
+        if is_experiment:
+            LOGGER.info(f"Resumed=False | {host_state}")
     else:
         state = trainer.init_state(args.seed)
         if is_experiment:

@@ -88,10 +88,18 @@ class Trainer:
     attn_impl: str = "auto"
     loss_fn: Callable = causal_lm_loss
     donate: bool = True
+    offload_opt_state: bool = False
 
     def __post_init__(self):
         if self.plan is None:
             self.plan = make_plan("single", make_mesh(devices=jax.devices()[:1]))
+        if self.offload_opt_state and jax.default_backend() != "tpu":
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "offload_opt_state requires a TPU backend with pinned_host "
+                "memory; keeping optimizer state on device")
+            self.offload_opt_state = False
 
     # ---- shapes & shardings ------------------------------------------------
     @cached_property
@@ -109,11 +117,17 @@ class Trainer:
     @cached_property
     def state_shardings(self) -> TrainState:
         opt_shapes = jax.eval_shape(self.optimizer.init, self.param_shapes)
+        opt_sh = _opt_state_shardings(self.plan, opt_shapes, self.logical_axes,
+                                      self.param_shapes)
+        if self.offload_opt_state:
+            # reference C5 (CPUOffloadPolicy, 04:85 / 05:69-72): Adam moments
+            # live in pinned host memory; XLA streams them in/out around the
+            # (fused) update.
+            opt_sh = jax.tree.map(lambda s: s.with_memory_kind("pinned_host"), opt_sh)
         return TrainState(
             step=NamedSharding(self.plan.mesh, P()),
             params=self.param_shardings,
-            opt_state=_opt_state_shardings(self.plan, opt_shapes, self.logical_axes,
-                                           self.param_shapes),
+            opt_state=opt_sh,
             rng=NamedSharding(self.plan.mesh, P()),
         )
 
@@ -128,22 +142,38 @@ class Trainer:
         return {"input_ids": sharding, "labels": sharding}
 
     # ---- init --------------------------------------------------------------
+    def _fresh_state(self, params, train_rng) -> TrainState:
+        """The single definition of a step-0 TrainState (shared by random init
+        and pretrained load, so the two paths can't drift)."""
+        return TrainState(step=jnp.zeros((), jnp.int32), params=params,
+                          opt_state=self.optimizer.init(params),
+                          rng=jax.random.key_data(train_rng))
+
     @cached_property
     def init_state(self) -> Callable[[jax.Array], TrainState]:
-        """Returns jitted (rng) -> TrainState, materialized *sharded* — big
+        """Returns jitted (seed) -> TrainState, materialized *sharded* — big
         models never exist unsharded anywhere (the reference needs meta-device
         init + per-rank materialization for this, ``04:76-95``)."""
 
         def make(seed):
             init_rng, train_rng = jax.random.split(jax.random.key(seed))
             params = self.bundle.init(self.bundle.config, init_rng)
-            opt_state = self.optimizer.init(params)
-            return TrainState(step=jnp.zeros((), jnp.int32), params=params,
-                              opt_state=opt_state,
-                              rng=jax.random.key_data(train_rng))
+            return self._fresh_state(params, train_rng)
 
         jitted = jax.jit(make, out_shardings=self.state_shardings)
         return lambda seed: jitted(jnp.asarray(seed, jnp.uint32))
+
+    def init_state_from_params(self, params, seed: int = 0) -> TrainState:
+        """Fresh optimizer state around externally-loaded (pretrained) params
+        — the reference's set_model_state_dict path (``05:118-126``)."""
+
+        def make(params, seed):
+            _, train_rng = jax.random.split(jax.random.key(seed))
+            return self._fresh_state(params, train_rng)
+
+        jitted = jax.jit(make, in_shardings=(self.param_shardings, None),
+                         out_shardings=self.state_shardings)
+        return jitted(params, jnp.asarray(seed, jnp.uint32))
 
     # ---- the step ----------------------------------------------------------
     @cached_property
@@ -152,10 +182,16 @@ class Trainer:
         apply = self.bundle.apply
         act_sharding = self.plan.activation_sharding()
 
+        attn_impl = self.attn_impl
+        if self.plan.mesh.shape["cp"] > 1 and not callable(attn_impl):
+            from ..ops.ring_attention import make_ring_attention
+
+            attn_impl = make_ring_attention(self.plan.mesh)
+
         def loss_on_microbatch(params, mb):
             logits = apply(cfg, params, mb["input_ids"],
                            positions=mb.get("positions"),
-                           remat=self.remat, attn_impl=self.attn_impl,
+                           remat=self.remat, attn_impl=attn_impl,
                            activation_sharding=act_sharding)
             return self.loss_fn(logits, mb["labels"])
 

@@ -1,0 +1,78 @@
+"""HF numerics parity: convert a real HF torch checkpoint, compare logits.
+
+This is the "matching HF model numerics in JAX" hard part (SURVEY.md section 7):
+build a tiny ``LlamaForCausalLM`` / ``GPT2LMHeadModel`` with torch (CPU),
+``save_pretrained`` to safetensors, stream-convert with
+``convert_hf_checkpoint``, load via the sharded loader, and require our
+pure-JAX forward to match torch's logits.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+transformers = pytest.importorskip("transformers")
+
+from distributed_training_guide_tpu.models import get_model
+from distributed_training_guide_tpu.models.hf_convert import (
+    convert_hf_checkpoint, load_pretrained)
+from distributed_training_guide_tpu.parallel import make_mesh, make_plan
+
+
+def _replicated_shardings(bundle, plan):
+    shapes = jax.eval_shape(lambda: bundle.init(bundle.config, jax.random.key(0)))
+    return plan.param_shardings(bundle.param_logical_axes(bundle.config), shapes)
+
+
+def test_llama_parity(tmp_path):
+    hf_cfg = transformers.LlamaConfig(
+        vocab_size=128, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=256, rope_theta=10000.0, rms_norm_eps=1e-5,
+        tie_word_embeddings=False)
+    torch.manual_seed(0)
+    model = transformers.LlamaForCausalLM(hf_cfg).eval()
+    model.save_pretrained(tmp_path / "hf", safe_serialization=True)
+
+    bundle = get_model("llama-debug", vocab_size=128, dtype=jnp.float32)
+    convert_hf_checkpoint(tmp_path / "hf", tmp_path / "conv", bundle=bundle)
+    plan = make_plan("single", make_mesh(devices=jax.devices()[:1]))
+    params = load_pretrained(bundle, _replicated_shardings(bundle, plan), tmp_path / "conv")
+
+    ids = np.random.RandomState(0).randint(0, 128, (2, 24))
+    ours = np.asarray(bundle.apply(bundle.config, params, jnp.asarray(ids)))
+    with torch.no_grad():
+        theirs = model(torch.tensor(ids)).logits.float().numpy()
+    np.testing.assert_allclose(ours, theirs, rtol=2e-4, atol=2e-4)
+
+    # pretrained params -> fresh TrainState -> one step (reference 05:118-126 path)
+    from distributed_training_guide_tpu.train import Trainer, adamw_cosine
+
+    trainer = Trainer(bundle=bundle, optimizer=adamw_cosine(1e-4), plan=plan,
+                      donate=False)
+    state = trainer.init_state_from_params(params)
+    batch = {k: jax.device_put(jnp.asarray(ids), trainer.batch_shardings()[k])
+             for k in ("input_ids", "labels")}
+    _, metrics = trainer.step_fn(state, batch)
+    assert np.isfinite(float(metrics["loss"]))
+
+
+def test_gpt2_parity(tmp_path):
+    hf_cfg = transformers.GPT2Config(
+        vocab_size=160, n_embd=64, n_layer=2, n_head=4, n_positions=128)
+    torch.manual_seed(0)
+    model = transformers.GPT2LMHeadModel(hf_cfg).eval()
+    model.save_pretrained(tmp_path / "hf", safe_serialization=True)
+
+    bundle = get_model("gpt2-debug", vocab_size=160, max_position_embeddings=128,
+                       dtype=jnp.float32)
+    convert_hf_checkpoint(tmp_path / "hf", tmp_path / "conv", bundle=bundle)
+    plan = make_plan("single", make_mesh(devices=jax.devices()[:1]))
+    params = load_pretrained(bundle, _replicated_shardings(bundle, plan), tmp_path / "conv")
+
+    ids = np.random.RandomState(0).randint(0, 160, (2, 24))
+    ours = np.asarray(bundle.apply(bundle.config, params, jnp.asarray(ids)))
+    with torch.no_grad():
+        theirs = model(torch.tensor(ids)).logits.float().numpy()
+    np.testing.assert_allclose(ours, theirs, rtol=2e-4, atol=2e-4)
