@@ -51,6 +51,8 @@ def main():
                         help="directory produced by convert_llama.py")
     parser.add_argument("--offload-opt-state", action="store_true",
                         help="Adam state in pinned host memory (reference 05:69-72)")
+    parser.add_argument("--no-checkpoint-activations", dest="checkpoint_activations",
+                        action="store_false")
     parser.set_defaults(checkpoint_activations=True)
     args = parser.parse_args()
     maybe_initialize_distributed()

@@ -45,7 +45,7 @@ def main():
     maybe_initialize_distributed()
 
     def plan_factory():
-        cp = args.context_parallel or len(jax.devices())
+        cp = args.context_parallel or len(jax.devices()) // args.fsdp
         strategy = "fsdp" if args.fsdp > 1 else "ddp"
         return make_plan(strategy, make_mesh(cp=cp, fsdp=args.fsdp))
 

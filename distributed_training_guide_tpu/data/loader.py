@@ -36,6 +36,7 @@ class ShardedBatchLoader:
         seed: int = 0,
         shuffle: bool = True,
         prefetch: int = 2,
+        native: bool = False,
     ):
         if global_batch_size % max(grad_accum, 1) != 0:
             raise ValueError("global_batch_size must be divisible by grad_accum")
@@ -47,6 +48,16 @@ class ShardedBatchLoader:
         self.shuffle = shuffle
         self.prefetch = prefetch
         self.epoch = 0
+        self._native = None
+        self._native_path = None
+        if native:
+            if not shuffle:
+                import logging
+
+                logging.getLogger(__name__).warning(
+                    "native loader has no unshuffled mode; using python assembly")
+            else:
+                self._native = self._make_native()
 
     def set_epoch(self, epoch: int) -> None:
         self.epoch = epoch
@@ -67,9 +78,59 @@ class ShardedBatchLoader:
         return jax.make_array_from_callback(
             np_batch.shape, self.sharding, lambda idx: np_batch[idx])
 
+    def _make_native(self):
+        """Back batch assembly with the C++ loader (csrc/token_loader.cpp):
+        mmap + worker threads + bounded prefetch, no GIL."""
+        import tempfile
+
+        from .native_loader import NativeTokenLoader, native_available, write_token_file
+
+        if not native_available():
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "native loader unavailable (no g++); using python assembly")
+            return None
+        tmp = tempfile.NamedTemporaryFile(suffix=".tokens.bin", delete=False)
+        self._native_path = tmp.name
+        write_token_file(self.dataset, tmp.name)
+        return NativeTokenLoader(tmp.name, seq_len=self.dataset.shape[1],
+                                 batch=self.global_batch_size, seed=self.seed,
+                                 prefetch=max(self.prefetch, 2))
+
+    def close(self) -> None:
+        if self._native is not None:
+            self._native.close()
+            self._native = None
+        if self._native_path is not None:
+            import os
+
+            try:
+                os.unlink(self._native_path)
+            except OSError:
+                pass
+            self._native_path = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
     def epoch_batches(self, start_step: int = 0) -> Iterator[dict]:
         """Yields {'input_ids', 'labels'} global jax.Arrays; skips the first
         ``start_step`` batches while preserving data order (resume)."""
+        if self._native is not None:
+            # same pending-queue H2D overlap as the python path, on top of the
+            # C++ assembly prefetch
+            pending: list[dict] = []
+            for np_batch in self._native.epoch_batches(self.epoch, start_step):
+                ids = self._make_global_array(np_batch)
+                pending.append({"input_ids": ids, "labels": ids})
+                if len(pending) > self.prefetch:
+                    yield pending.pop(0)
+            yield from pending
+            return
         order = self._epoch_order()
         n = len(self)
         pending: list[dict] = []

@@ -1,0 +1,125 @@
+"""ctypes bindings + on-demand build for the C++ token loader (csrc/).
+
+The reference's data path gets its native speed from torch's C++ DataLoader
+workers; this is our equivalent: ``csrc/token_loader.cpp`` mmaps a flat int32
+token file and assembles shuffled batches on C++ threads (no GIL), with a
+bounded prefetch queue. The Python side stays a thin iterator.
+
+The shared library is compiled once with g++ on first use and cached next to
+the source. Anything without a toolchain falls back to the pure-Python loader
+(``data/loader.py``) — same semantics, different shuffle order.
+"""
+from __future__ import annotations
+
+import ctypes
+import logging
+import subprocess
+import tempfile
+from pathlib import Path
+from typing import Iterator, Optional
+
+import numpy as np
+
+LOGGER = logging.getLogger(__name__)
+
+_CSRC = Path(__file__).parent.parent / "csrc"
+_LIB: Optional[ctypes.CDLL] = None
+_BUILD_FAILED = False
+
+
+def _build_library() -> Optional[Path]:
+    src = _CSRC / "token_loader.cpp"
+    out = _CSRC / "libtokenloader.so"
+    if out.exists() and out.stat().st_mtime >= src.stat().st_mtime:
+        return out
+    cmd = ["g++", "-O3", "-shared", "-fPIC", "-std=c++17",
+           "-o", str(out), str(src), "-lpthread"]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+        return out
+    except (OSError, subprocess.SubprocessError) as e:
+        LOGGER.warning(f"native loader build failed ({e}); using python loader")
+        return None
+
+
+def get_library() -> Optional[ctypes.CDLL]:
+    global _LIB, _BUILD_FAILED
+    if _LIB is not None or _BUILD_FAILED:
+        return _LIB
+    path = _build_library()
+    if path is None:
+        _BUILD_FAILED = True
+        return None
+    lib = ctypes.CDLL(str(path))
+    lib.tl_open.restype = ctypes.c_void_p
+    lib.tl_open.argtypes = [ctypes.c_char_p, ctypes.c_int64, ctypes.c_int64,
+                            ctypes.c_uint64, ctypes.c_int, ctypes.c_int]
+    lib.tl_num_batches.restype = ctypes.c_int64
+    lib.tl_num_batches.argtypes = [ctypes.c_void_p]
+    lib.tl_num_sequences.restype = ctypes.c_int64
+    lib.tl_num_sequences.argtypes = [ctypes.c_void_p]
+    lib.tl_start_epoch.argtypes = [ctypes.c_void_p, ctypes.c_int64, ctypes.c_int64]
+    lib.tl_next_batch.restype = ctypes.c_int
+    lib.tl_next_batch.argtypes = [ctypes.c_void_p,
+                                  ctypes.POINTER(ctypes.c_int32)]
+    lib.tl_close.argtypes = [ctypes.c_void_p]
+    _LIB = lib
+    return lib
+
+
+def native_available() -> bool:
+    return get_library() is not None
+
+
+def write_token_file(dataset: np.ndarray, path: str | Path) -> Path:
+    """Flat int32 token file — the native loader's (and mmap-friendly) format."""
+    path = Path(path)
+    np.ascontiguousarray(dataset, dtype=np.int32).tofile(path)
+    return path
+
+
+class NativeTokenLoader:
+    """Iterator over [batch, seq_len] int32 batches assembled in C++.
+
+    Deterministic per (seed, epoch); supports resume via ``start_step`` like
+    the python loader (the two use different shuffle orders — pick one backend
+    per experiment).
+    """
+
+    def __init__(self, token_file: str | Path, seq_len: int, batch: int,
+                 seed: int = 0, threads: int = 2, prefetch: int = 4):
+        lib = get_library()
+        if lib is None:
+            raise RuntimeError("native loader unavailable (no g++?)")
+        self._lib = lib
+        self._handle = lib.tl_open(str(token_file).encode(), seq_len, batch,
+                                   seed, threads, prefetch)
+        if not self._handle:
+            raise OSError(f"tl_open failed for {token_file}")
+        self.seq_len = seq_len
+        self.batch = batch
+
+    def __len__(self) -> int:
+        return self._lib.tl_num_batches(self._handle)
+
+    @property
+    def num_sequences(self) -> int:
+        return self._lib.tl_num_sequences(self._handle)
+
+    def epoch_batches(self, epoch: int = 0, start_step: int = 0) -> Iterator[np.ndarray]:
+        self._lib.tl_start_epoch(self._handle, epoch, start_step)
+        out = np.empty((self.batch, self.seq_len), dtype=np.int32)
+        ptr = out.ctypes.data_as(ctypes.POINTER(ctypes.c_int32))
+        while self._lib.tl_next_batch(self._handle, ptr):
+            yield out.copy()
+
+    def close(self) -> None:
+        if self._handle:
+            self._lib.tl_close(self._handle)
+            self._handle = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass

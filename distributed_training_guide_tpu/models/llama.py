@@ -229,6 +229,11 @@ PRESETS = {
                                max_position_embeddings=256),
     "tinyllama-1.1b": LlamaConfig(vocab_size=32000, hidden_size=2048, intermediate_size=5632,
                                   num_layers=22, num_heads=32, num_kv_heads=4),
+    # single-chip benchmark config: ~650M params, head_dim 128 (MXU/flash
+    # friendly), fits params+Adam in fp32 on a 16 GB chip at seq 2048
+    "llama-650m": LlamaConfig(vocab_size=32000, hidden_size=1536, intermediate_size=6144,
+                              num_layers=16, num_heads=12, num_kv_heads=4,
+                              max_position_embeddings=4096),
     "llama-3.2-1b": LlamaConfig(vocab_size=128256, hidden_size=2048, intermediate_size=8192,
                                 num_layers=16, num_heads=32, num_kv_heads=8,
                                 rope_theta=500000.0, max_position_embeddings=8192,

@@ -100,6 +100,7 @@ class ShardingPlan:
     strategy: str
     rules: dict
     sequence_sharded: bool = False  # SP: shard the seq dim of activations on tp
+    zero1: bool = False             # shard optimizer state over the data axes
 
     # ---- batch / data ------------------------------------------------------
     @property
@@ -152,18 +153,21 @@ class ShardingPlan:
 
     def optimizer_state_rules(self) -> dict:
         """Rules for optimizer-state leaves (adds ZeRO-1 on top of params)."""
-        if self.strategy in ("zero1", "ddp"):
-            return {**self.rules, **(ZERO1_RULES if self.strategy == "zero1" else {})}
+        if self.zero1:
+            return {**self.rules, **ZERO1_RULES}
         return self.rules
 
     def replicated(self) -> NamedSharding:
         return NamedSharding(self.mesh, P())
 
 
-def make_plan(strategy: str, mesh: Mesh, *, sequence_sharded: Optional[bool] = None) -> ShardingPlan:
+def make_plan(strategy: str, mesh: Mesh, *, sequence_sharded: Optional[bool] = None,
+              zero1: Optional[bool] = None) -> ShardingPlan:
     if strategy not in STRATEGIES:
         raise ValueError(f"unknown strategy {strategy!r}; choose from {sorted(STRATEGIES)}")
     if sequence_sharded is None:
         sequence_sharded = strategy in ("tp", "tp_fsdp")
+    if zero1 is None:
+        zero1 = strategy == "zero1"
     return ShardingPlan(mesh=mesh, strategy=strategy, rules=STRATEGIES[strategy],
-                        sequence_sharded=sequence_sharded)
+                        sequence_sharded=sequence_sharded, zero1=zero1)

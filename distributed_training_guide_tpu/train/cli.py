@@ -52,6 +52,8 @@ def get_parser() -> argparse.ArgumentParser:
                         help="remat decoder layers (reference 05:163-178)")
     parser.add_argument("--attn-impl", default="auto", choices=["auto", "xla", "flash"])
     parser.add_argument("--max-steps", default=None, type=int)
+    parser.add_argument("--native-loader", action="store_true",
+                        help="assemble batches with the C++ mmap/prefetch loader (csrc/)")
     return parser
 
 
@@ -103,7 +105,8 @@ def run_training(args, plan_factory: Callable, *, extra_log: Optional[dict] = No
     loader = ShardedBatchLoader(
         dataset, global_batch,
         trainer.batch_shardings()["input_ids"],
-        grad_accum=args.grad_accum, seed=args.seed)
+        grad_accum=args.grad_accum, seed=args.seed,
+        native=getattr(args, "native_loader", False))
     steps_per_epoch = len(loader)
     if args.steps_per_epoch:
         steps_per_epoch = min(steps_per_epoch, args.steps_per_epoch)

@@ -71,7 +71,9 @@ class TrainingEngine:
             mesh = make_mesh(tp=tp)
         else:
             mesh = make_mesh()
-        plan = make_plan(strategy, mesh)
+        # ZeRO-1's optimizer-state sharding is orthogonal to tp: keep it when
+        # stage 1 is combined with tensor_parallel
+        plan = make_plan(strategy, mesh, zero1=(stage == 1))
 
         opt_cfg = config.get("optimizer", {}).get("params", {})
         sched = config.get("scheduler", {})
@@ -111,12 +113,6 @@ class TrainingEngine:
         """fwd + bwd + optimizer step (= model_engine.backward + step)."""
         self.state, metrics = self.trainer.step_fn(self.state, batch)
         return {k: float(v) for k, v in metrics.items()}
-
-    def place_batch(self, np_batch: np.ndarray) -> dict:
-        sh = self.trainer.batch_shardings()["input_ids"]
-        arr = jax.make_array_from_callback(np_batch.shape, sh,
-                                           lambda idx: np_batch[idx])
-        return {"input_ids": arr, "labels": arr}
 
     def save_checkpoint(self, save_dir: str | Path, tag: Optional[str] = None) -> None:
         from ..checkpoint import CheckpointIO
