@@ -1,0 +1,58 @@
+"""Chapter 9 — pipeline parallelism (beyond the reference).
+
+The reference mentions pipeline parallelism only as Llama-405B-paper context
+(``06-tensor-parallel/README.md:8``); this chapter implements it. The stacked
+layer dim of every per-layer weight is sharded over the ``pp`` mesh axis —
+stage s owns layers [s*L/pp, (s+1)*L/pp) — and the step runs a GPipe
+fill/drain schedule under a partial-manual shard_map: activations hop between
+neighbor stages via ``ppermute`` (one ICI hop), microbatches stream through,
+and the loss psums from the last stage (``parallel/pipeline.py``).
+
+Composition today: pp alone, pp x dp, pp x fsdp (2-D); pp x tp needs a pure
+pp x tp submesh (XLA partitioner limitation, see pipeline.py). Bubble overhead
+is (pp-1)/(M+pp-1) for M microbatches — default M = 2*pp.
+
+When to reach for pp instead of fsdp: layers that no longer fit even sharded
+(very deep models), DCN-connected slices where fsdp's per-layer all-gathers
+are too slow but pp's point-to-point activation traffic is cheap, or tiny
+per-chip batches where fsdp gather volume dominates.
+
+Smoke:
+    JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+        python train_llm.py -m llama-debug -d synthetic:200000 -s 128 -b 1 \
+        --pipeline-parallel 2 --pp-microbatches 4 --num-epochs 1 \
+        --log-freq 2 --max-steps 4
+"""
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).parent.parent))
+
+import jax
+
+from distributed_training_guide_tpu.launch import maybe_initialize_distributed
+from distributed_training_guide_tpu.launch.errors import record
+from distributed_training_guide_tpu.parallel import make_mesh, make_plan
+from distributed_training_guide_tpu.train.cli import get_parser, run_training
+
+
+@record
+def main():
+    parser = get_parser()
+    parser.add_argument("--pipeline-parallel", type=int, default=2)
+    parser.add_argument("--pp-microbatches", type=int, default=None)
+    parser.add_argument("--fsdp", type=int, default=1,
+                        help="fsdp size alongside pp (2-D pp x fsdp)")
+    args = parser.parse_args()
+    maybe_initialize_distributed()
+
+    def plan_factory():
+        strategy = "pp_fsdp" if args.fsdp > 1 else "pp"
+        return make_plan(strategy,
+                         make_mesh(pp=args.pipeline_parallel, fsdp=args.fsdp))
+
+    run_training(args, plan_factory, pp_microbatches=args.pp_microbatches)
+
+
+if __name__ == "__main__":
+    main()

@@ -13,7 +13,8 @@
 //
 // C ABI for ctypes (see ../data/native_loader.py). Single-consumer.
 //
-// Build: g++ -O3 -march=native -shared -fPIC -o libtokenloader.so token_loader.cpp -lpthread
+// Build (done on demand by ../data/native_loader.py):
+//   g++ -O3 -shared -fPIC -std=c++17 -o libtokenloader.so token_loader.cpp -lpthread
 
 #include <atomic>
 #include <condition_variable>

@@ -92,6 +92,7 @@ class ShardedBatchLoader:
                 "native loader unavailable (no g++); using python assembly")
             return None
         tmp = tempfile.NamedTemporaryFile(suffix=".tokens.bin", delete=False)
+        tmp.close()  # the C++ side reopens by path; don't leak the fd
         self._native_path = tmp.name
         write_token_file(self.dataset, tmp.name)
         return NativeTokenLoader(tmp.name, seq_len=self.dataset.shape[1],

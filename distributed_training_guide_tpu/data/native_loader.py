@@ -27,10 +27,10 @@ _LIB: Optional[ctypes.CDLL] = None
 _BUILD_FAILED = False
 
 
-def _build_library() -> Optional[Path]:
+def _build_library(force: bool = False) -> Optional[Path]:
     src = _CSRC / "token_loader.cpp"
     out = _CSRC / "libtokenloader.so"
-    if out.exists() and out.stat().st_mtime >= src.stat().st_mtime:
+    if not force and out.exists() and out.stat().st_mtime > src.stat().st_mtime:
         return out
     cmd = ["g++", "-O3", "-shared", "-fPIC", "-std=c++17",
            "-o", str(out), str(src), "-lpthread"]
@@ -50,7 +50,19 @@ def get_library() -> Optional[ctypes.CDLL]:
     if path is None:
         _BUILD_FAILED = True
         return None
-    lib = ctypes.CDLL(str(path))
+    try:
+        lib = ctypes.CDLL(str(path))
+    except OSError:
+        # stale/foreign binary (e.g. different arch) — rebuild once, then
+        # fall back to the python loader
+        path = _build_library(force=True)
+        try:
+            lib = ctypes.CDLL(str(path)) if path else None
+        except OSError:
+            lib = None
+        if lib is None:
+            _BUILD_FAILED = True
+            return None
     lib.tl_open.restype = ctypes.c_void_p
     lib.tl_open.argtypes = [ctypes.c_char_p, ctypes.c_int64, ctypes.c_int64,
                             ctypes.c_uint64, ctypes.c_int, ctypes.c_int]

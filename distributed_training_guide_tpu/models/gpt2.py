@@ -138,6 +138,21 @@ def _block(config: GPT2Config, x, layer, positions, attn_impl, standard_layout=T
     return x + y
 
 
+def embed_tokens(config: GPT2Config, params: dict, input_ids: jnp.ndarray,
+                 positions: jnp.ndarray) -> jnp.ndarray:
+    """Token + learned-position embedding (pipeline stage-0 entry)."""
+    tok = jnp.take(params["wte"], input_ids, axis=0)
+    pos = jnp.take(params["wpe"], positions, axis=0)
+    return (tok + pos).astype(config.dtype)
+
+
+def lm_head_logits(config: GPT2Config, params: dict, x: jnp.ndarray) -> jnp.ndarray:
+    """Final LN + tied output projection (pipeline last-stage exit)."""
+    x = _layernorm(x, params["lnf"], config.layer_norm_eps)
+    return jnp.dot(x, params["wte"].T.astype(config.dtype),
+                   preferred_element_type=jnp.float32)
+
+
 def apply(
     config: GPT2Config,
     params: dict,
@@ -155,9 +170,7 @@ def apply(
         positions = jnp.arange(input_ids.shape[1])[None, :]
     positions = jnp.broadcast_to(positions, input_ids.shape)
 
-    tok = jnp.take(params["wte"], input_ids, axis=0)
-    pos = jnp.take(params["wpe"], positions, axis=0)
-    x = (tok + pos).astype(config.dtype)
+    x = embed_tokens(config, params, input_ids, positions)
 
     block = partial(_block, config, positions=positions, attn_impl=attn_impl,
                     standard_layout=standard_layout)
@@ -170,9 +183,7 @@ def apply(
         scan_body = jax.checkpoint(scan_body, policy=policy, prevent_cse=False)
 
     x, _ = jax.lax.scan(scan_body, x, params["layers"])
-    x = _layernorm(x, params["lnf"], config.layer_norm_eps)
-    return jnp.dot(x, params["wte"].T.astype(config.dtype),
-                   preferred_element_type=jnp.float32)
+    return lm_head_logits(config, params, x)
 
 
 PRESETS = {

@@ -170,6 +170,23 @@ def _block(config: LlamaConfig, x: jnp.ndarray, layer: dict,
     return constrain(x + down)
 
 
+def embed_tokens(config: LlamaConfig, params: dict, input_ids: jnp.ndarray,
+                 positions: jnp.ndarray) -> jnp.ndarray:
+    """Embedding sub-forward (pipeline stage-0 entry)."""
+    del positions  # rope is applied inside blocks
+    return jnp.take(params["embed"]["embedding"], input_ids, axis=0).astype(config.dtype)
+
+
+def lm_head_logits(config: LlamaConfig, params: dict, x: jnp.ndarray) -> jnp.ndarray:
+    """Final norm + output projection (pipeline last-stage exit)."""
+    x = _rmsnorm(x, params["final_norm"], config.rms_norm_eps)
+    if config.tie_word_embeddings:
+        w_out = params["embed"]["embedding"].T
+    else:
+        w_out = params["lm_head"]
+    return jnp.dot(x, w_out.astype(config.dtype), preferred_element_type=jnp.float32)
+
+
 def apply(
     config: LlamaConfig,
     params: dict,
@@ -194,7 +211,7 @@ def apply(
         positions = jnp.arange(input_ids.shape[1])[None, :]
     positions = jnp.broadcast_to(positions, input_ids.shape)
 
-    x = jnp.take(params["embed"]["embedding"], input_ids, axis=0).astype(config.dtype)
+    x = embed_tokens(config, params, input_ids, positions)
 
     block = partial(_block, config, positions=positions, attn_impl=attn_impl,
                     activation_sharding=activation_sharding,
@@ -209,13 +226,7 @@ def apply(
 
     x, _ = jax.lax.scan(scan_body, x, params["layers"])
 
-    x = _rmsnorm(x, params["final_norm"], config.rms_norm_eps)
-    if config.tie_word_embeddings:
-        w_out = params["embed"]["embedding"].T
-    else:
-        w_out = params["lm_head"]
-    return jnp.dot(x, w_out.astype(config.dtype),
-                   preferred_element_type=jnp.float32)
+    return lm_head_logits(config, params, x)
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,160 @@
+"""Pipeline parallelism: GPipe schedule over the ``pp`` mesh axis.
+
+The reference has no pipeline parallelism (mentioned only as Llama-405B-paper
+context, ``06-tensor-parallel/README.md:8``). The TPU build adds it as a
+first-class axis, the shard_map way:
+
+- the *stacked layer dimension* of every per-layer parameter is sharded over
+  ``pp`` — stage s owns layers [s*L/pp, (s+1)*L/pp); embedding/head params
+  are replicated across pp (their grads psum automatically through the
+  shard_map transpose);
+- the step runs a GPipe fill/drain schedule over T = M + pp - 1 ticks for M
+  microbatches: each tick, every stage runs its layer slice on its resident
+  activation, then hands the result to the next stage via ``ppermute``
+  (neighbor ICI hop). Stage 0 injects the next microbatch's embeddings; the
+  last stage computes head+loss under ``lax.cond`` (no wasted head matmuls on
+  other stages);
+- the wrapper is a *partial-manual* ``shard_map``: only ``pp`` is manual —
+  dp/fsdp/tp/cp stay with GSPMD inside the stage, so pipeline composes with
+  every other plan by rules-table union;
+- backward is plain ``jax.grad`` through the schedule (ppermute transposes to
+  the reverse permute), with optional per-tick remat.
+
+Bubble fraction is (pp-1)/(M+pp-1) — choose microbatches >= 2*pp to keep it
+under a third. 1F1B/interleaved schedules are the round-2 refinement.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from ..ops.cross_entropy import causal_lm_loss
+
+
+def _family_module(family: str):
+    from ..models import gpt2, llama
+
+    return {"llama": llama, "gpt2": gpt2}[family]
+
+
+def param_pipeline_specs(logical_axes_tree):
+    """shard_map in_specs for params: layer-stacked leaves are manual over pp
+    on their leading dim, everything else is replicated across pp."""
+    def spec(ax):
+        return P("pp") if ax and ax[0] == "layers" else P()
+
+    return jax.tree.map(spec, logical_axes_tree,
+                        is_leaf=lambda x: isinstance(x, tuple))
+
+
+def make_pipeline_loss(
+    bundle,
+    plan,
+    *,
+    microbatches: Optional[int] = None,
+    remat: bool = False,
+    attn_impl: str = "auto",
+    loss_fn: Callable = causal_lm_loss,
+) -> Callable:
+    """Returns loss(params, batch) running the GPipe schedule over plan.mesh's
+    pp axis. batch: {'input_ids','labels'} of shape [B, S]; B must divide by
+    microbatches, and B//microbatches by the data-axes size."""
+    mesh = plan.mesh
+    pp = mesh.shape["pp"]
+    if mesh.shape["cp"] > 1:
+        raise NotImplementedError("pp x cp composition is not supported yet")
+    if mesh.shape["tp"] > 1 and mesh.shape["dp"] * mesh.shape["fsdp"] > 1:
+        # XLA's SPMD partitioner hits a CHECK (spmd_partitioner_util.cc:495,
+        # ExpandDeviceGroupsWithIota) when auto tp collectives run under a
+        # manual-pp shard_map alongside a third nontrivial axis. pp x tp alone
+        # and pp x (dp/fsdp) alone both work.
+        raise NotImplementedError(
+            "pp x tp currently requires dp == fsdp == 1 (XLA partitioner "
+            "limitation); use pp x fsdp, or a pure pp x tp submesh")
+    cfg = bundle.config
+    mod = _family_module(bundle.family)
+    n_layers = cfg.num_layers
+    if n_layers % pp != 0:
+        raise ValueError(f"num_layers={n_layers} not divisible by pp={pp}")
+    M = microbatches or 2 * pp
+
+    def stage_fn(layers_local, x, positions):
+        block = functools.partial(mod._block, cfg, positions=positions,
+                                  attn_impl=attn_impl)
+
+        def body(carry, layer_params):
+            return block(carry, layer_params), None
+
+        if remat:
+            body = jax.checkpoint(body, prevent_cse=False,
+                                  policy=jax.checkpoint_policies.nothing_saveable)
+        x, _ = jax.lax.scan(body, x, layers_local)
+        return x
+
+    def pp_body(params, ids_mb, labels_mb):
+        # ids_mb/labels_mb: [M, mb, S]
+        s = jax.lax.axis_index("pp")
+        mb, seq = ids_mb.shape[1], ids_mb.shape[2]
+        positions = jnp.broadcast_to(jnp.arange(seq)[None, :], (mb, seq))
+        perm = [(i, i + 1) for i in range(pp - 1)]
+
+        buf = jnp.zeros((mb, seq, cfg.hidden_size), cfg.dtype)
+        loss_acc = jnp.zeros((), jnp.float32)
+
+        for t in range(M + pp - 1):
+            x0 = mod.embed_tokens(cfg, params, ids_mb[min(t, M - 1)], positions)
+            is_first = (s == 0) & (t < M)
+            x_in = jnp.where(is_first, x0, buf)
+            y = stage_fn(params["layers"], x_in, positions)
+
+            out_idx = t - (pp - 1)
+            if 0 <= out_idx < M:  # static: drain ticks only
+                # computed on every stage, masked to the last: the head may
+                # contain auto-axis (fsdp/tp) collectives, and those must be
+                # executed uniformly across pp ranks (lax.cond on a
+                # pp-dependent predicate would diverge the comm pattern)
+                logits = mod.lm_head_logits(cfg, params, y)
+                mb_loss = loss_fn(logits, labels_mb[out_idx]).astype(jnp.float32)
+                loss_acc = loss_acc + jnp.where(s == pp - 1, mb_loss, 0.0)
+            if t < M + pp - 2:
+                buf = jax.lax.ppermute(y, "pp", perm)
+
+        return jax.lax.psum(loss_acc, "pp") / M
+
+    param_specs = param_pipeline_specs(bundle.param_logical_axes(cfg))
+    sharded = jax.shard_map(
+        pp_body, mesh=mesh,
+        in_specs=(param_specs, P(), P()),
+        out_specs=P(),
+        axis_names={"pp"},
+        check_vma=False,
+    )
+
+    from jax.sharding import NamedSharding
+
+    mb_sharding = NamedSharding(mesh, P(None, plan.data_axes, None))
+    data_size = plan.data_parallel_size
+
+    def loss(params, batch):
+        ids = batch["input_ids"]
+        labels = batch["labels"]
+        b, seq = ids.shape
+        if b % M != 0:
+            raise ValueError(f"global batch {b} not divisible by microbatches={M}")
+        if (b // M) % data_size != 0:
+            raise ValueError(
+                f"microbatch size {b // M} not divisible by data-parallel size "
+                f"{data_size}; raise the batch or lower pp_microbatches")
+        # keep each microbatch's batch dim sharded over the data axes — the
+        # reshape would otherwise let GSPMD shard the scanned M dim
+        ids_mb = jax.lax.with_sharding_constraint(
+            ids.reshape(M, b // M, seq), mb_sharding)
+        labels_mb = jax.lax.with_sharding_constraint(
+            labels.reshape(M, b // M, seq), mb_sharding)
+        return sharded(params, ids_mb, labels_mb)
+
+    return loss

@@ -60,6 +60,11 @@ STRATEGIES: dict[str, dict[str, Any]] = {
         "vocab": "tp",
         "embed": "fsdp",
     },
+    # chapter 09 (beyond the reference): pipeline stages own layer slices;
+    # the stacked layer dim is the sharded one (parallel/pipeline.py)
+    "pp": {"layers": "pp"},
+    "pp_fsdp": {"layers": "pp", "embed": "fsdp", "vocab": "fsdp"},
+    "pp_tp": {"layers": "pp", "heads": "tp", "kv": "tp", "mlp": "tp", "vocab": "tp"},
 }
 
 # logical axes that shard the optimizer state only (ZeRO-1, reference C3):
@@ -140,6 +145,17 @@ class ShardingPlan:
         if self.strategy == "single":
             return None
         return NamedSharding(self.mesh, P(self.data_axes, None, None))
+
+    def logits_sharding(self) -> Optional[NamedSharding]:
+        """Loss-parallel layout [B, S, V]: keep the vocab dim tp-sharded
+        through the cross-entropy (logsumexp becomes local-reduce + psum)
+        instead of all-gathering full logits. The reference documents this as
+        ``loss_parallel`` but ships with ``Replicate()``
+        (``06-tensor-parallel/README.md:241-271``, ``06:117``)."""
+        if self.rules.get("vocab") == "tp" and self.mesh.shape["tp"] > 1:
+            seq = "cp" if self.mesh.shape["cp"] > 1 else None
+            return NamedSharding(self.mesh, P(self.data_axes, seq, "tp"))
+        return None
 
     # ---- params / optimizer state -----------------------------------------
     def param_shardings(self, logical_axes_tree, shape_tree) -> Any:

@@ -54,12 +54,16 @@ def get_parser() -> argparse.ArgumentParser:
     parser.add_argument("--max-steps", default=None, type=int)
     parser.add_argument("--native-loader", action="store_true",
                         help="assemble batches with the C++ mmap/prefetch loader (csrc/)")
+    parser.add_argument("--profile-dir", default=None,
+                        help="capture a jax.profiler trace of steps 10-15 into this dir "
+                             "(view with xprof/tensorboard; see diagnosing-errors/)")
     return parser
 
 
 def run_training(args, plan_factory: Callable, *, extra_log: Optional[dict] = None,
                  pretrained_dir: Optional[str] = None,
-                 offload_opt_state: bool = False) -> dict:
+                 offload_opt_state: bool = False,
+                 pp_microbatches: Optional[int] = None) -> dict:
     """The chapter-invariant training loop. Returns final metrics (for tests).
 
     ``plan_factory() -> ShardingPlan`` is the one thing chapters customize.
@@ -99,6 +103,7 @@ def run_training(args, plan_factory: Callable, *, extra_log: Optional[dict] = No
         remat=args.checkpoint_activations,
         attn_impl=args.attn_impl,
         offload_opt_state=offload_opt_state,
+        pp_microbatches=pp_microbatches,
     )
 
     global_batch = args.batch_size * plan.data_parallel_size * args.grad_accum
@@ -155,6 +160,8 @@ def run_training(args, plan_factory: Callable, *, extra_log: Optional[dict] = No
         except ImportError:
             pass
 
+    profile_started = profile_done = False
+    profile_start_step = 0
     done = False
     for epoch in range(host_state["epoch"], args.num_epochs):
         host_state["epoch"] = epoch
@@ -174,6 +181,17 @@ def run_training(args, plan_factory: Callable, *, extra_log: Optional[dict] = No
             host_state["running_loss"] += loss
             if progress:
                 progress.update(1)
+
+            if args.profile_dir:  # trace a ~5-step steady-state window (C22)
+                if not profile_started and host_state["global_step"] >= 10:
+                    jax.profiler.start_trace(args.profile_dir)
+                    profile_started = True
+                    profile_start_step = host_state["global_step"]
+                elif profile_started and not profile_done and \
+                        host_state["global_step"] >= profile_start_step + 5:
+                    jax.profiler.stop_trace()
+                    profile_done = True
+                    LOGGER.info(f"profiler trace written to {args.profile_dir}")
 
             if host_state["global_step"] % args.log_freq == 0:
                 ms_per_step = sum(t.avg_elapsed_ms() for t in timers.values())
@@ -211,6 +229,11 @@ def run_training(args, plan_factory: Callable, *, extra_log: Optional[dict] = No
         if done:
             break
 
+    if profile_started and not profile_done:
+        jax.profiler.stop_trace()
+        LOGGER.info(f"profiler trace written to {args.profile_dir} "
+                    f"(run ended inside the trace window)")
+    loader.close()
     if progress:
         progress.close()
     return {"host_state": host_state, "last_info": last_info, "state": state}

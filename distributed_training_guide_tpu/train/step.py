@@ -89,6 +89,7 @@ class Trainer:
     loss_fn: Callable = causal_lm_loss
     donate: bool = True
     offload_opt_state: bool = False
+    pp_microbatches: Optional[int] = None  # pipeline microbatches (default 2*pp)
 
     def __post_init__(self):
         if self.plan is None:
@@ -188,12 +189,23 @@ class Trainer:
 
             attn_impl = make_ring_attention(self.plan.mesh)
 
-        def loss_on_microbatch(params, mb):
-            logits = apply(cfg, params, mb["input_ids"],
-                           positions=mb.get("positions"),
-                           remat=self.remat, attn_impl=attn_impl,
-                           activation_sharding=act_sharding)
-            return self.loss_fn(logits, mb["labels"])
+        logits_sharding = self.plan.logits_sharding()
+
+        if self.plan.mesh.shape["pp"] > 1:
+            from ..parallel.pipeline import make_pipeline_loss
+
+            loss_on_microbatch = make_pipeline_loss(
+                self.bundle, self.plan, microbatches=self.pp_microbatches,
+                remat=self.remat, attn_impl=attn_impl, loss_fn=self.loss_fn)
+        else:
+            def loss_on_microbatch(params, mb):
+                logits = apply(cfg, params, mb["input_ids"],
+                               positions=mb.get("positions"),
+                               remat=self.remat, attn_impl=attn_impl,
+                               activation_sharding=act_sharding)
+                if logits_sharding is not None:  # loss-parallel (vocab sharded)
+                    logits = jax.lax.with_sharding_constraint(logits, logits_sharding)
+                return self.loss_fn(logits, mb["labels"])
 
         grad_fn = jax.value_and_grad(loss_on_microbatch)
 
