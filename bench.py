@@ -91,7 +91,7 @@ def main():
     dt = float(np.median(times))
 
     tokens_per_s = global_batch * seq / dt
-    fpt = transformer_flops_per_token(bundle.num_params(), cfg.num_layers,
+    fpt = transformer_flops_per_token(bundle.num_active_params(), cfg.num_layers,
                                       cfg.hidden_size, seq, vocab_size=cfg.vocab_size)
     mfu = compute_mfu(tokens_per_s, fpt, n_chips=n,
                       peak_flops_per_chip=device_peak_flops(devices[0]))

@@ -135,23 +135,20 @@ def _rmsnorm(x: jnp.ndarray, scale: jnp.ndarray, eps: float) -> jnp.ndarray:
     return (x * scale.astype(jnp.float32)).astype(dtype)
 
 
-def _block(config: LlamaConfig, x: jnp.ndarray, layer: dict,
-           positions: jnp.ndarray, attn_impl: str,
-           activation_sharding: Optional[Any] = None,
-           standard_layout: bool = True) -> jnp.ndarray:
+def attention_sublayer(config, x: jnp.ndarray, attn_params: dict, norm_scale,
+                       positions: jnp.ndarray, attn_impl,
+                       standard_layout: bool = True) -> jnp.ndarray:
+    """norm -> rope'd GQA attention -> output proj (residual added by caller).
+
+    Shared by the dense Llama block and the MoE family (config is duck-typed:
+    needs num_heads/num_kv_heads/head_size/rope_theta/rms_norm_eps/dtype)."""
     b, s, e = x.shape
     d = config.head_size
     cdt = config.dtype
-
-    def constrain(y):
-        if activation_sharding is not None:
-            return jax.lax.with_sharding_constraint(y, activation_sharding)
-        return y
-
-    h = _rmsnorm(x, layer["input_norm"], config.rms_norm_eps)
-    q = (h @ layer["attn"]["wq"].astype(cdt)).reshape(b, s, config.num_heads, d)
-    k = (h @ layer["attn"]["wk"].astype(cdt)).reshape(b, s, config.num_kv_heads, d)
-    v = (h @ layer["attn"]["wv"].astype(cdt)).reshape(b, s, config.num_kv_heads, d)
+    h = _rmsnorm(x, norm_scale, config.rms_norm_eps)
+    q = (h @ attn_params["wq"].astype(cdt)).reshape(b, s, config.num_heads, d)
+    k = (h @ attn_params["wk"].astype(cdt)).reshape(b, s, config.num_kv_heads, d)
+    v = (h @ attn_params["wv"].astype(cdt)).reshape(b, s, config.num_kv_heads, d)
     q = apply_rope(q, positions, config.rope_theta)
     k = apply_rope(k, positions, config.rope_theta)
     if callable(attn_impl):  # e.g. ring attention under context parallelism
@@ -160,7 +157,22 @@ def _block(config: LlamaConfig, x: jnp.ndarray, layer: dict,
         attn = multihead_attention(q, k, v, causal=True, positions=positions,
                                    kv_positions=positions, impl=attn_impl,
                                    standard_layout=standard_layout)
-    attn = attn.reshape(b, s, config.num_heads * d) @ layer["attn"]["wo"].astype(cdt)
+    return attn.reshape(b, s, config.num_heads * d) @ attn_params["wo"].astype(cdt)
+
+
+def _block(config: LlamaConfig, x: jnp.ndarray, layer: dict,
+           positions: jnp.ndarray, attn_impl: str,
+           activation_sharding: Optional[Any] = None,
+           standard_layout: bool = True) -> jnp.ndarray:
+    cdt = config.dtype
+
+    def constrain(y):
+        if activation_sharding is not None:
+            return jax.lax.with_sharding_constraint(y, activation_sharding)
+        return y
+
+    attn = attention_sublayer(config, x, layer["attn"], layer["input_norm"],
+                              positions, attn_impl, standard_layout)
     x = constrain(x + attn)
 
     h = _rmsnorm(x, layer["post_attn_norm"], config.rms_norm_eps)

@@ -9,9 +9,9 @@ unchanged (e.g. ``--model-name gpt2`` or ``meta-llama/Llama-3.1-405B``).
 from __future__ import annotations
 
 import dataclasses
-from typing import Any, Callable
+from typing import Any, Callable, Optional
 
-from . import gpt2, llama
+from . import gpt2, llama, moe
 
 
 @dataclasses.dataclass(frozen=True)
@@ -22,9 +22,17 @@ class ModelBundle:
     apply: Callable         # (config, params, input_ids, ...) -> logits
     param_logical_axes: Callable  # (config,) -> axes pytree
     family: str
+    # MoE models: (config, params, ids, ...) -> (logits, aux_loss); the
+    # trainer adds config.router_aux_coef * aux to the loss
+    apply_with_aux: Optional[Callable] = None
 
     def num_params(self) -> int:
         return self.config.num_params()
+
+    def num_active_params(self) -> int:
+        """Per-token active params (MoE: k of E experts) for FLOPs/MFU math."""
+        fn = getattr(self.config, "num_active_params", None)
+        return fn() if fn else self.config.num_params()
 
 
 _HF_ALIASES = {
@@ -42,7 +50,7 @@ _HF_ALIASES = {
 
 
 def list_models() -> list[str]:
-    return sorted(gpt2.PRESETS) + sorted(llama.PRESETS)
+    return sorted(gpt2.PRESETS) + sorted(llama.PRESETS) + sorted(moe.PRESETS)
 
 
 def get_model(name: str, **overrides) -> ModelBundle:
@@ -59,6 +67,13 @@ def get_model(name: str, **overrides) -> ModelBundle:
             config = dataclasses.replace(config, **overrides)
         return ModelBundle(key, config, llama.init, llama.apply,
                            llama.param_logical_axes, family="llama")
+    if key in moe.PRESETS:
+        config = moe.PRESETS[key]
+        if overrides:
+            config = dataclasses.replace(config, **overrides)
+        return ModelBundle(key, config, moe.init, moe.apply,
+                           moe.param_logical_axes, family="moe",
+                           apply_with_aux=moe.apply_with_aux)
     raise ValueError(
         f"Unknown model {name!r}. Available: {', '.join(list_models())} "
         f"(HF aliases: {', '.join(sorted(_HF_ALIASES))})"

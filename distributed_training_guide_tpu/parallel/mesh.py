@@ -8,6 +8,7 @@ axes is the single abstraction behind every chapter —
     dp    pure data parallelism (replica groups; multi-slice runs put DCN here)
     pp    pipeline parallelism (layer stages; ppermute between neighbors)
     fsdp  parameter-sharded data parallelism (ZeRO-3 / FULL_SHARD axis)
+    ep    expert parallelism (MoE expert dim; all-to-all dispatch)
     tp    tensor parallelism (fastest ICI axis — collectives per layer)
     cp    context parallelism (sequence-dim sharding for long context)
 
@@ -24,26 +25,26 @@ import jax
 from jax.experimental import mesh_utils
 from jax.sharding import Mesh
 
-AXIS_NAMES = ("dp", "pp", "fsdp", "tp", "cp")
+AXIS_NAMES = ("dp", "pp", "fsdp", "ep", "tp", "cp")
 
 
 def mesh_shape_for(n_devices: int, *, fsdp: int = 1, tp: int = 1, cp: int = 1,
-                   pp: int = 1, dp: Optional[int] = None) -> tuple[int, ...]:
-    """Fill in the dp axis so dp*pp*fsdp*tp*cp == n_devices."""
-    denom = pp * fsdp * tp * cp
+                   pp: int = 1, ep: int = 1, dp: Optional[int] = None) -> tuple[int, ...]:
+    """Fill in the dp axis so dp*pp*fsdp*ep*tp*cp == n_devices."""
+    denom = pp * fsdp * ep * tp * cp
     if n_devices % denom != 0:
-        raise ValueError(f"{n_devices} devices not divisible by pp*fsdp*tp*cp={denom}")
+        raise ValueError(f"{n_devices} devices not divisible by pp*fsdp*ep*tp*cp={denom}")
     inferred_dp = n_devices // denom
     if dp is not None and dp != inferred_dp:
         raise ValueError(f"dp={dp} inconsistent: need {inferred_dp}")
-    return (inferred_dp, pp, fsdp, tp, cp)
+    return (inferred_dp, pp, fsdp, ep, tp, cp)
 
 
-def make_mesh(*, fsdp: int = 1, tp: int = 1, cp: int = 1, pp: int = 1,
+def make_mesh(*, fsdp: int = 1, tp: int = 1, cp: int = 1, pp: int = 1, ep: int = 1,
               dp: Optional[int] = None,
               devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
     devices = list(devices) if devices is not None else jax.devices()
-    shape = mesh_shape_for(len(devices), fsdp=fsdp, tp=tp, cp=cp, pp=pp, dp=dp)
+    shape = mesh_shape_for(len(devices), fsdp=fsdp, tp=tp, cp=cp, pp=pp, ep=ep, dp=dp)
     if math.prod(shape) == 1:
         import numpy as np
 

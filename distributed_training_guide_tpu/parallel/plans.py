@@ -65,6 +65,11 @@ STRATEGIES: dict[str, dict[str, Any]] = {
     "pp": {"layers": "pp"},
     "pp_fsdp": {"layers": "pp", "embed": "fsdp", "vocab": "fsdp"},
     "pp_tp": {"layers": "pp", "heads": "tp", "kv": "tp", "mlp": "tp", "vocab": "tp"},
+    # chapter 10 (beyond the reference): MoE expert parallelism — the expert
+    # dim of stacked expert weights lives on ep; GSPMD derives the token
+    # all-to-all from the dispatch/combine einsums (models/moe.py)
+    "ep": {"experts": "ep"},
+    "ep_fsdp": {"experts": "ep", "embed": "fsdp", "vocab": "fsdp"},
 }
 
 # logical axes that shard the optimizer state only (ZeRO-1, reference C3):
@@ -110,8 +115,11 @@ class ShardingPlan:
     # ---- batch / data ------------------------------------------------------
     @property
     def data_axes(self) -> tuple:
-        """Mesh axes that partition the global batch dim."""
-        return ("dp", "fsdp")
+        """Mesh axes that partition the global batch dim. ``ep`` is a data
+        axis: tokens shard over it, and it is precisely the combination
+        (tokens over ep) x (experts over ep) that makes GSPMD partition the
+        MoE dispatch/combine einsums into the token all-to-all (GShard)."""
+        return ("dp", "fsdp", "ep")
 
     def batch_spec(self, ndim: int = 2) -> P:
         seq = ("cp",) if self.mesh.shape["cp"] > 1 else None

@@ -145,7 +145,7 @@ def run_training(args, plan_factory: Callable, *, extra_log: Optional[dict] = No
 
     timers = {k: LocalTimer() for k in ["data", "step"]}
     flops_per_token = transformer_flops_per_token(
-        bundle.num_params(), cfg.num_layers, cfg.hidden_size, seq_length,
+        bundle.num_active_params(), cfg.num_layers, cfg.hidden_size, seq_length,
         vocab_size=cfg.vocab_size)
     n_chips = plan.mesh.size
     tok_per_step = trainer.tokens_per_step(args.batch_size, seq_length)

@@ -192,11 +192,28 @@ class Trainer:
         logits_sharding = self.plan.logits_sharding()
 
         if self.plan.mesh.shape["pp"] > 1:
+            if self.bundle.apply_with_aux is not None:
+                raise NotImplementedError(
+                    "MoE models are not supported under pipeline parallelism "
+                    "yet (the GPipe schedule would drop the router aux loss); "
+                    "use ep/ep_fsdp plans for MoE")
             from ..parallel.pipeline import make_pipeline_loss
 
             loss_on_microbatch = make_pipeline_loss(
                 self.bundle, self.plan, microbatches=self.pp_microbatches,
                 remat=self.remat, attn_impl=attn_impl, loss_fn=self.loss_fn)
+        elif self.bundle.apply_with_aux is not None:
+            apply_aux = self.bundle.apply_with_aux
+            aux_coef = getattr(cfg, "router_aux_coef", 0.0)
+
+            def loss_on_microbatch(params, mb):
+                logits, aux = apply_aux(cfg, params, mb["input_ids"],
+                                        positions=mb.get("positions"),
+                                        remat=self.remat, attn_impl=attn_impl,
+                                        activation_sharding=act_sharding)
+                if logits_sharding is not None:
+                    logits = jax.lax.with_sharding_constraint(logits, logits_sharding)
+                return self.loss_fn(logits, mb["labels"]) + aux_coef * aux
         else:
             def loss_on_microbatch(params, mb):
                 logits = apply(cfg, params, mb["input_ids"],
