@@ -12,11 +12,42 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import time
 
 import numpy as np
 
+
+def _default_watchdog() -> int:
+    try:
+        return int(os.environ.get("BENCH_TIMEOUT", 1500))
+    except ValueError:
+        return 1500
+
 BASELINE_MFU = 0.335
+def _install_watchdog(seconds: int) -> None:
+    """The shared TPU pools this runs on can stall for minutes (see
+    utils/timers.py); emit a valid zero-result JSON line instead of hanging
+    the caller forever. A daemon thread (not SIGALRM): the main thread may be
+    blocked inside the TPU client's C code and never re-enter the interpreter
+    to run a Python signal handler."""
+    import os
+    import threading
+
+    def on_timeout():
+        print(json.dumps({
+            "metric": "mfu", "value": 0.0, "unit": "fraction_of_peak_bf16",
+            "vs_baseline": 0.0,
+            "detail": {"error": f"watchdog: no result within {seconds}s "
+                                f"(TPU pool unresponsive)"},
+        }), flush=True)
+        os._exit(2)
+
+    timer = threading.Timer(seconds, on_timeout)
+    timer.daemon = True
+    timer.start()
+
+
 def main():
     parser = argparse.ArgumentParser()
     parser.add_argument("--model", default=None, help="model preset (default: by device memory)")
@@ -27,7 +58,10 @@ def main():
     parser.add_argument("--remat", action="store_true", default=None)
     parser.add_argument("--no-remat", dest="remat", action="store_false")
     parser.add_argument("--attn-impl", default="auto")
+    parser.add_argument("--watchdog", type=int, default=_default_watchdog())
     args = parser.parse_args()
+    if args.watchdog:
+        _install_watchdog(args.watchdog)
 
     import jax
     import jax.numpy as jnp

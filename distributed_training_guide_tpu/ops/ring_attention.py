@@ -93,7 +93,7 @@ def _local_ring_attention(q, k, v, *, axis_name: str, cp: int, causal: bool):
 
 
 def make_ring_attention(mesh: Mesh, *, axis_name: str = "cp",
-                        data_axes=("dp", "fsdp"), head_axis: str = "tp",
+                        data_axes=("dp", "fsdp", "ep"), head_axis: str = "tp",
                         causal: bool = True) -> Callable:
     """Returns an attention callable with the ``multihead_attention``
     signature, internally a shard_map ring over ``axis_name``."""

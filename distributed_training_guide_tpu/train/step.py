@@ -187,7 +187,8 @@ class Trainer:
         if self.plan.mesh.shape["cp"] > 1 and not callable(attn_impl):
             from ..ops.ring_attention import make_ring_attention
 
-            attn_impl = make_ring_attention(self.plan.mesh)
+            attn_impl = make_ring_attention(self.plan.mesh,
+                                            data_axes=self.plan.data_axes)
 
         logits_sharding = self.plan.logits_sharding()
 

@@ -42,6 +42,32 @@ def test_moe_capacity_drops_are_bounded():
     np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-5)
 
 
+def test_moe_overflow_tokens_get_zero_output():
+    """Force every token onto one expert with capacity 2: exactly the first 2
+    tokens (greedy order) get expert output; overflow rows are exactly zero
+    (they fall through on the residual)."""
+    from distributed_training_guide_tpu.models.moe import _moe_ffn
+
+    bundle = get_model("moe-debug", dtype=jnp.float32, experts_per_token=1,
+                       capacity_factor=0.5)  # C = ceil(0.5 * 16 / 4) = 2
+    cfg = bundle.config
+    d, f, ex = cfg.hidden_size, cfg.intermediate_size, cfg.num_experts
+    rng = jax.random.key(0)
+    router = jnp.zeros((d, ex)).at[:, 0].set(1.0)  # all tokens -> expert 0
+    moe_params = {
+        "router": router,
+        "gate": jax.random.normal(rng, (ex, d, f)) * 0.02,
+        "up": jax.random.normal(rng, (ex, d, f)) * 0.02,
+        "down": jax.random.normal(rng, (ex, f, d)) * 0.02,
+    }
+    x = jnp.ones((1, 16, d))
+    y, _ = _moe_ffn(cfg, x, moe_params)
+    y = np.asarray(y)[0]
+    norms = np.linalg.norm(y, axis=-1)
+    assert (norms[:2] > 0).all(), "in-capacity tokens must get expert output"
+    np.testing.assert_array_equal(norms[2:], 0.0)
+
+
 def test_ep_matches_single_device(eight_devices):
     bundle = get_model("moe-debug", dtype=jnp.float32)
     opt = adamw_cosine(1e-3)
