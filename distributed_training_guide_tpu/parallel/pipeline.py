@@ -57,6 +57,7 @@ def make_pipeline_loss(
     *,
     microbatches: Optional[int] = None,
     remat: bool = False,
+    remat_policy=None,
     attn_impl: str = "auto",
     loss_fn: Callable = causal_lm_loss,
 ) -> Callable:
@@ -90,8 +91,9 @@ def make_pipeline_loss(
             return block(carry, layer_params), None
 
         if remat:
-            body = jax.checkpoint(body, prevent_cse=False,
-                                  policy=jax.checkpoint_policies.nothing_saveable)
+            body = jax.checkpoint(
+                body, prevent_cse=False,
+                policy=remat_policy or jax.checkpoint_policies.nothing_saveable)
         x, _ = jax.lax.scan(body, x, layers_local)
         return x
 

@@ -50,6 +50,10 @@ def get_parser() -> argparse.ArgumentParser:
     parser.add_argument("--grad-accum", default=1, type=int)
     parser.add_argument("--checkpoint-activations", action="store_true",
                         help="remat decoder layers (reference 05:163-178)")
+    parser.add_argument("--remat-policy", default="all", choices=["all", "dots"],
+                        help="what survives forward under remat: all=recompute "
+                             "everything (min memory); dots=keep matmul outputs "
+                             "(better MFU)")
     parser.add_argument("--attn-impl", default="auto", choices=["auto", "xla", "flash"])
     parser.add_argument("--max-steps", default=None, type=int)
     parser.add_argument("--native-loader", action="store_true",
@@ -101,6 +105,7 @@ def run_training(args, plan_factory: Callable, *, extra_log: Optional[dict] = No
         plan=plan,
         grad_accum=args.grad_accum,
         remat=args.checkpoint_activations,
+        remat_policy=args.remat_policy,
         attn_impl=args.attn_impl,
         offload_opt_state=offload_opt_state,
         pp_microbatches=pp_microbatches,

@@ -32,6 +32,16 @@ from ..parallel.plans import ShardingPlan, make_plan, spec_for_leaf
 from .state import TrainState
 
 
+REMAT_POLICIES = {
+    # "all": recompute everything (min memory, the reference's
+    # apply_activation_checkpointing semantics, 05:163-178)
+    "all": jax.checkpoint_policies.nothing_saveable,
+    # "dots": keep matmul outputs, recompute elementwise — the usual best
+    # MFU/memory trade on TPU (matmuls are the expensive recompute)
+    "dots": jax.checkpoint_policies.checkpoint_dots_with_no_batch_dims,
+}
+
+
 def _is_axes_leaf(x):
     return isinstance(x, tuple) and all(isinstance(e, (str, type(None))) for e in x)
 
@@ -85,6 +95,7 @@ class Trainer:
     plan: Optional[ShardingPlan] = None
     grad_accum: int = 1
     remat: bool = False
+    remat_policy: str = "all"  # all | dots (what survives the fwd pass under remat)
     attn_impl: str = "auto"
     loss_fn: Callable = causal_lm_loss
     donate: bool = True
@@ -191,6 +202,10 @@ class Trainer:
                                             data_axes=self.plan.data_axes)
 
         logits_sharding = self.plan.logits_sharding()
+        if self.remat_policy not in REMAT_POLICIES:
+            raise ValueError(f"unknown remat_policy {self.remat_policy!r}; "
+                             f"choose from {sorted(REMAT_POLICIES)}")
+        policy = REMAT_POLICIES[self.remat_policy]
 
         if self.plan.mesh.shape["pp"] > 1:
             if self.bundle.apply_with_aux is not None:
@@ -202,7 +217,8 @@ class Trainer:
 
             loss_on_microbatch = make_pipeline_loss(
                 self.bundle, self.plan, microbatches=self.pp_microbatches,
-                remat=self.remat, attn_impl=attn_impl, loss_fn=self.loss_fn)
+                remat=self.remat, remat_policy=policy, attn_impl=attn_impl,
+                loss_fn=self.loss_fn)
         elif self.bundle.apply_with_aux is not None:
             apply_aux = self.bundle.apply_with_aux
             aux_coef = getattr(cfg, "router_aux_coef", 0.0)
@@ -210,7 +226,8 @@ class Trainer:
             def loss_on_microbatch(params, mb):
                 logits, aux = apply_aux(cfg, params, mb["input_ids"],
                                         positions=mb.get("positions"),
-                                        remat=self.remat, attn_impl=attn_impl,
+                                        remat=self.remat, remat_policy=policy,
+                                        attn_impl=attn_impl,
                                         activation_sharding=act_sharding)
                 if logits_sharding is not None:
                     logits = jax.lax.with_sharding_constraint(logits, logits_sharding)
@@ -219,7 +236,8 @@ class Trainer:
             def loss_on_microbatch(params, mb):
                 logits = apply(cfg, params, mb["input_ids"],
                                positions=mb.get("positions"),
-                               remat=self.remat, attn_impl=attn_impl,
+                               remat=self.remat, remat_policy=policy,
+                               attn_impl=attn_impl,
                                activation_sharding=act_sharding)
                 if logits_sharding is not None:  # loss-parallel (vocab sharded)
                     logits = jax.lax.with_sharding_constraint(logits, logits_sharding)
