@@ -146,10 +146,18 @@ def embed_tokens(config: GPT2Config, params: dict, input_ids: jnp.ndarray,
     return (tok + pos).astype(config.dtype)
 
 
+def output_weights(config: GPT2Config, params: dict) -> jnp.ndarray:
+    """[E, V] tied output projection in compute dtype."""
+    return params["wte"].T.astype(config.dtype)
+
+
+def final_hidden(config: GPT2Config, params: dict, x: jnp.ndarray) -> jnp.ndarray:
+    return _layernorm(x, params["lnf"], config.layer_norm_eps)
+
+
 def lm_head_logits(config: GPT2Config, params: dict, x: jnp.ndarray) -> jnp.ndarray:
     """Final LN + tied output projection (pipeline last-stage exit)."""
-    x = _layernorm(x, params["lnf"], config.layer_norm_eps)
-    return jnp.dot(x, params["wte"].T.astype(config.dtype),
+    return jnp.dot(final_hidden(config, params, x), output_weights(config, params),
                    preferred_element_type=jnp.float32)
 
 
@@ -163,6 +171,7 @@ def apply(
     remat_policy: Optional[Any] = None,
     attn_impl: str = "auto",
     activation_sharding: Optional[Any] = None,
+    return_hidden: bool = False,
 ) -> jnp.ndarray:
     del activation_sharding  # gpt2 path is small; SP constraint not needed
     standard_layout = positions is None
@@ -183,6 +192,8 @@ def apply(
         scan_body = jax.checkpoint(scan_body, policy=policy, prevent_cse=False)
 
     x, _ = jax.lax.scan(scan_body, x, params["layers"])
+    if return_hidden:
+        return final_hidden(config, params, x)
     return lm_head_logits(config, params, x)
 
 

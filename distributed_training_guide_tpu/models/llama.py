@@ -189,14 +189,22 @@ def embed_tokens(config: LlamaConfig, params: dict, input_ids: jnp.ndarray,
     return jnp.take(params["embed"]["embedding"], input_ids, axis=0).astype(config.dtype)
 
 
+def output_weights(config: LlamaConfig, params: dict) -> jnp.ndarray:
+    """[E, V] output projection (tied or dedicated), in compute dtype."""
+    if config.tie_word_embeddings:
+        return params["embed"]["embedding"].T.astype(config.dtype)
+    return params["lm_head"].astype(config.dtype)
+
+
+def final_hidden(config: LlamaConfig, params: dict, x: jnp.ndarray) -> jnp.ndarray:
+    """Final norm only — pair with ``output_weights`` for chunked losses."""
+    return _rmsnorm(x, params["final_norm"], config.rms_norm_eps)
+
+
 def lm_head_logits(config: LlamaConfig, params: dict, x: jnp.ndarray) -> jnp.ndarray:
     """Final norm + output projection (pipeline last-stage exit)."""
-    x = _rmsnorm(x, params["final_norm"], config.rms_norm_eps)
-    if config.tie_word_embeddings:
-        w_out = params["embed"]["embedding"].T
-    else:
-        w_out = params["lm_head"]
-    return jnp.dot(x, w_out.astype(config.dtype), preferred_element_type=jnp.float32)
+    return jnp.dot(final_hidden(config, params, x), output_weights(config, params),
+                   preferred_element_type=jnp.float32)
 
 
 def apply(
@@ -209,8 +217,10 @@ def apply(
     remat_policy: Optional[Any] = None,
     attn_impl: str = "auto",
     activation_sharding: Optional[Any] = None,
+    return_hidden: bool = False,
 ) -> jnp.ndarray:
-    """Forward pass -> logits [B, S, V] in float32.
+    """Forward pass -> logits [B, S, V] in float32 (or the final-normed
+    hidden states [B, S, E] when ``return_hidden``, for chunked losses).
 
     ``positions`` must be passed explicitly when the sequence dim is sharded
     (sequence/context parallelism) — same constraint the reference hits at
@@ -238,6 +248,8 @@ def apply(
 
     x, _ = jax.lax.scan(scan_body, x, params["layers"])
 
+    if return_hidden:
+        return final_hidden(config, params, x)
     return lm_head_logits(config, params, x)
 
 

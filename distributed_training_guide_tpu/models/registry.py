@@ -49,6 +49,15 @@ _HF_ALIASES = {
 }
 
 
+def family_module(family: str):
+    """The module implementing a model family (block/embed/head helpers used
+    by the pipeline schedule and chunked losses)."""
+    mods = {"llama": llama, "gpt2": gpt2, "moe": moe}
+    if family not in mods:
+        raise KeyError(f"unknown model family {family!r}")
+    return mods[family]
+
+
 def list_models() -> list[str]:
     return sorted(gpt2.PRESETS) + sorted(llama.PRESETS) + sorted(moe.PRESETS)
 

@@ -36,9 +36,9 @@ from ..ops.cross_entropy import causal_lm_loss
 
 
 def _family_module(family: str):
-    from ..models import gpt2, llama
+    from ..models.registry import family_module
 
-    return {"llama": llama, "gpt2": gpt2}[family]
+    return family_module(family)
 
 
 def param_pipeline_specs(logical_axes_tree):

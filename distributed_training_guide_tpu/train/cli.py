@@ -58,6 +58,10 @@ def get_parser() -> argparse.ArgumentParser:
     parser.add_argument("--max-steps", default=None, type=int)
     parser.add_argument("--native-loader", action="store_true",
                         help="assemble batches with the C++ mmap/prefetch loader (csrc/)")
+    parser.add_argument("--loss-chunks", type=int, default=0,
+                        help=">0: compute the loss in sequence chunks, never "
+                             "materializing full [B,S,V] logits (big-vocab "
+                             "memory saver)")
     parser.add_argument("--profile-dir", default=None,
                         help="capture a jax.profiler trace of steps 10-15 into this dir "
                              "(view with xprof/tensorboard; see diagnosing-errors/)")
@@ -106,6 +110,7 @@ def run_training(args, plan_factory: Callable, *, extra_log: Optional[dict] = No
         grad_accum=args.grad_accum,
         remat=args.checkpoint_activations,
         remat_policy=args.remat_policy,
+        loss_chunks=args.loss_chunks,
         attn_impl=args.attn_impl,
         offload_opt_state=offload_opt_state,
         pp_microbatches=pp_microbatches,

@@ -96,6 +96,7 @@ class Trainer:
     grad_accum: int = 1
     remat: bool = False
     remat_policy: str = "all"  # all | dots (what survives the fwd pass under remat)
+    loss_chunks: int = 0  # >0: chunked CE from hidden states (no [B,S,V] logits)
     attn_impl: str = "auto"
     loss_fn: Callable = causal_lm_loss
     donate: bool = True
@@ -207,6 +208,12 @@ class Trainer:
                              f"choose from {sorted(REMAT_POLICIES)}")
         policy = REMAT_POLICIES[self.remat_policy]
 
+        if self.loss_chunks > 0 and (self.plan.mesh.shape["pp"] > 1
+                                     or self.bundle.apply_with_aux is not None):
+            raise NotImplementedError(
+                "loss_chunks is not supported under pipeline parallelism or "
+                "for MoE models yet — it would be silently ignored")
+
         if self.plan.mesh.shape["pp"] > 1:
             if self.bundle.apply_with_aux is not None:
                 raise NotImplementedError(
@@ -232,6 +239,31 @@ class Trainer:
                 if logits_sharding is not None:
                     logits = jax.lax.with_sharding_constraint(logits, logits_sharding)
                 return self.loss_fn(logits, mb["labels"]) + aux_coef * aux
+        elif self.loss_chunks > 0:
+            from ..models.registry import family_module
+            from ..ops.cross_entropy import chunked_causal_lm_loss
+
+            mod = family_module(self.bundle.family)
+            if not hasattr(mod, "output_weights"):
+                raise NotImplementedError(
+                    f"loss_chunks unsupported for family {self.bundle.family!r}")
+            if self.loss_fn is not causal_lm_loss:
+                raise NotImplementedError(
+                    "loss_chunks hardwires the causal-LM loss; drop the custom "
+                    "loss_fn or the chunking")
+            n_chunks = self.loss_chunks
+
+            def loss_on_microbatch(params, mb):
+                hidden = apply(cfg, params, mb["input_ids"],
+                               positions=mb.get("positions"),
+                               remat=self.remat, remat_policy=policy,
+                               attn_impl=attn_impl,
+                               activation_sharding=act_sharding,
+                               return_hidden=True)
+                w_out = mod.output_weights(cfg, params)
+                return chunked_causal_lm_loss(hidden, w_out, mb["labels"],
+                                              num_chunks=n_chunks,
+                                              logits_sharding=logits_sharding)
         else:
             def loss_on_microbatch(params, mb):
                 logits = apply(cfg, params, mb["input_ids"],
