@@ -42,13 +42,62 @@ def mesh_shape_for(n_devices: int, *, fsdp: int = 1, tp: int = 1, cp: int = 1,
 
 def make_mesh(*, fsdp: int = 1, tp: int = 1, cp: int = 1, pp: int = 1, ep: int = 1,
               dp: Optional[int] = None,
-              devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
+              devices: Optional[Sequence[jax.Device]] = None,
+              multi_slice: Optional[bool] = None) -> Mesh:
+    """Build the mesh, topology-aware.
+
+    Multi-slice pods (several ICI islands joined by DCN — the TPU analogue of
+    the reference's multi-node NCCL-over-ethernet setup) place the dp axis
+    across slices via ``create_hybrid_device_mesh``: dp traffic (grad
+    all-reduce, once per step) rides DCN while the chatty fsdp/tp/cp
+    collectives stay inside a slice on ICI. Auto-detected from device
+    metadata; force with ``multi_slice=``.
+    """
     devices = list(devices) if devices is not None else jax.devices()
     shape = mesh_shape_for(len(devices), fsdp=fsdp, tp=tp, cp=cp, pp=pp, ep=ep, dp=dp)
     if math.prod(shape) == 1:
         import numpy as np
 
         return Mesh(np.asarray(devices).reshape(shape), AXIS_NAMES)
+
+    slice_ids = {getattr(d, "slice_index", 0) for d in devices}
+    if multi_slice is None:
+        multi_slice = len(slice_ids) > 1
+    if multi_slice:
+        import logging
+
+        logger = logging.getLogger(__name__)
+        remaining = max(len(slice_ids), 1)
+        dcn_shape = [1] * len(shape)
+        ici_shape = list(shape)
+        # place slices on the least-communication-heavy axes first:
+        # dp (one all-reduce/step), pp (point-to-point), then fsdp/ep/cp;
+        # tp stays on ICI unconditionally
+        for name in ("dp", "pp", "fsdp", "ep", "cp"):
+            axis_idx = AXIS_NAMES.index(name)
+            g = math.gcd(shape[axis_idx], remaining)
+            if g > 1:
+                dcn_shape[axis_idx] = g
+                ici_shape[axis_idx] = shape[axis_idx] // g
+                remaining //= g
+            if remaining == 1:
+                break
+        if remaining != 1:
+            logger.warning(
+                f"cannot factor {len(slice_ids)} slices onto mesh "
+                f"{dict(zip(AXIS_NAMES, shape))}; building a topology-unaware "
+                f"mesh (collectives may cross DCN suboptimally)")
+        else:
+            try:
+                device_array = mesh_utils.create_hybrid_device_mesh(
+                    ici_shape, dcn_shape, devices=devices)
+                return Mesh(device_array, AXIS_NAMES)
+            except Exception as e:
+                logger.warning(
+                    f"hybrid (ICI x DCN) mesh construction failed ({e}); "
+                    f"falling back to a topology-unaware mesh — expect "
+                    f"degraded cross-slice collective performance")
+
     try:
         device_array = mesh_utils.create_device_mesh(shape, devices=devices)
     except Exception:
