@@ -27,12 +27,24 @@ from ..utils.procguards import is_process0, sync_processes
 
 
 class CheckpointIO:
-    def __init__(self, exp_dir: str | Path):
+    """``async_save=True`` overlaps the TensorStore writes with subsequent
+    training steps (the device arrays are snapshotted by Orbax before save
+    returns): the state.json swing + pruning for a save are deferred until
+    the write commits — finalized lazily at the *next* save or ``close()`` —
+    so crash-safety is preserved (an unfinalized save is invisible to
+    resume; the previous checkpoint stays referenced)."""
+
+    def __init__(self, exp_dir: str | Path, *, async_save: bool = False):
         self.exp_dir = Path(exp_dir)
         import orbax.checkpoint as ocp
 
         self._ocp = ocp
-        self._checkpointer = ocp.StandardCheckpointer()
+        self.async_save = async_save
+        if async_save:
+            self._checkpointer = ocp.AsyncCheckpointer(ocp.StandardCheckpointHandler())
+        else:
+            self._checkpointer = ocp.StandardCheckpointer()
+        self._pending: Optional[tuple[Path, dict, Optional[Path]]] = None
 
     # ---- paths -------------------------------------------------------------
     @property
@@ -59,17 +71,8 @@ class CheckpointIO:
         return self._current_ckpt_dir() is not None
 
     # ---- save --------------------------------------------------------------
-    def save(self, train_state: Any, host_state: dict) -> None:
-        """Crash-safe save: each step writes a fresh ``checkpoint-<step>`` dir
-        (all hosts write their own shards in parallel; Orbax finalizes the dir
-        atomically), then process 0 atomically swings state.json to it, then
-        older checkpoints are pruned. A crash at any point leaves the previous
-        checkpoint referenced by a valid state.json."""
-        self.exp_dir.mkdir(parents=True, exist_ok=True)
-        step = int(host_state.get("global_step", 0))
-        path = self._ckpt_dir(step)
-        old = self._current_ckpt_dir()
-        self._checkpointer.save(path, train_state, force=True)
+    def _finalize(self, path: Path, host_state: dict, old: Optional[Path]) -> None:
+        """Wait for the write, then atomically publish + prune."""
         self._checkpointer.wait_until_finished()
         sync_processes("ckpt_saved")
         if is_process0():
@@ -83,10 +86,40 @@ class CheckpointIO:
                 shutil.rmtree(old, ignore_errors=True)
         sync_processes("ckpt_state_json")
 
+    def flush(self) -> None:
+        """Finalize any in-flight async save (publishes its state.json)."""
+        if self._pending is not None:
+            self._finalize(*self._pending)
+            self._pending = None
+
+    def close(self) -> None:
+        self.flush()
+        close_fn = getattr(self._checkpointer, "close", None)
+        if close_fn:  # release the AsyncCheckpointer thread pool / barriers
+            close_fn()
+
+    def save(self, train_state: Any, host_state: dict) -> None:
+        """Crash-safe save: each step writes a fresh ``checkpoint-<step>`` dir
+        (all hosts write their own shards in parallel; Orbax finalizes the dir
+        atomically), then process 0 atomically swings state.json to it, then
+        older checkpoints are pruned. A crash at any point leaves the previous
+        checkpoint referenced by a valid state.json."""
+        self.flush()
+        self.exp_dir.mkdir(parents=True, exist_ok=True)
+        step = int(host_state.get("global_step", 0))
+        path = self._ckpt_dir(step)
+        old = self._current_ckpt_dir()
+        self._checkpointer.save(path, train_state, force=True)
+        if self.async_save:
+            self._pending = (path, dict(host_state), old)
+        else:
+            self._finalize(path, host_state, old)
+
     # ---- restore -----------------------------------------------------------
     def restore(self, abstract_state: Any) -> tuple[Any, dict]:
         """abstract_state: pytree of jax.ShapeDtypeStruct *with shardings* —
         each host reads exactly its shards from TensorStore."""
+        self.flush()
         path = self._current_ckpt_dir()
         if path is None:
             raise FileNotFoundError(f"no resumable checkpoint in {self.exp_dir}")

@@ -58,6 +58,9 @@ def get_parser() -> argparse.ArgumentParser:
     parser.add_argument("--max-steps", default=None, type=int)
     parser.add_argument("--native-loader", action="store_true",
                         help="assemble batches with the C++ mmap/prefetch loader (csrc/)")
+    parser.add_argument("--async-checkpoint", action="store_true",
+                        help="overlap checkpoint writes with training (Orbax "
+                             "async; state.json publishes when the write commits)")
     parser.add_argument("--loss-chunks", type=int, default=0,
                         help=">0: compute the loss in sequence chunks, never "
                              "materializing full [B,S,V] logits (big-vocab "
@@ -132,7 +135,8 @@ def run_training(args, plan_factory: Callable, *, extra_log: Optional[dict] = No
     is_experiment = args.experiment_name is not None
     if is_experiment:
         exp_dir = exp_dir / args.experiment_name
-    io = CheckpointIO(exp_dir) if is_experiment else None
+    io = (CheckpointIO(exp_dir, async_save=args.async_checkpoint)
+          if is_experiment else None)
 
     host_state = host_state_dict()
     if io is not None and io.can_resume():
@@ -173,77 +177,81 @@ def run_training(args, plan_factory: Callable, *, extra_log: Optional[dict] = No
     profile_started = profile_done = False
     profile_start_step = 0
     done = False
-    for epoch in range(host_state["epoch"], args.num_epochs):
-        host_state["epoch"] = epoch
-        loader.set_epoch(epoch)
-        LOGGER.info(f"Begin epoch {epoch} at step {host_state['epoch_step']}")
-        batches = loader.epoch_batches(start_step=host_state["epoch_step"])
+    try:
+        for epoch in range(host_state["epoch"], args.num_epochs):
+            host_state["epoch"] = epoch
+            loader.set_epoch(epoch)
+            LOGGER.info(f"Begin epoch {epoch} at step {host_state['epoch_step']}")
+            batches = loader.epoch_batches(start_step=host_state["epoch_step"])
 
-        for i_step in range(host_state["epoch_step"], steps_per_epoch):
-            with timers["data"]:
-                batch = next(batches)
-            with timers["step"]:
-                state, metrics = trainer.step_fn(state, batch)
-                loss = float(metrics["loss"])  # forces sync, like 01:163
+            for i_step in range(host_state["epoch_step"], steps_per_epoch):
+                with timers["data"]:
+                    batch = next(batches)
+                with timers["step"]:
+                    state, metrics = trainer.step_fn(state, batch)
+                    loss = float(metrics["loss"])  # forces sync, like 01:163
 
-            host_state["global_step"] += 1
-            host_state["epoch_step"] += 1
-            host_state["running_loss"] += loss
-            if progress:
-                progress.update(1)
+                host_state["global_step"] += 1
+                host_state["epoch_step"] += 1
+                host_state["running_loss"] += loss
+                if progress:
+                    progress.update(1)
 
-            if args.profile_dir:  # trace a ~5-step steady-state window (C22)
-                if not profile_started and host_state["global_step"] >= 10:
-                    jax.profiler.start_trace(args.profile_dir)
-                    profile_started = True
-                    profile_start_step = host_state["global_step"]
-                elif profile_started and not profile_done and \
-                        host_state["global_step"] >= profile_start_step + 5:
-                    jax.profiler.stop_trace()
-                    profile_done = True
-                    LOGGER.info(f"profiler trace written to {args.profile_dir}")
+                if args.profile_dir:  # trace a ~5-step steady-state window (C22)
+                    if not profile_started and host_state["global_step"] >= 10:
+                        jax.profiler.start_trace(args.profile_dir)
+                        profile_started = True
+                        profile_start_step = host_state["global_step"]
+                    elif profile_started and not profile_done and \
+                            host_state["global_step"] >= profile_start_step + 5:
+                        jax.profiler.stop_trace()
+                        profile_done = True
+                        LOGGER.info(f"profiler trace written to {args.profile_dir}")
 
-            if host_state["global_step"] % args.log_freq == 0:
-                ms_per_step = sum(t.avg_elapsed_ms() for t in timers.values())
-                tokens_per_s = 1000 * tok_per_step / max(ms_per_step, 1e-9)
-                info = {
-                    "global_step": host_state["global_step"],
-                    "lr": lr_at_step(host_state["global_step"], args.lr),
-                    "running_loss": host_state["running_loss"] / args.log_freq,
-                    "grad_norm": float(metrics["grad_norm"]),
-                    "epoch": epoch,
-                    "epoch_progress": host_state["epoch_step"] / steps_per_epoch,
-                    "num_batches_remaining": steps_per_epoch - i_step,
-                    **get_mem_stats(),
-                    "tokens_per_s": tokens_per_s,
-                    "mfu": compute_mfu(tokens_per_s, flops_per_token, n_chips),
-                    "time/total": ms_per_step,
-                    **{f"time/{k}": t.avg_elapsed_ms() for k, t in timers.items()},
-                    **(extra_log or {}),
-                }
-                LOGGER.info(info)
-                last_info = info
-                host_state["running_loss"] = 0.0
-                for t in timers.values():
-                    t.reset()
+                if host_state["global_step"] % args.log_freq == 0:
+                    ms_per_step = sum(t.avg_elapsed_ms() for t in timers.values())
+                    tokens_per_s = 1000 * tok_per_step / max(ms_per_step, 1e-9)
+                    info = {
+                        "global_step": host_state["global_step"],
+                        "lr": lr_at_step(host_state["global_step"], args.lr),
+                        "running_loss": host_state["running_loss"] / args.log_freq,
+                        "grad_norm": float(metrics["grad_norm"]),
+                        "epoch": epoch,
+                        "epoch_progress": host_state["epoch_step"] / steps_per_epoch,
+                        "num_batches_remaining": steps_per_epoch - i_step,
+                        **get_mem_stats(),
+                        "tokens_per_s": tokens_per_s,
+                        "mfu": compute_mfu(tokens_per_s, flops_per_token, n_chips),
+                        "time/total": ms_per_step,
+                        **{f"time/{k}": t.avg_elapsed_ms() for k, t in timers.items()},
+                        **(extra_log or {}),
+                    }
+                    LOGGER.info(info)
+                    last_info = info
+                    host_state["running_loss"] = 0.0
+                    for t in timers.values():
+                        t.reset()
 
-            if io is not None and host_state["global_step"] % args.ckpt_freq == 0:
-                LOGGER.info("Saving checkpoint.")
-                io.save(state, host_state)
+                if io is not None and host_state["global_step"] % args.ckpt_freq == 0:
+                    LOGGER.info("Saving checkpoint.")
+                    io.save(state, host_state)
 
-            if args.max_steps and host_state["global_step"] >= args.max_steps:
-                done = True
+                if args.max_steps and host_state["global_step"] >= args.max_steps:
+                    done = True
+                    break
+
+            host_state["epoch_step"] = 0
+            if done:
                 break
 
-        host_state["epoch_step"] = 0
-        if done:
-            break
-
-    if profile_started and not profile_done:
-        jax.profiler.stop_trace()
-        LOGGER.info(f"profiler trace written to {args.profile_dir} "
-                    f"(run ended inside the trace window)")
-    loader.close()
-    if progress:
-        progress.close()
+    finally:
+        if profile_started and not profile_done:
+            jax.profiler.stop_trace()
+            LOGGER.info(f"profiler trace written to {args.profile_dir} "
+                        f"(run ended inside the trace window)")
+        if io is not None:
+            io.close()  # finalize any in-flight async checkpoint
+        loader.close()
+        if progress:
+            progress.close()
     return {"host_state": host_state, "last_info": last_info, "state": state}

@@ -81,3 +81,31 @@ def test_checkpoint_roundtrip_resharded(tmp_path, eight_devices):
     batch2 = {k: jax.device_put(ids, t2.batch_shardings()[k]) for k in ("input_ids", "labels")}
     _, metrics = t2.step_fn(restored, batch2)
     assert np.isfinite(float(metrics["loss"]))
+
+
+def test_async_checkpoint(tmp_path, eight_devices):
+    """Async save: state.json publishes only at finalize; an unflushed save
+    is invisible (the previous checkpoint stays resumable)."""
+    bundle = get_model("llama-debug", dtype=jnp.float32)
+    opt = adamw_cosine(1e-3)
+    t = Trainer(bundle=bundle, optimizer=opt,
+                plan=make_plan("fsdp", make_mesh(fsdp=8)), donate=False)
+    state = t.init_state(0)
+
+    io = CheckpointIO(tmp_path / "exp", async_save=True)
+    h1 = host_state_dict()
+    h1["global_step"] = 1
+    io.save(state, h1)            # in flight; not yet published
+    io.flush()
+    assert io.can_resume()
+
+    h2 = host_state_dict()
+    h2["global_step"] = 2
+    io.save(state, h2)            # in flight, never flushed
+    # a new reader (crash simulation) must still see step 1
+    io2 = CheckpointIO(tmp_path / "exp")
+    restored, host = io2.restore(abstract_train_state(t))
+    assert host["global_step"] == 1
+    io.close()                    # now step 2 publishes
+    _, host = io2.restore(abstract_train_state(t))
+    assert host["global_step"] == 2
