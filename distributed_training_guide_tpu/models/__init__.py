@@ -1,4 +1,5 @@
-from .registry import get_model, list_models, ModelBundle
-from . import llama, gpt2
+from .registry import ModelBundle, family_module, get_model, list_models
+from . import gpt2, llama, moe
 
-__all__ = ["get_model", "list_models", "ModelBundle", "llama", "gpt2"]
+__all__ = ["get_model", "list_models", "family_module", "ModelBundle",
+           "gpt2", "llama", "moe"]

@@ -1,5 +1,11 @@
 from .attention import multihead_attention
+from .cross_entropy import causal_lm_loss, chunked_causal_lm_loss
 from .rope import apply_rope, rope_frequencies
-from .cross_entropy import causal_lm_loss
 
-__all__ = ["multihead_attention", "apply_rope", "rope_frequencies", "causal_lm_loss"]
+__all__ = [
+    "multihead_attention",
+    "apply_rope",
+    "rope_frequencies",
+    "causal_lm_loss",
+    "chunked_causal_lm_loss",
+]
