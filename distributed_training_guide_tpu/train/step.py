@@ -197,6 +197,13 @@ class Trainer:
 
         attn_impl = self.attn_impl
         if self.plan.mesh.shape["cp"] > 1 and not callable(attn_impl):
+            if self.plan.mesh.shape["tp"] > 1:
+                # same XLA SPMD partitioner CHECK class as pp x tp: the
+                # fully-manual ring shard_map + tp-sharded head params abort
+                # the compiler (spmd_partitioner_util.cc)
+                raise NotImplementedError(
+                    "cp x tp is not supported yet (XLA partitioner "
+                    "limitation); shard long context over cp x fsdp/dp")
             from ..ops.ring_attention import make_ring_attention
 
             attn_impl = make_ring_attention(self.plan.mesh,
