@@ -3,14 +3,17 @@
 The reference mentions pipeline parallelism only as Llama-405B-paper context
 (``06-tensor-parallel/README.md:8``); this chapter implements it. The stacked
 layer dim of every per-layer weight is sharded over the ``pp`` mesh axis —
-stage s owns layers [s*L/pp, (s+1)*L/pp) — and the step runs a GPipe
-fill/drain schedule under a partial-manual shard_map: activations hop between
-neighbor stages via ``ppermute`` (one ICI hop), microbatches stream through,
-and the loss psums from the last stage (``parallel/pipeline.py``).
+stage s owns layers [s*L/pp, (s+1)*L/pp) — and the step runs a
+hand-differentiated 1F1B schedule under a partial-manual shard_map:
+activations hop between neighbor stages via ``ppermute`` (one ICI hop),
+cotangents ride the reverse ring, each stage recomputes its forward from a
+saved-input ring buffer (O(pp) activation memory), embed/head run only on
+the first/last stage via ``lax.cond``, and the loss psums from the last
+stage (``parallel/pipeline.py``).
 
-Composition today: pp alone, pp x dp, pp x fsdp (2-D); pp x tp needs a pure
-pp x tp submesh (XLA partitioner limitation, see pipeline.py). Bubble overhead
-is (pp-1)/(M+pp-1) for M microbatches — default M = 2*pp.
+Composition: pp x dp, pp x fsdp, pp x tp, pp x tp x fsdp (tp is a second
+manual axis: megatron shards + vocab-parallel embed/head/loss). Bubble
+overhead is (pp-1)/(M+pp-1) for M microbatches — default M = 2*pp.
 
 When to reach for pp instead of fsdp: layers that no longer fit even sharded
 (very deep models), DCN-connected slices where fsdp's per-layer all-gathers

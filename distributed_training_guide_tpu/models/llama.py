@@ -30,6 +30,7 @@ import jax
 import jax.numpy as jnp
 
 from ..ops.attention import multihead_attention
+from ..ops.collectives import psum as _psum
 from ..ops.rope import apply_rope
 
 
@@ -137,18 +138,25 @@ def _rmsnorm(x: jnp.ndarray, scale: jnp.ndarray, eps: float) -> jnp.ndarray:
 
 def attention_sublayer(config, x: jnp.ndarray, attn_params: dict, norm_scale,
                        positions: jnp.ndarray, attn_impl,
-                       standard_layout: bool = True) -> jnp.ndarray:
+                       standard_layout: bool = True,
+                       tp_axis: Optional[str] = None) -> jnp.ndarray:
     """norm -> rope'd GQA attention -> output proj (residual added by caller).
 
     Shared by the dense Llama block and the MoE family (config is duck-typed:
-    needs num_heads/num_kv_heads/head_size/rope_theta/rms_norm_eps/dtype)."""
+    needs num_heads/num_kv_heads/head_size/rope_theta/rms_norm_eps/dtype).
+
+    ``tp_axis``: set when called inside a shard_map region where tp is a
+    *manual* axis (the pipeline schedule) — weights arrive as per-member head
+    shards (head counts are inferred from the weight shapes, not the config)
+    and the output projection's partial sum is psum'd explicitly, the
+    megatron Rowwise reduction GSPMD otherwise inserts."""
     b, s, e = x.shape
     d = config.head_size
     cdt = config.dtype
     h = _rmsnorm(x, norm_scale, config.rms_norm_eps)
-    q = (h @ attn_params["wq"].astype(cdt)).reshape(b, s, config.num_heads, d)
-    k = (h @ attn_params["wk"].astype(cdt)).reshape(b, s, config.num_kv_heads, d)
-    v = (h @ attn_params["wv"].astype(cdt)).reshape(b, s, config.num_kv_heads, d)
+    q = (h @ attn_params["wq"].astype(cdt)).reshape(b, s, -1, d)
+    k = (h @ attn_params["wk"].astype(cdt)).reshape(b, s, -1, d)
+    v = (h @ attn_params["wv"].astype(cdt)).reshape(b, s, -1, d)
     q = apply_rope(q, positions, config.rope_theta)
     k = apply_rope(k, positions, config.rope_theta)
     if callable(attn_impl):  # e.g. ring attention under context parallelism
@@ -157,13 +165,17 @@ def attention_sublayer(config, x: jnp.ndarray, attn_params: dict, norm_scale,
         attn = multihead_attention(q, k, v, causal=True, positions=positions,
                                    kv_positions=positions, impl=attn_impl,
                                    standard_layout=standard_layout)
-    return attn.reshape(b, s, config.num_heads * d) @ attn_params["wo"].astype(cdt)
+    out = attn.reshape(b, s, -1) @ attn_params["wo"].astype(cdt)
+    if tp_axis is not None:
+        out = _psum(out, tp_axis)
+    return out
 
 
 def _block(config: LlamaConfig, x: jnp.ndarray, layer: dict,
            positions: jnp.ndarray, attn_impl: str,
            activation_sharding: Optional[Any] = None,
-           standard_layout: bool = True) -> jnp.ndarray:
+           standard_layout: bool = True,
+           tp_axis: Optional[str] = None) -> jnp.ndarray:
     cdt = config.dtype
 
     def constrain(y):
@@ -172,13 +184,15 @@ def _block(config: LlamaConfig, x: jnp.ndarray, layer: dict,
         return y
 
     attn = attention_sublayer(config, x, layer["attn"], layer["input_norm"],
-                              positions, attn_impl, standard_layout)
+                              positions, attn_impl, standard_layout, tp_axis)
     x = constrain(x + attn)
 
     h = _rmsnorm(x, layer["post_attn_norm"], config.rms_norm_eps)
     gate = h @ layer["mlp"]["gate"].astype(cdt)
     up = h @ layer["mlp"]["up"].astype(cdt)
     down = (jax.nn.silu(gate) * up) @ layer["mlp"]["down"].astype(cdt)
+    if tp_axis is not None:  # megatron Rowwise: down-proj partial sums
+        down = _psum(down, tp_axis)
     return constrain(x + down)
 
 

@@ -1,0 +1,18 @@
+"""Manual-collective helpers shared by shard_map regions.
+
+``psum``: like ``jax.lax.psum`` but upcasting sub-fp32 floats to fp32 on
+non-TPU backends — jaxlib 0.9's CPU runtime aborts on a bf16 all-reduce
+(hlo_instruction.cc CHECK "Invalid binary instruction opcode copy"), which
+would otherwise kill the virtual-mesh test suite. On TPU the native bf16
+all-reduce is used (half the ICI bytes).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def psum(x: jnp.ndarray, axis) -> jnp.ndarray:
+    if jax.default_backend() != "tpu" and x.dtype in (jnp.bfloat16, jnp.float16):
+        return jax.lax.psum(x.astype(jnp.float32), axis).astype(x.dtype)
+    return jax.lax.psum(x, axis)

@@ -65,6 +65,10 @@ STRATEGIES: dict[str, dict[str, Any]] = {
     "pp": {"layers": "pp"},
     "pp_fsdp": {"layers": "pp", "embed": "fsdp", "vocab": "fsdp"},
     "pp_tp": {"layers": "pp", "heads": "tp", "kv": "tp", "mlp": "tp", "vocab": "tp"},
+    # pp x tp x fsdp: tp is manual inside the pipeline shard_map (megatron
+    # shards + vocab-parallel embed/head), fsdp stays auto on the embed dim
+    "pp_tp_fsdp": {"layers": "pp", "heads": "tp", "kv": "tp", "mlp": "tp",
+                   "vocab": "tp", "embed": "fsdp"},
     # chapter 10 (beyond the reference): MoE expert parallelism — the expert
     # dim of stacked expert weights lives on ep; GSPMD derives the token
     # all-to-all from the dispatch/combine einsums (models/moe.py)

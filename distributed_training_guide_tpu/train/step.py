@@ -197,13 +197,8 @@ class Trainer:
 
         attn_impl = self.attn_impl
         if self.plan.mesh.shape["cp"] > 1 and not callable(attn_impl):
-            if self.plan.mesh.shape["tp"] > 1:
-                # same XLA SPMD partitioner CHECK class as pp x tp: the
-                # fully-manual ring shard_map + tp-sharded head params abort
-                # the compiler (spmd_partitioner_util.cc)
-                raise NotImplementedError(
-                    "cp x tp is not supported yet (XLA partitioner "
-                    "limitation); shard long context over cp x fsdp/dp")
+            # only cp is manual inside the ring shard_map, so tp-sharded head
+            # dims stay auto (GSPMD) and cp x tp composes
             from ..ops.ring_attention import make_ring_attention
 
             attn_impl = make_ring_attention(self.plan.mesh,
@@ -221,15 +216,18 @@ class Trainer:
                 "loss_chunks is not supported under pipeline parallelism or "
                 "for MoE models yet — it would be silently ignored")
 
+        grad_fn = None
         if self.plan.mesh.shape["pp"] > 1:
             if self.bundle.apply_with_aux is not None:
                 raise NotImplementedError(
                     "MoE models are not supported under pipeline parallelism "
-                    "yet (the GPipe schedule would drop the router aux loss); "
+                    "yet (the 1F1B schedule would drop the router aux loss); "
                     "use ep/ep_fsdp plans for MoE")
-            from ..parallel.pipeline import make_pipeline_loss
+            from ..parallel.pipeline import make_pipeline_value_and_grad
 
-            loss_on_microbatch = make_pipeline_loss(
+            # the pipeline hand-differentiates its 1F1B schedule (cotangents
+            # ride the reverse ppermute), so it IS the value-and-grad
+            grad_fn = make_pipeline_value_and_grad(
                 self.bundle, self.plan, microbatches=self.pp_microbatches,
                 remat=self.remat, remat_policy=policy, attn_impl=attn_impl,
                 loss_fn=self.loss_fn)
@@ -282,7 +280,8 @@ class Trainer:
                     logits = jax.lax.with_sharding_constraint(logits, logits_sharding)
                 return self.loss_fn(logits, mb["labels"])
 
-        grad_fn = jax.value_and_grad(loss_on_microbatch)
+        if grad_fn is None:
+            grad_fn = jax.value_and_grad(loss_on_microbatch)
 
         def train_step(state: TrainState, batch: dict):
             if self.grad_accum > 1:

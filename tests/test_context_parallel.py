@@ -58,3 +58,19 @@ def test_cp_training_matches_single_device(eight_devices):
     np.testing.assert_allclose(cp, golden, rtol=2e-4)
     cp_fsdp = run(make_plan("fsdp", make_mesh(cp=2, fsdp=2)))
     np.testing.assert_allclose(cp_fsdp, golden, rtol=2e-4)
+    # cp x tp: the ring is manual only over cp, tp stays auto inside it
+    cp_tp = run(make_plan("tp", make_mesh(cp=2, tp=2)))
+    np.testing.assert_allclose(cp_tp, golden, rtol=2e-4)
+
+
+def test_ring_attention_zigzag_noncausal(eight_devices):
+    # non-causal path: every chunk pair is live; relayout must still invert
+    mesh = make_mesh(cp=4)
+    ring = make_ring_attention(mesh, causal=False)
+    ks = jax.random.split(jax.random.key(2), 3)
+    q = jax.random.normal(ks[0], (2, 32, 4, 16), jnp.float32)
+    k = jax.random.normal(ks[1], (2, 32, 2, 16), jnp.float32)
+    v = jax.random.normal(ks[2], (2, 32, 2, 16), jnp.float32)
+    ref = _xla_attention(q, k, v, causal=False, positions=None, kv_positions=None)
+    out = jax.jit(ring)(q, k, v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=1e-5, atol=1e-5)
