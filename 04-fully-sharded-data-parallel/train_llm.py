@@ -40,7 +40,7 @@ from distributed_training_guide_tpu.train.cli import get_parser, run_training
 def main():
     parser = get_parser()
     parser.add_argument("--cpu-offload", action="store_true",
-                        help="keep optimizer state in host memory (reference 04:85)")
+                        help="keep params AND optimizer state in host memory between steps (reference CPUOffloadPolicy, 04:85)")
     args = parser.parse_args()
     maybe_initialize_distributed()
 
@@ -48,7 +48,9 @@ def main():
         n = len(jax.devices())
         return make_plan("fsdp", make_mesh(fsdp=n))
 
-    run_training(args, plan_factory)
+    run_training(args, plan_factory,
+                 offload_opt_state=args.cpu_offload,
+                 offload_params=args.cpu_offload)
 
 
 if __name__ == "__main__":

@@ -1,0 +1,45 @@
+"""Resumable pretrained-weight download (reference ``05:download.py:1-20``).
+
+Fetches the model's safetensors snapshot with ``huggingface_hub``, which
+resumes partial files — at 191 files / ~764 GB for Llama-3.1-405B
+(``05/README.md:48``) interrupted downloads are the norm, not the exception.
+Point ``--local-dir`` at node-local disk, not a shared network drive (the
+reference measures 50 min vs 3 min init from shared vs local storage,
+``05/README.md:55``), then run ``convert_llama.py`` on the result to produce
+the sharded Orbax checkpoint the training script loads directly.
+
+Usage:
+    python download.py --model meta-llama/Llama-3.1-405B --local-dir /nvme/llama-405b
+"""
+from __future__ import annotations
+
+import argparse
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--model", default="meta-llama/Llama-3.1-405B")
+    parser.add_argument("--local-dir", required=True,
+                        help="node-local destination (NOT a shared net drive)")
+    parser.add_argument("--workers", type=int, default=8)
+    args = parser.parse_args()
+
+    try:
+        from huggingface_hub import snapshot_download
+    except ImportError as e:  # zero-egress test images ship without it
+        raise SystemExit(
+            "huggingface_hub is required for downloading; on hermetic "
+            "machines place the safetensors snapshot at --local-dir "
+            "yourself and skip this step") from e
+
+    snapshot_download(
+        args.model,
+        local_dir=args.local_dir,
+        allow_patterns=["*.safetensors", "*.json", "tokenizer*"],
+        max_workers=args.workers,
+    )
+    print(f"snapshot complete: {args.local_dir}")
+
+
+if __name__ == "__main__":
+    main()

@@ -49,6 +49,10 @@ def main():
     parser.add_argument("--tensor-parallel", type=int, default=1)
     parser.add_argument("--pretrained", default=None,
                         help="directory produced by convert_llama.py")
+    parser.add_argument("--offload-params", action="store_true",
+                        help="params live in pinned host memory between steps "
+                             "(fetch per step); pairs with --offload-opt-state "
+                             "for the reference's full CPUOffloadPolicy")
     parser.add_argument("--offload-opt-state", action="store_true",
                         help="Adam state in pinned host memory (reference 05:69-72)")
     parser.add_argument("--no-checkpoint-activations", dest="checkpoint_activations",
@@ -64,7 +68,8 @@ def main():
         return make_plan(strategy, make_mesh(tp=tp, fsdp=n // tp))
 
     run_training(args, plan_factory, pretrained_dir=args.pretrained,
-                 offload_opt_state=args.offload_opt_state)
+                 offload_opt_state=args.offload_opt_state,
+                 offload_params=args.offload_params)
 
 
 if __name__ == "__main__":

@@ -304,7 +304,7 @@ def main() -> None:
     else:  # --watchdog 0: no time limit
         deadline = time.time() + 86400
 
-    probe = _run_child(["--probe"], budget=min(120, deadline - time.time()))
+    probe = _run_child(["--probe"], budget=min(75, deadline - time.time()))
     platform = probe[-1].get("platform", "tpu") if probe else "tpu"
 
     if args.model is not None or args.batch is not None or args.seq is not None:
@@ -319,7 +319,7 @@ def main() -> None:
         ladder = [
             dict(model="llama-650m", batch=8, seq=2048, steps=args.steps,
                  warmup=args.warmup, remat=True, attn_impl=args.attn_impl,
-                 budget=650),
+                 budget=600),
             dict(model="llama-650m", batch=4, seq=1024, steps=6, warmup=2,
                  remat=True, attn_impl=args.attn_impl, budget=360),
             dict(model="llama-debug", batch=8, seq=512, steps=6, warmup=2,
@@ -332,31 +332,42 @@ def main() -> None:
 
     ladder_log = _Best.ladder = []
     final = None
-    for rung in ladder:
-        spec = {k: v for k, v in rung.items() if k != "budget"}
-        for attempt in range(2):  # retry a fully-stalled rung once
-            budget = min(rung["budget"], deadline - time.time())
-            if budget < 90:
-                ladder_log.append({"model": rung["model"], "seq": rung["seq"],
-                                   "status": "skipped_no_time"})
-                break
-            lines = _run_child(["--rung", json.dumps(spec)], budget)
-            results = [r for r in lines if r.get("metric") == "mfu" and r["value"] > 0]
-            if results:
-                best = results[-1]
-                status = "ok" if not best.get("partial") else "partial"
-                ladder_log.append({"model": rung["model"], "seq": rung["seq"],
-                                   "status": status,
-                                   "steps_timed": best["detail"]["steps_timed"]})
-                if _Best.result is None or best["value"] > _Best.result["value"]:
-                    _Best.result = dict(best)
-                if final is None:
-                    final = dict(best)
-                break
+
+    def try_rung(rung, attempt):
+        nonlocal final
+        budget = min(rung["budget"], deadline - time.time())
+        if budget < 90:
             ladder_log.append({"model": rung["model"], "seq": rung["seq"],
-                               "status": f"stalled_attempt_{attempt + 1}"})
-        if final is not None and not final.get("partial"):
-            break  # full run on the biggest rung that fit — done
+                               "status": "skipped_no_time"})
+            return False
+        spec = {k: v for k, v in rung.items() if k != "budget"}
+        lines = _run_child(["--rung", json.dumps(spec)], budget)
+        results = [r for r in lines if r.get("metric") == "mfu" and r["value"] > 0]
+        if not results:
+            ladder_log.append({"model": rung["model"], "seq": rung["seq"],
+                               "status": f"stalled_attempt_{attempt}"})
+            return False
+        best = results[-1]
+        ladder_log.append({"model": rung["model"], "seq": rung["seq"],
+                           "status": "ok" if not best.get("partial") else "partial",
+                           "steps_timed": best["detail"]["steps_timed"]})
+        if _Best.result is None or best["value"] > _Best.result["value"]:
+            _Best.result = dict(best)
+        if final is None:
+            final = dict(best)
+        return True
+
+    # pass 1: one attempt per rung, stopping at the first full success —
+    # on a sick pool a smaller config may finish where the big one stalls
+    for rung in ladder:
+        if try_rung(rung, attempt=1) and ladder_log[-1]["status"] == "ok":
+            break
+    # pass 2: nothing landed at all — spend what remains retrying (compile
+    # cache makes retries cheap if the pool has recovered)
+    if final is None:
+        for rung in ladder:
+            if try_rung(rung, attempt=2):
+                break
 
     if final is None:
         final = _Best.result  # a later partial is better than nothing

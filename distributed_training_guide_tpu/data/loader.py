@@ -137,6 +137,10 @@ class ShardedBatchLoader:
         pending: list[dict] = []
         for step in range(start_step, n):
             idx = order[step * self.global_batch_size:(step + 1) * self.global_batch_size]
+            # sorted for memmap read locality only: which sequences form the
+            # batch is shuffled (order above); their within-batch order is
+            # deliberately left ascending — example->device-slot assignment
+            # carries no semantics in this loop (grads sum over the batch)
             np_batch = self.dataset[np.sort(idx)]
             ids = self._make_global_array(np_batch)
             pending.append({"input_ids": ids, "labels": ids})

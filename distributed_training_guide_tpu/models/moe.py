@@ -20,10 +20,12 @@ einsum formulation — the design GSPMD was literally built around:
 Attention/norms/embedding reuse the dense Llama pieces so the families cannot
 drift.
 
-Known limitation (round-2 target): the one-hot dispatch/combine tensors are
-[T_local, E, C] — with tokens sharded over the data axes (dp/fsdp/ep are all
-data axes) this is modest per chip, but a *single-device* run at long seq pays
-O(T^2/E) memory; an index-based (sort/gather) dispatch removes that.
+Dispatch is index-based (stable sort by expert + positional rank within the
+group): O(k*T) index arrays and [E, C, D] expert buffers instead of the
+GShard one-hot [T, E, C] dispatch/combine tensors, whose memory grows
+O(T^2 * k / E * E) = O(T^2 * k) at fixed capacity factor. The router also
+reports the dropped-(token, choice) fraction, surfaced as the
+``moe_dropped_frac`` train metric.
 """
 from __future__ import annotations
 
@@ -149,12 +151,20 @@ def param_logical_axes(config: MoELlamaConfig) -> dict:
 
 
 def _moe_ffn(config: MoELlamaConfig, x: jnp.ndarray, moe: dict):
-    """Top-k routed FFN (GShard dispatch/combine einsums). x: [B, S, D].
-    Returns (y, aux_loss)."""
+    """Top-k routed FFN with index-based (sort/gather) dispatch. x: [B, S, D].
+    Returns (y, aux_loss, dropped_frac).
+
+    Dispatch is O(k*T) index arrays + [E, C, D] expert buffers — the round-1
+    one-hot formulation materialized [T, E, C] dispatch/combine tensors
+    (O(T^2 * k) floats at fixed capacity factor, ~640 MB at T=8k, k=2).
+    Capacity priority is greedy by choice rank then token order (all rank-0
+    choices before any rank-1), identical to the old sequential assignment.
+    """
     b, s, d = x.shape
     t = b * s
     ex, k = config.num_experts, config.experts_per_token
     capacity = max(int(math.ceil(config.capacity_factor * k * t / ex)), 1)
+    cdt = config.dtype
 
     xt = x.reshape(t, d)
     router_logits = (xt.astype(jnp.float32)
@@ -165,43 +175,58 @@ def _moe_ffn(config: MoELlamaConfig, x: jnp.ndarray, moe: dict):
     # renormalize the chosen weights (Mixtral convention)
     topk_probs = topk_probs / jnp.sum(topk_probs, axis=-1, keepdims=True)
 
-    # sequential-greedy capacity assignment per choice rank
-    dispatch = jnp.zeros((t, ex, capacity), jnp.float32)
-    combine = jnp.zeros((t, ex, capacity), jnp.float32)
-    used = jnp.zeros((ex,), jnp.int32)
-    for j in range(k):
-        onehot = jax.nn.one_hot(topk_idx[:, j], ex, dtype=jnp.float32)  # [T, E]
-        pos = jnp.cumsum(onehot, axis=0) - 1.0 + used[None, :].astype(jnp.float32)
-        fits = (pos < capacity) & (onehot > 0)
-        pos_oh = jax.nn.one_hot(pos.astype(jnp.int32), capacity,
-                                dtype=jnp.float32) * fits[..., None]     # [T, E, C]
-        dispatch = dispatch + pos_oh
-        combine = combine + pos_oh * topk_probs[:, j][:, None, None]
-        used = used + jnp.sum(onehot * fits, axis=0).astype(jnp.int32)
+    # flatten (token, choice) pairs choice-rank-major -> greedy priority
+    expert_flat = topk_idx.T.reshape(k * t)                      # [kT]
+    weight_flat = topk_probs.T.reshape(k * t)
+    token_flat = jnp.tile(jnp.arange(t), k)
 
-    expert_in = jnp.einsum("tec,td->ecd", dispatch.astype(config.dtype), xt)
-    h = jax.nn.silu(jnp.einsum("ecd,edf->ecf", expert_in, moe["gate"].astype(config.dtype)))
-    h = h * jnp.einsum("ecd,edf->ecf", expert_in, moe["up"].astype(config.dtype))
-    expert_out = jnp.einsum("ecf,efd->ecd", h, moe["down"].astype(config.dtype))
-    y = jnp.einsum("tec,ecd->td", combine.astype(config.dtype), expert_out)
+    # slot within each expert's buffer = rank of this pair among same-expert
+    # pairs (stable sort keeps greedy priority order within a group)
+    order = jnp.argsort(expert_flat, stable=True)
+    sorted_e = expert_flat[order]
+    group_start = jnp.searchsorted(sorted_e, sorted_e, side="left")
+    pos_sorted = jnp.arange(k * t, dtype=jnp.int32) - group_start.astype(jnp.int32)
+    pos_flat = jnp.zeros((k * t,), jnp.int32).at[order].set(pos_sorted)
 
-    # Switch load-balance loss: E * sum_e (token fraction)_e * (mean prob)_e
-    token_frac = jnp.mean(jax.nn.one_hot(topk_idx[:, 0], ex, dtype=jnp.float32), axis=0)
+    keep = pos_flat < capacity
+    dropped_frac = 1.0 - jnp.mean(keep.astype(jnp.float32))
+    # overflow pairs scatter to a sacrificial row that is sliced off
+    dest = jnp.where(keep, expert_flat * capacity + pos_flat, ex * capacity)
+
+    buf = jnp.zeros((ex * capacity + 1, d), cdt)
+    expert_in = buf.at[dest].set(xt[token_flat].astype(cdt))[:-1]
+    expert_in = expert_in.reshape(ex, capacity, d)
+
+    h = jax.nn.silu(jnp.einsum("ecd,edf->ecf", expert_in, moe["gate"].astype(cdt)))
+    h = h * jnp.einsum("ecd,edf->ecf", expert_in, moe["up"].astype(cdt))
+    expert_out = jnp.einsum("ecf,efd->ecd", h, moe["down"].astype(cdt))
+
+    out_flat = expert_out.reshape(ex * capacity, d)
+    y_choice = out_flat[jnp.clip(dest, 0, ex * capacity - 1)]
+    y_choice = jnp.where(keep[:, None], y_choice, 0)
+    y = jnp.zeros((t, d), cdt).at[token_flat].add(
+        y_choice * weight_flat[:, None].astype(cdt))
+
+    # Switch load-balance loss over ALL k dispatched choices (normalized by
+    # k): E * sum_e (choice fraction)_e * (mean prob)_e — counting only the
+    # first choice would never penalize second-choice hot spots
+    token_frac = jnp.mean(jax.nn.one_hot(topk_idx, ex, dtype=jnp.float32),
+                          axis=(0, 1))
     prob_frac = jnp.mean(probs, axis=0)
     aux = ex * jnp.sum(token_frac * prob_frac)
-    return y.reshape(b, s, d), aux
+    return y.reshape(b, s, d), aux, dropped_frac
 
 
 def _block(config: MoELlamaConfig, carry, layer: dict, positions, attn_impl,
            standard_layout=True):
-    x, aux_acc = carry
+    x, aux_acc, dropped_acc = carry
     attn = attention_sublayer(config, x, layer["attn"], layer["input_norm"],
                               positions, attn_impl, standard_layout)
     x = x + attn
 
     h = _rmsnorm(x, layer["post_attn_norm"], config.rms_norm_eps)
-    y, aux = _moe_ffn(config, h, layer["moe"])
-    return (x + y, aux_acc + aux)
+    y, aux, dropped = _moe_ffn(config, h, layer["moe"])
+    return (x + y, aux_acc + aux, dropped_acc + dropped)
 
 
 def apply_with_aux(
@@ -214,8 +239,13 @@ def apply_with_aux(
     remat_policy: Optional[Any] = None,
     attn_impl: str = "auto",
     activation_sharding: Optional[Any] = None,
+    return_metrics: bool = False,
 ):
-    """Forward -> (logits [B,S,V] fp32, mean router aux loss)."""
+    """Forward -> (logits [B,S,V] fp32, mean router aux loss[, metrics]).
+
+    ``return_metrics`` adds a dict of routing observability scalars
+    (currently ``dropped_frac``: mean fraction of (token, choice) pairs that
+    overflowed expert capacity) without changing the stable 2-tuple API."""
     standard_layout = positions is None
     if positions is None:
         positions = jnp.arange(input_ids.shape[1])[None, :]
@@ -231,23 +261,36 @@ def apply_with_aux(
         if activation_sharding is not None:
             new_carry = (jax.lax.with_sharding_constraint(new_carry[0],
                                                           activation_sharding),
-                         new_carry[1])
+                         *new_carry[1:])
         return new_carry, None
 
     if remat:
         policy = remat_policy or jax.checkpoint_policies.nothing_saveable
         scan_body = jax.checkpoint(scan_body, policy=policy, prevent_cse=False)
 
-    (x, aux), _ = jax.lax.scan(scan_body, (x, jnp.zeros((), jnp.float32)),
-                               params["layers"])
+    zero = jnp.zeros((), jnp.float32)
+    (x, aux, dropped), _ = jax.lax.scan(scan_body, (x, zero, zero),
+                                        params["layers"])
 
     logits = llama.lm_head_logits(config, params, x)
-    return logits, aux / config.num_layers
+    aux = aux / config.num_layers
+    if return_metrics:
+        return logits, aux, {"moe_dropped_frac": dropped / config.num_layers}
+    return logits, aux
 
 
 def apply(config, params, input_ids, positions=None, **kw):
     logits, _ = apply_with_aux(config, params, input_ids, positions, **kw)
     return logits
+
+
+# embedding/head sub-forwards are shared with the dense family (identical
+# params layout) — re-exported for the pipeline schedule's stage-0/last-stage
+# entry points and the chunked loss
+embed_tokens = llama.embed_tokens
+output_weights = llama.output_weights
+final_hidden = llama.final_hidden
+lm_head_logits = llama.lm_head_logits
 
 
 PRESETS = {

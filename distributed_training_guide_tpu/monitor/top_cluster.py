@@ -1,12 +1,19 @@
-"""Cluster-wide device monitor.
+"""Cluster-wide device monitor with activity + hang detection.
 
 Parity with the reference's ``top-cluster.py`` (ssh + nvidia-smi poll,
-``top-cluster.py:16-94``; hang heuristic = power-draw drop,
-``diagnosing-errors/README.md:7-19``): poll every host for per-chip HBM usage
-and an activity proxy, aggregate per node + cluster. TPU runtimes don't expose
-power per chip the way nvidia-smi does; the analogous stall signal is
-duty-cycle / HBM churn — we report bytes_in_use and peak since last poll from
-``jax.local_devices()[i].memory_stats()``.
+``top-cluster.py:16-94``) including its *hang heuristic*: the reference
+watches power draw and calls a node wedged when power drops while a job is
+resident (``diagnosing-errors/README.md:7-19``). TPU runtimes don't expose
+per-chip power the way nvidia-smi does; the analogous activity signal here is
+**allocator churn** — ``memory_stats()``'s ``num_allocs``/``bytes_in_use``
+counters move every step while a training job is making progress, and freeze
+when a collective deadlocks or the runtime wedges (memory stays *resident*,
+so HBM alone cannot distinguish busy from hung — exactly why the reference
+uses power, not memory).
+
+Each poll computes a per-host activity signature; ``--alert-after N``
+(default 3) consecutive identical signatures on a host with resident memory
+raises a STALLED alert on that row and in the cluster summary line.
 
 Modes:
   --local            one-shot stats for this host (also the ssh payload)
@@ -32,6 +39,7 @@ def local_stats() -> dict:
             "hbm_gb": round(1e-9 * s.get("bytes_in_use", 0), 2),
             "hbm_peak_gb": round(1e-9 * s.get("peak_bytes_in_use", 0), 2),
             "hbm_limit_gb": round(1e-9 * s.get("bytes_limit", 0), 2),
+            "num_allocs": s.get("num_allocs", 0),
         })
     return {"host": __import__("os").uname().nodename, "devices": devs}
 
@@ -46,11 +54,65 @@ def poll_host(host: str, timeout: float = 20.0) -> dict:
         return {"host": host, "error": str(e)}
 
 
+class ClusterWatch:
+    """Per-host activity tracking + stall detection (pure logic — the ssh
+    polling loop feeds it, and the unit tests feed it fake hosts)."""
+
+    def __init__(self, alert_after: int = 3):
+        self.alert_after = alert_after
+        self._last_sig: dict = {}
+        self._static_polls: dict = {}
+
+    def update(self, stats: dict) -> dict:
+        """Digest one host's poll result -> row dict with keys host, status
+        (ok | idle | stalled | error), hbm_gb, hbm_limit_gb, static_polls."""
+        host = stats.get("host", "?")
+        if "error" in stats:
+            return {"host": host, "status": "error", "error": stats["error"]}
+        used = sum(d["hbm_gb"] for d in stats["devices"])
+        limit = sum(d["hbm_limit_gb"] for d in stats["devices"])
+        sig = tuple((d["id"], d["num_allocs"], d["hbm_gb"], d["hbm_peak_gb"])
+                    for d in stats["devices"])
+        if self._last_sig.get(host) == sig:
+            self._static_polls[host] = self._static_polls.get(host, 0) + 1
+        else:
+            self._static_polls[host] = 0
+        self._last_sig[host] = sig
+
+        static = self._static_polls[host]
+        resident = used > 0.05  # a job's arrays are on the chips
+        if resident and static >= self.alert_after:
+            status = "stalled"
+        elif static >= self.alert_after:
+            status = "idle"
+        else:
+            status = "ok"
+        return {"host": host, "status": status, "hbm_gb": used,
+                "hbm_limit_gb": limit, "n_devices": len(stats["devices"]),
+                "static_polls": static}
+
+
+def format_row(row: dict) -> str:
+    if row["status"] == "error":
+        return f"{row['host']:<24} ERROR {row['error']}"
+    line = (f"{row['host']:<24} {row['n_devices']} chips  "
+            f"hbm {row['hbm_gb']:7.1f}/{row['hbm_limit_gb']:7.1f} GB")
+    if row["status"] == "stalled":
+        line += (f"  *** STALLED? no allocator activity for "
+                 f"{row['static_polls']} polls (see diagnosing-errors/) ***")
+    elif row["status"] == "idle":
+        line += "  (idle)"
+    return line
+
+
 def main():
     parser = argparse.ArgumentParser()
     parser.add_argument("--local", action="store_true")
     parser.add_argument("--hosts", default=None, help="file with one host per line")
     parser.add_argument("--interval", type=float, default=10.0)
+    parser.add_argument("--alert-after", type=int, default=3,
+                        help="polls without allocator activity before a "
+                             "resident host is flagged STALLED")
     args = parser.parse_args()
 
     if args.local or not args.hosts:
@@ -58,24 +120,22 @@ def main():
         return
 
     hosts = [h.strip() for h in open(args.hosts) if h.strip()]
+    watch = ClusterWatch(alert_after=args.alert_after)
     while True:
         t0 = time.time()
-        total_used = total_limit = n_dev = n_err = 0
+        total_used = total_limit = n_dev = n_err = n_stalled = 0
         for host in hosts:
-            stats = poll_host(host)
-            if "error" in stats:
+            row = watch.update(poll_host(host))
+            print(format_row(row))
+            if row["status"] == "error":
                 n_err += 1
-                print(f"{host:<24} ERROR {stats['error']}")
                 continue
-            used = sum(d["hbm_gb"] for d in stats["devices"])
-            limit = sum(d["hbm_limit_gb"] for d in stats["devices"])
-            total_used += used
-            total_limit += limit
-            n_dev += len(stats["devices"])
-            print(f"{host:<24} {len(stats['devices'])} chips  "
-                  f"hbm {used:7.1f}/{limit:7.1f} GB")
+            total_used += row["hbm_gb"]
+            total_limit += row["hbm_limit_gb"]
+            n_dev += row["n_devices"]
+            n_stalled += row["status"] == "stalled"
         print(f"{'CLUSTER':<24} {n_dev} chips  hbm {total_used:7.1f}/"
-              f"{total_limit:7.1f} GB  unreachable={n_err}\n")
+              f"{total_limit:7.1f} GB  stalled={n_stalled} unreachable={n_err}\n")
         time.sleep(max(0.0, args.interval - (time.time() - t0)))
 
 

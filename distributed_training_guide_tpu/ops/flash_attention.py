@@ -343,6 +343,16 @@ def flash_attention(
     """Blockwise fused attention; returns [B, S, Hq, D] in q.dtype."""
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
+    d = q.shape[-1]
+    if not interpret and (q.shape[1] % 8 or k.shape[1] % 8 or d % 64):
+        # without a tile-divisible block the kernel would fall back to one
+        # full-sequence block — certain VMEM blowup / opaque Mosaic errors on
+        # TPU. The "auto" dispatcher (ops/attention.py) guards this; a forced
+        # impl="flash" fails loudly instead.
+        raise ValueError(
+            f"flash_attention needs seq divisible by 8 and head_dim by 64; "
+            f"got seq_q={q.shape[1]}, seq_k={k.shape[1]}, head_dim={d} — "
+            f"pad the sequence or use impl='xla'")
     qt = q.transpose(0, 2, 1, 3)
     kt = k.transpose(0, 2, 1, 3)
     vt = v.transpose(0, 2, 1, 3)

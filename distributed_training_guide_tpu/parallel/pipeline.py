@@ -125,20 +125,34 @@ def make_pipeline_value_and_grad(
     vocab_tp = tp > 1  # vocab-parallel embed/head (llama-only, checked above)
     tp_axis = "tp" if tp > 1 else None
 
+    # MoE stages carry the router aux loss out of the scan; dense stages
+    # return a constant zero aux so the schedule has one shape everywhere
+    moe_family = bundle.apply_with_aux is not None
+    aux_coef = getattr(cfg, "router_aux_coef", 0.0) if moe_family else 0.0
+
     def stage_fn(layers_local, x, positions):
         tp_kw = {"tp_axis": tp_axis} if tp_axis else {}  # llama-only kwarg
         block = functools.partial(mod._block, cfg, positions=positions,
                                   attn_impl=attn_impl, **tp_kw)
 
-        def body(carry, layer_params):
-            return block(carry, layer_params), None
+        if moe_family:
+            def body(carry, layer_params):
+                # moe carry: (x, aux_acc, dropped_acc); dropped is a metric
+                # only — not plumbed through the pipeline schedule
+                return block(carry, layer_params), None
+        else:
+            def body(carry, layer_params):
+                x, aux = carry
+                return (block(x, layer_params), aux), None
 
         if remat:
             body = jax.checkpoint(
                 body, prevent_cse=False,
                 policy=remat_policy or jax.checkpoint_policies.nothing_saveable)
-        x, _ = jax.lax.scan(body, x, layers_local)
-        return x
+        zero = jnp.zeros((), jnp.float32)
+        carry0 = (x, zero, zero) if moe_family else (x, zero)
+        out, _ = jax.lax.scan(body, carry0, layers_local)
+        return out[0], out[1]
 
     def embed_fn(nl_params, ids, positions):
         # nl_params: the non-"layers" subtree of params
@@ -195,7 +209,14 @@ def make_pipeline_value_and_grad(
             else:
                 x_in = buf
             saved = saved.at[t % K].set(x_in)
-            y = stage_fn(layers, x_in, positions)
+            y, aux_t = stage_fn(layers, x_in, positions)
+            if aux_coef:
+                # router aux loss of this stage's layers for its resident
+                # microbatch (t-s), masked to real ticks. loss_acc is divided
+                # by M once at the end, so only the per-layer mean goes here.
+                vf = (t - s >= 0) & (t - s < M)
+                loss_acc = loss_acc + jnp.where(vf, aux_t, 0.0) * (
+                    aux_coef / n_layers)
 
             o = t - (pp - 1)
             if 0 <= o < M:
@@ -235,7 +256,10 @@ def make_pipeline_value_and_grad(
                                                    keepdims=False)
             _, vjp = jax.vjp(lambda lp, x: stage_fn(lp, x, positions),
                              layers, x_saved)
-            d_layers, dx = vjp(dy)
+            # second cotangent: the aux-loss path (zero for dense families)
+            daux = jnp.where(valid, aux_coef / (M * n_layers), 0.0).astype(
+                jnp.float32)
+            d_layers, dx = vjp((dy, daux))
             g_layers = jax.tree.map(lambda a, b: a + b.astype(jnp.float32),
                                     g_layers, d_layers)
 

@@ -39,6 +39,10 @@ STRATEGIES: dict[str, dict[str, Any]] = {
     # chapter 02 + ZeRO-1: params replicated, *optimizer state* sharded (the
     # optimizer-state rules below are applied by train/optimizer.py)
     "zero1": {},
+    # ZeRO-2 (deepspeed stage 2): params replicated, optimizer state AND the
+    # gradient-accumulation buffer sharded over the data axes — the grads'
+    # reduce-scatter replaces DDP's all-reduce, and full grads never persist
+    "zero2": {},
     # chapter 04: FULL_SHARD — every weight matrix sharded on its embed dim
     "fsdp": {
         "embed": "fsdp",
@@ -115,6 +119,7 @@ class ShardingPlan:
     rules: dict
     sequence_sharded: bool = False  # SP: shard the seq dim of activations on tp
     zero1: bool = False             # shard optimizer state over the data axes
+    zero2: bool = False             # zero1 + shard persistent gradients too
 
     # ---- batch / data ------------------------------------------------------
     @property
@@ -185,17 +190,33 @@ class ShardingPlan:
             return {**self.rules, **ZERO1_RULES}
         return self.rules
 
+    def grad_shardings(self, logical_axes_tree, shape_tree) -> Any:
+        """Shardings for *persistent* gradient buffers (ZeRO-2): grads follow
+        the optimizer-state layout, so under zero2 the accumulation buffer is
+        reduce-scattered across the data axes instead of living replicated."""
+        rules = self.optimizer_state_rules() if self.zero2 else self.rules
+        is_ax = lambda x: isinstance(x, tuple)
+        return jax.tree.map(
+            lambda ax, sd: NamedSharding(self.mesh, spec_for_leaf(self.mesh, ax, sd.shape, rules)),
+            logical_axes_tree, shape_tree,
+            is_leaf=is_ax,
+        )
+
     def replicated(self) -> NamedSharding:
         return NamedSharding(self.mesh, P())
 
 
 def make_plan(strategy: str, mesh: Mesh, *, sequence_sharded: Optional[bool] = None,
-              zero1: Optional[bool] = None) -> ShardingPlan:
+              zero1: Optional[bool] = None,
+              zero2: Optional[bool] = None) -> ShardingPlan:
     if strategy not in STRATEGIES:
         raise ValueError(f"unknown strategy {strategy!r}; choose from {sorted(STRATEGIES)}")
     if sequence_sharded is None:
         sequence_sharded = strategy in ("tp", "tp_fsdp")
+    if zero2 is None:
+        zero2 = strategy == "zero2"
     if zero1 is None:
-        zero1 = strategy == "zero1"
+        zero1 = strategy == "zero1" or zero2
     return ShardingPlan(mesh=mesh, strategy=strategy, rules=STRATEGIES[strategy],
-                        sequence_sharded=sequence_sharded, zero1=zero1)
+                        sequence_sharded=sequence_sharded, zero1=zero1,
+                        zero2=zero2)

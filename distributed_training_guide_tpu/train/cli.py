@@ -65,6 +65,14 @@ def get_parser() -> argparse.ArgumentParser:
                         help=">0: compute the loss in sequence chunks, never "
                              "materializing full [B,S,V] logits (big-vocab "
                              "memory saver)")
+    parser.add_argument("--wandb", action="store_true",
+                        help="log the info dict to wandb (reference C27; "
+                             "process-0 single run by default, resumable via "
+                             "a run id stored beside state.json)")
+    parser.add_argument("--wandb-project", default=None)
+    parser.add_argument("--wandb-per-host", action="store_true",
+                        help="grouped per-host runs instead of one process-0 "
+                             "run (wandb-configurations pattern 2)")
     parser.add_argument("--profile-dir", default=None,
                         help="capture a jax.profiler trace of steps 10-15 into this dir "
                              "(view with xprof/tensorboard; see diagnosing-errors/)")
@@ -74,6 +82,7 @@ def get_parser() -> argparse.ArgumentParser:
 def run_training(args, plan_factory: Callable, *, extra_log: Optional[dict] = None,
                  pretrained_dir: Optional[str] = None,
                  offload_opt_state: bool = False,
+                 offload_params: bool = False,
                  pp_microbatches: Optional[int] = None) -> dict:
     """The chapter-invariant training loop. Returns final metrics (for tests).
 
@@ -116,6 +125,7 @@ def run_training(args, plan_factory: Callable, *, extra_log: Optional[dict] = No
         loss_chunks=args.loss_chunks,
         attn_impl=args.attn_impl,
         offload_opt_state=offload_opt_state,
+        offload_params=offload_params,
         pp_microbatches=pp_microbatches,
     )
 
@@ -156,6 +166,12 @@ def run_training(args, plan_factory: Callable, *, extra_log: Optional[dict] = No
             LOGGER.info(f"Resumed=False | {host_state}")
     if is_experiment:
         exp_dir.mkdir(parents=True, exist_ok=True)
+
+    from ..utils.tracking import make_tracker
+
+    tracker = make_tracker(
+        args, mode="per-host" if getattr(args, "wandb_per_host", False) else "process0",
+        exp_dir=exp_dir if is_experiment else None, config=vars(args))
 
     timers = {k: LocalTimer() for k in ["data", "step"]}
     flops_per_token = transformer_flops_per_token(
@@ -216,6 +232,8 @@ def run_training(args, plan_factory: Callable, *, extra_log: Optional[dict] = No
                         "lr": lr_at_step(host_state["global_step"], args.lr),
                         "running_loss": host_state["running_loss"] / args.log_freq,
                         "grad_norm": float(metrics["grad_norm"]),
+                        **{k: float(v) for k, v in metrics.items()
+                           if k not in ("loss", "grad_norm")},
                         "epoch": epoch,
                         "epoch_progress": host_state["epoch_step"] / steps_per_epoch,
                         "num_batches_remaining": steps_per_epoch - i_step,
@@ -227,6 +245,7 @@ def run_training(args, plan_factory: Callable, *, extra_log: Optional[dict] = No
                         **(extra_log or {}),
                     }
                     LOGGER.info(info)
+                    tracker.log(info, step=host_state["global_step"])
                     last_info = info
                     host_state["running_loss"] = 0.0
                     for t in timers.values():
@@ -251,6 +270,7 @@ def run_training(args, plan_factory: Callable, *, extra_log: Optional[dict] = No
                         f"(run ended inside the trace window)")
         if io is not None:
             io.close()  # finalize any in-flight async checkpoint
+        tracker.finish()
         loader.close()
         if progress:
             progress.close()

@@ -8,7 +8,9 @@ precision, and the engine owns backward/step/checkpoint
 keeps the config-file surface (similar keys where they make sense) but maps
 stages to sharding plans:
 
-    stage 0 -> ddp, stage 1 -> zero1, stage 2/3 -> fsdp  (+ tensor_parallel)
+    stage 0 -> ddp, stage 1 -> zero1 (opt state sharded),
+    stage 2 -> zero2 (opt state + grads sharded, params replicated),
+    stage 3 -> fsdp (params sharded too)  (+ tensor_parallel)
 
 Eager ``backward()``/``step()`` calls make no sense under XLA — the engine's
 ``train_batch(batch)`` is the whole fused step (what DeepSpeed's pair does,
@@ -38,7 +40,7 @@ from typing import Any, Optional
 import jax
 import numpy as np
 
-_STAGE_TO_STRATEGY = {0: "ddp", 1: "zero1", 2: "fsdp", 3: "fsdp"}
+_STAGE_TO_STRATEGY = {0: "ddp", 1: "zero1", 2: "zero2", 3: "fsdp"}
 
 
 class TrainingEngine:
@@ -71,9 +73,11 @@ class TrainingEngine:
             mesh = make_mesh(tp=tp)
         else:
             mesh = make_mesh()
-        # ZeRO-1's optimizer-state sharding is orthogonal to tp: keep it when
-        # stage 1 is combined with tensor_parallel
-        plan = make_plan(strategy, mesh, zero1=(stage == 1))
+        # ZeRO-1/2 sharding is orthogonal to tp: keep the optimizer-state
+        # (and for stage 2 the gradient-buffer) sharding when the strategy
+        # string was rewritten for tensor_parallel
+        plan = make_plan(strategy, mesh, zero1=(stage in (1, 2)) or None,
+                         zero2=(stage == 2) or None)
 
         opt_cfg = config.get("optimizer", {}).get("params", {})
         sched = config.get("scheduler", {})

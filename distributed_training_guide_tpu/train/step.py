@@ -101,18 +101,18 @@ class Trainer:
     loss_fn: Callable = causal_lm_loss
     donate: bool = True
     offload_opt_state: bool = False
+    offload_params: bool = False  # params live in host memory between steps
     pp_microbatches: Optional[int] = None  # pipeline microbatches (default 2*pp)
 
     def __post_init__(self):
         if self.plan is None:
             self.plan = make_plan("single", make_mesh(devices=jax.devices()[:1]))
-        if self.offload_opt_state and jax.default_backend() != "tpu":
-            import logging
-
-            logging.getLogger(__name__).warning(
-                "offload_opt_state requires a TPU backend with pinned_host "
-                "memory; keeping optimizer state on device")
-            self.offload_opt_state = False
+        if self.offload_opt_state or self.offload_params:
+            kinds = {m.kind for m in jax.local_devices()[0].addressable_memories()}
+            if "pinned_host" not in kinds:
+                raise ValueError(
+                    f"host offload needs a backend with pinned_host memory "
+                    f"(this one has {sorted(kinds)})")
 
     # ---- shapes & shardings ------------------------------------------------
     @cached_property
@@ -128,18 +128,28 @@ class Trainer:
         return self.plan.param_shardings(self.logical_axes, self.param_shapes)
 
     @cached_property
-    def state_shardings(self) -> TrainState:
+    def opt_shardings_device(self):
         opt_shapes = jax.eval_shape(self.optimizer.init, self.param_shapes)
-        opt_sh = _opt_state_shardings(self.plan, opt_shapes, self.logical_axes,
-                                      self.param_shapes)
+        return _opt_state_shardings(self.plan, opt_shapes, self.logical_axes,
+                                    self.param_shapes)
+
+    @cached_property
+    def state_shardings(self) -> TrainState:
+        opt_sh = self.opt_shardings_device
         if self.offload_opt_state:
             # reference C5 (CPUOffloadPolicy, 04:85 / 05:69-72): Adam moments
             # live in pinned host memory; XLA streams them in/out around the
             # (fused) update.
             opt_sh = jax.tree.map(lambda s: s.with_memory_kind("pinned_host"), opt_sh)
+        param_sh = self.param_shardings
+        if self.offload_params:
+            # full C5: parameter storage is pinned host too — the step fetches
+            # them to HBM, computes, and the updated params stream back out
+            param_sh = jax.tree.map(lambda s: s.with_memory_kind("pinned_host"),
+                                    param_sh)
         return TrainState(
             step=NamedSharding(self.plan.mesh, P()),
-            params=self.param_shardings,
+            params=param_sh,
             opt_state=opt_sh,
             rng=NamedSharding(self.plan.mesh, P()),
         )
@@ -163,6 +173,20 @@ class Trainer:
                           rng=jax.random.key_data(train_rng))
 
     @cached_property
+    def _device_state_shardings(self) -> TrainState:
+        """state_shardings with default (device) memory kinds — the jit-init
+        target; XLA rejects mixed-memory out_shardings on the init program, so
+        offloaded storage is established by a device_put after init."""
+        default_kind = jax.local_devices()[0].default_memory().kind
+        return jax.tree.map(lambda s: s.with_memory_kind(default_kind),
+                            self.state_shardings)
+
+    def _place(self, state: TrainState) -> TrainState:
+        if self.offload_opt_state or self.offload_params:
+            return jax.device_put(state, self.state_shardings)
+        return state
+
+    @cached_property
     def init_state(self) -> Callable[[jax.Array], TrainState]:
         """Returns jitted (seed) -> TrainState, materialized *sharded* — big
         models never exist unsharded anywhere (the reference needs meta-device
@@ -173,8 +197,8 @@ class Trainer:
             params = self.bundle.init(self.bundle.config, init_rng)
             return self._fresh_state(params, train_rng)
 
-        jitted = jax.jit(make, out_shardings=self.state_shardings)
-        return lambda seed: jitted(jnp.asarray(seed, jnp.uint32))
+        jitted = jax.jit(make, out_shardings=self._device_state_shardings)
+        return lambda seed: self._place(jitted(jnp.asarray(seed, jnp.uint32)))
 
     def init_state_from_params(self, params, seed: int = 0) -> TrainState:
         """Fresh optimizer state around externally-loaded (pretrained) params
@@ -185,8 +209,8 @@ class Trainer:
             return self._fresh_state(params, train_rng)
 
         jitted = jax.jit(make, in_shardings=(self.param_shardings, None),
-                         out_shardings=self.state_shardings)
-        return jitted(params, jnp.asarray(seed, jnp.uint32))
+                         out_shardings=self._device_state_shardings)
+        return self._place(jitted(params, jnp.asarray(seed, jnp.uint32)))
 
     # ---- the step ----------------------------------------------------------
     @cached_property
@@ -216,34 +240,39 @@ class Trainer:
                 "loss_chunks is not supported under pipeline parallelism or "
                 "for MoE models yet — it would be silently ignored")
 
+        # every loss branch returns (loss, extras) where extras is a dict of
+        # auxiliary scalar metrics with the static key set ``extra_keys``
         grad_fn = None
+        extra_keys: tuple = ()
         if self.plan.mesh.shape["pp"] > 1:
-            if self.bundle.apply_with_aux is not None:
-                raise NotImplementedError(
-                    "MoE models are not supported under pipeline parallelism "
-                    "yet (the 1F1B schedule would drop the router aux loss); "
-                    "use ep/ep_fsdp plans for MoE")
             from ..parallel.pipeline import make_pipeline_value_and_grad
 
             # the pipeline hand-differentiates its 1F1B schedule (cotangents
             # ride the reverse ppermute), so it IS the value-and-grad
-            grad_fn = make_pipeline_value_and_grad(
+            pp_vag = make_pipeline_value_and_grad(
                 self.bundle, self.plan, microbatches=self.pp_microbatches,
                 remat=self.remat, remat_policy=policy, attn_impl=attn_impl,
                 loss_fn=self.loss_fn)
+
+            def grad_fn(params, mb):
+                loss, grads = pp_vag(params, mb)
+                return (loss, {}), grads
         elif self.bundle.apply_with_aux is not None:
             apply_aux = self.bundle.apply_with_aux
             aux_coef = getattr(cfg, "router_aux_coef", 0.0)
+            extra_keys = ("moe_dropped_frac",)
 
             def loss_on_microbatch(params, mb):
-                logits, aux = apply_aux(cfg, params, mb["input_ids"],
-                                        positions=mb.get("positions"),
-                                        remat=self.remat, remat_policy=policy,
-                                        attn_impl=attn_impl,
-                                        activation_sharding=act_sharding)
+                logits, aux, moe_metrics = apply_aux(
+                    cfg, params, mb["input_ids"],
+                    positions=mb.get("positions"),
+                    remat=self.remat, remat_policy=policy,
+                    attn_impl=attn_impl,
+                    activation_sharding=act_sharding, return_metrics=True)
                 if logits_sharding is not None:
                     logits = jax.lax.with_sharding_constraint(logits, logits_sharding)
-                return self.loss_fn(logits, mb["labels"]) + aux_coef * aux
+                loss = self.loss_fn(logits, mb["labels"]) + aux_coef * aux
+                return loss, jax.lax.stop_gradient(moe_metrics)
         elif self.loss_chunks > 0:
             from ..models.registry import family_module
             from ..ops.cross_entropy import chunked_causal_lm_loss
@@ -268,7 +297,7 @@ class Trainer:
                 w_out = mod.output_weights(cfg, params)
                 return chunked_causal_lm_loss(hidden, w_out, mb["labels"],
                                               num_chunks=n_chunks,
-                                              logits_sharding=logits_sharding)
+                                              logits_sharding=logits_sharding), {}
         else:
             def loss_on_microbatch(params, mb):
                 logits = apply(cfg, params, mb["input_ids"],
@@ -278,44 +307,83 @@ class Trainer:
                                activation_sharding=act_sharding)
                 if logits_sharding is not None:  # loss-parallel (vocab sharded)
                     logits = jax.lax.with_sharding_constraint(logits, logits_sharding)
-                return self.loss_fn(logits, mb["labels"])
+                return self.loss_fn(logits, mb["labels"]), {}
 
         if grad_fn is None:
-            grad_fn = jax.value_and_grad(loss_on_microbatch)
+            grad_fn = jax.value_and_grad(loss_on_microbatch, has_aux=True)
 
         def train_step(state: TrainState, batch: dict):
+            params = state.params
+            opt_state = state.opt_state
             if self.grad_accum > 1:
+                grad_sh = (self.plan.grad_shardings(self.logical_axes,
+                                                    self.param_shapes)
+                           if self.plan.zero2 else None)
+
                 def accum(carry, mb):
-                    loss_sum, grads_sum = carry
-                    loss, grads = grad_fn(state.params, mb)
+                    loss_sum, extras_sum, grads_sum = carry
+                    (loss, extras), grads = grad_fn(params, mb)
+                    grads_sum = jax.tree.map(jnp.add, grads_sum, grads)
+                    if grad_sh is not None:
+                        # ZeRO-2: the persistent accum buffer stays sharded
+                        # over the data axes (reduce-scatter per microbatch)
+                        grads_sum = jax.lax.with_sharding_constraint(
+                            grads_sum, grad_sh)
                     return (loss_sum + loss,
-                            jax.tree.map(jnp.add, grads_sum, grads)), None
+                            jax.tree.map(jnp.add, extras_sum, extras),
+                            grads_sum), None
 
                 zeros = jax.tree.map(lambda p: jnp.zeros(p.shape, jnp.float32),
-                                     state.params)
-                (loss_sum, grads), _ = jax.lax.scan(accum, (jnp.zeros((), jnp.float32), zeros), batch)
+                                     params)
+                zero_extras = {k: jnp.zeros((), jnp.float32) for k in extra_keys}
+                (loss_sum, extras, grads), _ = jax.lax.scan(
+                    accum, (jnp.zeros((), jnp.float32), zero_extras, zeros), batch)
                 loss = loss_sum / self.grad_accum
+                extras = {k: v / self.grad_accum for k, v in extras.items()}
                 grads = jax.tree.map(lambda g: (g / self.grad_accum).astype(jnp.float32), grads)
             else:
-                loss, grads = grad_fn(state.params, batch)
+                (loss, extras), grads = grad_fn(params, batch)
 
-            updates, new_opt = self.optimizer.update(grads, state.opt_state, state.params)
-            new_params = optax.apply_updates(state.params, updates)
+            updates, new_opt = self.optimizer.update(grads, opt_state, params)
+            new_params = optax.apply_updates(params, updates)
             metrics = {
                 "loss": loss.astype(jnp.float32),
                 "grad_norm": optax.global_norm(grads).astype(jnp.float32),
+                **{k: v.astype(jnp.float32) for k, v in extras.items()},
             }
             new_state = TrainState(step=state.step + 1, params=new_params,
                                    opt_state=new_opt, rng=state.rng)
             return new_state, metrics
 
-        metric_sharding = {"loss": self.plan.replicated(), "grad_norm": self.plan.replicated()}
-        return jax.jit(
+        metric_sharding = {"loss": self.plan.replicated(),
+                           "grad_norm": self.plan.replicated(),
+                           **{k: self.plan.replicated() for k in extra_keys}}
+        offloading = self.offload_params or self.offload_opt_state
+        jitted = jax.jit(
             train_step,
-            in_shardings=(self.state_shardings, self.batch_shardings()),
-            out_shardings=(self.state_shardings, metric_sharding),
+            in_shardings=(self._device_state_shardings, self.batch_shardings()),
+            out_shardings=(self._device_state_shardings, metric_sharding),
             donate_argnums=(0,) if self.donate else (),
         )
+        if not offloading:
+            return jitted
+
+        # Offloaded storage is managed OUTSIDE the jit: pinned_host -> HBM
+        # before the step, HBM -> pinned_host after, both async device_puts.
+        # In-jit memory-kind boundaries would let XLA stream leaf-by-leaf, but
+        # this jaxlib's SPMD partitioner rejects the placement annotation it
+        # emits for the rank-0 step/loss outputs whenever any boundary leaf is
+        # host-placed (spmd_partitioner.cc RET_CHECK "Side-effect HLO must
+        # have sharding"). Whole-state transfers match the reference's CPU
+        # offload semantics anyway (full grad D2H + host optimizer.step,
+        # 05/README.md:191-224); HBM still only holds params/opt state for
+        # the duration of the step.
+        def step_and_offload(state, batch):
+            state = jax.device_put(state, self._device_state_shardings)
+            new_state, metrics = jitted(state, batch)
+            return self._place(new_state), metrics
+
+        return step_and_offload
 
     # ---- accounting --------------------------------------------------------
     def tokens_per_step(self, per_device_batch: int, seq_len: int) -> int:

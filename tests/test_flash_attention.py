@@ -62,3 +62,11 @@ def test_uneven_blocks():
     ref = _xla_attention(q, k, v, causal=True, positions=None, kv_positions=None)
     out = flash_attention(q, k, v, causal=True, interpret=True)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=1e-5, atol=1e-5)
+
+
+def test_forced_flash_rejects_untiled_shapes():
+    """Compiled (non-interpret) flash with tile-indivisible shapes must fail
+    loudly, not fall back to a full-sequence block (opaque Mosaic errors)."""
+    q, k, v = make_qkv(1, 96, 2, 2, 32)
+    with pytest.raises(ValueError, match="divisible"):
+        flash_attention(q, k, v, causal=True, interpret=False)

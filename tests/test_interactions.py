@@ -37,10 +37,18 @@ def golden():
     return run("single", {})
 
 
-def test_cp_with_tp_raises(eight_devices):
-    """cp x tp aborts the XLA partitioner — must raise, not crash."""
-    with pytest.raises(NotImplementedError):
-        run("tp", {"cp": 2, "tp": 2}, sequence_sharded=False)
+def test_moe_dropped_frac_metric(eight_devices):
+    """MoE steps surface the routing overflow fraction as a metric."""
+    bundle = get_model("moe-debug", dtype=jnp.float32)
+    t = Trainer(bundle=bundle, optimizer=adamw_cosine(1e-3),
+                plan=make_plan("ep", make_mesh(ep=2)), donate=False)
+    state = t.init_state(0)
+    ids = np.random.RandomState(0).randint(0, 512, (GB, SEQ))
+    batch = {k: jax.device_put(jnp.asarray(ids), t.batch_shardings()[k])
+             for k in ("input_ids", "labels")}
+    _, m = t.step_fn(state, batch)
+    frac = float(m["moe_dropped_frac"])
+    assert 0.0 <= frac <= 1.0
 
 
 def test_pp_with_grad_accum(eight_devices):

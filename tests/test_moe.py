@@ -61,11 +61,12 @@ def test_moe_overflow_tokens_get_zero_output():
         "down": jax.random.normal(rng, (ex, f, d)) * 0.02,
     }
     x = jnp.ones((1, 16, d))
-    y, _ = _moe_ffn(cfg, x, moe_params)
+    y, _, dropped = _moe_ffn(cfg, x, moe_params)
     y = np.asarray(y)[0]
     norms = np.linalg.norm(y, axis=-1)
     assert (norms[:2] > 0).all(), "in-capacity tokens must get expert output"
     np.testing.assert_array_equal(norms[2:], 0.0)
+    np.testing.assert_allclose(float(dropped), 14 / 16, rtol=1e-6)
 
 
 def test_ep_matches_single_device(eight_devices):

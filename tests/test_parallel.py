@@ -115,3 +115,41 @@ def test_grad_accumulation_matches(eight_devices):
     for a, b in zip(jax.tree.leaves(jax.device_get(s1.params)),
                     jax.tree.leaves(jax.device_get(s2.params))):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-6)
+
+
+def test_host_offload_matches_golden(golden, eight_devices):
+    """Full C5 host offload (params + opt state in pinned_host) is a pure
+    storage-placement change: trajectory identical, params actually on host."""
+    bundle = get_model("llama-debug", dtype=jnp.float32)
+    t = Trainer(bundle=bundle, optimizer=adamw_cosine(1e-3),
+                plan=make_plan("fsdp", make_mesh(fsdp=8)), donate=False,
+                offload_opt_state=True, offload_params=True)
+    losses, state = run_steps(t)
+    np.testing.assert_allclose(losses, golden[0], rtol=2e-4)
+    assert state.params["final_norm"].sharding.memory_kind == "pinned_host"
+    kinds = {getattr(l.sharding, "memory_kind", None)
+             for l in jax.tree.leaves(state.opt_state) if hasattr(l, "sharding")}
+    assert "pinned_host" in kinds
+
+
+def test_zero2_matches_golden_and_shards_grads(golden, eight_devices):
+    """DeepSpeed stage 2 semantics: params replicated, opt state sharded,
+    persistent (accumulated) grads sharded over the data axes."""
+    bundle = get_model("llama-debug", dtype=jnp.float32)
+    t = Trainer(bundle=bundle, optimizer=adamw_cosine(1e-3),
+                plan=make_plan("zero2", make_mesh()), grad_accum=2,
+                donate=False)
+    losses, state = run_steps(t, accum=2)
+    # same trajectory as single-device at equal total tokens is NOT expected
+    # (2x tokens/step with accum=2) — instead compare against ddp with the
+    # same accumulation
+    t_ddp = Trainer(bundle=bundle, optimizer=adamw_cosine(1e-3),
+                    plan=make_plan("ddp", make_mesh()), grad_accum=2,
+                    donate=False)
+    losses_ddp, _ = run_steps(t_ddp, accum=2)
+    np.testing.assert_allclose(losses, losses_ddp, rtol=2e-4)
+    # params replicated, optimizer moments sharded
+    wq = state.params["layers"]["attn"]["wq"]
+    assert wq.sharding.spec == ()or wq.sharding.is_fully_replicated
+    mu_leaves = [l for l in jax.tree.leaves(state.opt_state) if hasattr(l, "sharding") and l.ndim > 0]
+    assert any(not l.sharding.is_fully_replicated for l in mu_leaves)

@@ -105,3 +105,27 @@ def test_pp_gpt2_family(eight_devices):
              for k in ("input_ids", "labels")}
     losses = [float(t.step_fn(state, batch)[1]["loss"])]
     np.testing.assert_allclose(losses, glosses, rtol=2e-4)
+
+
+def test_pp_moe_family(eight_devices):
+    """MoE under the 1F1B schedule: router aux loss flows through the
+    per-tick vjp (cotangent on the stage's aux output) and the trajectory
+    matches the single-device MoE run."""
+    bundle = get_model("moe-debug", dtype=jnp.float32)
+    ids = np.random.RandomState(0).randint(0, 512, (GB, SEQ))
+
+    def run_moe(plan, **kw):
+        t = Trainer(bundle=bundle, optimizer=adamw_cosine(1e-3), plan=plan,
+                    donate=False, attn_impl="xla", **kw)
+        state = t.init_state(0)
+        batch = {k: jax.device_put(jnp.asarray(ids), t.batch_shardings()[k])
+                 for k in ("input_ids", "labels")}
+        losses = []
+        for _ in range(2):
+            state, m = t.step_fn(state, batch)
+            losses.append(float(m["loss"]))
+        return losses
+
+    golden = run_moe(make_plan("single", make_mesh(devices=jax.devices()[:1])))
+    pp = run_moe(make_plan("pp", make_mesh(pp=2)), pp_microbatches=2)
+    np.testing.assert_allclose(pp, golden, rtol=2e-4)

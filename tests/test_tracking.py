@@ -1,0 +1,110 @@
+"""wandb integration smoke tests (reference C27) with a stubbed offline wandb.
+
+wandb is a soft dependency and absent from the hermetic test image, so these
+tests inject a minimal stand-in that mimics ``WANDB_MODE=offline`` behavior
+(a run directory on disk, no network) and assert the live integration paths:
+flag wiring through ``run_training``, the process-0 pattern, per-host groups,
+and run-id persistence for resume.
+"""
+import sys
+import types
+
+import numpy as np
+import pytest
+
+from distributed_training_guide_tpu.parallel import make_mesh, make_plan
+from distributed_training_guide_tpu.train.cli import get_parser, run_training
+
+
+class FakeRun:
+    def __init__(self, kwargs):
+        self.kwargs = kwargs
+
+
+def make_fake_wandb(tmp_path):
+    mod = types.ModuleType("wandb")
+    mod.logged = []
+    mod.inits = []
+    mod.finished = 0
+
+    def init(**kwargs):
+        mod.inits.append(kwargs)
+        run_dir = tmp_path / "wandb" / f"offline-run-{len(mod.inits)}"
+        run_dir.mkdir(parents=True, exist_ok=True)
+        return FakeRun(kwargs)
+
+    def log(info, step=None):
+        mod.logged.append((dict(info), step))
+
+    def finish():
+        mod.finished += 1
+
+    mod.init = init
+    mod.log = log
+    mod.finish = finish
+    mod.util = types.SimpleNamespace(generate_id=lambda: "fakeid01")
+    return mod
+
+
+@pytest.fixture
+def fake_wandb(tmp_path, monkeypatch):
+    mod = make_fake_wandb(tmp_path)
+    monkeypatch.setitem(sys.modules, "wandb", mod)
+    return mod
+
+
+def make_args(tmp_path, **over):
+    args = get_parser().parse_args(["-m", "llama-debug"])
+    args.dataset_name = "synthetic:60000"
+    args.seq_length = 64
+    args.batch_size = 1
+    args.num_epochs = 1
+    args.log_freq = 2
+    args.max_steps = 4
+    args.save_dir = str(tmp_path)
+    for k, v in over.items():
+        setattr(args, k, v)
+    return args
+
+
+def test_wandb_logs_info_dict(tmp_path, fake_wandb, eight_devices):
+    args = make_args(tmp_path, wandb=True)
+    out = run_training(args, lambda: make_plan("ddp", make_mesh()))
+    assert out["host_state"]["global_step"] == 4
+    assert len(fake_wandb.inits) == 1
+    assert fake_wandb.inits[0]["project"] == "distributed-training-guide-tpu"
+    assert len(fake_wandb.logged) == 2  # log_freq=2 over 4 steps
+    info, step = fake_wandb.logged[-1]
+    assert np.isfinite(info["running_loss"]) and step == 4
+    assert fake_wandb.finished == 1
+    assert any((tmp_path / "wandb").iterdir())  # offline run dir exists
+
+
+def test_wandb_run_id_persists_for_resume(tmp_path, fake_wandb, eight_devices):
+    args = make_args(tmp_path, wandb=True, experiment_name="exp", ckpt_freq=2,
+                     max_steps=2)
+    run_training(args, lambda: make_plan("ddp", make_mesh()))
+    id_file = tmp_path / "exp" / "wandb_id.txt"
+    assert id_file.read_text() == "fakeid01"
+    assert fake_wandb.inits[0]["id"] == "fakeid01"
+    assert fake_wandb.inits[0]["resume"] == "allow"
+    # a restarted job re-uses the stored id (same curve)
+    args2 = make_args(tmp_path, wandb=True, experiment_name="exp", ckpt_freq=2,
+                      max_steps=4)
+    run_training(args2, lambda: make_plan("ddp", make_mesh()))
+    assert fake_wandb.inits[1]["id"] == "fakeid01"
+
+
+def test_wandb_per_host_pattern(tmp_path, fake_wandb, eight_devices):
+    args = make_args(tmp_path, wandb=True, wandb_per_host=True,
+                     experiment_name="grp")
+    run_training(args, lambda: make_plan("ddp", make_mesh()))
+    assert fake_wandb.inits[0]["group"] == "grp"
+    assert fake_wandb.inits[0]["name"] == "proc-0"
+
+
+def test_no_wandb_is_noop(tmp_path, eight_devices):
+    # without --wandb (and with wandb uninstalled) training runs unchanged
+    args = make_args(tmp_path)
+    out = run_training(args, lambda: make_plan("ddp", make_mesh()))
+    assert out["host_state"]["global_step"] == 4

@@ -123,3 +123,42 @@ def test_top_cluster_local():
     stats = json.loads(result.stdout.strip().splitlines()[-1])
     assert len(stats["devices"]) == 8
     assert all("hbm_gb" in d for d in stats["devices"])
+
+
+# ---- cluster monitor stall detection (reference hang heuristic, C21) -------
+
+def _host_stats(host, num_allocs, hbm=4.0):
+    return {"host": host, "devices": [
+        {"id": 0, "kind": "fake", "hbm_gb": hbm, "hbm_peak_gb": hbm,
+         "hbm_limit_gb": 16.0, "num_allocs": num_allocs}]}
+
+
+def test_monitor_flags_stalled_host():
+    from distributed_training_guide_tpu.monitor.top_cluster import (
+        ClusterWatch, format_row)
+
+    watch = ClusterWatch(alert_after=2)
+    # busy host: allocator counters move every poll -> ok forever
+    for i in range(5):
+        row = watch.update(_host_stats("busy", num_allocs=100 + i))
+        assert row["status"] == "ok"
+    # wedged host: resident memory but frozen counters -> stalled after N
+    statuses = [watch.update(_host_stats("wedged", num_allocs=42))["status"]
+                for _ in range(4)]
+    assert statuses == ["ok", "ok", "stalled", "stalled"]
+    assert "STALLED" in format_row(watch.update(_host_stats("wedged", 42)))
+    # idle host: no resident memory, frozen counters -> idle, not stalled
+    for _ in range(4):
+        row = watch.update(_host_stats("empty", num_allocs=0, hbm=0.0))
+    assert row["status"] == "idle"
+    # recovery: counters move again -> back to ok
+    assert watch.update(_host_stats("wedged", num_allocs=43))["status"] == "ok"
+
+
+def test_monitor_error_row():
+    from distributed_training_guide_tpu.monitor.top_cluster import (
+        ClusterWatch, format_row)
+
+    row = ClusterWatch().update({"host": "gone", "error": "timeout"})
+    assert row["status"] == "error"
+    assert "ERROR" in format_row(row)
