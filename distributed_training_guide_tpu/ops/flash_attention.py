@@ -242,9 +242,15 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dv_ref[0, 0] = dv_scr[:].astype(dv_ref.dtype)
 
 
-def _flash_bwd(causal, block_q, block_k, interpret, residuals, g):
-    q, k, v, o, lse = residuals
-    do = g
+def flash_bwd_with_stats(q, k, v, do, lse, delta, *, causal, block_q=512,
+                         block_k=512, interpret=False):
+    """Flash backward from caller-supplied softmax stats -> (dq, dk, dv).
+
+    ``lse``/``delta`` ([B, Hq, Sq] fp32) are normally the forward's
+    logsumexp and ``rowsum(do * o)``; ring attention passes the *global*
+    (cross-chunk) stats here to get each chunk pair's exact gradient
+    contribution without rebuilding the full attention matrix.
+    """
     b, hq, sq, d = q.shape
     _, hkv, sk, _ = k.shape
     groups = hq // hkv
@@ -253,8 +259,6 @@ def _flash_bwd(causal, block_q, block_k, interpret, residuals, g):
     nq, nk = sq // block_q, sk // block_k
     scale = 1.0 / (d ** 0.5)
 
-    delta = jnp.einsum("bhsd,bhsd->bhs", do.astype(jnp.float32),
-                       o.astype(jnp.float32))                  # [B,H,S]
     lse_l = jnp.broadcast_to(lse[..., None], (*lse.shape, 128))
     delta_l = jnp.broadcast_to(delta[..., None], (*delta.shape, 128))
 
@@ -310,6 +314,16 @@ def _flash_bwd(causal, block_q, block_k, interpret, residuals, g):
     )(q, k, v, do, lse_l, delta_l)
 
     return dq, dk, dv
+
+
+def _flash_bwd(causal, block_q, block_k, interpret, residuals, g):
+    q, k, v, o, lse = residuals
+    do = g
+    delta = jnp.einsum("bhsd,bhsd->bhs", do.astype(jnp.float32),
+                       o.astype(jnp.float32))                  # [B,H,S]
+    return flash_bwd_with_stats(q, k, v, do, lse, delta, causal=causal,
+                                block_q=block_q, block_k=block_k,
+                                interpret=interpret)
 
 
 # ---------------------------------------------------------------------------

@@ -17,25 +17,27 @@ manual:
   chunk-pairs are (r+1) + (2cp-r) = 2cp+1, identical for all ranks. Outputs
   are re-layouted back, so the wrapper is layout-transparent.
 - **ring**: K/V zigzag blocks rotate via ``jax.lax.ppermute`` (neighbor ICI
-  hops), overlapping transfer with compute; partial results merge with the
-  online-softmax (m, l, acc) update in fp32.
-- **no wasted compute**: each hop touches 4 (q-chunk, kv-chunk) pairs whose
-  causal relation (past / diagonal / future) depends only on chunk ids —
-  future pairs are *skipped* by ``lax.cond`` (no FLOPs issued), diagonal
-  pairs apply the static in-chunk causal mask, past pairs run unmasked.
-  Scores materialize per chunk pair ([S/2cp, S/2cp] fp32), not per shard
-  pair.
-- **GQA without expansion**: scores are computed with a grouped einsum
-  ([B,Hkv,G,Sq,Sk]); K/V are never ``repeat``-ed, and the ring ships
-  Hkv-sized blocks.
+  hops), overlapping transfer with compute; per-pair partial results merge
+  with the standard (o, lse) online-softmax combine in fp32.
+- **flash kernel per chunk pair**: each live (q-chunk, kv-chunk) pair runs
+  the Pallas flash kernel (``flash_attention._flash_fwd``) — scores never
+  materialize outside VMEM tiles, and GQA is kernel-native (no K/V
+  expansion). Future pairs are *skipped* by ``lax.cond`` (no FLOPs issued);
+  diagonal pairs use the kernel's causal mode.
+- **hand-written ring backward** (``jax.custom_vjp``): the backward re-runs
+  the ring with the *global* logsumexp and ``delta = rowsum(do*o)`` feeding
+  ``flash_bwd_with_stats`` per pair — the flash-attention identity that
+  makes per-chunk gradient contributions exact without any full attention
+  matrix. dk/dv accumulators travel the ring *with* their K/V blocks and
+  arrive home after a full cycle.
 
 tp composes: only ``cp`` is manual in the shard_map, so the head dim stays
 auto-sharded over tp by GSPMD inside the body (round 1's fully-manual ring
 hit an XLA SPMD partitioner CHECK against tp-sharded head weights).
 
-Backward is plain autodiff: cotangents ride the transposed ppermutes around
-the reverse ring, and ``lax.cond`` differentiates per branch, so skipped
-pairs are skipped in the backward too.
+On non-TPU backends the same kernels run under ``interpret=True`` — the
+test-suite goldens (forward and gradients vs the dense XLA reference) cover
+exactly this code path.
 """
 from __future__ import annotations
 
@@ -46,39 +48,9 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
+from .flash_attention import _flash_fwd, flash_bwd_with_stats
+
 NEG_INF = -1e30
-
-
-def _chunk_pair_update(q_chunk, k_chunk, v_chunk, m, l, acc, *, relation, scale):
-    """Online-softmax update of one (q-chunk, kv-chunk) pair.
-
-    q_chunk: [B, S_c, Hkv, G, D] (grouped query heads); k/v_chunk:
-    [B, S_c, Hkv, D]; m/l: [B, Hkv, G, S_c] fp32; acc: [B, Hkv, G, S_c, D].
-    relation: traced int32 — 0 past (full), 1 diagonal (causal), 2 future
-    (skip). Future pairs cost nothing: the skip branch of the cond is a no-op.
-    """
-
-    def compute(masked):
-        s = jnp.einsum("bqhgd,bkhd->bhgqk", q_chunk, k_chunk.astype(jnp.float32),
-                       preferred_element_type=jnp.float32) * scale
-        if masked:
-            s_c = q_chunk.shape[1]
-            tri = jnp.arange(s_c)[:, None] >= jnp.arange(s_c)[None, :]
-            s = jnp.where(tri[None, None, None], s, NEG_INF)
-        m_cur = jnp.max(s, axis=-1)
-        m_new = jnp.maximum(m, m_cur)
-        corr = jnp.exp(m - m_new)
-        p = jnp.exp(s - m_new[..., None])
-        l_new = l * corr + jnp.sum(p, axis=-1)
-        acc_new = acc * corr[..., None] + jnp.einsum(
-            "bhgqk,bkhd->bhgqd", p, v_chunk.astype(jnp.float32))
-        return m_new, l_new, acc_new
-
-    return jax.lax.cond(
-        relation >= 2, lambda: (m, l, acc),
-        lambda: jax.lax.cond(relation == 1,
-                             functools.partial(compute, True),
-                             functools.partial(compute, False)))
 
 
 def _zigzag_perms(cp: int):
@@ -129,67 +101,151 @@ def _from_zigzag(x, idx, axis_name, cp):
     return stacked.reshape(b, -1, *x.shape[3:])
 
 
-def _local_ring_attention(q, k, v, *, axis_name: str, cp: int, causal: bool):
-    """Per-shard body. q: [B, S_local, Hq, D]; k/v: [B, S_local, Hkv, D]."""
-    idx = jax.lax.axis_index(axis_name)
-    b, s_loc, hq, d = q.shape
-    hkv = k.shape[2]
-    g = hq // hkv
-    if s_loc % 2:
-        raise ValueError(f"local sequence {s_loc} must be even (2*cp chunks); "
-                         f"pad seq to a multiple of {2 * cp}")
-    s_c = s_loc // 2
-    scale = 1.0 / (d ** 0.5)
+def _merge(o, lse, o_i, lse_i):
+    """Combine two normalized flash partials ([B,H,S,D] fp32, [B,H,S] fp32)."""
+    mx = jnp.maximum(lse, lse_i)
+    mx_safe = jnp.where(mx < NEG_INF / 2, 0.0, mx)  # both-empty rows
+    w0 = jnp.exp(lse - mx_safe)
+    w1 = jnp.exp(lse_i - mx_safe)
+    tot = w0 + w1
+    safe_tot = jnp.where(tot == 0.0, 1.0, tot)
+    o_new = (o * w0[..., None] + o_i * w1[..., None]) / safe_tot[..., None]
+    lse_new = jnp.where(tot == 0.0, NEG_INF, mx_safe + jnp.log(safe_tot))
+    return o_new, lse_new
 
-    qz = _to_zigzag(q, idx, axis_name, cp)            # [B,2,S_c,Hq,D]
-    kz = _to_zigzag(k, idx, axis_name, cp)            # [B,2,S_c,Hkv,D]
-    vz = _to_zigzag(v, idx, axis_name, cp)
-    qz = qz.reshape(b, 2, s_c, hkv, g, d).astype(jnp.float32)
 
-    my_chunks = (idx, 2 * cp - 1 - idx)               # traced chunk ids
+def _relation(kv_chunk, q_chunk, causal):
+    """0 past (full attention) / 1 diagonal (causal) / 2 future (skip)."""
+    if not causal:
+        return jnp.int32(0)
+    return jnp.where(kv_chunk == q_chunk, 1,
+                     jnp.where(kv_chunk < q_chunk, 0, 2))
 
-    # carries start as constants — mark them device-varying over the ring
-    # axis so both lax.cond branches type-check under check_vma
-    def vary(x):
-        return jax.lax.pcast(x, (axis_name,), to="varying")
 
-    m = vary(jnp.full((2, b, hkv, g, s_c), NEG_INF, jnp.float32))
-    l = vary(jnp.zeros((2, b, hkv, g, s_c), jnp.float32))
-    acc = vary(jnp.zeros((2, b, hkv, g, s_c, d), jnp.float32))
+def _build_ring(axis_name: str, cp: int, causal: bool, interpret: bool):
+    """Per-shard fwd/bwd ring bodies (flash kernel per chunk pair). The
+    custom_vjp pairing them lives OUTSIDE the shard_map (make_ring_attention)
+    so shard_map's own transpose machinery is never engaged."""
 
-    ring = [(i, (i + 1) % cp) for i in range(cp)]
-    k_blk, v_blk = kz, vz
+    def ring_fwd_body(q, k, v):
+        idx = jax.lax.axis_index(axis_name)
+        b, s_loc, hq, d = q.shape
+        hkv = k.shape[2]
+        if s_loc % 2:
+            raise ValueError(f"local sequence {s_loc} must be even (2*cp "
+                             f"chunks); pad seq to a multiple of {2 * cp}")
+        s_c = s_loc // 2
 
-    # cp is static (mesh shape): the unrolled loop lets XLA overlap each
-    # hop's ppermute with the current hop's compute
-    for i in range(cp):
-        src = (idx - i) % cp                          # owner of current block
-        if i < cp - 1:
-            k_nxt = jax.lax.ppermute(k_blk, axis_name, ring)
-            v_nxt = jax.lax.ppermute(v_blk, axis_name, ring)
-        kv_chunks = (src, 2 * cp - 1 - src)
-        for a in range(2):                            # my q chunk slot
-            for c in range(2):                        # their kv chunk slot
-                if causal:
-                    # 0 past / 1 diagonal / 2 future, from chunk ids
-                    rel = jnp.where(
-                        kv_chunks[c] == my_chunks[a], 1,
-                        jnp.where(kv_chunks[c] < my_chunks[a], 0, 2))
-                else:
-                    rel = jnp.int32(0)
-                m_a, l_a, acc_a = _chunk_pair_update(
-                    qz[:, a], k_blk[:, c], v_blk[:, c],
-                    m[a], l[a], acc[a], relation=rel, scale=scale)
-                m = m.at[a].set(m_a)
-                l = l.at[a].set(l_a)
-                acc = acc.at[a].set(acc_a)
-        if i < cp - 1:
-            k_blk, v_blk = k_nxt, v_nxt
+        # zigzag chunks in kernel layout [2, B, H, S_c, D]
+        qz = _to_zigzag(q, idx, axis_name, cp).transpose(1, 0, 3, 2, 4)
+        kz = _to_zigzag(k, idx, axis_name, cp).transpose(1, 0, 3, 2, 4)
+        vz = _to_zigzag(v, idx, axis_name, cp).transpose(1, 0, 3, 2, 4)
 
-    safe_l = jnp.where(l == 0.0, 1.0, l)
-    out = acc / safe_l[..., None]                     # [2,B,Hkv,G,S_c,D]
-    out = out.transpose(1, 0, 4, 2, 3, 5).reshape(b, 2, s_c, hq, d)
-    return _from_zigzag(out.astype(q.dtype), idx, axis_name, cp)
+        my_chunks = (idx, 2 * cp - 1 - idx)
+
+        o = jnp.zeros((2, b, hq, s_c, d), jnp.float32)
+        lse = jnp.full((2, b, hq, s_c), NEG_INF, jnp.float32)
+
+        ring = [(i, (i + 1) % cp) for i in range(cp)]
+        k_blk, v_blk = kz, vz
+        for i in range(cp):
+            src = (idx - i) % cp
+            if i < cp - 1:
+                k_nxt = jax.lax.ppermute(k_blk, axis_name, ring)
+                v_nxt = jax.lax.ppermute(v_blk, axis_name, ring)
+            kv_chunks = (src, 2 * cp - 1 - src)
+            for a in range(2):
+                for c in range(2):
+                    rel = _relation(kv_chunks[c], my_chunks[a], causal)
+                    qa, kc, vc = qz[a], k_blk[c], v_blk[c]
+                    o_a, lse_a = o[a], lse[a]
+
+                    # merge runs INSIDE the cond so skipped pairs issue no
+                    # elementwise work either
+                    def live(masked, qa=qa, kc=kc, vc=vc, o_a=o_a, lse_a=lse_a):
+                        o_i, lse_i = _flash_fwd(qa, kc, vc, masked, 512, 512,
+                                                interpret)
+                        return _merge(o_a, lse_a, o_i.astype(jnp.float32),
+                                      lse_i)
+
+                    o_a, lse_a = jax.lax.cond(
+                        rel >= 2, lambda: (o_a, lse_a),
+                        lambda: jax.lax.cond(rel == 1,
+                                             functools.partial(live, True),
+                                             functools.partial(live, False)))
+                    o = o.at[a].set(o_a)
+                    lse = lse.at[a].set(lse_a)
+            if i < cp - 1:
+                k_blk, v_blk = k_nxt, v_nxt
+
+        out = _from_zigzag(o.astype(q.dtype).transpose(1, 0, 3, 2, 4),
+                           idx, axis_name, cp)
+        return out, qz, kz, vz, o, lse
+
+    def ring_bwd_body(qz, kz, vz, o, lse, do):
+        in_dtype = qz.dtype
+        idx = jax.lax.axis_index(axis_name)
+        my_chunks = (idx, 2 * cp - 1 - idx)
+
+        doz = _to_zigzag(do, idx, axis_name, cp).transpose(1, 0, 3, 2, 4)
+        doz = doz.astype(jnp.float32)
+        # global softmax stats: the flash-bwd identity needs the FINAL lse and
+        # delta = rowsum(do * o_final) — per-pair contributions then sum to
+        # the exact gradient
+        delta = jnp.einsum("abhsd,abhsd->abhs", doz, o)        # [2,B,H,S_c]
+
+        dq = jnp.zeros(qz.shape, jnp.float32)
+        dk = jnp.zeros(kz.shape, jnp.float32)
+        dv = jnp.zeros(vz.shape, jnp.float32)
+
+        ring_perm = [(i, (i + 1) % cp) for i in range(cp)]
+        k_blk, v_blk = kz, vz
+        for i in range(cp):
+            src = (idx - i) % cp
+            if i < cp - 1:
+                k_nxt = jax.lax.ppermute(k_blk, axis_name, ring_perm)
+                v_nxt = jax.lax.ppermute(v_blk, axis_name, ring_perm)
+            kv_chunks = (src, 2 * cp - 1 - src)
+            for a in range(2):
+                for c in range(2):
+                    rel = _relation(kv_chunks[c], my_chunks[a], causal)
+                    qa, kc, vc = qz[a], k_blk[c], v_blk[c]
+                    doa, lsea, dta = doz[a], lse[a], delta[a]
+                    dq_a, dk_c, dv_c = dq[a], dk[c], dv[c]
+
+                    # accumulation runs INSIDE the cond: skipped pairs cost
+                    # nothing in the backward either
+                    def live(masked, qa=qa, kc=kc, vc=vc, doa=doa, lsea=lsea,
+                             dta=dta, dq_a=dq_a, dk_c=dk_c, dv_c=dv_c):
+                        dq_i, dk_i, dv_i = flash_bwd_with_stats(
+                            qa, kc, vc, doa.astype(qa.dtype), lsea, dta,
+                            causal=masked, interpret=interpret)
+                        return (dq_a + dq_i.astype(jnp.float32),
+                                dk_c + dk_i.astype(jnp.float32),
+                                dv_c + dv_i.astype(jnp.float32))
+
+                    dq_a, dk_c, dv_c = jax.lax.cond(
+                        rel >= 2, lambda: (dq_a, dk_c, dv_c),
+                        lambda: jax.lax.cond(rel == 1,
+                                             functools.partial(live, True),
+                                             functools.partial(live, False)))
+                    dq = dq.at[a].set(dq_a)
+                    dk = dk.at[c].set(dk_c)
+                    dv = dv.at[c].set(dv_c)
+            # dk/dv travel with their K/V blocks: after the final compute one
+            # more hop completes the cycle and delivers them to their owners
+            dk = jax.lax.ppermute(dk, axis_name, ring_perm)
+            dv = jax.lax.ppermute(dv, axis_name, ring_perm)
+            if i < cp - 1:
+                k_blk, v_blk = k_nxt, v_nxt
+
+        def back(x):
+            return _from_zigzag(x.astype(in_dtype).transpose(1, 0, 3, 2, 4),
+                                idx, axis_name, cp)
+
+        return back(dq), back(dk), back(dv)
+
+    return ring_fwd_body, ring_bwd_body
 
 
 def make_ring_attention(mesh: Mesh, *, axis_name: str = "cp",
@@ -201,14 +257,55 @@ def make_ring_attention(mesh: Mesh, *, axis_name: str = "cp",
     ring composes with dp/fsdp/tp."""
     del data_axes, head_axis  # auto axes now — kept for API compat
     cp = mesh.shape[axis_name]
-    spec = P(None, axis_name, None, None)
+    interpret = jax.default_backend() != "tpu"
+    spec = P(None, axis_name, None, None)          # [B, S_loc, H, D]
+    # residual layouts: zigzag chunk tensors; the S_c dim carries the cp
+    # sharding so the residuals round-trip between the fwd and bwd shard_maps
+    chunk5 = P(None, None, None, axis_name, None)  # [2, B, H, S_c, D]
+    chunk4 = P(None, None, None, axis_name)        # [2, B, H, S_c]
 
-    body = functools.partial(_local_ring_attention, axis_name=axis_name,
-                             cp=cp, causal=causal)
-    ring = jax.shard_map(body, mesh=mesh, in_specs=(spec, spec, spec),
-                         out_specs=spec, axis_names={axis_name})
+    fwd_body, bwd_body = _build_ring(axis_name, cp, causal, interpret)
+    # check_vma=False: pallas interpret mode (the CPU test path) trips the
+    # vma checker inside its own lowering ("dynamic_slice requires varying
+    # manual axes to match")
+    sm = functools.partial(jax.shard_map, mesh=mesh, axis_names={axis_name},
+                           check_vma=False)
+    fwd_sm = sm(fwd_body, in_specs=(spec, spec, spec),
+                out_specs=(spec, chunk5, chunk5, chunk5, chunk5, chunk4))
+    bwd_sm = sm(bwd_body,
+                in_specs=(chunk5, chunk5, chunk5, chunk5, chunk4, spec),
+                out_specs=(spec, spec, spec))
+
+    # the custom_vjp sits OUTSIDE the shard_maps: jax.grad never transposes
+    # through a partial-manual shard_map (which check_vma=False forbids) —
+    # forward and backward are each a plain, non-differentiated shard_map
+    @jax.custom_vjp
+    def ring(q, k, v):
+        return fwd_sm(q, k, v)[0]
+
+    def ring_vjp_fwd(q, k, v):
+        out, *res = fwd_sm(q, k, v)
+        return out, tuple(res)
+
+    def ring_vjp_bwd(res, do):
+        return bwd_sm(*res, do)
+
+    ring.defvjp(ring_vjp_fwd, ring_vjp_bwd)
+    # partial-manual shard_map only resolves its auto-axes shardings under
+    # jit (the eager path rejects the specs); nested jit is inlined when the
+    # caller is already jitted, so this costs nothing in the train step
+    ring = jax.jit(ring)
 
     def attention(q, k, v, standard_layout: bool = True, **kwargs):
+        if not interpret and (q.shape[1] % (16 * cp) or q.shape[-1] % 64):
+            # mirror flash_attention's loud guard: per-chunk seq must tile
+            # (S/(2cp) % 8) and head_dim must fill MXU lanes, else Mosaic
+            # fails opaquely
+            raise ValueError(
+                f"ring flash attention needs seq divisible by {16 * cp} "
+                f"(8-token tiles per zigzag chunk) and head_dim divisible by "
+                f"64; got seq={q.shape[1]}, head_dim={q.shape[-1]} — pad the "
+                f"sequence or lower cp")
         if not standard_layout:
             raise ValueError(
                 "ring attention assumes contiguous positions (rank r owns "
