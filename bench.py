@@ -85,7 +85,8 @@ def run_rung(rung: dict) -> None:
         plan = make_plan("single", make_mesh(devices=devices[:1]))
 
     trainer = Trainer(bundle=bundle, optimizer=adamw_cosine(3e-4), plan=plan,
-                      remat=remat, attn_impl=rung.get("attn_impl", "auto"))
+                      remat=remat, remat_policy=rung.get("remat_policy", "all"),
+                      attn_impl=rung.get("attn_impl", "auto"))
     state = trainer.init_state(0)
 
     global_batch = batch * plan.data_parallel_size
@@ -111,7 +112,9 @@ def run_rung(rung: dict) -> None:
                 "tokens_per_s_per_chip": round(tokens_per_s / n, 1),
                 "step_ms": round(1000 * dt, 2), "n_chips": n,
                 "device": getattr(devices[0], "device_kind", devices[0].platform),
-                "remat": remat, "loss": round(loss, 4),
+                "remat": remat,
+                "remat_policy": rung.get("remat_policy", "all"),
+                "loss": round(loss, 4),
                 "steps_timed": steps_timed,
             },
         }
@@ -334,19 +337,20 @@ def main() -> None:
     final = None
 
     def try_rung(rung, attempt):
+        """Run one rung; returns its (possibly partial) result dict or None."""
         nonlocal final
         budget = min(rung["budget"], deadline - time.time())
         if budget < 90:
             ladder_log.append({"model": rung["model"], "seq": rung["seq"],
                                "status": "skipped_no_time"})
-            return False
+            return None
         spec = {k: v for k, v in rung.items() if k != "budget"}
         lines = _run_child(["--rung", json.dumps(spec)], budget)
         results = [r for r in lines if r.get("metric") == "mfu" and r["value"] > 0]
         if not results:
             ladder_log.append({"model": rung["model"], "seq": rung["seq"],
                                "status": f"stalled_attempt_{attempt}"})
-            return False
+            return None
         best = results[-1]
         ladder_log.append({"model": rung["model"], "seq": rung["seq"],
                            "status": "ok" if not best.get("partial") else "partial",
@@ -355,19 +359,33 @@ def main() -> None:
             _Best.result = dict(best)
         if final is None:
             final = dict(best)
-        return True
+        return best
 
     # pass 1: one attempt per rung, stopping at the first full success —
     # on a sick pool a smaller config may finish where the big one stalls
-    for rung in ladder:
-        if try_rung(rung, attempt=1) and ladder_log[-1]["status"] == "ok":
+    top_rung_ok = False
+    for n, rung in enumerate(ladder):
+        res = try_rung(rung, attempt=1)
+        if res is not None and not res.get("partial"):
+            top_rung_ok = n == 0
             break
     # pass 2: nothing landed at all — spend what remains retrying (compile
     # cache makes retries cheap if the pool has recovered)
     if final is None:
         for rung in ladder:
-            if try_rung(rung, attempt=2):
+            if try_rung(rung, attempt=2) is not None:
                 break
+
+    # bonus pass: the HEADLINE rung fully succeeded (pool is demonstrably
+    # healthy) — A/B the remat policy ("dots" keeps matmul outputs: less
+    # recompute, more memory) and report whichever config measured faster.
+    # Only the tuned run's own COMPLETE result may displace the verified one.
+    if top_rung_ok and platform == "tpu" and deadline - time.time() > 420:
+        tuned = dict(ladder[0], remat_policy="dots", budget=360)
+        tuned_res = try_rung(tuned, attempt=1)
+        if (tuned_res is not None and not tuned_res.get("partial")
+                and tuned_res["value"] > final["value"]):
+            final = dict(tuned_res)
 
     if final is None:
         final = _Best.result  # a later partial is better than nothing
