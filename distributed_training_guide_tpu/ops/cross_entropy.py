@@ -80,3 +80,15 @@ def chunked_causal_lm_loss(hidden: jnp.ndarray, w_out: jnp.ndarray,
         body, (jnp.zeros((), jnp.float32), jnp.zeros((), jnp.int32)),
         (h, targets))
     return nll_sum / jnp.maximum(count, 1)
+
+
+def validate_chunked_loss_support(family_mod, family: str, loss_fn) -> None:
+    """Common preconditions for the chunked loss (checked by both the plain
+    and the pipeline step builders)."""
+    if not hasattr(family_mod, "output_weights"):
+        raise NotImplementedError(
+            f"loss_chunks unsupported for family {family!r}")
+    if loss_fn is not causal_lm_loss:
+        raise NotImplementedError(
+            "loss_chunks hardwires the causal-LM loss; drop the custom "
+            "loss_fn or the chunking")

@@ -88,6 +88,7 @@ def make_pipeline_value_and_grad(
     remat_policy=None,
     attn_impl: str = "auto",
     loss_fn: Callable = causal_lm_loss,
+    loss_chunks: int = 0,
 ) -> Callable:
     """Returns f(params, batch) -> (loss, grads) running the 1F1B schedule
     over plan.mesh's pp (and tp) axes. batch: {'input_ids','labels'} of shape
@@ -114,6 +115,10 @@ def make_pipeline_value_and_grad(
             raise NotImplementedError(
                 "pp x tp hardwires the vocab-parallel causal-LM loss; drop "
                 "the custom loss_fn or tp")
+        if loss_chunks > 0:
+            raise NotImplementedError(
+                "loss_chunks is redundant under pp x tp: the vocab-parallel "
+                "head already never materializes full logits")
         if cfg.num_kv_heads % tp or cfg.num_heads % tp:
             raise ValueError(f"num_heads={cfg.num_heads}/num_kv_heads="
                              f"{cfg.num_kv_heads} not divisible by tp={tp}")
@@ -161,6 +166,12 @@ def make_pipeline_value_and_grad(
                 nl_params["embed"]["embedding"].astype(cfg.dtype), ids, "tp")
         return mod.embed_tokens(cfg, nl_params, ids, positions)
 
+    use_chunked = loss_chunks > 0 and not vocab_tp
+    if use_chunked:
+        from ..ops.cross_entropy import validate_chunked_loss_support
+
+        validate_chunked_loss_support(mod, bundle.family, loss_fn)
+
     def head_loss_fn(nl_params, y, labels):
         if vocab_tp:
             from ..models.llama import _rmsnorm
@@ -170,6 +181,14 @@ def make_pipeline_value_and_grad(
                  else nl_params["lm_head"]).astype(cfg.dtype)
             logits_local = jnp.dot(h, w, preferred_element_type=jnp.float32)
             return vocab_parallel_causal_lm_loss(logits_local, labels, "tp")
+        if use_chunked:
+            # big-vocab path: per-tick [mb, S, V] logits never materialize
+            from ..ops.cross_entropy import chunked_causal_lm_loss
+
+            hidden = mod.final_hidden(cfg, nl_params, y)
+            w_out = mod.output_weights(cfg, nl_params)
+            return chunked_causal_lm_loss(hidden, w_out, labels,
+                                          num_chunks=loss_chunks)
         logits = mod.lm_head_logits(cfg, nl_params, y)
         return loss_fn(logits, labels)
 

@@ -234,11 +234,10 @@ class Trainer:
                              f"choose from {sorted(REMAT_POLICIES)}")
         policy = REMAT_POLICIES[self.remat_policy]
 
-        if self.loss_chunks > 0 and (self.plan.mesh.shape["pp"] > 1
-                                     or self.bundle.apply_with_aux is not None):
+        if self.loss_chunks > 0 and self.bundle.apply_with_aux is not None:
             raise NotImplementedError(
-                "loss_chunks is not supported under pipeline parallelism or "
-                "for MoE models yet — it would be silently ignored")
+                "loss_chunks is not supported for MoE models yet — it would "
+                "be silently ignored")
 
         # every loss branch returns (loss, extras) where extras is a dict of
         # auxiliary scalar metrics with the static key set ``extra_keys``
@@ -252,7 +251,7 @@ class Trainer:
             pp_vag = make_pipeline_value_and_grad(
                 self.bundle, self.plan, microbatches=self.pp_microbatches,
                 remat=self.remat, remat_policy=policy, attn_impl=attn_impl,
-                loss_fn=self.loss_fn)
+                loss_fn=self.loss_fn, loss_chunks=self.loss_chunks)
 
             def grad_fn(params, mb):
                 loss, grads = pp_vag(params, mb)
@@ -277,14 +276,10 @@ class Trainer:
             from ..models.registry import family_module
             from ..ops.cross_entropy import chunked_causal_lm_loss
 
+            from ..ops.cross_entropy import validate_chunked_loss_support
+
             mod = family_module(self.bundle.family)
-            if not hasattr(mod, "output_weights"):
-                raise NotImplementedError(
-                    f"loss_chunks unsupported for family {self.bundle.family!r}")
-            if self.loss_fn is not causal_lm_loss:
-                raise NotImplementedError(
-                    "loss_chunks hardwires the causal-LM loss; drop the custom "
-                    "loss_fn or the chunking")
+            validate_chunked_loss_support(mod, self.bundle.family, self.loss_fn)
             n_chunks = self.loss_chunks
 
             def loss_on_microbatch(params, mb):

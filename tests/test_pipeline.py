@@ -129,3 +129,20 @@ def test_pp_moe_family(eight_devices):
     golden = run_moe(make_plan("single", make_mesh(devices=jax.devices()[:1])))
     pp = run_moe(make_plan("pp", make_mesh(pp=2)), pp_microbatches=2)
     np.testing.assert_allclose(pp, golden, rtol=2e-4)
+
+
+def test_pp_with_loss_chunks(golden, eight_devices):
+    # chunked CE on the last stage: same trajectory, no [mb,S,V] logits
+    bundle = get_model("llama-debug", dtype=jnp.float32)
+    t = Trainer(bundle=bundle, optimizer=adamw_cosine(1e-3),
+                plan=make_plan("pp", make_mesh(pp=2)), donate=False,
+                pp_microbatches=2, loss_chunks=4)
+    state = t.init_state(0)
+    ids = np.random.RandomState(0).randint(0, 512, (GB, SEQ))
+    batch = {k: jax.device_put(jnp.asarray(ids), t.batch_shardings()[k])
+             for k in ("input_ids", "labels")}
+    losses = []
+    for _ in range(2):
+        state, m = t.step_fn(state, batch)
+        losses.append(float(m["loss"]))
+    np.testing.assert_allclose(losses, golden[0], rtol=2e-4)
