@@ -98,7 +98,12 @@ def make_pipeline_value_and_grad(
     pp = mesh.shape["pp"]
     tp = mesh.shape["tp"]
     if mesh.shape["cp"] > 1:
-        raise NotImplementedError("pp x cp composition is not supported yet")
+        raise NotImplementedError(
+            "pp x cp is not supported: the ring's cp-manual shard_map cannot "
+            "nest inside the pp-manual pipeline region (the Shardy lowering "
+            "rejects nested manual axes — 'parent bounding this axis as "
+            "manual'). Shard long context over cp x tp x fsdp meshes, or use "
+            "pp without cp.")
     cfg = bundle.config
     mod = _family_module(bundle.family)
     rules = plan.rules

@@ -61,6 +61,10 @@ def test_cp_training_matches_single_device(eight_devices):
     # cp x tp: the ring is manual only over cp, tp stays auto inside it
     cp_tp = run(make_plan("tp", make_mesh(cp=2, tp=2)))
     np.testing.assert_allclose(cp_tp, golden, rtol=2e-4)
+    # 3-axis: cp x tp x fsdp on all 8 devices (the llama-3-style long-context
+    # layout minus pp)
+    cp_tp_fsdp = run(make_plan("tp_fsdp", make_mesh(cp=2, tp=2, fsdp=2)))
+    np.testing.assert_allclose(cp_tp_fsdp, golden, rtol=2e-4)
 
 
 def test_ring_attention_zigzag_noncausal(eight_devices):
