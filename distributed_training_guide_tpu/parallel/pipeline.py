@@ -23,6 +23,9 @@ first-class axis, the shard_map way:
   + loss run under ``lax.cond`` on stage 0 / the last stage only — no wasted
   head matmuls on other stages (jax.grad over a GPipe loop cannot express
   either property: it stores every tick's residuals and reverses strictly).
+  Bubble (fill/drain) ticks are also ``lax.cond``-skipped in both directions:
+  in a masked-SPMD schedule the bubble would otherwise be *real* FLOPs on
+  garbage activations rather than idle time.
 
 Bubble fraction stays (pp-1)/(M+pp-1) — choose microbatches >= 2*pp to keep
 it under a third.
@@ -233,14 +236,20 @@ def make_pipeline_value_and_grad(
             else:
                 x_in = buf
             saved = saved.at[t % K].set(x_in)
-            y, aux_t = stage_fn(layers, x_in, positions)
+            # bubble (fill/drain) ticks hold no real microbatch — skip the
+            # stage compute entirely instead of crunching garbage (in the
+            # masked-SPMD formulation the bubble would otherwise be real
+            # FLOPs, not idle time)
+            valid_f = (t - s >= 0) & (t - s < M)
+            y, aux_t = jax.lax.cond(
+                valid_f,
+                lambda: stage_fn(layers, x_in, positions),
+                lambda: (jnp.zeros_like(x_in), jnp.zeros((), jnp.float32)))
             if aux_coef:
                 # router aux loss of this stage's layers for its resident
-                # microbatch (t-s), masked to real ticks. loss_acc is divided
-                # by M once at the end, so only the per-layer mean goes here.
-                vf = (t - s >= 0) & (t - s < M)
-                loss_acc = loss_acc + jnp.where(vf, aux_t, 0.0) * (
-                    aux_coef / n_layers)
+                # microbatch (t-s). loss_acc is divided by M once at the end,
+                # so only the per-layer mean goes here.
+                loss_acc = loss_acc + aux_t * (aux_coef / n_layers)
 
             o = t - (pp - 1)
             if 0 <= o < M:
@@ -273,17 +282,23 @@ def make_pipeline_value_and_grad(
             # the head cotangent enters scaled by the 1/M of the loss mean;
             # everything upstream then arrives pre-scaled via dy_recv
             dy = jnp.where(is_last, dy_head / M, dy_recv)
-            dy = jnp.where(valid, dy, 0.0)
             idx = jnp.mod(u - (pp - 1) + 2 * s, K)  # out-of-window reads are
-            # clamped zeros with a zero cotangent — contributions vanish
+            # clamped zeros on invalid ticks — their branch never computes
             x_saved = jax.lax.dynamic_index_in_dim(saved, idx, axis=0,
                                                    keepdims=False)
-            _, vjp = jax.vjp(lambda lp, x: stage_fn(lp, x, positions),
-                             layers, x_saved)
-            # second cotangent: the aux-loss path (zero for dense families)
-            daux = jnp.where(valid, aux_coef / (M * n_layers), 0.0).astype(
-                jnp.float32)
-            d_layers, dx = vjp((dy, daux))
+
+            def bwd_live():
+                _, vjp = jax.vjp(lambda lp, x: stage_fn(lp, x, positions),
+                                 layers, x_saved)
+                # second cotangent: the aux-loss path (zero for dense)
+                daux = jnp.asarray(aux_coef / (M * n_layers), jnp.float32)
+                return vjp((dy, daux))
+
+            def bwd_skip():  # bubble tick: no recompute, no cotangent
+                return (jax.tree.map(lambda p: jnp.zeros(p.shape, p.dtype),
+                                     layers), jnp.zeros_like(x_saved))
+
+            d_layers, dx = jax.lax.cond(valid, bwd_live, bwd_skip)
             g_layers = jax.tree.map(lambda a, b: a + b.astype(jnp.float32),
                                     g_layers, d_layers)
 
