@@ -38,11 +38,18 @@ from distributed_training_guide_tpu.train.cli import get_parser, run_training
 @record
 def main():
     parser = get_parser()
+    parser.add_argument("--zero2", action="store_true",
+                        help="ZeRO-2: optimizer state AND the grad-accumulation "
+                             "buffer sharded over data ranks (params replicated). "
+                             "The grad-buffer sharding only exists with "
+                             "--grad-accum > 1 — without accumulation grads are "
+                             "transient and ZeRO-2 degenerates to ZeRO-1")
     parser.add_argument("--zero1", action="store_true",
                         help="shard optimizer state across data-parallel devices")
     args = parser.parse_args()
     maybe_initialize_distributed()
-    plan_factory = lambda: make_plan("zero1" if args.zero1 else "ddp", make_mesh())
+    strategy = "zero2" if args.zero2 else ("zero1" if args.zero1 else "ddp")
+    plan_factory = lambda: make_plan(strategy, make_mesh())
     run_training(args, plan_factory)
 
 
