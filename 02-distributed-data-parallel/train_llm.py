@@ -15,6 +15,11 @@ all-reduce in backward, ``02:66-68``) and ``ZeroRedundancyOptimizer``
          params. Unlike the reference (which skips optimizer checkpointing
          because ZeRO save is slow, ``02/README.md:308``), Orbax saves the
          sharded state in parallel with no extra cost.
+- zero2: zero1 plus gradient sharding — under ``--grad-accum`` the
+         persistent accumulation buffer is reduce-scattered per microbatch
+         instead of all-reduced, cutting its memory by the data-axis size
+         (the capability DeepSpeed calls stage 2; no reference analogue
+         outside the DeepSpeed chapter).
 
 Multi-host: launch one copy per host (chapter 3) — rendezvous is
 ``jax.distributed.initialize`` instead of torchrun's c10d store.

@@ -202,6 +202,7 @@ def run_flash_check() -> None:
             times.append(time.perf_counter() - t0)
         outs[impl] = (np.asarray(grad, dtype=np.float32), float(out))
         results[f"{impl}_ms"] = round(1000 * float(np.median(times)), 2)
+        _emit({**results, "partial": True})  # survives a stall mid-check
 
     grad_diff = float(np.max(np.abs(outs["flash"][0] - outs["xla"][0])))
     sum_rel = abs(outs["flash"][1] - outs["xla"][1]) / max(1.0, abs(outs["xla"][1]))
@@ -218,18 +219,27 @@ def run_flash_check() -> None:
 # parent: ladder orchestration (never touches the TPU itself)
 # ---------------------------------------------------------------------------
 
-def _run_child(mode_args: list, budget: float) -> list:
-    """Run this script in child mode; return parsed JSON lines from stdout
-    (possibly empty if the child stalled and was killed)."""
+def _run_child(mode_args: list, budget: float) -> tuple:
+    """Run this script in child mode; return (parsed JSON lines from stdout,
+    failure kind). Lines may be empty if the child stalled (killed at budget),
+    crashed (OOM etc.), or the pool ate it."""
     env = dict(os.environ, JAX_COMPILATION_CACHE_DIR=CACHE_DIR)
     proc = subprocess.Popen([sys.executable, os.path.abspath(__file__)] + mode_args,
                             stdout=subprocess.PIPE, stderr=subprocess.PIPE,
                             text=True, env=env, cwd=REPO)
     try:
         out, err = proc.communicate(timeout=budget)
+        if proc.returncode == 0:
+            kind = "ok"
+        elif "RESOURCE_EXHAUSTED" in err or "Out of memory" in err \
+                or "Largest program allocations" in err:
+            kind = "oom"
+        else:
+            kind = f"crashed_rc_{proc.returncode}"
     except subprocess.TimeoutExpired:
         proc.kill()
         out, err = proc.communicate()
+        kind = "stalled"
     if err:
         sys.stderr.write(err[-2000:])
     parsed = []
@@ -240,7 +250,7 @@ def _run_child(mode_args: list, budget: float) -> list:
                 parsed.append(json.loads(line))
             except json.JSONDecodeError:
                 pass
-    return parsed
+    return parsed, kind
 
 
 class _Best:
@@ -307,7 +317,7 @@ def main() -> None:
     else:  # --watchdog 0: no time limit
         deadline = time.time() + 86400
 
-    probe = _run_child(["--probe"], budget=min(75, deadline - time.time()))
+    probe, _ = _run_child(["--probe"], budget=min(75, deadline - time.time()))
     platform = probe[-1].get("platform", "tpu") if probe else "tpu"
 
     if args.model is not None or args.batch is not None or args.seq is not None:
@@ -345,14 +355,18 @@ def main() -> None:
                                "status": "skipped_no_time"})
             return None
         spec = {k: v for k, v in rung.items() if k != "budget"}
-        lines = _run_child(["--rung", json.dumps(spec)], budget)
+        lines, kind = _run_child(["--rung", json.dumps(spec)], budget)
         results = [r for r in lines if r.get("metric") == "mfu" and r["value"] > 0]
+        entry = {"model": rung["model"], "seq": rung["seq"],
+                 **({"remat_policy": rung["remat_policy"]}
+                    if "remat_policy" in rung else {})}
         if not results:
-            ladder_log.append({"model": rung["model"], "seq": rung["seq"],
-                               "status": f"stalled_attempt_{attempt}"})
+            if kind == "ok":  # exited clean but produced no usable number
+                kind = "no_result"
+            ladder_log.append({**entry, "status": f"{kind}_attempt_{attempt}"})
             return None
         best = results[-1]
-        ladder_log.append({"model": rung["model"], "seq": rung["seq"],
+        ladder_log.append({**entry,
                            "status": "ok" if not best.get("partial") else "partial",
                            "steps_timed": best["detail"]["steps_timed"]})
         if _Best.result is None or best["value"] > _Best.result["value"]:
@@ -401,9 +415,11 @@ def main() -> None:
     if platform == "tpu" and not args.skip_flash_check:
         remaining = deadline - time.time()
         if remaining > 120:
-            flash = _run_child(["--check-flash"], budget=min(300, remaining))
-            final["detail"]["flash_check"] = (
-                flash[-1] if flash else {"error": "stalled"})
+            flash, kind = _run_child(["--check-flash"], budget=min(420, remaining))
+            record = flash[-1] if flash else {}
+            if kind != "ok":
+                record = {**record, "error": kind}
+            final["detail"]["flash_check"] = record
     _Best.result = dict(final)
     _Best.emitted = True
     _emit(final)

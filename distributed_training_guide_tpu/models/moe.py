@@ -240,12 +240,15 @@ def apply_with_aux(
     attn_impl: str = "auto",
     activation_sharding: Optional[Any] = None,
     return_metrics: bool = False,
+    return_hidden: bool = False,
 ):
     """Forward -> (logits [B,S,V] fp32, mean router aux loss[, metrics]).
 
     ``return_metrics`` adds a dict of routing observability scalars
     (currently ``dropped_frac``: mean fraction of (token, choice) pairs that
-    overflowed expert capacity) without changing the stable 2-tuple API."""
+    overflowed expert capacity) without changing the stable 2-tuple API.
+    ``return_hidden`` swaps the logits for the final-normed hidden states
+    [B, S, E] (chunked-loss path — pair with ``output_weights``)."""
     standard_layout = positions is None
     if positions is None:
         positions = jnp.arange(input_ids.shape[1])[None, :]
@@ -272,11 +275,12 @@ def apply_with_aux(
     (x, aux, dropped), _ = jax.lax.scan(scan_body, (x, zero, zero),
                                         params["layers"])
 
-    logits = llama.lm_head_logits(config, params, x)
+    out = (llama.final_hidden(config, params, x) if return_hidden
+           else llama.lm_head_logits(config, params, x))
     aux = aux / config.num_layers
     if return_metrics:
-        return logits, aux, {"moe_dropped_frac": dropped / config.num_layers}
-    return logits, aux
+        return out, aux, {"moe_dropped_frac": dropped / config.num_layers}
+    return out, aux
 
 
 def apply(config, params, input_ids, positions=None, **kw):

@@ -234,10 +234,14 @@ class Trainer:
                              f"choose from {sorted(REMAT_POLICIES)}")
         policy = REMAT_POLICIES[self.remat_policy]
 
-        if self.loss_chunks > 0 and self.bundle.apply_with_aux is not None:
-            raise NotImplementedError(
-                "loss_chunks is not supported for MoE models yet — it would "
-                "be silently ignored")
+        chunk_mod = None
+        if self.loss_chunks > 0 and self.plan.mesh.shape["pp"] == 1:
+            from ..models.registry import family_module
+            from ..ops.cross_entropy import validate_chunked_loss_support
+
+            chunk_mod = family_module(self.bundle.family)
+            validate_chunked_loss_support(chunk_mod, self.bundle.family,
+                                          self.loss_fn)
 
         # every loss branch returns (loss, extras) where extras is a dict of
         # auxiliary scalar metrics with the static key set ``extra_keys``
@@ -260,26 +264,31 @@ class Trainer:
             apply_aux = self.bundle.apply_with_aux
             aux_coef = getattr(cfg, "router_aux_coef", 0.0)
             extra_keys = ("moe_dropped_frac",)
+            n_chunks = self.loss_chunks
+            if n_chunks > 0:
+                from ..ops.cross_entropy import chunked_causal_lm_loss
 
             def loss_on_microbatch(params, mb):
-                logits, aux, moe_metrics = apply_aux(
+                out, aux, moe_metrics = apply_aux(
                     cfg, params, mb["input_ids"],
                     positions=mb.get("positions"),
                     remat=self.remat, remat_policy=policy,
                     attn_impl=attn_impl,
-                    activation_sharding=act_sharding, return_metrics=True)
-                if logits_sharding is not None:
-                    logits = jax.lax.with_sharding_constraint(logits, logits_sharding)
-                loss = self.loss_fn(logits, mb["labels"]) + aux_coef * aux
-                return loss, jax.lax.stop_gradient(moe_metrics)
+                    activation_sharding=act_sharding, return_metrics=True,
+                    return_hidden=n_chunks > 0)
+                if n_chunks > 0:
+                    w_out = chunk_mod.output_weights(cfg, params)
+                    ce = chunked_causal_lm_loss(out, w_out, mb["labels"],
+                                                num_chunks=n_chunks,
+                                                logits_sharding=logits_sharding)
+                else:
+                    if logits_sharding is not None:
+                        out = jax.lax.with_sharding_constraint(out, logits_sharding)
+                    ce = self.loss_fn(out, mb["labels"])
+                return ce + aux_coef * aux, jax.lax.stop_gradient(moe_metrics)
         elif self.loss_chunks > 0:
-            from ..models.registry import family_module
             from ..ops.cross_entropy import chunked_causal_lm_loss
 
-            from ..ops.cross_entropy import validate_chunked_loss_support
-
-            mod = family_module(self.bundle.family)
-            validate_chunked_loss_support(mod, self.bundle.family, self.loss_fn)
             n_chunks = self.loss_chunks
 
             def loss_on_microbatch(params, mb):
@@ -289,7 +298,7 @@ class Trainer:
                                attn_impl=attn_impl,
                                activation_sharding=act_sharding,
                                return_hidden=True)
-                w_out = mod.output_weights(cfg, params)
+                w_out = chunk_mod.output_weights(cfg, params)
                 return chunked_causal_lm_loss(hidden, w_out, mb["labels"],
                                               num_chunks=n_chunks,
                                               logits_sharding=logits_sharding), {}

@@ -53,3 +53,29 @@ def test_trainer_loss_chunks_matches(eight_devices):
     for a, b in zip(jax.tree.leaves(jax.device_get(s1.params)),
                     jax.tree.leaves(jax.device_get(s2.params))):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-3, atol=1e-5)
+
+
+def test_trainer_loss_chunks_matches_moe(eight_devices):
+    """Chunked CE composes with the MoE aux-loss path (router aux + dropped
+    metric must survive the return_hidden forward)."""
+    bundle = get_model("moe-debug", dtype=jnp.float32)
+    opt = adamw_cosine(1e-3)
+    ids = np.random.RandomState(1).randint(0, 512, (8, 33))
+
+    def run(loss_chunks):
+        t = Trainer(bundle=bundle, optimizer=opt,
+                    plan=make_plan("ep", make_mesh(ep=4)),
+                    loss_chunks=loss_chunks, donate=False)
+        state = t.init_state(0)
+        batch = {k: jax.device_put(jnp.asarray(ids), t.batch_shardings()[k])
+                 for k in ("input_ids", "labels")}
+        state, m = t.step_fn(state, batch)
+        assert "moe_dropped_frac" in m
+        return float(m["loss"]), state
+
+    loss_full, s1 = run(0)
+    loss_chunked, s2 = run(4)
+    np.testing.assert_allclose(loss_chunked, loss_full, rtol=1e-5)
+    for a, b in zip(jax.tree.leaves(jax.device_get(s1.params)),
+                    jax.tree.leaves(jax.device_get(s2.params))):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-3, atol=1e-5)
