@@ -46,13 +46,20 @@ def main():
     parser.add_argument("--pp-microbatches", type=int, default=None)
     parser.add_argument("--fsdp", type=int, default=1,
                         help="fsdp size alongside pp (2-D pp x fsdp)")
+    parser.add_argument("--tensor-parallel", type=int, default=1,
+                        help="manual-tp size inside the pipeline shard_map "
+                             "(megatron layer shards + vocab-parallel "
+                             "embed/head; llama and moe families)")
     args = parser.parse_args()
     maybe_initialize_distributed()
 
     def plan_factory():
-        strategy = "pp_fsdp" if args.fsdp > 1 else "pp"
+        tp, fsdp = args.tensor_parallel, args.fsdp
+        strategy = ("pp_tp_fsdp" if tp > 1 and fsdp > 1
+                    else "pp_tp" if tp > 1
+                    else "pp_fsdp" if fsdp > 1 else "pp")
         return make_plan(strategy,
-                         make_mesh(pp=args.pipeline_parallel, fsdp=args.fsdp))
+                         make_mesh(pp=args.pipeline_parallel, tp=tp, fsdp=fsdp))
 
     run_training(args, plan_factory, pp_microbatches=args.pp_microbatches)
 

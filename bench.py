@@ -366,8 +366,10 @@ def main() -> None:
             ladder_log.append({**entry, "status": f"{kind}_attempt_{attempt}"})
             return None
         best = results[-1]
-        ladder_log.append({**entry,
-                           "status": "ok" if not best.get("partial") else "partial",
+        status = "ok" if not best.get("partial") else "partial"
+        if kind != "ok":  # produced numbers, then crashed/stalled mid-rung
+            status = f"{status}_then_{kind}"
+        ladder_log.append({**entry, "status": status,
                            "steps_timed": best["detail"]["steps_timed"]})
         if _Best.result is None or best["value"] > _Best.result["value"]:
             _Best.result = dict(best)

@@ -39,6 +39,7 @@ import jax.numpy as jnp
 
 from . import llama
 from .llama import _rmsnorm, attention_sublayer
+from ..ops.collectives import psum as _psum
 
 
 @dataclasses.dataclass(frozen=True)
@@ -150,7 +151,8 @@ def param_logical_axes(config: MoELlamaConfig) -> dict:
     return axes
 
 
-def _moe_ffn(config: MoELlamaConfig, x: jnp.ndarray, moe: dict):
+def _moe_ffn(config: MoELlamaConfig, x: jnp.ndarray, moe: dict,
+             tp_axis: Optional[str] = None):
     """Top-k routed FFN with index-based (sort/gather) dispatch. x: [B, S, D].
     Returns (y, aux_loss, dropped_frac).
 
@@ -159,6 +161,13 @@ def _moe_ffn(config: MoELlamaConfig, x: jnp.ndarray, moe: dict):
     (O(T^2 * k) floats at fixed capacity factor, ~640 MB at T=8k, k=2).
     Capacity priority is greedy by choice rank then token order (all rank-0
     choices before any rank-1), identical to the old sequential assignment.
+
+    ``tp_axis``: set inside a shard_map region where tp is a *manual* axis
+    (the pipeline schedule). The router is replicated over tp, so every
+    member computes identical dispatch indices; gate/up/down arrive as
+    megatron mlp-dim shards and the combined output is a partial sum —
+    combine is linear in the expert outputs, so one psum of y at the end is
+    exact (commutes with the gather/scatter-add).
     """
     b, s, d = x.shape
     t = b * s
@@ -206,6 +215,8 @@ def _moe_ffn(config: MoELlamaConfig, x: jnp.ndarray, moe: dict):
     y_choice = jnp.where(keep[:, None], y_choice, 0)
     y = jnp.zeros((t, d), cdt).at[token_flat].add(
         y_choice * weight_flat[:, None].astype(cdt))
+    if tp_axis is not None:
+        y = _psum(y, tp_axis)
 
     # Switch load-balance loss over ALL k dispatched choices (normalized by
     # k): E * sum_e (choice fraction)_e * (mean prob)_e — counting only the
@@ -218,14 +229,14 @@ def _moe_ffn(config: MoELlamaConfig, x: jnp.ndarray, moe: dict):
 
 
 def _block(config: MoELlamaConfig, carry, layer: dict, positions, attn_impl,
-           standard_layout=True):
+           standard_layout=True, tp_axis=None):
     x, aux_acc, dropped_acc = carry
     attn = attention_sublayer(config, x, layer["attn"], layer["input_norm"],
-                              positions, attn_impl, standard_layout)
+                              positions, attn_impl, standard_layout, tp_axis)
     x = x + attn
 
     h = _rmsnorm(x, layer["post_attn_norm"], config.rms_norm_eps)
-    y, aux, dropped = _moe_ffn(config, h, layer["moe"])
+    y, aux, dropped = _moe_ffn(config, h, layer["moe"], tp_axis)
     return (x + y, aux_acc + aux, dropped_acc + dropped)
 
 

@@ -111,10 +111,11 @@ def make_pipeline_value_and_grad(
     mod = _family_module(bundle.family)
     rules = plan.rules
     if tp > 1:
-        if bundle.family != "llama":
+        if bundle.family not in ("llama", "moe"):
             raise NotImplementedError(
-                f"pp x tp is implemented for the llama family (manual megatron "
-                f"shards); family {bundle.family!r} supports pp with tp=1")
+                f"pp x tp is implemented for the llama and moe families "
+                f"(manual megatron shards); family {bundle.family!r} supports "
+                f"pp with tp=1")
         if rules.get("heads") != "tp":
             raise ValueError(
                 f"mesh has tp={tp} but plan {plan.strategy!r} maps no logical "
@@ -144,7 +145,7 @@ def make_pipeline_value_and_grad(
     aux_coef = getattr(cfg, "router_aux_coef", 0.0) if moe_family else 0.0
 
     def stage_fn(layers_local, x, positions):
-        tp_kw = {"tp_axis": tp_axis} if tp_axis else {}  # llama-only kwarg
+        tp_kw = {"tp_axis": tp_axis} if tp_axis else {}  # llama/moe kwarg
         block = functools.partial(mod._block, cfg, positions=positions,
                                   attn_impl=attn_impl, **tp_kw)
 
@@ -259,6 +260,15 @@ def make_pipeline_value_and_grad(
                 def head_branch():
                     (l, (g, dy)) = jax.value_and_grad(
                         head_loss_fn, argnums=(0, 1))(nl, y, labels_mb[o])
+                    if tp > 1:
+                        # The vocab-parallel loss psums over tp and psum
+                        # transposes to psum (check_vma=False), so every tp
+                        # member's cotangent is tp x the true one; rescale at
+                        # the source so sharded-leaf grads come out true and
+                        # replicated-leaf grads are per-member partials (the
+                        # reduce_grad psum then sums them to the true grad).
+                        g = jax.tree.map(lambda a: a / tp, g)
+                        dy = dy / tp
                     return l, g, dy
 
                 def zero_branch():
@@ -290,8 +300,12 @@ def make_pipeline_value_and_grad(
             def bwd_live():
                 _, vjp = jax.vjp(lambda lp, x: stage_fn(lp, x, positions),
                                  layers, x_saved)
-                # second cotangent: the aux-loss path (zero for dense)
-                daux = jnp.asarray(aux_coef / (M * n_layers), jnp.float32)
+                # second cotangent: the aux-loss path (zero for dense). The
+                # aux is computed redundantly on every tp member (router and
+                # its inputs are tp-replicated), so the per-member cotangent
+                # carries 1/tp — the replicated-leaf grad psum in reduce_grad
+                # then reconstructs exactly one copy.
+                daux = jnp.asarray(aux_coef / (M * n_layers * tp), jnp.float32)
                 return vjp((dy, daux))
 
             def bwd_skip():  # bubble tick: no recompute, no cotangent

@@ -234,14 +234,22 @@ class Trainer:
                              f"choose from {sorted(REMAT_POLICIES)}")
         policy = REMAT_POLICIES[self.remat_policy]
 
-        chunk_mod = None
+        chunked_ce = None
         if self.loss_chunks > 0 and self.plan.mesh.shape["pp"] == 1:
             from ..models.registry import family_module
-            from ..ops.cross_entropy import validate_chunked_loss_support
+            from ..ops.cross_entropy import (chunked_causal_lm_loss,
+                                             validate_chunked_loss_support)
 
             chunk_mod = family_module(self.bundle.family)
             validate_chunked_loss_support(chunk_mod, self.bundle.family,
                                           self.loss_fn)
+            n_chunks = self.loss_chunks
+
+            def chunked_ce(params, hidden, labels):
+                w_out = chunk_mod.output_weights(cfg, params)
+                return chunked_causal_lm_loss(hidden, w_out, labels,
+                                              num_chunks=n_chunks,
+                                              logits_sharding=logits_sharding)
 
         # every loss branch returns (loss, extras) where extras is a dict of
         # auxiliary scalar metrics with the static key set ``extra_keys``
@@ -264,9 +272,6 @@ class Trainer:
             apply_aux = self.bundle.apply_with_aux
             aux_coef = getattr(cfg, "router_aux_coef", 0.0)
             extra_keys = ("moe_dropped_frac",)
-            n_chunks = self.loss_chunks
-            if n_chunks > 0:
-                from ..ops.cross_entropy import chunked_causal_lm_loss
 
             def loss_on_microbatch(params, mb):
                 out, aux, moe_metrics = apply_aux(
@@ -275,22 +280,15 @@ class Trainer:
                     remat=self.remat, remat_policy=policy,
                     attn_impl=attn_impl,
                     activation_sharding=act_sharding, return_metrics=True,
-                    return_hidden=n_chunks > 0)
-                if n_chunks > 0:
-                    w_out = chunk_mod.output_weights(cfg, params)
-                    ce = chunked_causal_lm_loss(out, w_out, mb["labels"],
-                                                num_chunks=n_chunks,
-                                                logits_sharding=logits_sharding)
+                    return_hidden=chunked_ce is not None)
+                if chunked_ce is not None:
+                    ce = chunked_ce(params, out, mb["labels"])
                 else:
                     if logits_sharding is not None:
                         out = jax.lax.with_sharding_constraint(out, logits_sharding)
                     ce = self.loss_fn(out, mb["labels"])
                 return ce + aux_coef * aux, jax.lax.stop_gradient(moe_metrics)
         elif self.loss_chunks > 0:
-            from ..ops.cross_entropy import chunked_causal_lm_loss
-
-            n_chunks = self.loss_chunks
-
             def loss_on_microbatch(params, mb):
                 hidden = apply(cfg, params, mb["input_ids"],
                                positions=mb.get("positions"),
@@ -298,10 +296,7 @@ class Trainer:
                                attn_impl=attn_impl,
                                activation_sharding=act_sharding,
                                return_hidden=True)
-                w_out = chunk_mod.output_weights(cfg, params)
-                return chunked_causal_lm_loss(hidden, w_out, mb["labels"],
-                                              num_chunks=n_chunks,
-                                              logits_sharding=logits_sharding), {}
+                return chunked_ce(params, hidden, mb["labels"]), {}
         else:
             def loss_on_microbatch(params, mb):
                 logits = apply(cfg, params, mb["input_ids"],

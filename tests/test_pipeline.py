@@ -131,6 +131,83 @@ def test_pp_moe_family(eight_devices):
     np.testing.assert_allclose(pp, golden, rtol=2e-4)
 
 
+@pytest.mark.parametrize("model,coef", [("llama-debug", None), ("moe-debug", 1.0)])
+def test_pp_tp_grad_parity(eight_devices, model, coef):
+    """pp x tp gradients must equal the single-device gradients EXACTLY (not
+    just up to a scale — Adam is invariant to uniform grad scaling, so the
+    trajectory goldens above cannot catch a tp x factor, but grad_norm,
+    clipping, and plain SGD all can). The reference is the per-microbatch
+    mean loss, matching the schedule's aux semantics. Covers the vocab-
+    parallel head (psum-transposes-to-psum cotangent scaling) and, for moe,
+    the tp-redundant router aux path."""
+    from distributed_training_guide_tpu.ops.cross_entropy import causal_lm_loss
+    from distributed_training_guide_tpu.parallel.pipeline import (
+        make_pipeline_value_and_grad)
+
+    kw = {"dtype": jnp.float32}
+    if coef is not None:
+        kw["router_aux_coef"] = coef
+    bundle = get_model(model, **kw)
+    cfg = bundle.config
+    M = 2
+    ids = jnp.asarray(np.random.RandomState(0).randint(0, 512, (GB, SEQ)))
+    params = jax.jit(lambda: bundle.init(cfg, jax.random.key(0)))()
+
+    def ref_loss(p):
+        tot = 0.0
+        for m in range(M):
+            chunk = ids[m * (GB // M):(m + 1) * (GB // M)]
+            if bundle.apply_with_aux is not None:
+                logits, aux = bundle.apply_with_aux(cfg, p, chunk, attn_impl="xla")
+                tot += causal_lm_loss(logits, chunk) + cfg.router_aux_coef * aux
+            else:
+                tot += causal_lm_loss(
+                    bundle.apply(cfg, p, chunk, attn_impl="xla"), chunk)
+        return tot / M
+
+    ref_l, ref_g = jax.jit(jax.value_and_grad(ref_loss))(params)
+
+    plan = make_plan("pp_tp", make_mesh(pp=2, tp=2, devices=jax.devices()[:4]))
+    vag = make_pipeline_value_and_grad(bundle, plan, microbatches=M,
+                                       attn_impl="xla")
+    shardings = plan.param_shardings(
+        bundle.param_logical_axes(cfg),
+        jax.eval_shape(lambda: bundle.init(cfg, jax.random.key(0))))
+    l, g = jax.jit(vag)(jax.device_put(params, shardings),
+                        {"input_ids": ids, "labels": ids})
+
+    np.testing.assert_allclose(float(l), float(ref_l), rtol=1e-6)
+    for (path, r), p in zip(jax.tree_util.tree_flatten_with_path(ref_g)[0],
+                            jax.tree.leaves(g)):
+        np.testing.assert_allclose(
+            np.asarray(jax.device_get(p)), np.asarray(r), rtol=5e-3, atol=1e-5,
+            err_msg=jax.tree_util.keystr(path))
+
+
+def test_pp_tp_moe_trajectory(eight_devices):
+    """pp=2 x tp=2 x dp=2 with the MoE family: megatron expert-FFN shards +
+    vocab-parallel embed/head, trajectory matches single-device."""
+    bundle = get_model("moe-debug", dtype=jnp.float32)
+    ids = np.random.RandomState(0).randint(0, 512, (GB, SEQ))
+
+    def run_moe(plan, **kw):
+        t = Trainer(bundle=bundle, optimizer=adamw_cosine(1e-3), plan=plan,
+                    donate=False, attn_impl="xla", **kw)
+        state = t.init_state(0)
+        batch = {k: jax.device_put(jnp.asarray(ids), t.batch_shardings()[k])
+                 for k in ("input_ids", "labels")}
+        losses = []
+        for _ in range(2):
+            state, m = t.step_fn(state, batch)
+            losses.append(float(m["loss"]))
+        return losses
+
+    golden = run_moe(make_plan("single", make_mesh(devices=jax.devices()[:1])))
+    pp_tp = run_moe(make_plan("pp_tp", make_mesh(pp=2, tp=2)),
+                    pp_microbatches=2)
+    np.testing.assert_allclose(pp_tp, golden, rtol=2e-4)
+
+
 def test_pp_with_loss_chunks(golden, eight_devices):
     # chunked CE on the last stage: same trajectory, no [mb,S,V] logits
     bundle = get_model("llama-debug", dtype=jnp.float32)
