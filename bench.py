@@ -296,6 +296,8 @@ def main() -> None:
     parser.add_argument("--remat", action="store_true", default=None)
     parser.add_argument("--no-remat", dest="remat", action="store_false")
     parser.add_argument("--attn-impl", default="auto")
+    parser.add_argument("--remat-policy", default=None,
+                        choices=["all", "dots", "attn"])
     parser.add_argument("--watchdog", type=int, default=_default_watchdog())
     parser.add_argument("--skip-flash-check", action="store_true")
     # child modes
@@ -303,6 +305,9 @@ def main() -> None:
     parser.add_argument("--probe", action="store_true", help=argparse.SUPPRESS)
     parser.add_argument("--check-flash", action="store_true", help=argparse.SUPPRESS)
     args = parser.parse_args()
+    if args.remat is False and args.remat_policy:
+        parser.error("--no-remat contradicts --remat-policy "
+                     "(the policy only applies under remat)")
 
     if args.rung:
         return run_rung(json.loads(args.rung))
@@ -320,19 +325,32 @@ def main() -> None:
     probe, _ = _run_child(["--probe"], budget=min(75, deadline - time.time()))
     platform = probe[-1].get("platform", "tpu") if probe else "tpu"
 
-    if args.model is not None or args.batch is not None or args.seq is not None:
+    if (args.model is not None or args.batch is not None
+            or args.seq is not None or args.remat_policy is not None):
         on_tpu = platform == "tpu"
         ladder = [dict(model=args.model or ("llama-650m" if on_tpu else "llama-debug"),
                        batch=args.batch or (8 if on_tpu else 2),
                        seq=args.seq or (2048 if on_tpu else 128),
                        steps=args.steps, warmup=args.warmup,
-                       remat=args.remat if args.remat is not None else on_tpu,
-                       attn_impl=args.attn_impl, budget=deadline - time.time())]
+                       # an explicit policy implies remat (a policy without
+                       # remat would silently measure the no-remat program)
+                       remat=(args.remat if args.remat is not None
+                              else on_tpu or args.remat_policy is not None),
+                       attn_impl=args.attn_impl, budget=deadline - time.time(),
+                       **({"remat_policy": args.remat_policy}
+                          if args.remat_policy else {}))]
     elif platform == "tpu":
+        # headline: remat_policy="attn" keeps only attention outputs + flash
+        # lse, so backward never re-runs the attention kernel (measured
+        # 50.5% vs 48.5% MFU for "all" on v5e, 2026-07-29); rung 2 is the
+        # min-memory "all" fallback at the same shape
         ladder = [
             dict(model="llama-650m", batch=8, seq=2048, steps=args.steps,
+                 warmup=args.warmup, remat=True, remat_policy="attn",
+                 attn_impl=args.attn_impl, budget=600),
+            dict(model="llama-650m", batch=8, seq=2048, steps=args.steps,
                  warmup=args.warmup, remat=True, attn_impl=args.attn_impl,
-                 budget=600),
+                 budget=420),
             dict(model="llama-650m", batch=4, seq=1024, steps=6, warmup=2,
                  remat=True, attn_impl=args.attn_impl, budget=360),
             dict(model="llama-debug", batch=8, seq=512, steps=6, warmup=2,
@@ -393,12 +411,13 @@ def main() -> None:
                 break
 
     # bonus pass: the HEADLINE rung fully succeeded (pool is demonstrably
-    # healthy) — A/B the remat policy ("dots" keeps matmul outputs: less
-    # recompute, more memory) and report whichever config measured faster.
-    # Only the tuned run's own COMPLETE result may displace the verified one.
-    if top_rung_ok and platform == "tpu" and deadline - time.time() > 420:
-        tuned = dict(ladder[0], remat_policy="dots", budget=360)
-        tuned_res = try_rung(tuned, attempt=1)
+    # healthy) — measure the min-memory "all" policy at the same shape so
+    # every healthy run records the attn-vs-all delta. ("dots" is NOT
+    # retried: BENCH.md records it OOMing at this shape on the 16 GB chip.)
+    # Only the A/B run's own COMPLETE result may displace the verified one.
+    if (top_rung_ok and platform == "tpu" and len(ladder) > 1
+            and deadline - time.time() > 420):
+        tuned_res = try_rung(dict(ladder[1], budget=360), attempt=1)
         if (tuned_res is not None and not tuned_res.get("partial")
                 and tuned_res["value"] > final["value"]):
             final = dict(tuned_res)

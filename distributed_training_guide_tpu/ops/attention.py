@@ -15,6 +15,7 @@ from typing import Optional
 
 import jax
 import jax.numpy as jnp
+from jax.ad_checkpoint import checkpoint_name
 
 
 def _xla_attention(
@@ -46,7 +47,12 @@ def _xla_attention(
     probs = jax.nn.softmax(scores, axis=-1)
     out = jnp.einsum("bhgqk,bkhd->bqhgd", probs.astype(v.dtype), v,
                      preferred_element_type=jnp.float32)
-    return out.reshape(b, sq, hq, d).astype(q.dtype)
+    # tagged so REMAT_POLICIES["attn"] can keep the [B,S,H,D] output: layers
+    # downstream then never re-run this attention forward. (This path's own
+    # backward still rebuilds scores/probs — the [S,S] recompute is only
+    # fully eliminated on the flash path, whose lse residual is also tagged.)
+    return checkpoint_name(out.reshape(b, sq, hq, d).astype(q.dtype),
+                           "attn_out")
 
 
 def multihead_attention(

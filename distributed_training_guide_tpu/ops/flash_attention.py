@@ -33,6 +33,7 @@ from typing import Optional
 
 import jax
 import jax.numpy as jnp
+from jax.ad_checkpoint import checkpoint_name
 from jax.experimental import pallas as pl
 
 try:  # pltpu is importable on CPU builds too; guard only for exotic setups
@@ -338,6 +339,12 @@ def _flash(q, k, v, causal, block_q, block_k, interpret):
 
 def _flash_vjp_fwd(q, k, v, causal, block_q, block_k, interpret):
     o, lse = _flash_fwd(q, k, v, causal, block_q, block_k, interpret)
+    # checkpoint_name tags let a remat policy keep the kernel's backward
+    # residuals (o + lse; q/k/v are cheap projections) so the forward kernel
+    # is not re-run inside the backward pass — see train/step.py
+    # REMAT_POLICIES["attn"]
+    o = checkpoint_name(o, "flash_out")
+    lse = checkpoint_name(lse, "flash_lse")
     return o, (q, k, v, o, lse)
 
 

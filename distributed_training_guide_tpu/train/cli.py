@@ -50,10 +50,12 @@ def get_parser() -> argparse.ArgumentParser:
     parser.add_argument("--grad-accum", default=1, type=int)
     parser.add_argument("--checkpoint-activations", action="store_true",
                         help="remat decoder layers (reference 05:163-178)")
-    parser.add_argument("--remat-policy", default="all", choices=["all", "dots"],
+    parser.add_argument("--remat-policy", default="all", choices=["all", "dots", "attn"],
                         help="what survives forward under remat: all=recompute "
                              "everything (min memory); dots=keep matmul outputs "
-                             "(better MFU)")
+                             "(most memory); attn=keep attention outputs + flash "
+                             "lse so backward never re-runs the attention kernel "
+                             "(best measured MFU, small memory cost)")
     parser.add_argument("--attn-impl", default="auto", choices=["auto", "xla", "flash"])
     parser.add_argument("--max-steps", default=None, type=int)
     parser.add_argument("--native-loader", action="store_true",

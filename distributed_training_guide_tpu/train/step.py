@@ -39,6 +39,12 @@ REMAT_POLICIES = {
     # "dots": keep matmul outputs, recompute elementwise — the usual best
     # MFU/memory trade on TPU (matmuls are the expensive recompute)
     "dots": jax.checkpoint_policies.checkpoint_dots_with_no_batch_dims,
+    # "attn": keep only the attention outputs (+ the flash kernel's lse
+    # residual) so backward never re-runs the attention kernel; everything
+    # else (projections, mlp) is recomputed. ~o(B*S*H*D) extra bytes per
+    # layer vs "all" — far less than "dots"
+    "attn": jax.checkpoint_policies.save_only_these_names(
+        "attn_out", "flash_out", "flash_lse"),
 }
 
 
@@ -95,7 +101,7 @@ class Trainer:
     plan: Optional[ShardingPlan] = None
     grad_accum: int = 1
     remat: bool = False
-    remat_policy: str = "all"  # all | dots (what survives the fwd pass under remat)
+    remat_policy: str = "all"  # all | dots | attn (what survives under remat)
     loss_chunks: int = 0  # >0: chunked CE from hidden states (no [B,S,V] logits)
     attn_impl: str = "auto"
     loss_fn: Callable = causal_lm_loss

@@ -72,6 +72,28 @@ def test_remat_matches_no_remat():
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-2, atol=5e-3)
 
 
+def test_remat_policies_match_no_remat():
+    """Every named policy ("all"/"dots"/"attn") is a pure memory/time trade —
+    gradients must match the no-remat program (attn relies on the
+    checkpoint_name tags in ops/attention.py + ops/flash_attention.py)."""
+    from distributed_training_guide_tpu.train.step import REMAT_POLICIES
+
+    bundle = get_model("llama-debug")
+    params = bundle.init(bundle.config, jax.random.key(0))
+    ids = jax.random.randint(jax.random.key(1), (1, 16), 0, bundle.config.vocab_size)
+
+    def grads(**kw):
+        return jax.grad(lambda p: causal_lm_loss(
+            bundle.apply(bundle.config, p, ids, **kw), ids))(params)
+
+    ref = grads(remat=False)
+    for name, policy in REMAT_POLICIES.items():
+        got = grads(remat=True, remat_policy=policy)
+        for a, b in zip(jax.tree.leaves(ref), jax.tree.leaves(got)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=1e-2, atol=5e-3, err_msg=name)
+
+
 def test_logical_axes_mirror_params():
     for name in ["gpt2-debug", "llama-debug"]:
         bundle = get_model(name)
