@@ -49,7 +49,7 @@ def main():
     parser.add_argument("--tensor-parallel", type=int, default=1,
                         help="manual-tp size inside the pipeline shard_map "
                              "(megatron layer shards + vocab-parallel "
-                             "embed/head; llama and moe families)")
+                             "embed/head; all model families)")
     args = parser.parse_args()
     maybe_initialize_distributed()
 

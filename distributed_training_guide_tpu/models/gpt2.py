@@ -15,6 +15,7 @@ import jax
 import jax.numpy as jnp
 
 from ..ops.attention import multihead_attention
+from ..ops.collectives import psum as _psum
 
 
 @dataclasses.dataclass(frozen=True)
@@ -57,8 +58,12 @@ def init(config: GPT2Config, rng: jax.Array) -> dict:
         "layers": {
             "ln1": ln((l, e)),
             "attn": {
-                "wqkv": dense(next(keys), (l, e, 3 * e)),
-                "bqkv": jnp.zeros((l, 3 * e), config.param_dtype),
+                # fused QKV as [l, e, 3, e] (not [l, e, 3e]) so the head dim
+                # is the trailing axis: sharding it over tp gives each member
+                # the q/k/v columns of ITS heads — a contiguous slice of the
+                # flat 3e dim would instead split q/k/v unevenly
+                "wqkv": dense(next(keys), (l, e, 3, e)),
+                "bqkv": jnp.zeros((l, 3, e), config.param_dtype),
                 "wo": dense(next(keys), (l, e, e)),
                 "bo": jnp.zeros((l, e), config.param_dtype),
             },
@@ -83,8 +88,8 @@ def param_logical_axes(config: GPT2Config) -> dict:
         "layers": {
             "ln1": ln_l,
             "attn": {
-                "wqkv": ("layers", "embed", "heads"),
-                "bqkv": ("layers", "heads_vector"),
+                "wqkv": ("layers", "embed", "qkv", "heads"),
+                "bqkv": ("layers", "qkv", "heads_vector"),
                 "wo": ("layers", "heads", "embed"),
                 "bo": ("layers", "embed_vector"),
             },
@@ -109,33 +114,49 @@ def _layernorm(x, p, eps):
     return (y * p["scale"].astype(jnp.float32) + p["bias"].astype(jnp.float32)).astype(dtype)
 
 
-def _block(config: GPT2Config, x, layer, positions, attn_impl, standard_layout=True):
+def _block(config: GPT2Config, x, layer, positions, attn_impl,
+           standard_layout=True, tp_axis=None):
+    """One pre-LN transformer block.
+
+    ``tp_axis``: set inside a shard_map region where tp is a *manual* axis
+    (the pipeline schedule, ``parallel/pipeline.py``): wqkv/bqkv/wi/bi arrive
+    column-sharded (local head / mlp slices, inferred from shapes), wo / mlp
+    wo row-sharded with an explicit psum of the partial sums, and the
+    replicated row biases are added once, after the psum."""
     b, s, e = x.shape
-    h, d = config.num_heads, config.head_size
+    d = config.head_size
     cdt = config.dtype
+    wqkv = layer["attn"]["wqkv"]          # [e, 3, e/tp] under manual tp
+    e_loc = wqkv.shape[-1]
+    h_loc = e_loc // d
 
     y = _layernorm(x, {"scale": layer["ln1"]["scale"], "bias": layer["ln1"]["bias"]},
                    config.layer_norm_eps)
-    qkv = y @ layer["attn"]["wqkv"].astype(cdt) + layer["attn"]["bqkv"].astype(cdt)
+    qkv = (y @ wqkv.reshape(e, 3 * e_loc).astype(cdt)
+           + layer["attn"]["bqkv"].reshape(3 * e_loc).astype(cdt))
     q, k, v = jnp.split(qkv, 3, axis=-1)
-    q = q.reshape(b, s, h, d)
-    k = k.reshape(b, s, h, d)
-    v = v.reshape(b, s, h, d)
+    q = q.reshape(b, s, h_loc, d)
+    k = k.reshape(b, s, h_loc, d)
+    v = v.reshape(b, s, h_loc, d)
     if callable(attn_impl):  # e.g. ring attention under context parallelism
         attn = attn_impl(q, k, v, standard_layout=standard_layout)
     else:
         attn = multihead_attention(q, k, v, causal=True, positions=positions,
                                    kv_positions=positions, impl=attn_impl,
                                    standard_layout=standard_layout)
-    attn = attn.reshape(b, s, e) @ layer["attn"]["wo"].astype(cdt) + layer["attn"]["bo"].astype(cdt)
-    x = x + attn
+    attn = attn.reshape(b, s, e_loc) @ layer["attn"]["wo"].astype(cdt)
+    if tp_axis is not None:  # megatron Rowwise: out-proj partial sums
+        attn = _psum(attn, tp_axis)
+    x = x + attn + layer["attn"]["bo"].astype(cdt)
 
     y = _layernorm(x, {"scale": layer["ln2"]["scale"], "bias": layer["ln2"]["bias"]},
                    config.layer_norm_eps)
     y = jax.nn.gelu(y @ layer["mlp"]["wi"].astype(cdt) + layer["mlp"]["bi"].astype(cdt),
                     approximate=True)
-    y = y @ layer["mlp"]["wo"].astype(cdt) + layer["mlp"]["bo"].astype(cdt)
-    return x + y
+    y = y @ layer["mlp"]["wo"].astype(cdt)
+    if tp_axis is not None:
+        y = _psum(y, tp_axis)
+    return x + y + layer["mlp"]["bo"].astype(cdt)
 
 
 def embed_tokens(config: GPT2Config, params: dict, input_ids: jnp.ndarray,
@@ -149,6 +170,18 @@ def embed_tokens(config: GPT2Config, params: dict, input_ids: jnp.ndarray,
 def output_weights(config: GPT2Config, params: dict) -> jnp.ndarray:
     """[E, V] tied output projection in compute dtype."""
     return params["wte"].T.astype(config.dtype)
+
+
+def tp_embed(config: GPT2Config, params: dict, input_ids: jnp.ndarray,
+             positions: jnp.ndarray, axis: str) -> jnp.ndarray:
+    """Stage-0 embedding when tp is a manual axis: vocab-sharded token table
+    (megatron vocab parallelism) + the replicated learned-position table."""
+    from ..ops.vocab_parallel import vocab_parallel_embed
+
+    tok = vocab_parallel_embed(params["wte"].astype(config.dtype),
+                               input_ids, axis)
+    pos = jnp.take(params["wpe"], positions, axis=0).astype(config.dtype)
+    return tok + pos
 
 
 def final_hidden(config: GPT2Config, params: dict, x: jnp.ndarray) -> jnp.ndarray:

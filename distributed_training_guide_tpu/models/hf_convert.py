@@ -171,6 +171,20 @@ def convert_hf_checkpoint(hf_dir: str | Path, out_dir: str | Path,
                 if transpose:
                     tensor = tensor.T
                 mm = leaf_mm(leaf)
+                target = mm.shape if layer is None else mm.shape[1:]
+                if tensor.shape != tuple(target):
+                    # only re-factor TRAILING dims (same data, finer
+                    # factoring — e.g. gpt2's fused QKV is [E, 3E] in HF but
+                    # [E, 3, E] here so the head dim shards on its own,
+                    # models/gpt2.py). Leading-dim mismatches (e.g. a
+                    # transposed Linear-vs-Conv1D layout) must stay loud:
+                    # an unconditional reshape would silently scramble them.
+                    if tensor.ndim > 1 and tensor.shape[:1] != tuple(target[:1]):
+                        raise ValueError(
+                            f"{name}: shape {tensor.shape} does not match "
+                            f"target {tuple(target)} for leaf {leaf!r} "
+                            f"(transposed source layout?)")
+                    tensor = tensor.reshape(target)
                 if layer is None:
                     mm[...] = tensor.astype(mm.dtype)
                 else:

@@ -210,6 +210,17 @@ def output_weights(config: LlamaConfig, params: dict) -> jnp.ndarray:
     return params["lm_head"].astype(config.dtype)
 
 
+def tp_embed(config: LlamaConfig, params: dict, input_ids: jnp.ndarray,
+             positions: jnp.ndarray, axis: str) -> jnp.ndarray:
+    """Stage-0 embedding when tp is a manual axis (pipeline schedule):
+    megatron vocab parallelism over the sharded table."""
+    del positions  # rope is applied inside blocks
+    from ..ops.vocab_parallel import vocab_parallel_embed
+
+    return vocab_parallel_embed(params["embed"]["embedding"].astype(config.dtype),
+                                input_ids, axis)
+
+
 def final_hidden(config: LlamaConfig, params: dict, x: jnp.ndarray) -> jnp.ndarray:
     """Final norm only — pair with ``output_weights`` for chunked losses."""
     return _rmsnorm(x, params["final_norm"], config.rms_norm_eps)

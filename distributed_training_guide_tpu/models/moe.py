@@ -306,6 +306,7 @@ embed_tokens = llama.embed_tokens
 output_weights = llama.output_weights
 final_hidden = llama.final_hidden
 lm_head_logits = llama.lm_head_logits
+tp_embed = llama.tp_embed
 
 
 PRESETS = {

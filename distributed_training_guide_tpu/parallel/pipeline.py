@@ -41,8 +41,7 @@ import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ..ops.cross_entropy import causal_lm_loss
-from ..ops.vocab_parallel import (vocab_parallel_causal_lm_loss,
-                                  vocab_parallel_embed)
+from ..ops.vocab_parallel import vocab_parallel_causal_lm_loss
 
 
 def _family_module(family: str):
@@ -111,11 +110,10 @@ def make_pipeline_value_and_grad(
     mod = _family_module(bundle.family)
     rules = plan.rules
     if tp > 1:
-        if bundle.family not in ("llama", "moe"):
+        if not hasattr(mod, "tp_embed"):
             raise NotImplementedError(
-                f"pp x tp is implemented for the llama and moe families "
-                f"(manual megatron shards); family {bundle.family!r} supports "
-                f"pp with tp=1")
+                f"pp x tp needs family {bundle.family!r} to provide manual "
+                f"megatron shards (a tp_axis-aware _block + tp_embed)")
         if rules.get("heads") != "tp":
             raise ValueError(
                 f"mesh has tp={tp} but plan {plan.strategy!r} maps no logical "
@@ -128,15 +126,21 @@ def make_pipeline_value_and_grad(
             raise NotImplementedError(
                 "loss_chunks is redundant under pp x tp: the vocab-parallel "
                 "head already never materializes full logits")
-        if cfg.num_kv_heads % tp or cfg.num_heads % tp:
+        n_kv = getattr(cfg, "num_kv_heads", cfg.num_heads)
+        if n_kv % tp or cfg.num_heads % tp:
             raise ValueError(f"num_heads={cfg.num_heads}/num_kv_heads="
-                             f"{cfg.num_kv_heads} not divisible by tp={tp}")
+                             f"{n_kv} not divisible by tp={tp}")
+        if cfg.vocab_size % tp:
+            raise ValueError(
+                f"vocab_size={cfg.vocab_size} not divisible by tp={tp}: the "
+                f"manual vocab-parallel embed/head needs equal vocab shards "
+                f"(gpt2's 50257 never divides — pad the vocab, e.g. "
+                f"vocab_size=50304, or run pp with tp=1)")
     n_layers = cfg.num_layers
     if n_layers % pp != 0:
         raise ValueError(f"num_layers={n_layers} not divisible by pp={pp}")
     M = microbatches or 2 * pp
-    tied = getattr(cfg, "tie_word_embeddings", False)
-    vocab_tp = tp > 1  # vocab-parallel embed/head (llama-only, checked above)
+    vocab_tp = tp > 1  # vocab-parallel embed/head (family tp hooks, above)
     tp_axis = "tp" if tp > 1 else None
 
     # MoE stages carry the router aux loss out of the scan; dense stages
@@ -145,7 +149,7 @@ def make_pipeline_value_and_grad(
     aux_coef = getattr(cfg, "router_aux_coef", 0.0) if moe_family else 0.0
 
     def stage_fn(layers_local, x, positions):
-        tp_kw = {"tp_axis": tp_axis} if tp_axis else {}  # llama/moe kwarg
+        tp_kw = {"tp_axis": tp_axis} if tp_axis else {}  # family _block kwarg
         block = functools.partial(mod._block, cfg, positions=positions,
                                   attn_impl=attn_impl, **tp_kw)
 
@@ -171,8 +175,7 @@ def make_pipeline_value_and_grad(
     def embed_fn(nl_params, ids, positions):
         # nl_params: the non-"layers" subtree of params
         if vocab_tp:
-            return vocab_parallel_embed(
-                nl_params["embed"]["embedding"].astype(cfg.dtype), ids, "tp")
+            return mod.tp_embed(cfg, nl_params, ids, positions, "tp")
         return mod.embed_tokens(cfg, nl_params, ids, positions)
 
     use_chunked = loss_chunks > 0 and not vocab_tp
@@ -183,12 +186,9 @@ def make_pipeline_value_and_grad(
 
     def head_loss_fn(nl_params, y, labels):
         if vocab_tp:
-            from ..models.llama import _rmsnorm
-
-            h = _rmsnorm(y, nl_params["final_norm"], cfg.rms_norm_eps)
-            w = (nl_params["embed"]["embedding"].T if tied
-                 else nl_params["lm_head"]).astype(cfg.dtype)
-            logits_local = jnp.dot(h, w, preferred_element_type=jnp.float32)
+            # the family head is shape-agnostic: on this member's vocab shard
+            # it yields local [mb, S, V/tp] logits
+            logits_local = mod.lm_head_logits(cfg, nl_params, y)
             return vocab_parallel_causal_lm_loss(logits_local, labels, "tp")
         if use_chunked:
             # big-vocab path: per-tick [mb, S, V] logits never materialize

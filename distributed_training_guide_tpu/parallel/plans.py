@@ -49,12 +49,16 @@ STRATEGIES: dict[str, dict[str, Any]] = {
         "vocab": "fsdp",  # embedding + lm_head shard vocab (big dim, avoids
                           # resharding the embed dim used in every matmul)
     },
-    # chapter 06: megatron TP + sequence parallelism for activations
+    # chapter 06: megatron TP + sequence parallelism for activations.
+    # *_vector axes are the gpt2 biases — a column-parallel projection's
+    # bias shards with its columns
     "tp": {
         "heads": "tp",
         "kv": "tp",
         "mlp": "tp",
         "vocab": "tp",
+        "heads_vector": "tp",
+        "mlp_vector": "tp",
     },
     # chapter 07: 2-D = FSDP x TP on orthogonal axes
     "tp_fsdp": {
@@ -62,17 +66,21 @@ STRATEGIES: dict[str, dict[str, Any]] = {
         "kv": "tp",
         "mlp": "tp",
         "vocab": "tp",
+        "heads_vector": "tp",
+        "mlp_vector": "tp",
         "embed": "fsdp",
     },
     # chapter 09 (beyond the reference): pipeline stages own layer slices;
     # the stacked layer dim is the sharded one (parallel/pipeline.py)
     "pp": {"layers": "pp"},
     "pp_fsdp": {"layers": "pp", "embed": "fsdp", "vocab": "fsdp"},
-    "pp_tp": {"layers": "pp", "heads": "tp", "kv": "tp", "mlp": "tp", "vocab": "tp"},
+    "pp_tp": {"layers": "pp", "heads": "tp", "kv": "tp", "mlp": "tp",
+              "vocab": "tp", "heads_vector": "tp", "mlp_vector": "tp"},
     # pp x tp x fsdp: tp is manual inside the pipeline shard_map (megatron
     # shards + vocab-parallel embed/head), fsdp stays auto on the embed dim
     "pp_tp_fsdp": {"layers": "pp", "heads": "tp", "kv": "tp", "mlp": "tp",
-                   "vocab": "tp", "embed": "fsdp"},
+                   "vocab": "tp", "heads_vector": "tp", "mlp_vector": "tp",
+                   "embed": "fsdp"},
     # chapter 10 (beyond the reference): MoE expert parallelism — the expert
     # dim of stacked expert weights lives on ep; GSPMD derives the token
     # all-to-all from the dispatch/combine einsums (models/moe.py)

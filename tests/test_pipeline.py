@@ -85,8 +85,9 @@ def test_pp_tp_composes_with_fsdp(golden, eight_devices):
 
 
 def test_pp_gpt2_family(eight_devices):
-    # the schedule is family-generic at tp=1 (gpt2 exercises tied embeddings
-    # + learned position embeddings through the embed/head vjp paths)
+    # gpt2 exercises tied embeddings + learned position embeddings through
+    # the embed/head vjp paths; under pp x tp also the column-sharded fused
+    # QKV ([l,e,3,e] layout), sharded biases, and the tied vocab-parallel head
     bundle = get_model("gpt2-debug", dtype=jnp.float32)
     golden_t = Trainer(bundle=bundle, optimizer=adamw_cosine(1e-3),
                        plan=make_plan("single", make_mesh(devices=jax.devices()[:1])),
@@ -97,14 +98,15 @@ def test_pp_gpt2_family(eight_devices):
               for k in ("input_ids", "labels")}
     glosses = [float(golden_t.step_fn(gstate, gbatch)[1]["loss"])]
 
-    t = Trainer(bundle=bundle, optimizer=adamw_cosine(1e-3),
-                plan=make_plan("pp", make_mesh(pp=2)), donate=False,
-                pp_microbatches=2)
-    state = t.init_state(0)
-    batch = {k: jax.device_put(jnp.asarray(ids), t.batch_shardings()[k])
-             for k in ("input_ids", "labels")}
-    losses = [float(t.step_fn(state, batch)[1]["loss"])]
-    np.testing.assert_allclose(losses, glosses, rtol=2e-4)
+    for strategy, mesh_kw in (("pp", {"pp": 2}), ("pp_tp", {"pp": 2, "tp": 2})):
+        t = Trainer(bundle=bundle, optimizer=adamw_cosine(1e-3),
+                    plan=make_plan(strategy, make_mesh(**mesh_kw)), donate=False,
+                    pp_microbatches=2)
+        state = t.init_state(0)
+        batch = {k: jax.device_put(jnp.asarray(ids), t.batch_shardings()[k])
+                 for k in ("input_ids", "labels")}
+        losses = [float(t.step_fn(state, batch)[1]["loss"])]
+        np.testing.assert_allclose(losses, glosses, rtol=2e-4, err_msg=strategy)
 
 
 def test_pp_moe_family(eight_devices):
@@ -131,7 +133,9 @@ def test_pp_moe_family(eight_devices):
     np.testing.assert_allclose(pp, golden, rtol=2e-4)
 
 
-@pytest.mark.parametrize("model,coef", [("llama-debug", None), ("moe-debug", 1.0)])
+@pytest.mark.parametrize("model,coef", [("llama-debug", None),
+                                        ("moe-debug", 1.0),
+                                        ("gpt2-debug", None)])
 def test_pp_tp_grad_parity(eight_devices, model, coef):
     """pp x tp gradients must equal the single-device gradients EXACTLY (not
     just up to a scale — Adam is invariant to uniform grad scaling, so the
