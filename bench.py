@@ -297,7 +297,7 @@ def main() -> None:
     parser.add_argument("--no-remat", dest="remat", action="store_false")
     parser.add_argument("--attn-impl", default="auto")
     parser.add_argument("--remat-policy", default=None,
-                        choices=["all", "dots", "attn"])
+                        choices=["all", "dots", "attn", "attn_mlp"])
     parser.add_argument("--watchdog", type=int, default=_default_watchdog())
     parser.add_argument("--skip-flash-check", action="store_true")
     # child modes

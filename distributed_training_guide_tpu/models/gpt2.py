@@ -13,6 +13,7 @@ from typing import Any, Optional
 
 import jax
 import jax.numpy as jnp
+from jax.ad_checkpoint import checkpoint_name
 
 from ..ops.attention import multihead_attention
 from ..ops.collectives import psum as _psum
@@ -153,6 +154,8 @@ def _block(config: GPT2Config, x, layer, positions, attn_impl,
                    config.layer_norm_eps)
     y = jax.nn.gelu(y @ layer["mlp"]["wi"].astype(cdt) + layer["mlp"]["bi"].astype(cdt),
                     approximate=True)
+    # tagged for REMAT_POLICIES["attn_mlp"] (same role as llama's mlp_act)
+    y = checkpoint_name(y, "mlp_act")
     y = y @ layer["mlp"]["wo"].astype(cdt)
     if tp_axis is not None:
         y = _psum(y, tp_axis)

@@ -28,6 +28,7 @@ from typing import Any, Optional
 
 import jax
 import jax.numpy as jnp
+from jax.ad_checkpoint import checkpoint_name
 
 from ..ops.attention import multihead_attention
 from ..ops.collectives import psum as _psum
@@ -190,7 +191,10 @@ def _block(config: LlamaConfig, x: jnp.ndarray, layer: dict,
     h = _rmsnorm(x, layer["post_attn_norm"], config.rms_norm_eps)
     gate = h @ layer["mlp"]["gate"].astype(cdt)
     up = h @ layer["mlp"]["up"].astype(cdt)
-    down = (jax.nn.silu(gate) * up) @ layer["mlp"]["down"].astype(cdt)
+    # tagged for REMAT_POLICIES["attn_mlp"]: saving the [B,S,I] inner
+    # activation skips the gate/up matmul recompute in backward
+    act = checkpoint_name(jax.nn.silu(gate) * up, "mlp_act")
+    down = act @ layer["mlp"]["down"].astype(cdt)
     if tp_axis is not None:  # megatron Rowwise: down-proj partial sums
         down = _psum(down, tp_axis)
     return constrain(x + down)

@@ -36,6 +36,7 @@ from typing import Any, Optional
 
 import jax
 import jax.numpy as jnp
+from jax.ad_checkpoint import checkpoint_name
 
 from . import llama
 from .llama import _rmsnorm, attention_sublayer
@@ -208,6 +209,9 @@ def _moe_ffn(config: MoELlamaConfig, x: jnp.ndarray, moe: dict,
 
     h = jax.nn.silu(jnp.einsum("ecd,edf->ecf", expert_in, moe["gate"].astype(cdt)))
     h = h * jnp.einsum("ecd,edf->ecf", expert_in, moe["up"].astype(cdt))
+    # tagged for REMAT_POLICIES["attn_mlp"] (the [E,C,F] inner activation;
+    # same role as llama's mlp_act)
+    h = checkpoint_name(h, "mlp_act")
     expert_out = jnp.einsum("ecf,efd->ecd", h, moe["down"].astype(cdt))
 
     out_flat = expert_out.reshape(ex * capacity, d)

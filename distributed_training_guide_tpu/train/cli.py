@@ -50,12 +50,14 @@ def get_parser() -> argparse.ArgumentParser:
     parser.add_argument("--grad-accum", default=1, type=int)
     parser.add_argument("--checkpoint-activations", action="store_true",
                         help="remat decoder layers (reference 05:163-178)")
-    parser.add_argument("--remat-policy", default="all", choices=["all", "dots", "attn"],
+    parser.add_argument("--remat-policy", default="all", choices=["all", "dots", "attn", "attn_mlp"],
                         help="what survives forward under remat: all=recompute "
                              "everything (min memory); dots=keep matmul outputs "
                              "(most memory); attn=keep attention outputs + flash "
                              "lse so backward never re-runs the attention kernel "
-                             "(best measured MFU, small memory cost)")
+                             "(best measured MFU, small memory cost); attn_mlp="
+                             "attn plus the [B,S,I] MLP inner activations "
+                             "(also skips the gate/up matmul recompute)")
     parser.add_argument("--attn-impl", default="auto", choices=["auto", "xla", "flash"])
     parser.add_argument("--max-steps", default=None, type=int)
     parser.add_argument("--native-loader", action="store_true",

@@ -45,6 +45,11 @@ REMAT_POLICIES = {
     # layer vs "all" — far less than "dots"
     "attn": jax.checkpoint_policies.save_only_these_names(
         "attn_out", "flash_out", "flash_lse"),
+    # "attn_mlp": additionally keep the MLP inner activation ([B,S,I] per
+    # layer — the big one) so backward also skips the gate/up matmuls;
+    # between "attn" and "dots" on the memory/time curve
+    "attn_mlp": jax.checkpoint_policies.save_only_these_names(
+        "attn_out", "flash_out", "flash_lse", "mlp_act"),
 }
 
 
