@@ -40,6 +40,12 @@ def get_parser() -> argparse.ArgumentParser:
     parser.add_argument("--seed", default=0, type=int)
     parser.add_argument("--num-epochs", default=100, type=int)
     parser.add_argument("--lr", default=3e-5, type=float)
+    parser.add_argument("--optimizer", default="adamw",
+                        choices=["adamw", "adafactor"],
+                        help="adamw = reference parity (fused AdamW, 2x-fp32 "
+                             "moments); adafactor = factored second moment, "
+                             "~0 optimizer memory (the TPU-native lever for "
+                             "fitting big models without CPU offload)")
     parser.add_argument("-b", "--batch-size", default=1, type=int,
                         help="per-data-parallel-replica batch size (reference semantics)")
     parser.add_argument("--log-freq", default=10, type=int)
@@ -95,7 +101,7 @@ def run_training(args, plan_factory: Callable, *, extra_log: Optional[dict] = No
     from ..checkpoint import CheckpointIO, abstract_train_state
     from ..data import ShardedBatchLoader, get_tokenizer, load_and_preprocess_data
     from ..models import get_model
-    from ..train import Trainer, adamw_cosine
+    from ..train import Trainer, adafactor_cosine, adamw_cosine
     from ..train.optimizer import lr_at_step
     from ..train.state import host_state_dict
     from ..utils import (LocalTimer, compute_mfu, get_mem_stats, init_logging,
@@ -121,7 +127,8 @@ def run_training(args, plan_factory: Callable, *, extra_log: Optional[dict] = No
 
     trainer = Trainer(
         bundle=bundle,
-        optimizer=adamw_cosine(args.lr),
+        optimizer=(adafactor_cosine if args.optimizer == "adafactor"
+                   else adamw_cosine)(args.lr),
         plan=plan,
         grad_accum=args.grad_accum,
         remat=args.checkpoint_activations,

@@ -106,7 +106,7 @@ class Trainer:
     plan: Optional[ShardingPlan] = None
     grad_accum: int = 1
     remat: bool = False
-    remat_policy: str = "all"  # all | dots | attn (what survives under remat)
+    remat_policy: str = "all"  # REMAT_POLICIES key (what survives under remat)
     loss_chunks: int = 0  # >0: chunked CE from hidden states (no [B,S,V] logits)
     attn_impl: str = "auto"
     loss_fn: Callable = causal_lm_loss

@@ -1,0 +1,72 @@
+"""Optimizer coverage: the AdamW parity path and the Adafactor memory lever.
+
+Reference counterpart: fused AdamW + CosineAnnealingLR
+(``01-single-gpu/train_llm.py:73-78``); Adafactor is TPU-native extra
+(factored second moment — the memory story the reference solves with CPU
+offload instead, ``05-training-llama-405b/train_llm.py:69-72``).
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from distributed_training_guide_tpu.models import get_model
+from distributed_training_guide_tpu.parallel import make_mesh, make_plan
+from distributed_training_guide_tpu.train import (Trainer, adafactor_cosine,
+                                                  adamw_cosine)
+
+
+def _run(optimizer, steps=10, **trainer_kw):
+    bundle = get_model("llama-debug")
+    t = Trainer(bundle=bundle, optimizer=optimizer, **trainer_kw)
+    state = t.init_state(0)
+    ids = np.random.RandomState(0).randint(0, bundle.config.vocab_size, (8, 64))
+    batch = {k: jax.device_put(jnp.asarray(ids), t.batch_shardings()[k])
+             for k in ("input_ids", "labels")}
+    losses = []
+    for _ in range(steps):
+        state, m = t.step_fn(state, batch)
+        losses.append(float(m["loss"]))
+    return losses, state
+
+
+def _tree_bytes(tree):
+    return sum(l.size * l.dtype.itemsize for l in jax.tree.leaves(tree))
+
+
+def test_adafactor_trains():
+    losses, _ = _run(adafactor_cosine(1e-2))
+    assert losses[-1] < losses[0] - 0.1, losses
+
+
+def test_adafactor_state_is_factored():
+    """The whole point: optimizer state must be a sliver of AdamW's 2x fp32.
+    llama-debug's dims sit under the production factoring threshold (128),
+    so lower it to the test scale; real presets (1536+) factor by default."""
+    _, fact_state = _run(adafactor_cosine(1e-2, min_dim_size_to_factor=8),
+                         steps=1)
+    _, adam_state = _run(adamw_cosine(1e-3), steps=1)
+    param_bytes = _tree_bytes(fact_state.params)
+    assert _tree_bytes(adam_state.opt_state) > 1.9 * param_bytes  # mu + nu
+    assert _tree_bytes(fact_state.opt_state) < 0.1 * param_bytes
+
+
+def test_adafactor_composes_with_fsdp(eight_devices):
+    losses, state = _run(adafactor_cosine(1e-2), steps=3,
+                         plan=make_plan("fsdp", make_mesh(fsdp=8)), donate=False)
+    assert np.isfinite(losses).all()
+    # params stay sharded; the (tiny, shape-mismatched) factored state
+    # falls back to replicated — assert that stays true and cheap
+    wq = state.params["layers"]["attn"]["wq"]
+    assert "fsdp" in str(wq.sharding.spec)
+
+
+def test_adafactor_decay_is_decoupled_and_lr_scaled():
+    """optax.adafactor's canned weight_decay_rate applies AFTER lr scaling
+    (wd*p per step — ~1e4x AdamW's); our chain must match AdamW's decoupled
+    -lr*wd*p instead. Pinned with a zero gradient, where the whole update IS
+    the decay term."""
+    lr, wd = 3e-5, 0.01
+    p = {"w": jnp.ones((256, 256), jnp.float32)}
+    tx = adafactor_cosine(lr, weight_decay=wd)
+    u, _ = tx.update(jax.tree.map(jnp.zeros_like, p), tx.init(p), p)
+    np.testing.assert_allclose(np.asarray(u["w"]), -lr * wd, rtol=1e-3)
