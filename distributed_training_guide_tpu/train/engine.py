@@ -47,7 +47,7 @@ class TrainingEngine:
     def __init__(self, config: dict | str | Path):
         from ..models import get_model
         from ..parallel import make_mesh, make_plan
-        from .optimizer import adamw_cosine
+        from .optimizer import adafactor_cosine, adamw_cosine
         from .step import Trainer
 
         if not isinstance(config, dict):
@@ -79,18 +79,27 @@ class TrainingEngine:
         plan = make_plan(strategy, mesh, zero1=(stage in (1, 2)) or None,
                          zero2=(stage == 2) or None)
 
+        opt_type = config.get("optimizer", {}).get("type", "AdamW").lower()
         opt_cfg = config.get("optimizer", {}).get("params", {})
         sched = config.get("scheduler", {})
-        optimizer = adamw_cosine(
-            opt_cfg.get("lr", 3e-5),
+        common = dict(
             weight_decay=opt_cfg.get("weight_decay", 0.01),
-            b1=opt_cfg.get("betas", [0.9, 0.999])[0],
-            b2=opt_cfg.get("betas", [0.9, 0.999])[1],
             t_max=sched.get("t_max", 1000),
             eta_min_ratio=sched.get("eta_min_ratio", 0.01),
             warmup_steps=sched.get("warmup_steps", 0),
             grad_clip=config.get("gradient_clipping"),
         )
+        if opt_type in ("adamw", "adam"):
+            optimizer = adamw_cosine(
+                opt_cfg.get("lr", 3e-5),
+                b1=opt_cfg.get("betas", [0.9, 0.999])[0],
+                b2=opt_cfg.get("betas", [0.9, 0.999])[1],
+                **common)
+        elif opt_type == "adafactor":
+            optimizer = adafactor_cosine(opt_cfg.get("lr", 3e-5), **common)
+        else:
+            raise ValueError(f"unknown optimizer.type {opt_type!r}; "
+                             f"use AdamW or Adafactor")
 
         self.trainer = Trainer(
             bundle=bundle,

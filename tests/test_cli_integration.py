@@ -78,3 +78,23 @@ def test_engine_roundtrip(tmp_path, eight_devices):
     engine.save_checkpoint(tmp_path / "eng")
     host = engine.load_checkpoint(tmp_path / "eng")
     assert host["global_step"] == 1
+
+
+def test_engine_optimizer_type_dispatch(eight_devices):
+    from distributed_training_guide_tpu.train.engine import initialize
+
+    config = {
+        "model": "llama-debug",
+        "zero_optimization": {"stage": 3},
+        "optimizer": {"type": "Adafactor", "params": {"lr": 1e-2}},
+    }
+    engine = initialize(config)
+    ids = np.random.RandomState(0).randint(0, 512, (engine.global_batch_size, 32))
+    batch_sh = engine.trainer.batch_shardings()
+    batch = {k: jax.device_put(ids, batch_sh[k]) for k in ("input_ids", "labels")}
+    assert np.isfinite(engine.train_batch(batch)["loss"])
+    # the config actually selected adafactor: no fp32 Adam mu anywhere
+    state_names = {type(s).__name__ for s in engine.state.opt_state}
+    assert "ScaleByAdamState" not in state_names
+    with pytest.raises(ValueError, match="optimizer.type"):
+        initialize({"model": "llama-debug", "optimizer": {"type": "SGD"}})

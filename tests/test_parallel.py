@@ -77,6 +77,31 @@ def test_strategy_matches_single_device(strategy, mesh_kw, golden, eight_devices
         np.testing.assert_allclose(a, b, rtol=1e-2, atol=1e-4)
 
 
+def test_gpt2_tp_matches_single_device(eight_devices):
+    """gpt2 under auto (GSPMD) tensor parallelism: exercises the [l,e,3,e]
+    fused-QKV layout and the column-sharded biases (*_vector -> tp rules)
+    that the llama goldens above cannot cover (llama has no biases)."""
+    bundle = get_model("gpt2-debug", dtype=jnp.float32)
+
+    def run(strategy, mesh):
+        t = Trainer(bundle=bundle, optimizer=adamw_cosine(1e-3),
+                    plan=make_plan(strategy, mesh), donate=False)
+        state = t.init_state(0)
+        ids = np.random.RandomState(0).randint(0, 512, (GLOBAL_BATCH, SEQ))
+        batch = {k: jax.device_put(jnp.asarray(ids), t.batch_shardings()[k])
+                 for k in ("input_ids", "labels")}
+        losses = []
+        for _ in range(2):
+            state, m = t.step_fn(state, batch)
+            losses.append(float(m["loss"]))
+        return losses
+
+    golden = run("single", make_mesh(devices=jax.devices()[:1]))
+    for strategy, mesh_kw in (("tp", {"tp": 4}), ("tp_fsdp", {"fsdp": 2, "tp": 2})):
+        got = run(strategy, make_mesh(**mesh_kw))
+        np.testing.assert_allclose(got, golden, rtol=1e-4, err_msg=strategy)
+
+
 def test_params_actually_sharded(eight_devices):
     trainer = make_trainer("fsdp", fsdp=8)
     state = trainer.init_state(0)

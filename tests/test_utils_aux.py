@@ -162,3 +162,16 @@ def test_monitor_error_row():
     row = ClusterWatch().update({"host": "gone", "error": "timeout"})
     assert row["status"] == "error"
     assert "ERROR" in format_row(row)
+
+
+def test_multi_slice_mesh_fallback(eight_devices):
+    """Forcing multi_slice on CPU devices (no slice_index metadata) must fall
+    back to the flat mesh, not crash — the degradation path a real pod hits
+    when DCN topology metadata is missing."""
+    import jax
+
+    from distributed_training_guide_tpu.parallel import make_mesh
+
+    mesh = make_mesh(fsdp=4, multi_slice=True)
+    assert mesh.shape["fsdp"] == 4 and mesh.shape["dp"] == 2
+    assert mesh.devices.size == len(jax.devices())
