@@ -118,6 +118,13 @@ def run_rung(rung: dict) -> None:
                 "steps_timed": steps_timed,
             },
         }
+        try:
+            stats = devices[0].memory_stats() or {}
+        except Exception:  # some backends raise instead of returning None
+            stats = {}
+        if stats.get("peak_bytes_in_use"):
+            out["detail"]["peak_hbm_gb"] = round(
+                1e-9 * stats["peak_bytes_in_use"], 2)
         if partial:
             out["partial"] = True
         return out
@@ -172,11 +179,12 @@ def run_flash_check() -> None:
 
     from distributed_training_guide_tpu.ops.attention import multihead_attention
 
-    B, S, H, D = 4, 2048, 16, 64
+    # the llama-650m headline attention shape, GQA included
+    B, S, Hq, Hkv, D = 8, 2048, 12, 4, 128
     ks = jax.random.split(jax.random.key(0), 3)
-    q = jax.random.normal(ks[0], (B, S, H, D), jnp.bfloat16)
-    k = jax.random.normal(ks[1], (B, S, H, D), jnp.bfloat16)
-    v = jax.random.normal(ks[2], (B, S, H, D), jnp.bfloat16)
+    q = jax.random.normal(ks[0], (B, S, Hq, D), jnp.bfloat16)
+    k = jax.random.normal(ks[1], (B, S, Hkv, D), jnp.bfloat16)
+    v = jax.random.normal(ks[2], (B, S, Hkv, D), jnp.bfloat16)
 
     def make(impl):
         @jax.jit
@@ -207,7 +215,7 @@ def run_flash_check() -> None:
     grad_diff = float(np.max(np.abs(outs["flash"][0] - outs["xla"][0])))
     sum_rel = abs(outs["flash"][1] - outs["xla"][1]) / max(1.0, abs(outs["xla"][1]))
     results.update({
-        "shape": [B, S, H, D], "dtype": "bfloat16",
+        "shape": [B, S, Hq, Hkv, D], "dtype": "bfloat16",
         "grad_max_abs_diff": round(grad_diff, 5),
         "out_sum_rel_diff": round(sum_rel, 6),
         "ok": bool(grad_diff < 0.1 and sum_rel < 1e-2),
