@@ -66,7 +66,8 @@ def run_rung(rung: dict) -> None:
 
     from distributed_training_guide_tpu.models import get_model
     from distributed_training_guide_tpu.parallel import make_mesh, make_plan
-    from distributed_training_guide_tpu.train import Trainer, adamw_cosine
+    from distributed_training_guide_tpu.train import (Trainer, adafactor_cosine,
+                                                      adamw_cosine)
     from distributed_training_guide_tpu.utils import (
         compute_mfu, device_peak_flops, transformer_flops_per_token)
 
@@ -84,7 +85,9 @@ def run_rung(rung: dict) -> None:
     else:
         plan = make_plan("single", make_mesh(devices=devices[:1]))
 
-    trainer = Trainer(bundle=bundle, optimizer=adamw_cosine(3e-4), plan=plan,
+    make_opt = (adafactor_cosine if rung.get("optimizer") == "adafactor"
+                else adamw_cosine)
+    trainer = Trainer(bundle=bundle, optimizer=make_opt(3e-4), plan=plan,
                       remat=remat, remat_policy=rung.get("remat_policy", "all"),
                       attn_impl=rung.get("attn_impl", "auto"))
     state = trainer.init_state(0)
@@ -114,6 +117,7 @@ def run_rung(rung: dict) -> None:
                 "device": getattr(devices[0], "device_kind", devices[0].platform),
                 "remat": remat,
                 "remat_policy": rung.get("remat_policy", "all"),
+                "optimizer": rung.get("optimizer", "adamw"),
                 "loss": round(loss, 4),
                 "steps_timed": steps_timed,
             },
@@ -306,6 +310,8 @@ def main() -> None:
     parser.add_argument("--attn-impl", default="auto")
     parser.add_argument("--remat-policy", default=None,
                         choices=["all", "dots", "attn", "attn_mlp"])
+    parser.add_argument("--optimizer", default=None,
+                        choices=["adamw", "adafactor"])
     parser.add_argument("--watchdog", type=int, default=_default_watchdog())
     parser.add_argument("--skip-flash-check", action="store_true")
     # child modes
@@ -334,7 +340,8 @@ def main() -> None:
     platform = probe[-1].get("platform", "tpu") if probe else "tpu"
 
     if (args.model is not None or args.batch is not None
-            or args.seq is not None or args.remat_policy is not None):
+            or args.seq is not None or args.remat_policy is not None
+            or args.optimizer is not None):
         on_tpu = platform == "tpu"
         ladder = [dict(model=args.model or ("llama-650m" if on_tpu else "llama-debug"),
                        batch=args.batch or (8 if on_tpu else 2),
@@ -346,7 +353,9 @@ def main() -> None:
                               else on_tpu or args.remat_policy is not None),
                        attn_impl=args.attn_impl, budget=deadline - time.time(),
                        **({"remat_policy": args.remat_policy}
-                          if args.remat_policy else {}))]
+                          if args.remat_policy else {}),
+                       **({"optimizer": args.optimizer}
+                          if args.optimizer else {}))]
     elif platform == "tpu":
         # headline: remat_policy="attn" keeps only attention outputs + flash
         # lse, so backward never re-runs the attention kernel (measured
