@@ -12,12 +12,13 @@ from distributed_training_guide_tpu.train import Trainer, adamw_cosine
 GB, SEQ = 8, 32
 
 
-def run(strategy, mesh_kw, steps=2, sequence_sharded=None, gb=GB, **trainer_kw):
+def run(strategy, mesh_kw, steps=2, sequence_sharded=None, gb=GB,
+        optimizer=None, **trainer_kw):
     bundle = get_model("llama-debug", dtype=jnp.float32)
     mesh = (make_mesh(devices=jax.devices()[:1]) if strategy == "single"
             else make_mesh(**mesh_kw))
     plan = make_plan(strategy, mesh, sequence_sharded=sequence_sharded)
-    t = Trainer(bundle=bundle, optimizer=adamw_cosine(1e-3),
+    t = Trainer(bundle=bundle, optimizer=optimizer or adamw_cosine(1e-3),
                 plan=plan, donate=False, **trainer_kw)
     state = t.init_state(0)
     ids = np.random.RandomState(0).randint(0, 512, (gb, SEQ))
@@ -62,3 +63,35 @@ def test_pp_with_grad_accum(eight_devices):
 def test_cp_with_remat_and_chunked_loss(golden, eight_devices):
     losses = run("ddp", {"cp": 4}, remat=True, loss_chunks=4)
     np.testing.assert_allclose(losses, golden, rtol=2e-4)
+
+
+def test_pp_with_attn_remat_policy(golden, eight_devices):
+    """The attn/attn_mlp checkpoint_name tags must survive inside the
+    pipeline's per-tick jax.vjp (the policy applies between the backward
+    tick's recompute and its cotangent pass)."""
+    from distributed_training_guide_tpu.train.step import REMAT_POLICIES
+
+    for policy in ("attn", "attn_mlp"):
+        losses = run("pp", {"pp": 2}, remat=True, remat_policy=policy,
+                     pp_microbatches=2)
+        np.testing.assert_allclose(losses, golden, rtol=2e-4, err_msg=policy)
+    assert {"attn", "attn_mlp"} <= set(REMAT_POLICIES)
+
+
+def test_cp_with_attn_remat_policy(golden, eight_devices):
+    """Under context parallelism attention runs the ring custom_vjp (no
+    flash_out tags inside) — the attn policy must degrade gracefully to
+    plain recompute, not crash or change numerics."""
+    losses = run("ddp", {"cp": 4}, remat=True, remat_policy="attn")
+    np.testing.assert_allclose(losses, golden, rtol=2e-4)
+
+
+def test_pp_with_adafactor(eight_devices):
+    """Optimizer state for pp-sharded layer params follows the generic
+    opt-state sharding machinery; adafactor's factored leaves must not
+    break it."""
+    from distributed_training_guide_tpu.train import adafactor_cosine
+
+    losses = run("pp", {"pp": 2}, optimizer=adafactor_cosine(1e-2),
+                 pp_microbatches=2)
+    assert np.isfinite(losses).all() and losses[1] < losses[0]
