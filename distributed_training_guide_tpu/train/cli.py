@@ -86,6 +86,12 @@ def get_parser() -> argparse.ArgumentParser:
     parser.add_argument("--profile-dir", default=None,
                         help="capture a jax.profiler trace of steps 10-15 into this dir "
                              "(view with xprof/tensorboard; see diagnosing-errors/)")
+    parser.add_argument("--preflight", action="store_true",
+                        help="don't train: abstractly trace + SPMD-lower the "
+                             "full step for this (model, mesh, flags) and "
+                             "print the per-device HBM budget, then exit — "
+                             "catches sharding/divisibility/fit problems "
+                             "without touching an accelerator")
     return parser
 
 
@@ -117,14 +123,7 @@ def run_training(args, plan_factory: Callable, *, extra_log: Optional[dict] = No
     LOGGER.info(f"Training {bundle.num_params():,} model parameters "
                 f"on mesh {dict(plan.mesh.shape)} strategy={plan.strategy}")
 
-    tokenizer = get_tokenizer(args.model_name)
     seq_length = min(args.seq_length, cfg.max_position_embeddings)
-    dataset = load_and_preprocess_data(
-        args.dataset_name, tokenizer, seq_length,
-        dataset_subset=args.dataset_subset,
-        max_position_embeddings=cfg.max_position_embeddings, seed=args.seed)
-    LOGGER.info(f"{len(dataset)} training sequences of length {seq_length}")
-
     trainer = Trainer(
         bundle=bundle,
         optimizer=(adafactor_cosine if args.optimizer == "adafactor"
@@ -141,6 +140,19 @@ def run_training(args, plan_factory: Callable, *, extra_log: Optional[dict] = No
     )
 
     global_batch = args.batch_size * plan.data_parallel_size * args.grad_accum
+
+    if getattr(args, "preflight", False):
+        from .preflight import run_preflight
+
+        return run_preflight(trainer, global_batch=global_batch,
+                             seq_length=seq_length)
+
+    tokenizer = get_tokenizer(args.model_name)
+    dataset = load_and_preprocess_data(
+        args.dataset_name, tokenizer, seq_length,
+        dataset_subset=args.dataset_subset,
+        max_position_embeddings=cfg.max_position_embeddings, seed=args.seed)
+    LOGGER.info(f"{len(dataset)} training sequences of length {seq_length}")
     loader = ShardedBatchLoader(
         dataset, global_batch,
         trainer.batch_shardings()["input_ids"],

@@ -1,0 +1,91 @@
+"""Pre-flight: abstract memory budget + partitioning check, no device state.
+
+The reference's 405B chapter walks the HBM math by hand (params + grads +
+Adam moments vs 80 GB, ``05-training-llama-405b/README.md:191-224``) and
+discovers partitioning mistakes at full scale. Here both are automated:
+``--preflight`` traces and SPMD-lowers the COMPLETE training step for the
+requested (model, mesh, flags) with fully abstract parameters — any
+shape/sharding/divisibility error surfaces in seconds on a login host — and
+prints the per-device resident-bytes budget derived from the actual
+shardings (``NamedSharding.shard_shape``), so "will it fit" is answered
+before a single chip is reserved.
+"""
+from __future__ import annotations
+
+import logging
+
+import jax
+import numpy as np
+
+LOGGER = logging.getLogger(__name__)
+
+
+def _per_device_bytes(shapes_tree, shardings_tree) -> int:
+    total = 0
+    for sd, sh in zip(jax.tree.leaves(shapes_tree), jax.tree.leaves(shardings_tree)):
+        shard = sh.shard_shape(sd.shape) if sd.shape else ()
+        total += int(np.prod(shard, dtype=np.int64)) * sd.dtype.itemsize
+    return total
+
+
+def run_preflight(trainer, *, global_batch: int, seq_length: int) -> dict:
+    """Lower the train step abstractly and report the per-device budget.
+
+    Returns the report dict (also logged) — keys in bytes unless noted.
+    """
+    from ..checkpoint import abstract_train_state
+
+    state = abstract_train_state(trainer)
+    if trainer.grad_accum > 1:  # leading scanned microbatch axis
+        shape = (trainer.grad_accum, global_batch // trainer.grad_accum,
+                 seq_length)
+    else:
+        shape = (global_batch, seq_length)
+    batch = {
+        k: jax.ShapeDtypeStruct(shape, np.int32, sharding=sh)
+        for k, sh in trainer.batch_shardings().items()
+    }
+    # under host offload, step_fn is a python wrapper (transfers outside jit);
+    # lower its compiled core against the device-resident shardings it expects
+    step = trainer.step_fn
+    if hasattr(step, "jitted"):
+        step = step.jitted
+        state = jax.tree.map(
+            lambda sds, sh: jax.ShapeDtypeStruct(sds.shape, sds.dtype, sharding=sh),
+            state, trainer._device_state_shardings)
+    lowered = step.lower(state, batch)  # raises on sharding bugs
+
+    params_b = _per_device_bytes(state.params, trainer.param_shardings)
+    opt_b = _per_device_bytes(
+        state.opt_state,
+        jax.tree.map(lambda s: s.sharding, state.opt_state))
+    # grads are transient but resident at the optimizer boundary, fp32,
+    # sharded like the params
+    grad_b = _per_device_bytes(
+        jax.tree.map(lambda sd: jax.ShapeDtypeStruct(sd.shape, np.float32),
+                     jax.tree.leaves(state.params)),
+        jax.tree.leaves(trainer.param_shardings))
+    report = {
+        "per_device_param_bytes": params_b,
+        "per_device_opt_state_bytes": opt_b,
+        "per_device_grad_bytes_transient": grad_b,
+        "per_device_state_total_bytes": params_b + opt_b,
+        "n_devices": trainer.plan.mesh.devices.size,
+        "mesh": dict(trainer.plan.mesh.shape),
+        "lowered": True,
+    }
+    try:
+        stats = jax.devices()[0].memory_stats() or {}
+        if stats.get("bytes_limit"):
+            report["device_bytes_limit"] = int(stats["bytes_limit"])
+    except Exception:
+        pass
+    gib = 1 / 2**30
+    LOGGER.info(
+        f"preflight OK: step lowers on mesh {report['mesh']}; per device "
+        f"params {params_b * gib:.2f} GiB + opt {opt_b * gib:.2f} GiB "
+        f"(+ transient grads {grad_b * gib:.2f} GiB)"
+        + (f"; device limit {report['device_bytes_limit'] * gib:.2f} GiB"
+           if "device_bytes_limit" in report else ""))
+    del lowered
+    return report

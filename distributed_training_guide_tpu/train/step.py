@@ -393,6 +393,8 @@ class Trainer:
             new_state, metrics = jitted(state, batch)
             return self._place(new_state), metrics
 
+        # the compiled core, for ahead-of-time inspection (train/preflight.py)
+        step_and_offload.jitted = jitted
         return step_and_offload
 
     # ---- accounting --------------------------------------------------------

@@ -98,3 +98,26 @@ def test_engine_optimizer_type_dispatch(eight_devices):
     assert "ScaleByAdamState" not in state_names
     with pytest.raises(ValueError, match="optimizer.type"):
         initialize({"model": "llama-debug", "optimizer": {"type": "SGD"}})
+
+
+def test_preflight_budget_and_lowering(eight_devices):
+    from distributed_training_guide_tpu.models import get_model
+    from distributed_training_guide_tpu.parallel import make_mesh, make_plan
+    from distributed_training_guide_tpu.train import Trainer, adamw_cosine
+    from distributed_training_guide_tpu.train.preflight import run_preflight
+
+    bundle = get_model("llama-debug")
+    t = Trainer(bundle=bundle, optimizer=adamw_cosine(1e-3),
+                plan=make_plan("fsdp", make_mesh(fsdp=8)), donate=False)
+    rep = run_preflight(t, global_batch=8, seq_length=64)
+    assert rep["lowered"] and rep["n_devices"] == 8
+
+    total_param_bytes = sum(
+        np.prod(l.shape) * l.dtype.itemsize
+        for l in jax.tree.leaves(t.param_shapes))
+    # fsdp shards most leaves 8-ways; small replicated leaves (norms) mean
+    # per-device sits between total/8 and total
+    assert total_param_bytes / 8 <= rep["per_device_param_bytes"] < total_param_bytes
+    # fp32 Adam: mu + nu ~= 2x the param bytes, same shardings
+    assert 1.8 * rep["per_device_param_bytes"] < rep["per_device_opt_state_bytes"] \
+        < 2.2 * rep["per_device_param_bytes"] + 4096
