@@ -1,0 +1,75 @@
+"""Loader-throughput microbenchmark: python vs native (C++) batch assembly.
+
+TPU-native counterpart of the reference chapter's num_workers/prefetch_factor
+measurements (``related-topics/optimizing-data-loading/README.md:24-102``):
+instead of sweeping DataLoader knobs, compare the two batch-assembly paths
+this framework ships — numpy gather (``data/loader.py``) and the C++
+mmap/prefetch loader (``csrc/token_loader.cpp``) — and report tokens/s of
+pure host-side work. Run it on the machine whose ``time/data`` timer looks
+suspicious; if both paths are far above your model's tokens/s, the loader is
+not your bottleneck (the usual verdict — batch assembly is a gather, not
+per-example python).
+
+Usage: python bench_loader.py [--seqs 40000] [--seq-len 2048] [--batch 64]
+Prints one JSON line per path.
+"""
+import argparse
+import json
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).parent.parent.parent))
+
+import numpy as np
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--seqs", type=int, default=40000)
+    p.add_argument("--seq-len", type=int, default=2048)
+    p.add_argument("--batch", type=int, default=64)
+    p.add_argument("--batches", type=int, default=200)
+    args = p.parse_args()
+
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from distributed_training_guide_tpu.data import ShardedBatchLoader
+    from distributed_training_guide_tpu.parallel import make_mesh
+
+    dataset = np.random.RandomState(0).randint(
+        0, 32000, (args.seqs, args.seq_len), dtype=np.int32)
+    mesh = make_mesh(devices=jax.devices())
+    sharding = NamedSharding(mesh, P(("dp", "fsdp", "ep"), None))
+
+    if args.seqs < 2 * args.batch:
+        p.error(f"--seqs must be >= 2*batch ({2 * args.batch}) for a warmup "
+                f"batch plus at least one timed batch")
+
+    for native in (False, True):
+        loader = ShardedBatchLoader(dataset, args.batch, sharding,
+                                    seed=0, native=native)
+        try:
+            it = loader.epoch_batches()
+            next(it)  # absorb first-batch setup (mmap dump, prefetch fill)
+            n = min(args.batches, len(loader) - 1)
+            t0 = time.perf_counter()
+            for _ in range(n):
+                batch = next(it)
+            jax.block_until_ready(batch["input_ids"])
+            dt = time.perf_counter() - t0
+            used_native = loader._native is not None  # before close() clears it
+        finally:
+            loader.close()  # the native path holds a dataset-sized temp file
+        tok = n * args.batch * args.seq_len
+        print(json.dumps({
+            "path": "native_cpp" if used_native else "python_numpy",
+            "tokens_per_s": round(tok / dt),
+            "batches_per_s": round(n / dt, 1),
+            "ms_per_batch": round(1000 * dt / n, 3),
+        }), flush=True)
+
+
+if __name__ == "__main__":
+    main()
