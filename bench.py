@@ -66,8 +66,8 @@ def run_rung(rung: dict) -> None:
 
     from distributed_training_guide_tpu.models import get_model
     from distributed_training_guide_tpu.parallel import make_mesh, make_plan
-    from distributed_training_guide_tpu.train import (Trainer, adafactor_cosine,
-                                                      adamw_cosine)
+    from distributed_training_guide_tpu.train import Trainer
+    from distributed_training_guide_tpu.train.optimizer import OPTIMIZERS
     from distributed_training_guide_tpu.utils import (
         compute_mfu, device_peak_flops, transformer_flops_per_token)
 
@@ -85,8 +85,7 @@ def run_rung(rung: dict) -> None:
     else:
         plan = make_plan("single", make_mesh(devices=devices[:1]))
 
-    make_opt = (adafactor_cosine if rung.get("optimizer") == "adafactor"
-                else adamw_cosine)
+    make_opt = OPTIMIZERS[rung.get("optimizer", "adamw")]
     trainer = Trainer(bundle=bundle, optimizer=make_opt(3e-4), plan=plan,
                       remat=remat, remat_policy=rung.get("remat_policy", "all"),
                       attn_impl=rung.get("attn_impl", "auto"))

@@ -107,8 +107,8 @@ def run_training(args, plan_factory: Callable, *, extra_log: Optional[dict] = No
     from ..checkpoint import CheckpointIO, abstract_train_state
     from ..data import ShardedBatchLoader, get_tokenizer, load_and_preprocess_data
     from ..models import get_model
-    from ..train import Trainer, adafactor_cosine, adamw_cosine
-    from ..train.optimizer import lr_at_step
+    from ..train import Trainer
+    from ..train.optimizer import OPTIMIZERS, lr_at_step
     from ..train.state import host_state_dict
     from ..utils import (LocalTimer, compute_mfu, get_mem_stats, init_logging,
                          is_process0, transformer_flops_per_token)
@@ -126,8 +126,7 @@ def run_training(args, plan_factory: Callable, *, extra_log: Optional[dict] = No
     seq_length = min(args.seq_length, cfg.max_position_embeddings)
     trainer = Trainer(
         bundle=bundle,
-        optimizer=(adafactor_cosine if args.optimizer == "adafactor"
-                   else adamw_cosine)(args.lr),
+        optimizer=OPTIMIZERS[args.optimizer](args.lr),
         plan=plan,
         grad_accum=args.grad_accum,
         remat=args.checkpoint_activations,

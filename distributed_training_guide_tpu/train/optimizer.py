@@ -93,6 +93,11 @@ def adafactor_cosine(
     return tx
 
 
+# name -> constructor, the dispatch shared by the chapter CLI (--optimizer)
+# and bench.py rung specs; the engine facade adds its own config mapping
+OPTIMIZERS = {"adamw": adamw_cosine, "adafactor": adafactor_cosine}
+
+
 def lr_at_step(step: int, lr: float, t_max: int = 1000, eta_min_ratio: float = 0.01,
                warmup_steps: int = 0) -> float:
     """Host-side mirror of the schedule for logging (reference logs

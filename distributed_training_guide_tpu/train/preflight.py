@@ -63,8 +63,8 @@ def run_preflight(trainer, *, global_batch: int, seq_length: int) -> dict:
     # sharded like the params
     grad_b = _per_device_bytes(
         jax.tree.map(lambda sd: jax.ShapeDtypeStruct(sd.shape, np.float32),
-                     jax.tree.leaves(state.params)),
-        jax.tree.leaves(trainer.param_shardings))
+                     state.params),
+        trainer.param_shardings)
     report = {
         "per_device_param_bytes": params_b,
         "per_device_opt_state_bytes": opt_b,
