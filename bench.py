@@ -88,7 +88,8 @@ def run_rung(rung: dict) -> None:
     make_opt = OPTIMIZERS[rung.get("optimizer", "adamw")]
     trainer = Trainer(bundle=bundle, optimizer=make_opt(3e-4), plan=plan,
                       remat=remat, remat_policy=rung.get("remat_policy", "all"),
-                      attn_impl=rung.get("attn_impl", "auto"))
+                      attn_impl=rung.get("attn_impl", "auto"),
+                      loss_chunks=rung.get("loss_chunks", 0))
     state = trainer.init_state(0)
 
     global_batch = batch * plan.data_parallel_size
@@ -117,6 +118,10 @@ def run_rung(rung: dict) -> None:
                 "remat": remat,
                 "remat_policy": rung.get("remat_policy", "all"),
                 "optimizer": rung.get("optimizer", "adamw"),
+                **({"loss_chunks": rung["loss_chunks"]}
+                   if rung.get("loss_chunks") else {}),
+                **({"fence_every": rung["fence_every"]}
+                   if rung.get("fence_every", 1) > 1 else {}),
                 "loss": round(loss, 4),
                 "steps_timed": steps_timed,
             },
@@ -132,10 +137,15 @@ def run_rung(rung: dict) -> None:
             out["partial"] = True
         return out
 
-    # fence = per-step host-read of the loss (device_get). On the remote-pool
-    # TPU platforms used for CI, block_until_ready can return early and deep
-    # dispatch-ahead queues stall, so each step is synchronized and timed
-    # individually; the median is robust to pool-latency outliers.
+    # fence = host-read of the loss (device_get). On the remote-pool TPU
+    # platforms used for CI, block_until_ready can return early and deep
+    # dispatch-ahead queues stall, so steps are synchronized and timed in
+    # groups of fence_every (default 1: every step individually); the median
+    # is robust to pool-latency outliers. fence_every>1 lets the host run
+    # ahead within a group — the chip never idles on dispatch latency — while
+    # the group's last loss read is still a hard fence (each step consumes
+    # the previous state, so reading step N's loss forces steps 1..N).
+    fence = max(1, rung.get("fence_every", 1))
     warmup_times = []
     for i in range(rung.get("warmup", 2)):
         t0 = time.perf_counter()
@@ -145,14 +155,17 @@ def run_rung(rung: dict) -> None:
         if i > 0:  # step 0 includes compile; later warmups estimate step time
             _emit(result(min(warmup_times[1:]), loss, 0, partial=True))
 
-    times = []
-    for i in range(rung.get("steps", 10)):
+    times = []  # per-step times (group walltime / group size)
+    total, done = rung.get("steps", 10), 0
+    while done < total:
+        g = min(fence, total - done)  # short last group; never exceeds steps
         t0 = time.perf_counter()
-        state, metrics = trainer.step_fn(state, batch_arrays)
+        for _ in range(g):
+            state, metrics = trainer.step_fn(state, batch_arrays)
         loss = float(metrics["loss"])
-        times.append(time.perf_counter() - t0)
-        _emit(result(float(np.median(times)), loss, len(times),
-                     partial=i < rung.get("steps", 10) - 1))
+        times.append((time.perf_counter() - t0) / g)
+        done += g
+        _emit(result(float(np.median(times)), loss, done, partial=done < total))
 
 
 def run_probe() -> None:
@@ -310,7 +323,11 @@ def main() -> None:
     parser.add_argument("--remat-policy", default=None,
                         choices=["all", "dots", "attn", "attn_mlp"])
     parser.add_argument("--optimizer", default=None,
-                        choices=["adamw", "adafactor"])
+                        choices=["adamw", "adafactor", "lion"])
+    parser.add_argument("--loss-chunks", type=int, default=None)
+    parser.add_argument("--fence-every", type=int, default=None,
+                        help="time steps in groups of N with one host-read "
+                             "fence per group (default 1: per-step fence)")
     parser.add_argument("--watchdog", type=int, default=_default_watchdog())
     parser.add_argument("--skip-flash-check", action="store_true")
     # child modes
@@ -340,7 +357,8 @@ def main() -> None:
 
     if (args.model is not None or args.batch is not None
             or args.seq is not None or args.remat_policy is not None
-            or args.optimizer is not None):
+            or args.optimizer is not None or args.loss_chunks is not None
+            or args.fence_every is not None):
         on_tpu = platform == "tpu"
         ladder = [dict(model=args.model or ("llama-650m" if on_tpu else "llama-debug"),
                        batch=args.batch or (8 if on_tpu else 2),
@@ -354,7 +372,11 @@ def main() -> None:
                        **({"remat_policy": args.remat_policy}
                           if args.remat_policy else {}),
                        **({"optimizer": args.optimizer}
-                          if args.optimizer else {}))]
+                          if args.optimizer else {}),
+                       **({"loss_chunks": args.loss_chunks}
+                          if args.loss_chunks else {}),
+                       **({"fence_every": args.fence_every}
+                          if args.fence_every else {}))]
     elif platform == "tpu":
         # headline: remat_policy="attn" keeps only attention outputs + flash
         # lse, so backward never re-runs the attention kernel (measured

@@ -1,5 +1,6 @@
 from .state import TrainState
-from .optimizer import adafactor_cosine, adamw_cosine
+from .optimizer import adafactor_cosine, adamw_cosine, lion_cosine
 from .step import Trainer
 
-__all__ = ["TrainState", "adafactor_cosine", "adamw_cosine", "Trainer"]
+__all__ = ["TrainState", "adafactor_cosine", "adamw_cosine", "lion_cosine",
+           "Trainer"]

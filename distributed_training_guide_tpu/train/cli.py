@@ -41,11 +41,13 @@ def get_parser() -> argparse.ArgumentParser:
     parser.add_argument("--num-epochs", default=100, type=int)
     parser.add_argument("--lr", default=3e-5, type=float)
     parser.add_argument("--optimizer", default="adamw",
-                        choices=["adamw", "adafactor"],
+                        choices=["adamw", "adafactor", "lion"],
                         help="adamw = reference parity (fused AdamW, 2x-fp32 "
                              "moments); adafactor = factored second moment, "
                              "~0 optimizer memory (the TPU-native lever for "
-                             "fitting big models without CPU offload)")
+                             "fitting big models without CPU offload); lion = "
+                             "one momentum slot, sign updates (use ~3-10x "
+                             "lower lr / higher weight decay than adamw)")
     parser.add_argument("-b", "--batch-size", default=1, type=int,
                         help="per-data-parallel-replica batch size (reference semantics)")
     parser.add_argument("--log-freq", default=10, type=int)

@@ -47,7 +47,7 @@ class TrainingEngine:
     def __init__(self, config: dict | str | Path):
         from ..models import get_model
         from ..parallel import make_mesh, make_plan
-        from .optimizer import adafactor_cosine, adamw_cosine
+        from .optimizer import adafactor_cosine, adamw_cosine, lion_cosine
         from .step import Trainer
 
         if not isinstance(config, dict):
@@ -97,9 +97,15 @@ class TrainingEngine:
                 **common)
         elif opt_type == "adafactor":
             optimizer = adafactor_cosine(opt_cfg.get("lr", 3e-5), **common)
+        elif opt_type == "lion":
+            optimizer = lion_cosine(
+                opt_cfg.get("lr", 1e-5),
+                b1=opt_cfg.get("betas", [0.9, 0.99])[0],
+                b2=opt_cfg.get("betas", [0.9, 0.99])[1],
+                **common)
         else:
             raise ValueError(f"unknown optimizer.type {opt_type!r}; "
-                             f"use AdamW or Adafactor")
+                             f"use AdamW, Adafactor, or Lion")
 
         self.trainer = Trainer(
             bundle=bundle,

@@ -93,9 +93,39 @@ def adafactor_cosine(
     return tx
 
 
+def lion_cosine(
+    lr: float,
+    *,
+    t_max: int = 1000,
+    eta_min_ratio: float = 0.01,
+    warmup_steps: int = 0,
+    weight_decay: float = 0.01,
+    b1: float = 0.9,
+    b2: float = 0.99,
+    grad_clip: Optional[float] = None,
+) -> optax.GradientTransformation:
+    """Lion (Chen et al. 2023) with the shared cosine schedule.
+
+    The middle point of the optimizer-memory ladder: one momentum slot
+    (AdamW keeps two, adafactor ~none), and sign-based updates whose
+    magnitude is set purely by ``lr`` — the usual recipe is ~3-10x lower lr
+    and ~3-10x higher weight decay than AdamW. ``optax.lion`` already
+    applies decay decoupled and before the lr scaling (same semantics as
+    ``optax.adamw``), so no re-chaining is needed here.
+    """
+    tx = optax.lion(
+        learning_rate=cosine_schedule(lr, t_max, eta_min_ratio, warmup_steps),
+        b1=b1, b2=b2, weight_decay=weight_decay,
+    )
+    if grad_clip:
+        tx = optax.chain(optax.clip_by_global_norm(grad_clip), tx)
+    return tx
+
+
 # name -> constructor, the dispatch shared by the chapter CLI (--optimizer)
 # and bench.py rung specs; the engine facade adds its own config mapping
-OPTIMIZERS = {"adamw": adamw_cosine, "adafactor": adafactor_cosine}
+OPTIMIZERS = {"adamw": adamw_cosine, "adafactor": adafactor_cosine,
+              "lion": lion_cosine}
 
 
 def lr_at_step(step: int, lr: float, t_max: int = 1000, eta_min_ratio: float = 0.01,

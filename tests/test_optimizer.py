@@ -12,7 +12,7 @@ import numpy as np
 from distributed_training_guide_tpu.models import get_model
 from distributed_training_guide_tpu.parallel import make_mesh, make_plan
 from distributed_training_guide_tpu.train import (Trainer, adafactor_cosine,
-                                                  adamw_cosine)
+                                                  adamw_cosine, lion_cosine)
 
 
 def _run(optimizer, steps=10, **trainer_kw):
@@ -68,6 +68,26 @@ def test_adafactor_decay_is_decoupled_and_lr_scaled():
     lr, wd = 3e-5, 0.01
     p = {"w": jnp.ones((256, 256), jnp.float32)}
     tx = adafactor_cosine(lr, weight_decay=wd)
+    u, _ = tx.update(jax.tree.map(jnp.zeros_like, p), tx.init(p), p)
+    np.testing.assert_allclose(np.asarray(u["w"]), -lr * wd, rtol=1e-3)
+
+
+def test_lion_trains_with_single_moment():
+    """Lion: loss decreases, and optimizer state is exactly ONE moment
+    (AdamW keeps two) — the middle rung of the optimizer-memory ladder."""
+    losses, state = _run(lion_cosine(1e-3))
+    assert losses[-1] < losses[0] - 0.1, losses
+    param_bytes = _tree_bytes(state.params)
+    moment_bytes = _tree_bytes(state.opt_state)
+    assert moment_bytes < 1.1 * param_bytes, (moment_bytes, param_bytes)
+
+
+def test_lion_decay_is_decoupled_and_lr_scaled():
+    """Same pin as adafactor's: with zero gradient the update must be
+    -lr*wd*p (optax.lion applies add_decayed_weights before the lr scale)."""
+    lr, wd = 3e-5, 0.01
+    p = {"w": jnp.ones((256, 256), jnp.float32)}
+    tx = lion_cosine(lr, weight_decay=wd)
     u, _ = tx.update(jax.tree.map(jnp.zeros_like, p), tx.init(p), p)
     np.testing.assert_allclose(np.asarray(u["w"]), -lr * wd, rtol=1e-3)
 
