@@ -400,6 +400,7 @@ def main() -> None:
                        budget=deadline - time.time())]
 
     ladder_log = _Best.ladder = []
+    _Best.result, _Best.emitted = None, False  # fresh per main() call (tests)
     final = None
 
     def try_rung(rung, attempt):

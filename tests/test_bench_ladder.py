@@ -1,0 +1,154 @@
+"""bench.py parent-orchestration tests (no device, no subprocesses).
+
+The degradation ladder is what turns a sick shared pool into a nonzero
+official number (BENCH.md "Round-2 hardening"), so its control flow — walk
+on stall, retry pass, OOM classification, best-so-far selection, the
+attn-vs-all bonus A/B — is pinned here with a scripted fake `_run_child`.
+The reference has no analogue (its quality strategy is runnable examples,
+SURVEY.md section 4); this guards the driver-facing measurement path.
+"""
+import json
+import os
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+import bench  # noqa: E402
+
+
+def _mfu(value, steps=10, partial=False, **detail):
+    out = {"metric": "mfu", "value": value, "unit": "fraction_of_peak_bf16",
+           "vs_baseline": round(value / 0.335, 3),
+           "detail": {"steps_timed": steps, **detail}}
+    if partial:
+        out["partial"] = True
+    return out
+
+
+class FakeChildren:
+    """Scripted responses: probe -> platform line; rung -> pop from queue;
+    flash check -> fixed record. Each rung response is (lines, kind)."""
+
+    def __init__(self, rung_responses, platform="tpu"):
+        self.rung_responses = list(rung_responses)
+        self.platform = platform
+        self.calls = []
+
+    def __call__(self, mode_args, budget):
+        self.calls.append(mode_args)
+        assert budget > 0
+        if mode_args == ["--probe"]:
+            return [{"platform": self.platform, "n_devices": 1}], "ok"
+        if mode_args == ["--check-flash"]:
+            return [{"flash_ms": 70.0, "xla_ms": 95.0, "ok": True}], "ok"
+        assert mode_args[0] == "--rung"
+        if not self.rung_responses:
+            return [], "stalled"
+        return self.rung_responses.pop(0)
+
+
+def _run_main(monkeypatch, capsys, fake, argv=("--watchdog", "0")):
+    monkeypatch.setattr(bench, "_run_child", fake)
+    monkeypatch.setattr(sys, "argv", ["bench.py", *argv])
+    code = 0
+    try:
+        bench.main()
+    except SystemExit as e:
+        code = e.code or 0
+    lines = [json.loads(l) for l in capsys.readouterr().out.splitlines()
+             if l.startswith("{")]
+    return lines[-1], code
+
+
+def test_headline_success_records_ab_and_flash(monkeypatch, capsys):
+    """Healthy pool: rung 1 full success -> bonus 'all'-policy A/B runs, and
+    the verified headline stays final when the A/B is slower."""
+    fake = FakeChildren([
+        ([_mfu(0.50)], "ok"),          # headline rung (remat_policy=attn)
+        ([_mfu(0.48)], "ok"),          # bonus A/B at ladder[1] (policy=all)
+    ])
+    final, code = _run_main(monkeypatch, capsys, fake)
+    assert code == 0 and final["value"] == 0.50
+    statuses = [e["status"] for e in final["detail"]["ladder"]]
+    assert statuses == ["ok", "ok"]
+    assert final["detail"]["flash_check"]["ok"] is True
+    # never reached rungs 3/4: 1 probe + 2 rungs + 1 flash check
+    assert len(fake.calls) == 4
+
+
+def test_ab_result_displaces_only_when_complete_and_better(monkeypatch, capsys):
+    fake = FakeChildren([
+        ([_mfu(0.48)], "ok"),
+        ([_mfu(0.52)], "ok"),          # A/B wins -> becomes final
+    ])
+    final, _ = _run_main(monkeypatch, capsys, fake)
+    assert final["value"] == 0.52
+
+    fake = FakeChildren([
+        ([_mfu(0.48)], "ok"),
+        ([_mfu(0.55, partial=True)], "stalled"),  # better but PARTIAL
+    ])
+    final, _ = _run_main(monkeypatch, capsys, fake)
+    assert final["value"] == 0.48   # partial A/B may not displace verified
+
+
+def test_stall_walks_down_the_ladder(monkeypatch, capsys):
+    """Rung 1 dies mid-run after partial emission; rung 2 completes. Pass 1
+    stops there — and a smaller complete result wins over a bigger partial."""
+    fake = FakeChildren([
+        ([_mfu(0.51, steps=3, partial=True)], "stalled"),
+        ([_mfu(0.47)], "ok"),
+    ])
+    final, code = _run_main(monkeypatch, capsys, fake)
+    assert code == 0
+    assert final["value"] == 0.51   # best-so-far partial is still the max
+    assert final["detail"]["ladder"][0]["status"] == "partial_then_stalled"
+    assert final["detail"]["ladder"][1]["status"] == "ok"
+
+
+def test_oom_is_classified_and_walk_continues(monkeypatch, capsys):
+    fake = FakeChildren([
+        ([], "oom"),
+        ([_mfu(0.45)], "ok"),
+    ])
+    final, _ = _run_main(monkeypatch, capsys, fake)
+    assert final["value"] == 0.45
+    assert final["detail"]["ladder"][0]["status"] == "oom_attempt_1"
+
+
+def test_total_stall_then_retry_pass_lands(monkeypatch, capsys):
+    """Nothing lands in pass 1 (4 stalls); pass 2's first retry succeeds —
+    the compile-cache-makes-retries-cheap design."""
+    fake = FakeChildren([
+        ([], "stalled"), ([], "stalled"), ([], "stalled"), ([], "stalled"),
+        ([_mfu(0.49)], "ok"),
+    ])
+    final, code = _run_main(monkeypatch, capsys, fake)
+    assert code == 0 and final["value"] == 0.49
+    assert final["detail"]["ladder"][4]["status"] == "ok"
+
+
+def test_everything_dead_emits_zero_and_rc2(monkeypatch, capsys):
+    fake = FakeChildren([])  # every rung response: stalled, forever
+    final, code = _run_main(monkeypatch, capsys, fake)
+    assert code == 2
+    assert final["value"] == 0.0
+    assert "stalled" in json.dumps(final["detail"]["ladder"])
+
+
+def test_explicit_flags_build_single_rung(monkeypatch, capsys):
+    """--optimizer/--fence-every/--loss-chunks build a one-rung ladder whose
+    spec carries the flags through to the child verbatim."""
+    fake = FakeChildren([([_mfu(0.50)], "ok")])
+    final, _ = _run_main(
+        monkeypatch, capsys, fake,
+        argv=("--watchdog", "0", "--optimizer", "lion", "--fence-every", "4",
+              "--loss-chunks", "8", "--skip-flash-check"))
+    rung_calls = [c for c in fake.calls if c[0] == "--rung"]
+    assert len(rung_calls) == 1
+    spec = json.loads(rung_calls[0][1])
+    assert (spec["optimizer"], spec["fence_every"], spec["loss_chunks"]) == \
+        ("lion", 4, 8)
+    assert spec["remat"] is True   # explicit flags on tpu default to remat
+    assert final["value"] == 0.50
