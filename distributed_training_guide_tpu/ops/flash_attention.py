@@ -351,6 +351,136 @@ def _flash_vjp_fwd(q, k, v, causal, block_q, block_k, interpret):
 _flash.defvjp(_flash_vjp_fwd, _flash_bwd)
 
 
+def make_sharded_flash_attention(
+    mesh,
+    *,
+    batch_axes=("dp", "fsdp", "ep"),
+    head_axis: Optional[str] = "tp",
+    causal: bool = True,
+    block_q: int = 512,
+    block_k: int = 512,
+    forced: bool = False,
+):
+    """Flash attention that PARTITIONS over batch/head mesh axes.
+
+    The XLA SPMD partitioner cannot shard a Mosaic custom call: a bare
+    ``flash_attention`` under a GSPMD mesh compiles, but the partitioner's
+    fallback all-gathers q/k/v and runs the FULL kernel on every device
+    (output sharding comes back replicated) — mesh_size x wasted attention
+    FLOPs on a real pod. This factory returns an attention callable (the
+    same contract as ``make_ring_attention``) whose pallas calls run inside
+    a shard_map that is manual over exactly the axes that shard attention's
+    data-parallel dims: batch over ``batch_axes``, heads over ``head_axis``.
+    Attention has no cross-batch or cross-head interaction, so the body
+    needs no collectives; the sequence dim stays unsharded (cp>1 uses the
+    ring instead).
+
+    Returns None when no relevant axis has size > 1 (single-device meshes:
+    the plain kernel path is already optimal). Not usable inside the
+    pipeline's pp-manual shard_map (nested manual regions are rejected;
+    there the batch dim's dp/fsdp sharding still pays the gather — noted in
+    ``parallel/pipeline.py``).
+
+    The custom_vjp sits OUTSIDE the two shard_maps, like the ring's: grad
+    cannot transpose through a partial-manual shard_map, so forward and
+    backward are each a plain non-differentiated shard_map and the lse/o
+    residuals ride between them with explicit specs.
+    """
+    from jax.sharding import PartitionSpec as P
+
+    batch_axes = tuple(a for a in batch_axes
+                       if a in mesh.shape and mesh.shape[a] > 1)
+    if head_axis is not None and mesh.shape.get(head_axis, 1) == 1:
+        head_axis = None
+    if not batch_axes and head_axis is None:
+        return None
+    tp = mesh.shape[head_axis] if head_axis else 1
+    interpret = jax.default_backend() != "tpu"
+
+    manual = set(batch_axes) | ({head_axis} if head_axis else set())
+    b_spec = batch_axes if batch_axes else None
+    spec_bshd = P(b_spec, None, head_axis, None)   # q/k/v/do/out [B, S, H, D]
+    spec_bhsd = P(b_spec, head_axis, None, None)   # residuals    [B, H, S, D]
+    spec_bhs = P(b_spec, head_axis, None)          # lse          [B, H, S]
+
+    def fwd_body(q, k, v):
+        qt, kt, vt = (x.transpose(0, 2, 1, 3) for x in (q, k, v))
+        o, lse = _flash_fwd(qt, kt, vt, causal, block_q, block_k, interpret)
+        return o.transpose(0, 2, 1, 3), (qt, kt, vt, o, lse)
+
+    def bwd_body(qt, kt, vt, o, lse, do):
+        dq, dk, dv = _flash_bwd(causal, block_q, block_k, interpret,
+                                (qt, kt, vt, o, lse), do.transpose(0, 2, 1, 3))
+        return tuple(g.transpose(0, 2, 1, 3) for g in (dq, dk, dv))
+
+    res_specs = (spec_bhsd, spec_bhsd, spec_bhsd, spec_bhsd, spec_bhs)
+    sm = functools.partial(jax.shard_map, mesh=mesh, axis_names=manual,
+                           check_vma=False)
+    fwd_sm = sm(fwd_body, in_specs=(spec_bshd,) * 3,
+                out_specs=(spec_bshd, res_specs))
+    bwd_sm = sm(bwd_body, in_specs=(*res_specs, spec_bshd),
+                out_specs=(spec_bshd,) * 3)
+
+    @jax.custom_vjp
+    def sharded_flash(q, k, v):
+        return fwd_sm(q, k, v)[0]
+
+    def vjp_fwd(q, k, v):
+        out, (qt, kt, vt, o, lse) = fwd_sm(q, k, v)
+        # same remat tags as the plain path (_flash_vjp_fwd): a
+        # REMAT_POLICIES["attn"] policy keeps the kernel output + lse so
+        # backward never re-runs the forward kernel
+        o = checkpoint_name(o, "flash_out")
+        lse = checkpoint_name(lse, "flash_lse")
+        return out, (qt, kt, vt, o, lse)
+
+    def vjp_bwd(res, do):
+        return bwd_sm(*res, do)
+
+    sharded_flash.defvjp(vjp_fwd, vjp_bwd)
+    # partial-manual shard_map resolves auto-axis shardings only under jit;
+    # inlined into the caller's jit so this costs nothing in the train step
+    sharded_flash = jax.jit(sharded_flash)
+
+    import math
+
+    batch_div = math.prod(mesh.shape[a] for a in batch_axes) if batch_axes else 1
+
+    def attention(q, k, v, standard_layout: bool = True, **kwargs):
+        if not standard_layout:
+            # the callable contract carries no positions, so a correct mask
+            # for packed/sharded-seq layouts is unbuildable here — fail loud
+            # like the ring does rather than mask with arange silently
+            raise ValueError(
+                "sharded flash attention assumes the standard contiguous "
+                "position layout; for packed sequences or explicit positions "
+                "on a sharded mesh use attn_impl='xla'")
+        hq, hkv, d = q.shape[2], k.shape[2], q.shape[-1]
+        eligible = (causal
+                    and hq % tp == 0 and hkv % tp == 0
+                    and q.shape[0] % batch_div == 0
+                    # tile divisibility binds only on compiled Mosaic; the
+                    # interpret path (CPU tests) takes any shape
+                    and (interpret or (q.shape[1] % 8 == 0
+                                       and k.shape[1] % 8 == 0
+                                       and d % 64 == 0)))
+        if not eligible:
+            if forced:
+                raise ValueError(
+                    f"sharded flash attention needs causal masking, heads "
+                    f"divisible by {head_axis}={tp}, batch divisible by "
+                    f"{batch_axes}={batch_div}, seq divisible by 8 and "
+                    f"head_dim by 64; got heads={hq}/{hkv}, "
+                    f"batch={q.shape[0]}, seq={q.shape[1]}, head_dim={d} — "
+                    f"pad, or use impl='xla'")
+            from .attention import multihead_attention
+
+            return multihead_attention(q, k, v, causal=causal, impl="xla")
+        return sharded_flash(q, k, v)
+
+    return attention
+
+
 def flash_attention(
     q: jnp.ndarray,   # [B, S, Hq, D]
     k: jnp.ndarray,   # [B, S, Hkv, D]

@@ -238,6 +238,23 @@ class Trainer:
 
             attn_impl = make_ring_attention(self.plan.mesh,
                                             data_axes=self.plan.data_axes)
+        elif (self.plan.mesh.shape["pp"] == 1 and not callable(attn_impl)
+              and (attn_impl == "flash"
+                   or (attn_impl == "auto"
+                       and jax.default_backend() == "tpu"))):
+            # GSPMD cannot partition the Mosaic custom call (it all-gathers
+            # q/k/v and runs the full kernel on every device); wrap the flash
+            # path in a batch/head-manual shard_map so the kernel stays local.
+            # Skipped under pp (no nested manual regions) and under "auto"
+            # off-TPU (the dispatcher resolves to the partitionable XLA path).
+            from ..ops.flash_attention import make_sharded_flash_attention
+
+            wrapped = make_sharded_flash_attention(
+                self.plan.mesh, batch_axes=self.plan.data_axes,
+                head_axis="tp" if self.plan.rules.get("heads") == "tp" else None,
+                forced=attn_impl == "flash")
+            if wrapped is not None:
+                attn_impl = wrapped
 
         logits_sharding = self.plan.logits_sharding()
         if self.remat_policy not in REMAT_POLICIES:

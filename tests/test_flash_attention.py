@@ -72,6 +72,79 @@ def test_forced_flash_rejects_untiled_shapes():
         flash_attention(q, k, v, causal=True, interpret=False)
 
 
+def test_sharded_flash_partitions_instead_of_replicating(eight_devices):
+    """GSPMD's fallback for the Mosaic custom call is gather-and-replicate;
+    the shard_map wrapper must instead keep the kernel local: numerics match
+    the dense reference AND the output/grad shardings keep their mesh axes
+    (a replicated grad spec is exactly the failure being guarded)."""
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    from distributed_training_guide_tpu.ops.flash_attention import (
+        make_sharded_flash_attention)
+
+    mesh = Mesh(np.array(eight_devices).reshape(2, 4), ("dp", "tp"))
+    q, k, v = make_qkv(4, 128, 8, 4, 64, seed=2)
+    attn = make_sharded_flash_attention(mesh, batch_axes=("dp",),
+                                        head_axis="tp", forced=True)
+    sh = NamedSharding(mesh, P("dp", None, "tp", None))
+    qs, ks, vs = (jax.device_put(x, sh) for x in (q, k, v))
+
+    @jax.jit
+    def f(q, k, v):
+        return jax.value_and_grad(
+            lambda q: jnp.sum(attn(q, k, v).astype(jnp.float32) ** 2))(q)
+
+    loss, grad = f(qs, ks, vs)
+    ref = jax.value_and_grad(
+        lambda q: jnp.sum(_xla_attention(q, k, v, True, None, None)
+                          .astype(jnp.float32) ** 2))(q)
+    np.testing.assert_allclose(float(loss), float(ref[0]), rtol=1e-4)
+    np.testing.assert_allclose(np.asarray(grad), np.asarray(ref[1]),
+                               rtol=2e-4, atol=2e-4)
+    assert grad.sharding.spec == P("dp", None, "tp", None), grad.sharding
+    # single-device meshes need no wrapper
+    assert make_sharded_flash_attention(
+        Mesh(np.array(eight_devices[:1]).reshape(1, 1), ("dp", "tp"))) is None
+    # packed/non-contiguous layouts must fail loud (no positions reach the
+    # callable, so a silent arange mask would be wrong)
+    with pytest.raises(ValueError, match="contiguous"):
+        attn(q, k, v, standard_layout=False)
+    # batch not divisible by the manual axes: non-forced falls back to the
+    # partitionable XLA path instead of crashing in shard_map
+    attn_auto = make_sharded_flash_attention(mesh, batch_axes=("dp",),
+                                             head_axis="tp", forced=False)
+    q3, k3, v3 = make_qkv(3, 128, 8, 4, 64, seed=4)
+    ref3 = _xla_attention(q3, k3, v3, True, None, None)
+    np.testing.assert_allclose(np.asarray(attn_auto(q3, k3, v3)),
+                               np.asarray(ref3), rtol=2e-4, atol=2e-4)
+
+
+def test_trainer_forced_flash_matches_xla_on_sharded_plan(eight_devices):
+    """End-to-end: a tp_fsdp train step with attn_impl='flash' (the sharded
+    wrapper engages) reproduces the attn_impl='xla' losses."""
+    from distributed_training_guide_tpu.models import get_model
+    from distributed_training_guide_tpu.parallel import make_mesh, make_plan
+    from distributed_training_guide_tpu.train import Trainer, adamw_cosine
+
+    def run(attn_impl):
+        bundle = get_model("llama-debug")
+        plan = make_plan("tp_fsdp", make_mesh(tp=2, fsdp=2))
+        t = Trainer(bundle=bundle, optimizer=adamw_cosine(1e-3), plan=plan,
+                    attn_impl=attn_impl, donate=False)
+        state = t.init_state(0)
+        ids = np.random.RandomState(3).randint(0, bundle.config.vocab_size,
+                                               (4, 128))
+        batch = {kk: jax.device_put(jnp.asarray(ids), t.batch_shardings()[kk])
+                 for kk in ("input_ids", "labels")}
+        losses = []
+        for _ in range(3):
+            state, m = t.step_fn(state, batch)
+            losses.append(float(m["loss"]))
+        return losses
+
+    np.testing.assert_allclose(run("flash"), run("xla"), rtol=2e-4)
+
+
 def test_attn_remat_policy_through_flash_vjp():
     """The "attn" policy's checkpoint_name tags (flash_out / flash_lse,
     tagged inside the kernel's custom_vjp fwd) must survive jax.checkpoint:
