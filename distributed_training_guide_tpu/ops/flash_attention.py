@@ -351,6 +351,37 @@ def _flash_vjp_fwd(q, k, v, causal, block_q, block_k, interpret):
 _flash.defvjp(_flash_vjp_fwd, _flash_bwd)
 
 
+def resolve_attention_manual_axes(mesh, batch_axes, head_axis):
+    """Shared preamble for the manual-axes attention wrappers (this module's
+    sharded flash and ``ring_attention``): keep only mesh axes of size > 1,
+    and return (batch_axes, head_axis, tp, batch_div, b_spec, manual_set)."""
+    batch_axes = tuple(a for a in batch_axes
+                       if a in mesh.shape and mesh.shape[a] > 1)
+    if head_axis is not None and mesh.shape.get(head_axis, 1) == 1:
+        head_axis = None
+    tp = mesh.shape[head_axis] if head_axis else 1
+    batch_div = 1
+    for a in batch_axes:
+        batch_div *= mesh.shape[a]
+    b_spec = batch_axes if batch_axes else None
+    manual = set(batch_axes) | ({head_axis} if head_axis else set())
+    return batch_axes, head_axis, tp, batch_div, b_spec, manual
+
+
+def attention_divisibility_error(batch_axes, head_axis, tp, batch_div,
+                                 hq, hkv, batch, kind):
+    """Error text naming only the dimension(s) that actually failed."""
+    problems = []
+    if head_axis and (hq % tp or hkv % tp):
+        problems.append(f"heads {hq}/{hkv} not divisible by {head_axis}={tp}")
+    if batch_axes and batch % batch_div:
+        problems.append(f"batch {batch} not divisible by "
+                        f"{'x'.join(batch_axes)}={batch_div}")
+    return (f"{kind} shards attention over manual mesh axes (the Pallas "
+            f"kernels cannot be auto-partitioned): "
+            f"{'; '.join(problems)} — pad, or drop the unused mesh axis")
+
+
 def make_sharded_flash_attention(
     mesh,
     *,
@@ -388,17 +419,11 @@ def make_sharded_flash_attention(
     """
     from jax.sharding import PartitionSpec as P
 
-    batch_axes = tuple(a for a in batch_axes
-                       if a in mesh.shape and mesh.shape[a] > 1)
-    if head_axis is not None and mesh.shape.get(head_axis, 1) == 1:
-        head_axis = None
-    if not batch_axes and head_axis is None:
+    batch_axes, head_axis, tp, batch_div, b_spec, manual = \
+        resolve_attention_manual_axes(mesh, batch_axes, head_axis)
+    if not manual:
         return None
-    tp = mesh.shape[head_axis] if head_axis else 1
     interpret = jax.default_backend() != "tpu"
-
-    manual = set(batch_axes) | ({head_axis} if head_axis else set())
-    b_spec = batch_axes if batch_axes else None
     spec_bshd = P(b_spec, None, head_axis, None)   # q/k/v/do/out [B, S, H, D]
     spec_bhsd = P(b_spec, head_axis, None, None)   # residuals    [B, H, S, D]
     spec_bhs = P(b_spec, head_axis, None)          # lse          [B, H, S]
@@ -441,10 +466,6 @@ def make_sharded_flash_attention(
     # partial-manual shard_map resolves auto-axis shardings only under jit;
     # inlined into the caller's jit so this costs nothing in the train step
     sharded_flash = jax.jit(sharded_flash)
-
-    import math
-
-    batch_div = math.prod(mesh.shape[a] for a in batch_axes) if batch_axes else 1
 
     def attention(q, k, v, standard_layout: bool = True, **kwargs):
         if not standard_layout:

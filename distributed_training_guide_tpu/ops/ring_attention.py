@@ -31,9 +31,14 @@ manual:
   matrix. dk/dv accumulators travel the ring *with* their K/V blocks and
   arrive home after a full cycle.
 
-tp composes: only ``cp`` is manual in the shard_map, so the head dim stays
-auto-sharded over tp by GSPMD inside the body (round 1's fully-manual ring
-hit an XLA SPMD partitioner CHECK against tp-sharded head weights).
+tp composes: heads (tp) and batch (dp/fsdp/ep) are *manual* axes of the
+same shard_map — the Pallas calls inside the ring are Mosaic custom calls
+the SPMD partitioner cannot shard, so leaving them auto would gather and
+replicate every hop's chunks across dp/tp on a real pod. The body needs no
+collectives over those axes (attention is independent per batch and head),
+so only cp carries ppermutes. Round 1's partitioner CHECK came from
+auto-tp *weights* inside a manual region; q/k/v here are already-projected
+activations, which shard cleanly.
 
 On non-TPU backends the same kernels run under ``interpret=True`` — the
 test-suite goldens (forward and gradients vs the dense XLA reference) cover
@@ -252,23 +257,36 @@ def make_ring_attention(mesh: Mesh, *, axis_name: str = "cp",
                         data_axes=("dp", "fsdp", "ep"), head_axis: str = "tp",
                         causal: bool = True) -> Callable:
     """Returns an attention callable with the ``multihead_attention``
-    signature, internally a shard_map ring over ``axis_name``. Only ``cp`` is
-    manual: batch and head dims keep their auto (GSPMD) shardings, so the
-    ring composes with dp/fsdp/tp."""
-    del data_axes, head_axis  # auto axes now — kept for API compat
+    signature, internally a shard_map ring over ``axis_name``.
+
+    Batch and head dims are manual too (over ``data_axes`` / ``head_axis``
+    when those mesh axes are >1): the Pallas calls inside the ring are
+    Mosaic custom calls, which the SPMD partitioner cannot shard — leaving
+    dp/tp auto here would gather-and-replicate q/k/v chunks per hop on a
+    real pod (same failure ``make_sharded_flash_attention`` guards on the
+    cp=1 path). The body needs no collectives over those axes, so the ring
+    logic is unchanged; only cp carries ppermutes. The round-1 partitioner
+    CHECK that forced partial-manual was auto-*tp on weights* inside a
+    manual region — q/k/v here are activations, already projected."""
+    from .flash_attention import (attention_divisibility_error,
+                                  resolve_attention_manual_axes)
+
     cp = mesh.shape[axis_name]
+    batch_axes, head_axis, tp, batch_div, b_spec, manual = \
+        resolve_attention_manual_axes(mesh, data_axes, head_axis)
+    manual = manual | {axis_name}
     interpret = jax.default_backend() != "tpu"
-    spec = P(None, axis_name, None, None)          # [B, S_loc, H, D]
+    spec = P(b_spec, axis_name, head_axis, None)   # [B, S_loc, H, D]
     # residual layouts: zigzag chunk tensors; the S_c dim carries the cp
     # sharding so the residuals round-trip between the fwd and bwd shard_maps
-    chunk5 = P(None, None, None, axis_name, None)  # [2, B, H, S_c, D]
-    chunk4 = P(None, None, None, axis_name)        # [2, B, H, S_c]
+    chunk5 = P(None, b_spec, head_axis, axis_name, None)  # [2, B, H, S_c, D]
+    chunk4 = P(None, b_spec, head_axis, axis_name)        # [2, B, H, S_c]
 
     fwd_body, bwd_body = _build_ring(axis_name, cp, causal, interpret)
     # check_vma=False: pallas interpret mode (the CPU test path) trips the
     # vma checker inside its own lowering ("dynamic_slice requires varying
     # manual axes to match")
-    sm = functools.partial(jax.shard_map, mesh=mesh, axis_names={axis_name},
+    sm = functools.partial(jax.shard_map, mesh=mesh, axis_names=manual,
                            check_vma=False)
     fwd_sm = sm(fwd_body, in_specs=(spec, spec, spec),
                 out_specs=(spec, chunk5, chunk5, chunk5, chunk5, chunk4))
@@ -312,6 +330,11 @@ def make_ring_attention(mesh: Mesh, *, axis_name: str = "cp",
                 "[r*S/cp, (r+1)*S/cp)); caller-supplied positions would "
                 "desynchronize the causal mask — don't pass explicit "
                 "positions under context parallelism")
+        hq, hkv = q.shape[2], k.shape[2]
+        if hq % tp or hkv % tp or q.shape[0] % batch_div:
+            raise ValueError(attention_divisibility_error(
+                batch_axes, head_axis, tp, batch_div, hq, hkv, q.shape[0],
+                "ring attention"))
         return ring(q, k, v)
 
     return attention

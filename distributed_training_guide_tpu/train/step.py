@@ -232,12 +232,14 @@ class Trainer:
 
         attn_impl = self.attn_impl
         if self.plan.mesh.shape["cp"] > 1 and not callable(attn_impl):
-            # only cp is manual inside the ring shard_map, so tp-sharded head
-            # dims stay auto (GSPMD) and cp x tp composes
+            # cp carries the ring's ppermutes; batch/head axes are manual
+            # too (local Pallas calls — GSPMD would gather them), with heads
+            # manual only when this plan actually tp-shards them
             from ..ops.ring_attention import make_ring_attention
 
-            attn_impl = make_ring_attention(self.plan.mesh,
-                                            data_axes=self.plan.data_axes)
+            attn_impl = make_ring_attention(
+                self.plan.mesh, data_axes=self.plan.data_axes,
+                head_axis="tp" if self.plan.rules.get("heads") == "tp" else None)
         elif (self.plan.mesh.shape["pp"] == 1 and not callable(attn_impl)
               and (attn_impl == "flash"
                    or (attn_impl == "auto"

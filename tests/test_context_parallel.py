@@ -37,6 +37,38 @@ def test_ring_attention_grads(eight_devices):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-5)
 
 
+def test_ring_output_keeps_batch_and_head_shardings(eight_devices):
+    """Batch/head are manual axes of the ring shard_map: the output (and
+    grads) must come back sharded over dp/tp, not replicated — the SPMD
+    partitioner's gather-and-replicate fallback for the inner Pallas calls
+    is exactly what the manual axes exist to prevent."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    mesh = make_mesh(cp=2, tp=2)  # remaining devices -> dp=2
+    ring = make_ring_attention(mesh)
+    ks = jax.random.split(jax.random.key(2), 3)
+    q = jax.random.normal(ks[0], (2, 32, 4, 16), jnp.float32)
+    k = jax.random.normal(ks[1], (2, 32, 2, 16), jnp.float32)
+    v = jax.random.normal(ks[2], (2, 32, 2, 16), jnp.float32)
+    sh = NamedSharding(mesh, P("dp", "cp", "tp", None))
+    qs = jax.device_put(q, sh)
+    ks_ = jax.device_put(k, NamedSharding(mesh, P("dp", "cp", "tp", None)))
+    vs = jax.device_put(v, NamedSharding(mesh, P("dp", "cp", "tp", None)))
+
+    @jax.jit
+    def f(q, k, v):
+        return jax.value_and_grad(lambda q: jnp.sum(ring(q, k, v) ** 2))(q)
+
+    loss, grad = f(qs, ks_, vs)
+    ref = jax.value_and_grad(
+        lambda q: jnp.sum(_xla_attention(q, k, v, True, None, None) ** 2))(q)
+    np.testing.assert_allclose(float(loss), float(ref[0]), rtol=1e-4)
+    np.testing.assert_allclose(np.asarray(grad), np.asarray(ref[1]),
+                               rtol=1e-4, atol=1e-5)
+    spec = grad.sharding.spec
+    assert "dp" in str(spec) and "tp" in str(spec), spec
+
+
 def test_cp_training_matches_single_device(eight_devices):
     bundle = get_model("llama-debug", dtype=jnp.float32)
     opt = adamw_cosine(1e-3)
@@ -58,7 +90,8 @@ def test_cp_training_matches_single_device(eight_devices):
     np.testing.assert_allclose(cp, golden, rtol=2e-4)
     cp_fsdp = run(make_plan("fsdp", make_mesh(cp=2, fsdp=2)))
     np.testing.assert_allclose(cp_fsdp, golden, rtol=2e-4)
-    # cp x tp: the ring is manual only over cp, tp stays auto inside it
+    # cp x tp: heads join the ring's manual axes (the trainer gates this on
+    # the plan actually tp-sharding heads)
     cp_tp = run(make_plan("tp", make_mesh(cp=2, tp=2)))
     np.testing.assert_allclose(cp_tp, golden, rtol=2e-4)
     # 3-axis: cp x tp x fsdp on all 8 devices (the llama-3-style long-context
