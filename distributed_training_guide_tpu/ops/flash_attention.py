@@ -353,18 +353,25 @@ _flash.defvjp(_flash_vjp_fwd, _flash_bwd)
 
 def resolve_attention_manual_axes(mesh, batch_axes, head_axis):
     """Shared preamble for the manual-axes attention wrappers (this module's
-    sharded flash and ``ring_attention``): keep only mesh axes of size > 1,
-    and return (batch_axes, head_axis, tp, batch_div, b_spec, manual_set)."""
+    sharded flash, ``ring_attention``, and the Ulysses wrapper): keep only
+    mesh axes of size > 1, and return (batch_axes, head_axis, tp, batch_div,
+    b_spec, manual_set). ``head_axis`` may be one axis name or a tuple of
+    names (Ulysses shards heads over ('tp', 'cp')); the normalized form is a
+    tuple or None, and ``tp`` is the product of the head-axis sizes."""
     batch_axes = tuple(a for a in batch_axes
                        if a in mesh.shape and mesh.shape[a] > 1)
-    if head_axis is not None and mesh.shape.get(head_axis, 1) == 1:
-        head_axis = None
-    tp = mesh.shape[head_axis] if head_axis else 1
+    if isinstance(head_axis, str):
+        head_axis = (head_axis,)
+    head_axis = tuple(a for a in (head_axis or ())
+                      if mesh.shape.get(a, 1) > 1) or None
+    tp = 1
+    for a in head_axis or ():
+        tp *= mesh.shape[a]
     batch_div = 1
     for a in batch_axes:
         batch_div *= mesh.shape[a]
     b_spec = batch_axes if batch_axes else None
-    manual = set(batch_axes) | ({head_axis} if head_axis else set())
+    manual = set(batch_axes) | set(head_axis or ())
     return batch_axes, head_axis, tp, batch_div, b_spec, manual
 
 
@@ -373,7 +380,8 @@ def attention_divisibility_error(batch_axes, head_axis, tp, batch_div,
     """Error text naming only the dimension(s) that actually failed."""
     problems = []
     if head_axis and (hq % tp or hkv % tp):
-        problems.append(f"heads {hq}/{hkv} not divisible by {head_axis}={tp}")
+        problems.append(f"heads {hq}/{hkv} not divisible by "
+                        f"{'x'.join(head_axis)}={tp}")
     if batch_axes and batch % batch_div:
         problems.append(f"batch {batch} not divisible by "
                         f"{'x'.join(batch_axes)}={batch_div}")
@@ -489,11 +497,12 @@ def make_sharded_flash_attention(
             if forced:
                 raise ValueError(
                     f"sharded flash attention needs causal masking, heads "
-                    f"divisible by {head_axis}={tp}, batch divisible by "
-                    f"{batch_axes}={batch_div}, seq divisible by 8 and "
-                    f"head_dim by 64; got heads={hq}/{hkv}, "
-                    f"batch={q.shape[0]}, seq={q.shape[1]}, head_dim={d} — "
-                    f"pad, or use impl='xla'")
+                    f"divisible by {'x'.join(head_axis or ())}={tp}, batch "
+                    f"divisible by {'x'.join(batch_axes)}={batch_div}, seq "
+                    f"divisible by 8 and head_dim by 64; got "
+                    f"heads={hq}/{hkv}, batch={q.shape[0]}, "
+                    f"seq={q.shape[1]}, head_dim={d} — pad, or use "
+                    f"impl='xla'")
             from .attention import multihead_attention
 
             return multihead_attention(q, k, v, causal=causal, impl="xla")

@@ -1,0 +1,89 @@
+"""Ulysses (all-to-all) context parallelism: the ring's head-sharded dual.
+
+The reference name-checks context parallelism but never implements it
+(``06-tensor-parallel/README.md:7``); chapter 08 builds the ring. This
+module adds the other standard CP scheme (DeepSpeed-Ulysses, Jacobs et al.
+2023): outside attention the sequence dim is sharded over ``cp`` exactly as
+for the ring, but *during* attention the layout flips — heads shard over
+cp (x tp) and every device sees the FULL sequence for its head slice. The
+layout flip is an all-to-all on entry and exit, which on TPU is cheap
+ICI traffic that XLA/GSPMD inserts from the sharding change alone.
+
+Trade-offs vs the ring (``--context-impl`` picks per run):
+
+- Ulysses: 2 all-to-alls total, plain flash kernel per device (no per-hop
+  merge math), but needs ``num_kv_heads % (cp*tp) == 0`` — GQA models cap
+  cp at the kv-head count and it cannot scale past heads.
+- Ring: cp-1 neighbor ppermutes overlapped with compute, works for any
+  head count and arbitrarily long sequences, but pays the zigzag
+  relayout + online-softmax merges.
+
+TPU-native formulation — there is no hand-written all-to-all anywhere:
+
+- flash path: ``make_sharded_flash_attention`` with the head dim manual
+  over ``(tp, cp)``. The wrapper's shard_map in_specs declare heads
+  cp-sharded and seq unsharded; since the caller's activations are
+  seq-sharded, XLA materializes the all-to-all at the shard_map boundary.
+- xla path (and 'auto' off-TPU): two ``with_sharding_constraint`` calls
+  around the einsum reference implementation — the pure-GSPMD version of
+  the same thing (the einsum path needs no manual axes at all).
+
+Sharding-semantics note: under GSPMD everything stays *global* — positions
+are the default arange, the causal mask is exact, and no zigzag balancing
+is needed (every device owns full rows of the attention matrix for its
+heads, so causal work is balanced by construction).
+"""
+from __future__ import annotations
+
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from .flash_attention import (make_sharded_flash_attention,
+                              resolve_attention_manual_axes)
+
+
+def make_ulysses_attention(mesh: Mesh, *, axis_name: str = "cp",
+                           data_axes=("dp", "fsdp", "ep"),
+                           head_axis="tp", causal: bool = True,
+                           impl: str = "auto"):
+    """Attention callable (``make_ring_attention`` contract) running the
+    Ulysses layout flip over ``axis_name``. ``impl`` as in
+    ``multihead_attention``: 'flash' forces the manual-axes kernel wrapper,
+    'xla' the constraint-based einsum path, 'auto' picks flash on TPU."""
+    import jax
+
+    head_axes = (head_axis,) if isinstance(head_axis, str) else tuple(head_axis or ())
+    # resolve_attention_manual_axes (called by both paths below) drops
+    # size-1 axes, so the raw concatenation is safe to pass through
+    ulysses_heads = (*head_axes, axis_name)
+
+    if impl == "auto":
+        impl = "flash" if jax.default_backend() == "tpu" else "xla"
+    if impl == "flash":
+        attn = make_sharded_flash_attention(
+            mesh, batch_axes=data_axes, head_axis=ulysses_heads,
+            causal=causal, forced=True)
+        assert attn is not None  # cp > 1 guarantees a manual axis
+        return attn
+
+    batch_axes, heads_t, tp, _, b_spec, _ = resolve_attention_manual_axes(
+        mesh, data_axes, ulysses_heads)
+    inner = NamedSharding(mesh, P(b_spec, None, heads_t, None))
+    outer = NamedSharding(mesh, P(b_spec, axis_name,
+                                  tuple(a for a in (heads_t or ())
+                                        if a != axis_name) or None, None))
+
+    def attention(q, k, v, standard_layout: bool = True, **kwargs):
+        if not standard_layout:
+            raise ValueError(
+                "ulysses attention assumes the standard contiguous position "
+                "layout; don't pass explicit positions under context "
+                "parallelism")
+        from .attention import multihead_attention
+
+        qc, kc, vc = (jax.lax.with_sharding_constraint(x, inner)
+                      for x in (q, k, v))
+        out = multihead_attention(qc, kc, vc, causal=causal, impl="xla")
+        # flip back to the sequence sharding the surrounding blocks carry
+        return jax.lax.with_sharding_constraint(out, outer)
+
+    return attention

@@ -67,6 +67,12 @@ def get_parser() -> argparse.ArgumentParser:
                              "attn plus the [B,S,I] MLP inner activations "
                              "(also skips the gate/up matmul recompute)")
     parser.add_argument("--attn-impl", default="auto", choices=["auto", "xla", "flash"])
+    parser.add_argument("--context-impl", default="ring",
+                        choices=["ring", "ulysses"],
+                        help="cp>1 attention scheme: ring = zigzag ppermute "
+                             "ring (any head count, any length); ulysses = "
+                             "all-to-all head sharding during attention "
+                             "(cheaper comms, needs kv_heads %% (cp*tp) == 0)")
     parser.add_argument("--max-steps", default=None, type=int)
     parser.add_argument("--native-loader", action="store_true",
                         help="assemble batches with the C++ mmap/prefetch loader (csrc/)")
@@ -135,6 +141,7 @@ def run_training(args, plan_factory: Callable, *, extra_log: Optional[dict] = No
         remat_policy=args.remat_policy,
         loss_chunks=args.loss_chunks,
         attn_impl=args.attn_impl,
+        context_impl=getattr(args, "context_impl", "ring"),
         offload_opt_state=offload_opt_state,
         offload_params=offload_params,
         pp_microbatches=pp_microbatches,

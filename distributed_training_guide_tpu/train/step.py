@@ -109,6 +109,7 @@ class Trainer:
     remat_policy: str = "all"  # REMAT_POLICIES key (what survives under remat)
     loss_chunks: int = 0  # >0: chunked CE from hidden states (no [B,S,V] logits)
     attn_impl: str = "auto"
+    context_impl: str = "ring"  # cp>1 attention: "ring" or "ulysses"
     loss_fn: Callable = causal_lm_loss
     donate: bool = True
     offload_opt_state: bool = False
@@ -232,14 +233,31 @@ class Trainer:
 
         attn_impl = self.attn_impl
         if self.plan.mesh.shape["cp"] > 1 and not callable(attn_impl):
-            # cp carries the ring's ppermutes; batch/head axes are manual
-            # too (local Pallas calls — GSPMD would gather them), with heads
-            # manual only when this plan actually tp-shards them
-            from ..ops.ring_attention import make_ring_attention
+            plan_head_axis = ("tp" if self.plan.rules.get("heads") == "tp"
+                              else None)
+            if self.context_impl == "ulysses":
+                # all-to-all CP: heads shard over cp (x tp) during
+                # attention, full sequence per device — see
+                # ops/ulysses_attention.py for the ring-vs-ulysses trade
+                from ..ops.ulysses_attention import make_ulysses_attention
 
-            attn_impl = make_ring_attention(
-                self.plan.mesh, data_axes=self.plan.data_axes,
-                head_axis="tp" if self.plan.rules.get("heads") == "tp" else None)
+                attn_impl = make_ulysses_attention(
+                    self.plan.mesh, data_axes=self.plan.data_axes,
+                    head_axis=plan_head_axis, impl=attn_impl)
+            elif self.context_impl == "ring":
+                # cp carries the ring's ppermutes; batch/head axes are
+                # manual too (local Pallas calls — GSPMD would gather
+                # them), with heads manual only when this plan actually
+                # tp-shards them
+                from ..ops.ring_attention import make_ring_attention
+
+                attn_impl = make_ring_attention(
+                    self.plan.mesh, data_axes=self.plan.data_axes,
+                    head_axis=plan_head_axis)
+            else:
+                raise ValueError(f"unknown context_impl "
+                                 f"{self.context_impl!r}; use 'ring' or "
+                                 f"'ulysses'")
         elif (self.plan.mesh.shape["pp"] == 1 and not callable(attn_impl)
               and (attn_impl == "flash"
                    or (attn_impl == "auto"
