@@ -96,6 +96,12 @@ def test_engine_optimizer_type_dispatch(eight_devices):
     # the config actually selected adafactor: no fp32 Adam mu anywhere
     state_names = {type(s).__name__ for s in engine.state.opt_state}
     assert "ScaleByAdamState" not in state_names
+
+    lion_engine = initialize({"model": "llama-debug",
+                              "optimizer": {"type": "Lion",
+                                            "params": {"lr": 1e-4}}})
+    lion_names = {type(s).__name__ for s in lion_engine.state.opt_state}
+    assert "ScaleByLionState" in lion_names
     with pytest.raises(ValueError, match="optimizer.type"):
         initialize({"model": "llama-debug", "optimizer": {"type": "SGD"}})
 
