@@ -153,6 +153,9 @@ def test_ulysses_training_matches_single_device(eight_devices):
     ulysses_flash = run(make_plan("ddp", make_mesh(cp=2)),
                         context_impl="ulysses", attn_impl="flash")
     np.testing.assert_allclose(ulysses_flash, golden, rtol=2e-4)
+    ulysses_fsdp = run(make_plan("fsdp", make_mesh(cp=2, fsdp=2)),
+                       context_impl="ulysses")
+    np.testing.assert_allclose(ulysses_fsdp, golden, rtol=2e-4)
 
 
 def test_ring_attention_zigzag_noncausal(eight_devices):
