@@ -133,12 +133,15 @@ def _block(config: GPT2Config, x, layer, positions, attn_impl,
 
     y = _layernorm(x, {"scale": layer["ln1"]["scale"], "bias": layer["ln1"]["bias"]},
                    config.layer_norm_eps)
-    qkv = (y @ wqkv.reshape(e, 3 * e_loc).astype(cdt)
-           + layer["attn"]["bqkv"].reshape(3 * e_loc).astype(cdt))
-    q, k, v = jnp.split(qkv, 3, axis=-1)
-    q = q.reshape(b, s, h_loc, d)
-    k = k.reshape(b, s, h_loc, d)
-    v = v.reshape(b, s, h_loc, d)
+    # project WITHOUT flattening [3, e_loc] into 3*e_loc: the trailing head
+    # dim may be tp-sharded, and GSPMD cannot represent the strided tiling a
+    # merged 3e dim would need — it would all-gather the QKV weight on the
+    # auto tp/tp_fsdp paths (the layout's whole point is that it shards)
+    qkv = (jnp.einsum("bse,eqh->bsqh", y, wqkv.astype(cdt))
+           + layer["attn"]["bqkv"].astype(cdt))
+    q = qkv[:, :, 0].reshape(b, s, h_loc, d)
+    k = qkv[:, :, 1].reshape(b, s, h_loc, d)
+    v = qkv[:, :, 2].reshape(b, s, h_loc, d)
     if callable(attn_impl):  # e.g. ring attention under context parallelism
         attn = attn_impl(q, k, v, standard_layout=standard_layout)
     else:

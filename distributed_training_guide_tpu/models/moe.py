@@ -1,14 +1,17 @@
 """Mixture-of-Experts Llama variant with expert parallelism.
 
 The reference has no MoE/expert parallelism at all (SURVEY.md §2 scorecard:
-"EP: absent entirely"); this adds the capability TPU-first using the GShard
-einsum formulation — the design GSPMD was literally built around:
+"EP: absent entirely"); this adds the capability TPU-first:
 
 - every layer's FFN is replaced by a router + E experts whose weights are
   *stacked* on an expert dim ``[L, E, ...]`` carrying the logical axis
-  ``experts``; the "ep" plan maps it to the ``ep`` mesh axis, and XLA derives
-  the token all-to-all from the dispatch/combine einsums — no hand-written
-  collectives;
+  ``experts``; the "ep" plan maps it to the ``ep`` mesh axis. GSPMD
+  partitions the index-based dispatch scatter and the expert einsums over
+  ep WITHOUT replicating either the [E, C, D] buffers or the expert
+  weights: each device computes only its E/ep experts and token movement
+  lowers to collective-permutes — verified at the compiled-HLO level by
+  ``tests/test_moe.py::test_ep_dispatch_stays_local`` (no hand-written
+  collectives needed);
 - routing is top-k (default 2) with a static per-expert capacity
   ``C = ceil(capacity_factor * k * tokens / E)`` — static shapes (XLA
   requirement), overflow tokens drop to the residual path (standard

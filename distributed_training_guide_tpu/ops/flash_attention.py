@@ -399,8 +399,14 @@ def make_sharded_flash_attention(
     block_q: int = 512,
     block_k: int = 512,
     forced: bool = False,
+    fallback=None,
 ):
     """Flash attention that PARTITIONS over batch/head mesh axes.
+
+    ``fallback``: attention callable used instead of the plain-xla einsum
+    when a shape is ineligible and ``forced`` is False — callers with their
+    own sharding discipline (Ulysses) substitute their constraint-based
+    path so 'auto' degrades to the RIGHT program, not an unconstrained one.
 
     The XLA SPMD partitioner cannot shard a Mosaic custom call: a bare
     ``flash_attention`` under a GSPMD mesh compiles, but the partitioner's
@@ -415,10 +421,14 @@ def make_sharded_flash_attention(
     ring instead).
 
     Returns None when no relevant axis has size > 1 (single-device meshes:
-    the plain kernel path is already optimal). Not usable inside the
-    pipeline's pp-manual shard_map (nested manual regions are rejected;
-    there the batch dim's dp/fsdp sharding still pays the gather — noted in
-    ``parallel/pipeline.py``).
+    the plain kernel path is already optimal). Usable inside the pipeline's
+    pp-manual shard_map too: the flash maps are built at trace time against
+    the *context* mesh, so inside a manual region they nest as a
+    dp/fsdp-manual sub-region over the still-auto data axes (pass
+    ``head_axis=None`` there — heads arrive pre-sharded as manual megatron
+    shards). Building against the factory's concrete mesh instead would
+    fail: the trace context's AbstractMesh marks pp/tp Manual and shard_map
+    requires an exact mesh match.
 
     The custom_vjp sits OUTSIDE the two shard_maps, like the ring's: grad
     cannot transpose through a partial-manual shard_map, so forward and
@@ -447,19 +457,31 @@ def make_sharded_flash_attention(
         return tuple(g.transpose(0, 2, 1, 3) for g in (dq, dk, dv))
 
     res_specs = (spec_bhsd, spec_bhsd, spec_bhsd, spec_bhsd, spec_bhs)
-    sm = functools.partial(jax.shard_map, mesh=mesh, axis_names=manual,
-                           check_vma=False)
-    fwd_sm = sm(fwd_body, in_specs=(spec_bshd,) * 3,
-                out_specs=(spec_bshd, res_specs))
-    bwd_sm = sm(bwd_body, in_specs=(*res_specs, spec_bshd),
-                out_specs=(spec_bshd,) * 3)
+
+    def _maps():
+        # resolved at TRACE time: inside another manual region (the pp
+        # pipeline) the context AbstractMesh marks pp/tp Manual and shard_map
+        # insists on an exact mesh match — nesting works iff the inner maps
+        # are built against that context mesh (their own manual axes stay
+        # the auto dp/fsdp ones). At top level the context mesh is empty.
+        m = jax.sharding.get_abstract_mesh()
+        if not (m.axis_names and
+                any(t == jax.sharding.AxisType.Manual for t in m.axis_types)):
+            m = mesh
+        sm = functools.partial(jax.shard_map, mesh=m, axis_names=manual,
+                               check_vma=False)
+        fwd = sm(fwd_body, in_specs=(spec_bshd,) * 3,
+                 out_specs=(spec_bshd, res_specs))
+        bwd = sm(bwd_body, in_specs=(*res_specs, spec_bshd),
+                 out_specs=(spec_bshd,) * 3)
+        return fwd, bwd
 
     @jax.custom_vjp
     def sharded_flash(q, k, v):
-        return fwd_sm(q, k, v)[0]
+        return _maps()[0](q, k, v)[0]
 
     def vjp_fwd(q, k, v):
-        out, (qt, kt, vt, o, lse) = fwd_sm(q, k, v)
+        out, (qt, kt, vt, o, lse) = _maps()[0](q, k, v)
         # same remat tags as the plain path (_flash_vjp_fwd): a
         # REMAT_POLICIES["attn"] policy keeps the kernel output + lse so
         # backward never re-runs the forward kernel
@@ -468,12 +490,15 @@ def make_sharded_flash_attention(
         return out, (qt, kt, vt, o, lse)
 
     def vjp_bwd(res, do):
-        return bwd_sm(*res, do)
+        return _maps()[1](*res, do)
 
     sharded_flash.defvjp(vjp_fwd, vjp_bwd)
-    # partial-manual shard_map resolves auto-axis shardings only under jit;
-    # inlined into the caller's jit so this costs nothing in the train step
-    sharded_flash = jax.jit(sharded_flash)
+    # partial-manual shard_map resolves auto-axis shardings only under jit.
+    # Eager callers (tests) go through this jit; traced callers use the raw
+    # custom_vjp directly — they are already under the caller's jit, and the
+    # jit cache must not pin a top-level trace onto a later in-pipeline call
+    # whose context mesh differs
+    sharded_flash_eager = jax.jit(sharded_flash)
 
     def attention(q, k, v, standard_layout: bool = True, **kwargs):
         if not standard_layout:
@@ -503,10 +528,15 @@ def make_sharded_flash_attention(
                     f"heads={hq}/{hkv}, batch={q.shape[0]}, "
                     f"seq={q.shape[1]}, head_dim={d} — pad, or use "
                     f"impl='xla'")
+            if fallback is not None:
+                return fallback(q, k, v, standard_layout=standard_layout,
+                                **kwargs)
             from .attention import multihead_attention
 
             return multihead_attention(q, k, v, causal=causal, impl="xla")
-        return sharded_flash(q, k, v)
+        if isinstance(q, jax.core.Tracer):
+            return sharded_flash(q, k, v)
+        return sharded_flash_eager(q, k, v)
 
     return attention
 

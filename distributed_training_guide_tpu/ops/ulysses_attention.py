@@ -56,15 +56,15 @@ def make_ulysses_attention(mesh: Mesh, *, axis_name: str = "cp",
     # size-1 axes, so the raw concatenation is safe to pass through
     ulysses_heads = (*head_axes, axis_name)
 
-    if impl == "auto":
+    auto = impl == "auto"
+    if auto:
         impl = "flash" if jax.default_backend() == "tpu" else "xla"
-    if impl == "flash":
-        attn = make_sharded_flash_attention(
-            mesh, batch_axes=data_axes, head_axis=ulysses_heads,
-            causal=causal, forced=True)
-        assert attn is not None  # cp > 1 guarantees a manual axis
-        return attn
 
+    # the constraint-based xla path is built unconditionally: under 'auto'
+    # it is also the fallback when a GQA model's kv heads don't divide
+    # cp*tp — consistent with 'auto' semantics elsewhere (the sharded-flash
+    # factory degrades instead of raising); an explicit impl='flash' still
+    # fails loud on ineligible shapes
     batch_axes, heads_t, tp, _, b_spec, _ = resolve_attention_manual_axes(
         mesh, data_axes, ulysses_heads)
     inner = NamedSharding(mesh, P(b_spec, None, heads_t, None))
@@ -85,5 +85,13 @@ def make_ulysses_attention(mesh: Mesh, *, axis_name: str = "cp",
         out = multihead_attention(qc, kc, vc, causal=causal, impl="xla")
         # flip back to the sequence sharding the surrounding blocks carry
         return jax.lax.with_sharding_constraint(out, outer)
+
+    if impl == "flash":
+        flash = make_sharded_flash_attention(
+            mesh, batch_axes=data_axes, head_axis=ulysses_heads,
+            causal=causal, forced=not auto,
+            fallback=attention if auto else None)
+        assert flash is not None  # cp > 1 guarantees a manual axis
+        return flash
 
     return attention

@@ -30,13 +30,15 @@ first-class axis, the shard_map way:
 Bubble fraction stays (pp-1)/(M+pp-1) — choose microbatches >= 2*pp to keep
 it under a third.
 
-Flash attention inside the pipeline: the batch/head-manual shard_map that
-makes the Pallas kernel partition under pure-GSPMD plans
-(``ops/flash_attention.make_sharded_flash_attention``) cannot nest inside
-this pp-manual region, so under pp x dp/fsdp the kernel's batch dim falls
-back to the partitioner's gather-and-replicate. tp is unaffected (heads
-arrive pre-sharded as manual megatron shards here). Prefer attn_impl='xla'
-for pp runs with a sharded batch dim, or keep dp=fsdp=1 inside pp stages.
+Flash attention inside the pipeline: the batch-manual shard_map that makes
+the Pallas kernel partition under pure-GSPMD plans
+(``ops/flash_attention.make_sharded_flash_attention``) nests inside this
+pp-manual region as a dp/fsdp-manual sub-region — it is built at trace
+time against the context mesh (whose pp/tp axes are already Manual), so
+the kernel runs on local batch shards instead of the partitioner's
+gather-and-replicate fallback. Heads arrive pre-sharded as manual megatron
+shards, so the nested wrapper declares only the batch axes
+(``train/step.py`` passes ``head_axis=None`` under pp).
 """
 from __future__ import annotations
 

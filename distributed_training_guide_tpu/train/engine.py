@@ -81,6 +81,18 @@ class TrainingEngine:
 
         opt_type = config.get("optimizer", {}).get("type", "AdamW").lower()
         opt_cfg = config.get("optimizer", {}).get("params", {})
+        known = {"adamw": {"lr", "betas", "weight_decay"},
+                 "adam": {"lr", "betas", "weight_decay"},
+                 "adafactor": {"lr", "weight_decay"},
+                 "lion": {"lr", "betas", "weight_decay"}}.get(opt_type)
+        unknown = set(opt_cfg) - known if known is not None else set()
+        if unknown:
+            # silently dropping e.g. betas for Adafactor would run different
+            # dynamics than the (likely AdamW-ported) config implies
+            raise ValueError(
+                f"optimizer.params {sorted(unknown)} are not supported for "
+                f"optimizer.type {opt_type!r} (supported: {sorted(known)}); "
+                f"remove them or switch type")
         sched = config.get("scheduler", {})
         common = dict(
             weight_decay=opt_cfg.get("weight_decay", 0.01),

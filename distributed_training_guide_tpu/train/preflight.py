@@ -36,6 +36,12 @@ def run_preflight(trainer, *, global_batch: int, seq_length: int) -> dict:
     from ..checkpoint import abstract_train_state
 
     state = abstract_train_state(trainer)
+    if global_batch % trainer.grad_accum:
+        # a silent floor-div here would lower a SMALLER step than training
+        # runs, making both the budget and the "it lowers" signal wrong
+        raise ValueError(
+            f"global batch {global_batch} is not divisible by "
+            f"gradient accumulation {trainer.grad_accum}")
     if trainer.grad_accum > 1:  # leading scanned microbatch axis
         shape = (trainer.grad_accum, global_batch // trainer.grad_accum,
                  seq_length)

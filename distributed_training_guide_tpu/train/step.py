@@ -258,20 +258,25 @@ class Trainer:
                 raise ValueError(f"unknown context_impl "
                                  f"{self.context_impl!r}; use 'ring' or "
                                  f"'ulysses'")
-        elif (self.plan.mesh.shape["pp"] == 1 and not callable(attn_impl)
+        elif (not callable(attn_impl)
               and (attn_impl == "flash"
                    or (attn_impl == "auto"
                        and jax.default_backend() == "tpu"))):
             # GSPMD cannot partition the Mosaic custom call (it all-gathers
             # q/k/v and runs the full kernel on every device); wrap the flash
             # path in a batch/head-manual shard_map so the kernel stays local.
-            # Skipped under pp (no nested manual regions) and under "auto"
-            # off-TPU (the dispatcher resolves to the partitionable XLA path).
+            # Inside the pipeline's pp-manual region the wrapper nests as a
+            # dp/fsdp-manual sub-region (built against the context mesh);
+            # heads there arrive pre-sharded as manual megatron shards, so
+            # only the batch axes are declared. Skipped under "auto" off-TPU
+            # (the dispatcher resolves to the partitionable XLA path).
             from ..ops.flash_attention import make_sharded_flash_attention
 
+            under_pp = self.plan.mesh.shape["pp"] > 1
             wrapped = make_sharded_flash_attention(
                 self.plan.mesh, batch_axes=self.plan.data_axes,
-                head_axis="tp" if self.plan.rules.get("heads") == "tp" else None,
+                head_axis=("tp" if not under_pp
+                           and self.plan.rules.get("heads") == "tp" else None),
                 forced=attn_impl == "flash")
             if wrapped is not None:
                 attn_impl = wrapped

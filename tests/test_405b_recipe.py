@@ -8,6 +8,11 @@ XLA partitioner CHECK) while materializing zero bytes of the 1.6 TB state.
 Reference counterpart: ``05-training-llama-405b/train_llm.py`` (the recipe
 itself; the reference has no analogous pre-flight check).
 """
+import json
+import os
+import subprocess
+import sys
+
 import jax
 import numpy as np
 
@@ -39,3 +44,57 @@ def test_405b_train_step_lowers(eight_devices):
     assert text.count("sdy.sharding") > 100  # every param leaf is annotated
     n_params = sum(int(np.prod(s.shape)) for s in jax.tree.leaves(state.params))
     assert abs(n_params - 405.8e9) / 405.8e9 < 0.01
+
+
+_POD_SCRIPT = """
+import json
+import jax
+from distributed_training_guide_tpu.models import get_model
+from distributed_training_guide_tpu.parallel import make_mesh, make_plan
+from distributed_training_guide_tpu.train import Trainer, adamw_cosine
+from distributed_training_guide_tpu.train.preflight import run_preflight
+
+assert len(jax.devices()) == 256
+bundle = get_model("llama-3.1-405b")
+plan = make_plan("tp_fsdp", make_mesh(tp=8, fsdp=32))
+trainer = Trainer(bundle=bundle, optimizer=adamw_cosine(1e-4), plan=plan,
+                  remat=True, remat_policy="attn", donate=False)
+report = run_preflight(trainer, global_batch=32, seq_length=4096)
+report["mesh"] = dict(report["mesh"])
+print("REPORT:" + json.dumps(report))
+"""
+
+
+def test_405b_preflight_at_pod_shape():
+    """The chapter's OWN recommended config — fsdp=32 x tp=8 on a v5p-512
+    host group (``05-training-llama-405b/train_llm.py`` docstring) — must
+    lower, and the preflight's per-device budget must fit v5p HBM (95 GB)
+    with remat=attn. Runs in a subprocess: the pod shape needs 256 virtual
+    devices, and the device count is fixed per process. Reference anchor:
+    the reference proves its recipe by running it on 64xH100
+    (``/root/reference/05-training-llama-405b/README.md:268-276``); this is
+    the equivalent evidence available without a pod."""
+    env = dict(
+        os.environ,
+        JAX_PLATFORMS="cpu",
+        XLA_FLAGS="--xla_force_host_platform_device_count=256",
+    )
+    proc = subprocess.run(
+        [sys.executable, "-c", _POD_SCRIPT], env=env, text=True,
+        capture_output=True, timeout=1200,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    assert proc.returncode == 0, proc.stderr[-4000:]
+    line = next(l for l in proc.stdout.splitlines() if l.startswith("REPORT:"))
+    report = json.loads(line[len("REPORT:"):])
+
+    assert report["lowered"] and report["n_devices"] == 256
+    assert report["mesh"]["tp"] == 8 and report["mesh"]["fsdp"] == 32
+    state = report["per_device_state_total_bytes"]
+    grads = report["per_device_grad_bytes_transient"]
+    V5P_HBM = 95e9
+    # params (fp32 master) + Adam moments + transient fp32 grads: must leave
+    # >= 25% of the chip for activations/temp at the chapter's microbatch --
+    # ~25.4 GB expected (1.6 TB state + 0.4 TB grads over 256 chips)
+    assert state + grads < 0.75 * V5P_HBM, (
+        f"per-device state {state / 2**30:.1f} GiB + grads "
+        f"{grads / 2**30:.1f} GiB leaves <25% of v5p HBM for activations")

@@ -26,12 +26,22 @@ def _mfu(value, steps=10, partial=False, **detail):
     return out
 
 
-class FakeChildren:
-    """Scripted responses: probe -> platform line; rung -> pop from queue;
-    flash check -> fixed record. Each rung response is (lines, kind)."""
+@pytest.fixture(autouse=True)
+def _isolated_caches(tmp_path, monkeypatch):
+    """Keep test runs away from the REAL evidence cache (.bench_last_good.json
+    holds the measured headline; a fake 0.52 must never clobber it)."""
+    monkeypatch.setattr(bench, "LAST_GOOD_PATH", str(tmp_path / "last_good.json"))
+    monkeypatch.setattr(bench, "SWEEP_LOG_PATH", str(tmp_path / "sweep.jsonl"))
 
-    def __init__(self, rung_responses, platform="tpu"):
+
+class FakeChildren:
+    """Scripted responses: probe -> platform line (or a scripted failure);
+    rung -> pop from queue; flash check -> fixed record. Each rung response
+    is (lines, kind); each probe response is True (healthy) or False."""
+
+    def __init__(self, rung_responses, platform="tpu", probe_responses=None):
         self.rung_responses = list(rung_responses)
+        self.probe_responses = list(probe_responses or [])
         self.platform = platform
         self.calls = []
 
@@ -39,6 +49,9 @@ class FakeChildren:
         self.calls.append(mode_args)
         assert budget > 0
         if mode_args == ["--probe"]:
+            ok = self.probe_responses.pop(0) if self.probe_responses else True
+            if not ok:
+                return [], "stalled"
             return [{"platform": self.platform, "n_devices": 1}], "ok"
         if mode_args == ["--check-flash"]:
             return [{"flash_ms": 70.0, "xla_ms": 95.0, "ok": True}], "ok"
@@ -135,6 +148,96 @@ def test_everything_dead_emits_zero_and_rc2(monkeypatch, capsys):
     assert code == 2
     assert final["value"] == 0.0
     assert "stalled" in json.dumps(final["detail"]["ladder"])
+
+
+def test_pool_down_gate_sleeps_instead_of_burning_rungs(monkeypatch, capsys):
+    """Dead pool at start: the parent sleep-polls the probe and launches NO
+    rung until a probe succeeds (round-3 hardening: rung budgets must not be
+    burned stalling against a pool the probe already shows is dead)."""
+    sleeps = []
+    monkeypatch.setattr(bench.time, "sleep", sleeps.append)
+    fake = FakeChildren([([_mfu(0.50)], "ok"), ([_mfu(0.48)], "ok")],
+                        probe_responses=[False, False, True])
+    final, code = _run_main(monkeypatch, capsys, fake)
+    assert code == 0 and final["value"] == 0.50
+    assert len(sleeps) == 2          # one sleep per failed probe
+    probe_calls = [c for c in fake.calls if c == ["--probe"]]
+    rung_idx = next(i for i, c in enumerate(fake.calls) if c[0] == "--rung")
+    assert len(probe_calls) == 3 and rung_idx == 3  # all probes before rung 1
+    assert [p["ok"] for p in final["detail"]["probes"]] == [False, False, True]
+
+
+def test_stalled_rung_regates_on_probe(monkeypatch, capsys):
+    """A rung stall mid-ladder re-gates: the pool must answer a probe before
+    the next rung is launched."""
+    sleeps = []
+    monkeypatch.setattr(bench.time, "sleep", sleeps.append)
+    fake = FakeChildren([([], "stalled"), ([_mfu(0.47)], "ok")],
+                        probe_responses=[True, False, True])
+    final, code = _run_main(monkeypatch, capsys, fake)
+    assert code == 0 and final["value"] == 0.47
+    # initial probe ok; rung1 stalled; gate probe fails once then succeeds
+    assert len([c for c in fake.calls if c == ["--probe"]]) == 3
+    assert len(sleeps) == 1
+
+
+def test_outage_zero_carries_last_good_evidence(monkeypatch, capsys):
+    """The round-2 failure mode: pool dead for the whole window. The zero
+    line must carry the cached best measurement (value/config/timestamp) so
+    the official record is never evidence-free."""
+    seeded = {"value": 0.505, "unit": "fraction_of_peak_bf16", "ts": 1.0,
+              "utc": "2026-07-29T14:20:00Z",
+              "config": {"model": "llama-650m", "step_ms": 695.0}}
+    with open(bench.LAST_GOOD_PATH, "w") as f:
+        json.dump(seeded, f)
+    monkeypatch.setattr(bench.time, "sleep", lambda s: None)
+    fake = FakeChildren([])  # rungs stall forever; probes ok
+    final, code = _run_main(monkeypatch, capsys, fake)
+    assert code == 2 and final["value"] == 0.0
+    assert final["detail"]["last_good"]["value"] == 0.505
+    assert final["detail"]["last_good"]["config"]["model"] == "llama-650m"
+
+
+def test_success_persists_last_good_and_never_degrades(monkeypatch, capsys):
+    fake = FakeChildren([([_mfu(0.50)], "ok"), ([_mfu(0.48)], "ok")])
+    final, _ = _run_main(monkeypatch, capsys, fake)
+    assert bench._load_last_good()["value"] == 0.50
+    # a later, worse run must not clobber the best evidence...
+    fake = FakeChildren([([_mfu(0.43)], "ok"), ([_mfu(0.41)], "ok")])
+    final, _ = _run_main(monkeypatch, capsys, fake)
+    assert bench._load_last_good()["value"] == 0.50
+    # ...and the degraded line itself points at the better cached number
+    assert final["detail"]["last_good"]["value"] == 0.50
+    # a better run does take over
+    fake = FakeChildren([([_mfu(0.52)], "ok"), ([_mfu(0.48)], "ok")])
+    _run_main(monkeypatch, capsys, fake)
+    assert bench._load_last_good()["value"] == 0.52
+
+
+def test_sweep_is_probe_gated_and_resumable(monkeypatch, capsys):
+    """--sweep: completed experiments are skipped on re-run; a complete
+    result lands in the sweep log and updates the last-good cache."""
+    queue = [dict(name="exp_a", model="llama-650m", batch=8, seq=2048,
+                  remat=True, remat_policy="attn_mlp"),
+             dict(name="exp_b", model="llama-650m", batch=16, seq=2048,
+                  remat=True, remat_policy="attn", optimizer="adafactor")]
+    monkeypatch.setattr(bench, "SWEEP_QUEUE", queue)
+    with open(bench.SWEEP_LOG_PATH, "w") as f:   # exp_a already done
+        f.write(json.dumps({"name": "exp_a", "result": _mfu(0.49)}) + "\n")
+    sleeps = []
+    monkeypatch.setattr(bench.time, "sleep", sleeps.append)
+    fake = FakeChildren([([_mfu(0.52)], "ok")], probe_responses=[False, True])
+    _, code = _run_main(monkeypatch, capsys, fake,
+                        argv=("--watchdog", "0", "--sweep"))
+    assert code == 0
+    rung_calls = [c for c in fake.calls if c[0] == "--rung"]
+    assert len(rung_calls) == 1      # exp_a skipped, exp_b run
+    assert json.loads(rung_calls[0][1])["optimizer"] == "adafactor"
+    assert len(sleeps) == 1          # gated on the failed probe
+    with open(bench.SWEEP_LOG_PATH) as f:
+        recs = [json.loads(l) for l in f]
+    assert recs[-1]["name"] == "exp_b" and recs[-1]["result"]["value"] == 0.52
+    assert bench._load_last_good()["value"] == 0.52
 
 
 def test_explicit_flags_build_single_rung(monkeypatch, capsys):

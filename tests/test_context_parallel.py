@@ -2,6 +2,7 @@
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 from distributed_training_guide_tpu.models import get_model
 from distributed_training_guide_tpu.ops.attention import _xla_attention
@@ -126,6 +127,32 @@ def test_ulysses_attention_matches_dense(eight_devices):
                                    err_msg=impl)
         np.testing.assert_allclose(np.asarray(grad), np.asarray(ref[1]),
                                    rtol=2e-4, atol=1e-4, err_msg=impl)
+
+
+def test_ulysses_auto_falls_back_on_gqa_indivisibility(eight_devices, monkeypatch):
+    """impl='auto' on TPU resolves to flash — but a GQA model whose kv heads
+    don't divide cp*tp must degrade to the constraint-based xla path instead
+    of hard-erroring (consistent with 'auto' semantics elsewhere); an
+    explicit impl='flash' still fails loud."""
+    from distributed_training_guide_tpu.ops.ulysses_attention import (
+        make_ulysses_attention)
+
+    mesh = make_mesh(cp=2, tp=2)
+    monkeypatch.setattr(jax, "default_backend", lambda: "tpu")  # force 'auto'->flash
+    auto_attn = make_ulysses_attention(mesh, impl="auto")
+    flash_attn = make_ulysses_attention(mesh, impl="flash")
+    monkeypatch.undo()
+
+    ks = jax.random.split(jax.random.key(5), 3)
+    q = jax.random.normal(ks[0], (2, 32, 8, 16), jnp.float32)
+    k = jax.random.normal(ks[1], (2, 32, 2, 16), jnp.float32)  # 2 % (cp*tp)=4 != 0
+    v = jax.random.normal(ks[2], (2, 32, 2, 16), jnp.float32)
+    ref = _xla_attention(q, k, v, True, None, None)
+    out = jax.jit(auto_attn)(q, k, v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-4, atol=1e-5)
+    with pytest.raises(ValueError, match="divisible"):
+        jax.jit(flash_attn)(q, k, v)
 
 
 def test_ulysses_training_matches_single_device(eight_devices):

@@ -84,6 +84,68 @@ def test_pp_tp_composes_with_fsdp(golden, eight_devices):
     np.testing.assert_allclose(losses, golden[0], rtol=2e-4)
 
 
+def _nested_shard_maps(jaxpr):
+    """(depth-inside-pp-region, manual_axes, in_specs) for every shard_map
+    nested inside the pipeline's pp-manual shard_map."""
+    def subjaxprs(params):
+        for v in params.values():
+            vs = v if isinstance(v, (tuple, list)) else (v,)
+            for w in vs:
+                if hasattr(w, "jaxpr") and hasattr(w.jaxpr, "eqns"):
+                    yield w.jaxpr
+                elif hasattr(w, "eqns"):
+                    yield w
+
+    found = []
+
+    def walk(jx, inside_pp):
+        for eqn in jx.eqns:
+            now_inside = inside_pp
+            if eqn.primitive.name == "shard_map":
+                axes = frozenset(eqn.params["manual_axes"])
+                if inside_pp:
+                    found.append((axes, eqn.params["in_specs"]))
+                now_inside = inside_pp or "pp" in axes
+            for sub in subjaxprs(eqn.params):
+                walk(sub, now_inside)
+
+    walk(jaxpr.jaxpr, False)
+    return found
+
+
+def test_pp_fsdp_flash_partitions_batch(golden, eight_devices):
+    """Flash under pp (round-2 weakness closed): the sharded-flash wrapper
+    nests inside the pp-manual schedule as a dp/fsdp-manual sub-region built
+    against the context mesh, so the Pallas kernel runs on local batch
+    shards — NOT the SPMD partitioner's gather-and-replicate fallback.
+    Checks the trajectory against the single-device golden AND the program
+    structure: nested batch-manual flash maps inside the pipeline region."""
+    from jax.sharding import PartitionSpec as P
+
+    bundle = get_model("llama-debug", dtype=jnp.float32)
+    t = Trainer(bundle=bundle, optimizer=adamw_cosine(1e-3),
+                plan=make_plan("pp_fsdp", make_mesh(pp=2, fsdp=2)),
+                donate=False, pp_microbatches=2, attn_impl="flash")
+    state = t.init_state(0)
+    ids = np.random.RandomState(0).randint(0, 512, (GB, SEQ))
+    batch = {k: jax.device_put(jnp.asarray(ids), t.batch_shardings()[k])
+             for k in ("input_ids", "labels")}
+
+    jaxpr = jax.make_jaxpr(lambda s, b: t.step_fn(s, b))(state, batch)
+    nested = [(axes, specs) for axes, specs in _nested_shard_maps(jaxpr)
+              if "fsdp" in axes]
+    assert nested, "no batch-manual flash shard_map nested in the pp region"
+    batch_spec = P(("dp", "fsdp"), None, None, None)
+    assert any(specs and specs[0] == batch_spec for _, specs in nested), \
+        [s[:1] for _, s in nested]
+
+    losses = []
+    for _ in range(2):
+        state, m = t.step_fn(state, batch)
+        losses.append(float(m["loss"]))
+    np.testing.assert_allclose(losses, golden[0], rtol=2e-4)
+
+
 def test_pp_gpt2_family(eight_devices):
     # gpt2 exercises tied embeddings + learned position embeddings through
     # the embed/head vjp paths; under pp x tp also the column-sharded fused
