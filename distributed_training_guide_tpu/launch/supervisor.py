@@ -4,7 +4,11 @@ Parity with torchrun's elasticity (reference ``related-topics/elastic-training/
 README.md:5-16``): ``--max-restarts N`` restarts the worker when it fails, and
 — like torchrun — recovery correctness comes from the normal resume path
 (state.json + checkpoints + sampler fast-forward), not from preserving any
-in-process state. Per-attempt logs and error files are kept under
+in-process state. That path is world-size-agnostic (``--nnodes=1:4``
+equivalence): a restart that comes up on fewer hosts builds a smaller mesh
+and the checkpoint reshards into it on restore — see
+``related-topics/elastic-training/README.md`` "Dynamic world size" and
+``tests/test_data_checkpoint.py::test_elastic_world_size_resume``. Per-attempt logs and error files are kept under
 ``<log_dir>/attempt_<n>/`` (torchrun's ``--redirects 3 --log-dir``,
 ``02-distributed-data-parallel/README.md:99-100``).
 

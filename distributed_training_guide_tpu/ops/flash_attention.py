@@ -351,6 +351,17 @@ def _flash_vjp_fwd(q, k, v, causal, block_q, block_k, interpret):
 _flash.defvjp(_flash_vjp_fwd, _flash_bwd)
 
 
+def _in_manual_context() -> bool:
+    """True when tracing inside a manual shard_map region (the pipeline):
+    the attention wrappers must then build their shard_maps against the
+    context AbstractMesh and skip their eager-entry jit (the caller's jit
+    is already above us, and the eager jit's cache must never mix top-level
+    and in-pipeline programs)."""
+    m = jax.sharding.get_abstract_mesh()
+    return bool(m.axis_names) and any(
+        t == jax.sharding.AxisType.Manual for t in m.axis_types)
+
+
 def resolve_attention_manual_axes(mesh, batch_axes, head_axis):
     """Shared preamble for the manual-axes attention wrappers (this module's
     sharded flash, ``ring_attention``, and the Ulysses wrapper): keep only
@@ -464,10 +475,8 @@ def make_sharded_flash_attention(
         # insists on an exact mesh match — nesting works iff the inner maps
         # are built against that context mesh (their own manual axes stay
         # the auto dp/fsdp ones). At top level the context mesh is empty.
-        m = jax.sharding.get_abstract_mesh()
-        if not (m.axis_names and
-                any(t == jax.sharding.AxisType.Manual for t in m.axis_types)):
-            m = mesh
+        m = (jax.sharding.get_abstract_mesh() if _in_manual_context()
+             else mesh)
         sm = functools.partial(jax.shard_map, mesh=m, axis_names=manual,
                                check_vma=False)
         fwd = sm(fwd_body, in_specs=(spec_bshd,) * 3,
@@ -493,11 +502,11 @@ def make_sharded_flash_attention(
         return _maps()[1](*res, do)
 
     sharded_flash.defvjp(vjp_fwd, vjp_bwd)
-    # partial-manual shard_map resolves auto-axis shardings only under jit.
-    # Eager callers (tests) go through this jit; traced callers use the raw
-    # custom_vjp directly — they are already under the caller's jit, and the
-    # jit cache must not pin a top-level trace onto a later in-pipeline call
-    # whose context mesh differs
+    # partial-manual shard_map resolves auto-axis shardings only under jit,
+    # so every top-level call — eager OR traced — goes through this jit.
+    # ONLY manual-context callers (the pipeline) bypass it for the raw
+    # custom_vjp: this jit's cache must hold concrete-mesh programs
+    # exclusively, never a context-mesh trace
     sharded_flash_eager = jax.jit(sharded_flash)
 
     def attention(q, k, v, standard_layout: bool = True, **kwargs):
@@ -534,8 +543,8 @@ def make_sharded_flash_attention(
             from .attention import multihead_attention
 
             return multihead_attention(q, k, v, causal=causal, impl="xla")
-        if isinstance(q, jax.core.Tracer):
-            return sharded_flash(q, k, v)
+        if _in_manual_context():  # nested in the pipeline: caller's jit is
+            return sharded_flash(q, k, v)  # already above us
         return sharded_flash_eager(q, k, v)
 
     return attention

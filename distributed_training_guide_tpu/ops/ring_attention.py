@@ -130,10 +130,17 @@ def _relation(kv_chunk, q_chunk, causal):
 def _build_ring(axis_name: str, cp: int, causal: bool, interpret: bool):
     """Per-shard fwd/bwd ring bodies (flash kernel per chunk pair). The
     custom_vjp pairing them lives OUTSIDE the shard_map (make_ring_attention)
-    so shard_map's own transpose machinery is never engaged."""
+    so shard_map's own transpose machinery is never engaged.
 
-    def ring_fwd_body(q, k, v):
-        idx = jax.lax.axis_index(axis_name)
+    ``member`` (size-1 int32, the cp-sharded iota) carries this member's
+    ring position instead of ``jax.lax.axis_index``: when the ring nests
+    inside the pipeline's pp-manual region, Shardy lowers axis_index of an
+    auto-queried axis as a manual computation over the *complement* axes —
+    which re-binds pp and is rejected ("already bound by a parent"). A
+    sharded iota argument carries the same value with no such lowering."""
+
+    def ring_fwd_body(member, q, k, v):
+        idx = member[0]
         b, s_loc, hq, d = q.shape
         hkv = k.shape[2]
         if s_loc % 2:
@@ -187,9 +194,9 @@ def _build_ring(axis_name: str, cp: int, causal: bool, interpret: bool):
                            idx, axis_name, cp)
         return out, qz, kz, vz, o, lse
 
-    def ring_bwd_body(qz, kz, vz, o, lse, do):
+    def ring_bwd_body(member, qz, kz, vz, o, lse, do):
         in_dtype = qz.dtype
-        idx = jax.lax.axis_index(axis_name)
+        idx = member[0]
         my_chunks = (idx, 2 * cp - 1 - idx)
 
         doz = _to_zigzag(do, idx, axis_name, cp).transpose(1, 0, 3, 2, 4)
@@ -268,7 +275,8 @@ def make_ring_attention(mesh: Mesh, *, axis_name: str = "cp",
     logic is unchanged; only cp carries ppermutes. The round-1 partitioner
     CHECK that forced partial-manual was auto-*tp on weights* inside a
     manual region — q/k/v here are activations, already projected."""
-    from .flash_attention import (attention_divisibility_error,
+    from .flash_attention import (_in_manual_context,
+                                  attention_divisibility_error,
                                   resolve_attention_manual_axes)
 
     cp = mesh.shape[axis_name]
@@ -283,36 +291,53 @@ def make_ring_attention(mesh: Mesh, *, axis_name: str = "cp",
     chunk4 = P(None, b_spec, head_axis, axis_name)        # [2, B, H, S_c]
 
     fwd_body, bwd_body = _build_ring(axis_name, cp, causal, interpret)
-    # check_vma=False: pallas interpret mode (the CPU test path) trips the
-    # vma checker inside its own lowering ("dynamic_slice requires varying
-    # manual axes to match")
-    sm = functools.partial(jax.shard_map, mesh=mesh, axis_names=manual,
-                           check_vma=False)
-    fwd_sm = sm(fwd_body, in_specs=(spec, spec, spec),
-                out_specs=(spec, chunk5, chunk5, chunk5, chunk5, chunk4))
-    bwd_sm = sm(bwd_body,
-                in_specs=(chunk5, chunk5, chunk5, chunk5, chunk4, spec),
-                out_specs=(spec, spec, spec))
+
+    def _maps():
+        # resolved at TRACE time, like the sharded-flash wrapper: inside the
+        # pipeline's pp-manual region the context AbstractMesh marks pp/tp
+        # Manual and shard_map insists on an exact mesh match — the ring
+        # nests there iff built against that context mesh (its own manual
+        # axes, cp + batch, are still auto in the pp region)
+        m = (jax.sharding.get_abstract_mesh() if _in_manual_context()
+             else mesh)
+        # check_vma=False: pallas interpret mode (the CPU test path) trips
+        # the vma checker inside its own lowering ("dynamic_slice requires
+        # varying manual axes to match")
+        sm = functools.partial(jax.shard_map, mesh=m, axis_names=manual,
+                               check_vma=False)
+        member = P(axis_name)   # [cp] iota -> each member's ring position
+        fwd = sm(fwd_body, in_specs=(member, spec, spec, spec),
+                 out_specs=(spec, chunk5, chunk5, chunk5, chunk5, chunk4))
+        bwd = sm(bwd_body,
+                 in_specs=(member, chunk5, chunk5, chunk5, chunk5, chunk4,
+                           spec),
+                 out_specs=(spec, spec, spec))
+        return fwd, bwd
 
     # the custom_vjp sits OUTSIDE the shard_maps: jax.grad never transposes
     # through a partial-manual shard_map (which check_vma=False forbids) —
     # forward and backward are each a plain, non-differentiated shard_map
     @jax.custom_vjp
     def ring(q, k, v):
-        return fwd_sm(q, k, v)[0]
+        members = jnp.arange(cp, dtype=jnp.int32)
+        return _maps()[0](members, q, k, v)[0]
 
     def ring_vjp_fwd(q, k, v):
-        out, *res = fwd_sm(q, k, v)
+        members = jnp.arange(cp, dtype=jnp.int32)
+        out, *res = _maps()[0](members, q, k, v)
         return out, tuple(res)
 
     def ring_vjp_bwd(res, do):
-        return bwd_sm(*res, do)
+        members = jnp.arange(cp, dtype=jnp.int32)
+        return _maps()[1](members, *res, do)
 
     ring.defvjp(ring_vjp_fwd, ring_vjp_bwd)
     # partial-manual shard_map only resolves its auto-axes shardings under
-    # jit (the eager path rejects the specs); nested jit is inlined when the
-    # caller is already jitted, so this costs nothing in the train step
-    ring = jax.jit(ring)
+    # jit (the eager path rejects the specs), so every top-level call —
+    # eager OR traced — goes through this jit. ONLY manual-context callers
+    # (the pipeline) bypass it for the raw custom_vjp: this jit's cache must
+    # hold concrete-mesh programs exclusively, never a context-mesh trace
+    ring_eager = jax.jit(ring)
 
     def attention(q, k, v, standard_layout: bool = True, **kwargs):
         if not interpret and (q.shape[1] % (16 * cp) or q.shape[-1] % 64):
@@ -335,6 +360,12 @@ def make_ring_attention(mesh: Mesh, *, axis_name: str = "cp",
             raise ValueError(attention_divisibility_error(
                 batch_axes, head_axis, tp, batch_div, hq, hkv, q.shape[0],
                 "ring attention"))
-        return ring(q, k, v)
+        if _in_manual_context():
+            # nested in the pipeline's manual region — by construction under
+            # the caller's jit already; the raw custom_vjp builds its maps
+            # against the context mesh (the eager jit's cache must never mix
+            # top-level and in-pipeline programs)
+            return ring(q, k, v)
+        return ring_eager(q, k, v)
 
     return attention

@@ -39,6 +39,13 @@ the kernel runs on local batch shards instead of the partitioner's
 gather-and-replicate fallback. Heads arrive pre-sharded as manual megatron
 shards, so the nested wrapper declares only the batch axes
 (``train/step.py`` passes ``head_axis=None`` under pp).
+
+Context parallelism composes the same way: with cp > 1 the Trainer passes
+the ring or Ulysses attention callable, whose cp(+batch)-manual shard_map
+nests inside this region too (cp is auto here). The microbatch sequence
+dim stays cp-sharded through the schedule; embedding, norms, and MLP are
+pointwise over sequence, so only attention pays the cp collectives —
+exactly as outside the pipeline.
 """
 from __future__ import annotations
 
@@ -109,13 +116,13 @@ def make_pipeline_value_and_grad(
     mesh = plan.mesh
     pp = mesh.shape["pp"]
     tp = mesh.shape["tp"]
-    if mesh.shape["cp"] > 1:
-        raise NotImplementedError(
-            "pp x cp is not supported: the ring's cp-manual shard_map cannot "
-            "nest inside the pp-manual pipeline region (the Shardy lowering "
-            "rejects nested manual axes — 'parent bounding this axis as "
-            "manual'). Shard long context over cp x tp x fsdp meshes, or use "
-            "pp without cp.")
+    cp = mesh.shape["cp"]
+    if cp > 1 and not callable(attn_impl):
+        raise ValueError(
+            "pp x cp needs a context-parallel attention callable (ring or "
+            "Ulysses, built by the Trainer from --context-impl); a plain "
+            f"attn_impl={attn_impl!r} would silently gather the cp-sharded "
+            "sequence inside every stage")
     cfg = bundle.config
     mod = _family_module(bundle.family)
     rules = plan.rules
@@ -227,6 +234,23 @@ def make_pipeline_value_and_grad(
         C = M + pp - 1                     # forward (= backward) tick count
         K = min(2 * pp - 1, C)             # saved-input ring-buffer depth
 
+        run_all = cp > 1
+
+        def sync_cond(pred, live, zero):
+            """Stage-divergent dispatch. The dense path ``lax.cond``-skips
+            the dead branch, so bubbles cost idle time, not FLOPs. Under cp
+            the live branch carries collectives (ring ppermutes / Ulysses
+            all-to-alls / GSPMD seq reshards) whose participation set spans
+            pp stages — a pp-divergent cond strands the live stages at the
+            rendezvous (CPU runtime aborts, a pod hangs). So with cp > 1
+            the live branch runs on EVERY member and the caller masks the
+            outputs or cotangents, which is exact: outputs are selected
+            against the cond's zero branch, and gradients are linear in the
+            cotangent, so masked cotangents contribute exact zeros."""
+            if run_all:
+                return live()
+            return jax.lax.cond(pred, live, zero)
+
         act = functools.partial(jnp.zeros, dtype=cfg.dtype)
         buf = act((mb, seq, cfg.hidden_size))        # resident activation
         dy_recv = act((mb, seq, cfg.hidden_size))    # cotangent from downstream
@@ -239,10 +263,9 @@ def make_pipeline_value_and_grad(
         def fwd_tick(t, buf, saved, loss_acc, dy_head, g_nl):
             if t < M:
                 # embedding on stage 0 only; other stages' branch is free
-                x0 = jax.lax.cond(
-                    is_first,
-                    lambda: embed_fn(nl, ids_mb[t], positions),
-                    lambda: act((mb, seq, cfg.hidden_size)))
+                x0 = sync_cond(is_first,
+                               lambda: embed_fn(nl, ids_mb[t], positions),
+                               lambda: act((mb, seq, cfg.hidden_size)))
                 x_in = jnp.where(is_first, x0, buf)
             else:
                 x_in = buf
@@ -252,10 +275,13 @@ def make_pipeline_value_and_grad(
             # masked-SPMD formulation the bubble would otherwise be real
             # FLOPs, not idle time)
             valid_f = (t - s >= 0) & (t - s < M)
-            y, aux_t = jax.lax.cond(
+            y, aux_t = sync_cond(
                 valid_f,
                 lambda: stage_fn(layers, x_in, positions),
                 lambda: (jnp.zeros_like(x_in), jnp.zeros((), jnp.float32)))
+            if run_all:  # the masked-SPMD bubble cost is the price of pp x cp
+                y = jnp.where(valid_f, y, 0)
+                aux_t = jnp.where(valid_f, aux_t, 0)
             if aux_coef:
                 # router aux loss of this stage's layers for its resident
                 # microbatch (t-s). loss_acc is divided by M once at the end,
@@ -286,7 +312,13 @@ def make_pipeline_value_and_grad(
                             jax.tree.map(lambda p: jnp.zeros(p.shape, jnp.float32), nl),
                             act((mb, seq, cfg.hidden_size)))
 
-                mb_loss, g_head, dy = jax.lax.cond(is_last, head_branch, zero_branch)
+                mb_loss, g_head, dy = sync_cond(is_last, head_branch,
+                                                zero_branch)
+                if run_all:  # the seq-dim loss reduction carries cp reshards
+                    mb_loss = jnp.where(is_last, mb_loss, 0)
+                    g_head = jax.tree.map(
+                        lambda a: jnp.where(is_last, a, 0), g_head)
+                    dy = jnp.where(is_last, dy, 0)
                 loss_acc = loss_acc + mb_loss
                 g_nl = jax.tree.map(lambda a, b: a + b / M, g_nl, g_head)
                 dy_head = dy
@@ -316,26 +348,32 @@ def make_pipeline_value_and_grad(
                 # carries 1/tp — the replicated-leaf grad psum in reduce_grad
                 # then reconstructs exactly one copy.
                 daux = jnp.asarray(aux_coef / (M * n_layers * tp), jnp.float32)
+                if run_all:  # sync_cond masking, applied to the COTANGENTS
+                    mask = valid.astype(jnp.float32)
+                    return vjp((dy * mask.astype(dy.dtype), daux * mask))
                 return vjp((dy, daux))
 
             def bwd_skip():  # bubble tick: no recompute, no cotangent
                 return (jax.tree.map(lambda p: jnp.zeros(p.shape, p.dtype),
                                      layers), jnp.zeros_like(x_saved))
 
-            d_layers, dx = jax.lax.cond(valid, bwd_live, bwd_skip)
+            d_layers, dx = sync_cond(valid, bwd_live, bwd_skip)
             g_layers = jax.tree.map(lambda a, b: a + b.astype(jnp.float32),
                                     g_layers, d_layers)
 
             # embedding backward on stage 0 (static microbatch index there)
             m0 = u - (pp - 1)
             if 0 <= m0 < M:
-                def embed_bwd():
+                def embed_bwd(cotangent):
                     _, evjp = jax.vjp(
                         lambda p: embed_fn(p, ids_mb[m0], positions), nl)
-                    return evjp(dx)[0]
+                    return evjp(cotangent)[0]
 
-                g_embed = jax.lax.cond(
-                    is_first, embed_bwd,
+                g_embed = sync_cond(
+                    is_first,
+                    # sync_cond masking, applied to the cotangent
+                    lambda: embed_bwd(jnp.where(is_first, dx, 0)
+                                      if run_all else dx),
                     lambda: jax.tree.map(
                         lambda p: jnp.zeros(p.shape, jnp.float32), nl))
                 g_nl = jax.tree.map(lambda a, b: a + b.astype(jnp.float32),
@@ -384,7 +422,10 @@ def make_pipeline_value_and_grad(
         check_vma=False,
     )
 
-    mb_sharding = NamedSharding(mesh, P(None, plan.data_axes, None))
+    # seq stays cp-sharded through the schedule when cp > 1 (the ring /
+    # Ulysses attention callables re-anchor it at their shard_map boundary)
+    mb_sharding = NamedSharding(
+        mesh, P(None, plan.data_axes, "cp" if cp > 1 else None))
     data_size = plan.data_parallel_size
 
     def value_and_grad(params, batch):

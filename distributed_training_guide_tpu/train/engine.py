@@ -10,7 +10,14 @@ stages to sharding plans:
 
     stage 0 -> ddp, stage 1 -> zero1 (opt state sharded),
     stage 2 -> zero2 (opt state + grads sharded, params replicated),
-    stage 3 -> fsdp (params sharded too)  (+ tensor_parallel)
+    stage 3 -> fsdp (params sharded too)
+
+and covers the WHOLE strategy space beyond the reference's engine:
+``tensor_parallel``, ``pipeline_parallel`` (+ ``pp_microbatches``),
+``context_parallel`` (+ ``context_impl``: "ring"/"ulysses"),
+``expert_parallel``, ``attn_impl``, ``loss_chunks``, and
+``activation_checkpointing`` as a bool or
+``{"enabled": true, "policy": "attn"}`` (a REMAT_POLICIES key).
 
 Eager ``backward()``/``step()`` calls make no sense under XLA — the engine's
 ``train_batch(batch)`` is the whole fused step (what DeepSpeed's pair does,
@@ -63,16 +70,39 @@ class TrainingEngine:
 
         stage = config.get("zero_optimization", {}).get("stage", 0)
         tp = config.get("tensor_parallel", 1)
+        pp = config.get("pipeline_parallel", 1)
+        cp = config.get("context_parallel", 1)
+        ep = config.get("expert_parallel", 1)
         n = len(jax.devices())
-        strategy = _STAGE_TO_STRATEGY[stage]
-        if tp > 1:
-            strategy = "tp_fsdp" if strategy == "fsdp" else "tp"
-        if strategy in ("fsdp", "tp_fsdp"):
-            mesh = make_mesh(tp=tp, fsdp=n // tp)
-        elif strategy == "tp":
-            mesh = make_mesh(tp=tp)
+        if ep > 1 and (tp > 1 or pp > 1):
+            raise ValueError(
+                "expert_parallel composes with data/fsdp axes only (the ep "
+                "plans); drop tensor_parallel/pipeline_parallel or ep")
+        if stage in (1, 2) and (pp > 1 or ep > 1):
+            raise ValueError(
+                "ZeRO stage 1/2 shards optimizer/grad state over the data "
+                "axes of ddp/tp plans; with pipeline_parallel or "
+                "expert_parallel use stage 0 or 3")
+        denom = tp * pp * cp * ep
+        if n % denom:
+            raise ValueError(f"{n} devices not divisible by tensor x "
+                             f"pipeline x context x expert = {denom}")
+        fsdp_like = stage == 3
+        if ep > 1:
+            strategy = "ep_fsdp" if fsdp_like else "ep"
+        elif pp > 1:
+            strategy = ("pp_tp_fsdp" if tp > 1 and fsdp_like
+                        else "pp_tp" if tp > 1
+                        else "pp_fsdp" if fsdp_like else "pp")
+        elif tp > 1:
+            strategy = "tp_fsdp" if fsdp_like else "tp"
         else:
-            mesh = make_mesh()
+            strategy = _STAGE_TO_STRATEGY[stage]
+        mesh_kw = {k: v for k, v in
+                   dict(tp=tp, pp=pp, cp=cp, ep=ep).items() if v > 1}
+        if fsdp_like:
+            mesh_kw["fsdp"] = n // denom
+        mesh = make_mesh(**mesh_kw)
         # ZeRO-1/2 sharding is orthogonal to tp: keep the optimizer-state
         # (and for stage 2 the gradient-buffer) sharding when the strategy
         # string was rewritten for tensor_parallel
@@ -119,12 +149,24 @@ class TrainingEngine:
             raise ValueError(f"unknown optimizer.type {opt_type!r}; "
                              f"use AdamW, Adafactor, or Lion")
 
+        # bool (DeepSpeed-style) or {"enabled": bool, "policy": <REMAT key>}
+        ac = config.get("activation_checkpointing", False)
+        if isinstance(ac, dict):
+            remat, remat_policy = ac.get("enabled", True), ac.get("policy", "all")
+        else:
+            remat, remat_policy = bool(ac), "all"
+
         self.trainer = Trainer(
             bundle=bundle,
             optimizer=optimizer,
             plan=plan,
             grad_accum=config.get("gradient_accumulation_steps", 1),
-            remat=config.get("activation_checkpointing", False),
+            remat=remat,
+            remat_policy=remat_policy,
+            attn_impl=config.get("attn_impl", "auto"),
+            context_impl=config.get("context_impl", "ring"),
+            loss_chunks=config.get("loss_chunks", 0),
+            pp_microbatches=config.get("pp_microbatches"),
             offload_opt_state=config.get("offload_optimizer", False),
         )
         self.state = self.trainer.init_state(config.get("seed", 0))

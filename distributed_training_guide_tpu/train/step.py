@@ -233,17 +233,33 @@ class Trainer:
 
         attn_impl = self.attn_impl
         if self.plan.mesh.shape["cp"] > 1 and not callable(attn_impl):
-            plan_head_axis = ("tp" if self.plan.rules.get("heads") == "tp"
+            # under pp the CP callable runs INSIDE the pp-manual region:
+            # heads arrive pre-sharded as manual megatron shards (declare no
+            # tp axis), and the CP shard_map nests against the context mesh
+            under_pp = self.plan.mesh.shape["pp"] > 1
+            plan_head_axis = ("tp" if not under_pp
+                              and self.plan.rules.get("heads") == "tp"
                               else None)
             if self.context_impl == "ulysses":
                 # all-to-all CP: heads shard over cp (x tp) during
                 # attention, full sequence per device — see
-                # ops/ulysses_attention.py for the ring-vs-ulysses trade
+                # ops/ulysses_attention.py for the ring-vs-ulysses trade.
+                # Inside the pipeline only the shard_map (flash) path can
+                # nest — the xla path's sharding constraints name the
+                # concrete mesh, which a manual region rejects
                 from ..ops.ulysses_attention import make_ulysses_attention
 
+                if under_pp and attn_impl == "xla":
+                    raise ValueError(
+                        "attn_impl='xla' cannot run Ulysses inside the "
+                        "pipeline: the constraint-based xla path names the "
+                        "concrete mesh, which the pp-manual region rejects. "
+                        "Drop --attn-impl (the flash wrapper nests), or use "
+                        "--context-impl ring")
                 attn_impl = make_ulysses_attention(
                     self.plan.mesh, data_axes=self.plan.data_axes,
-                    head_axis=plan_head_axis, impl=attn_impl)
+                    head_axis=plan_head_axis,
+                    impl="flash" if under_pp else attn_impl)
             elif self.context_impl == "ring":
                 # cp carries the ring's ppermutes; batch/head axes are
                 # manual too (local Pallas calls — GSPMD would gather

@@ -106,6 +106,48 @@ def test_engine_optimizer_type_dispatch(eight_devices):
         initialize({"model": "llama-debug", "optimizer": {"type": "SGD"}})
 
 
+def test_engine_full_strategy_space(eight_devices):
+    """The engine config covers pp/cp/ep + context_impl + remat policy, not
+    just ZeRO stage + tp: a pp x tp config must build the pipeline plan and
+    train, and the strategy-derivation guards must fire on bad combos."""
+    from distributed_training_guide_tpu.train.engine import initialize
+
+    engine = initialize({
+        "model": "llama-debug",
+        "zero_optimization": {"stage": 0},
+        "tensor_parallel": 2,
+        "pipeline_parallel": 2,
+        "pp_microbatches": 2,
+        "activation_checkpointing": {"enabled": True, "policy": "attn"},
+        "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
+    })
+    assert engine.trainer.plan.strategy == "pp_tp"
+    assert dict(engine.trainer.plan.mesh.shape)["pp"] == 2
+    assert engine.trainer.remat and engine.trainer.remat_policy == "attn"
+    ids = np.random.RandomState(0).randint(0, 512, (4, 32))
+    batch_sh = engine.trainer.batch_shardings()
+    batch = {k: jax.device_put(ids, batch_sh[k])
+             for k in ("input_ids", "labels")}
+    losses = [engine.train_batch(batch)["loss"] for _ in range(2)]
+    assert np.isfinite(losses).all() and losses[1] < losses[0]
+
+    # cp rides any strategy as a mesh axis + context_impl
+    cp_engine = initialize({"model": "llama-debug", "context_parallel": 2,
+                            "context_impl": "ulysses"})
+    assert dict(cp_engine.trainer.plan.mesh.shape)["cp"] == 2
+    assert cp_engine.trainer.context_impl == "ulysses"
+
+    # ep x tp has no plan; ZeRO-1 x pp has no sharding rules — both must
+    # fail loudly instead of silently dropping an axis
+    with pytest.raises(ValueError, match="expert_parallel"):
+        initialize({"model": "moe-debug", "expert_parallel": 2,
+                    "tensor_parallel": 2})
+    with pytest.raises(ValueError, match="stage"):
+        initialize({"model": "llama-debug",
+                    "zero_optimization": {"stage": 1},
+                    "pipeline_parallel": 2})
+
+
 def test_preflight_budget_and_lowering(eight_devices):
     from distributed_training_guide_tpu.models import get_model
     from distributed_training_guide_tpu.parallel import make_mesh, make_plan

@@ -83,6 +83,53 @@ def test_checkpoint_roundtrip_resharded(tmp_path, eight_devices):
     assert np.isfinite(float(metrics["loss"]))
 
 
+def test_elastic_world_size_resume(tmp_path, eight_devices):
+    """Dynamic world size (reference: torchrun --nnodes=1:4,
+    related-topics/elastic-training/README.md:10-16): train on 8 devices,
+    lose half the pod, resume on 4 — the restart builds its mesh from the
+    live devices, ``abstract_train_state`` targets the NEW shardings, and
+    Orbax re-slices the checkpoint into them. The continued trajectory must
+    match the uninterrupted 8-device run (same global batch), not merely
+    produce finite losses."""
+    bundle = get_model("llama-debug", dtype=jnp.float32)
+    opt = adamw_cosine(1e-3)
+    ids = jnp.asarray(np.random.RandomState(0).randint(0, 512, (8, 16)))
+
+    def step(t, state, n):
+        batch = {k: jax.device_put(ids, t.batch_shardings()[k])
+                 for k in ("input_ids", "labels")}
+        losses = []
+        for _ in range(n):
+            state, m = t.step_fn(state, batch)
+            losses.append(float(m["loss"]))
+        return state, losses
+
+    # golden: 4 uninterrupted steps on the full 8-device mesh
+    tg = Trainer(bundle=bundle, optimizer=opt,
+                 plan=make_plan("fsdp", make_mesh(fsdp=8)), donate=False)
+    _, golden = step(tg, tg.init_state(0), 4)
+
+    # elastic: 2 steps on 8 devices, checkpoint, "lose" 4 devices, resume
+    t8 = Trainer(bundle=bundle, optimizer=opt,
+                 plan=make_plan("fsdp", make_mesh(fsdp=8)), donate=False)
+    state, first = step(t8, t8.init_state(0), 2)
+    io = CheckpointIO(tmp_path / "exp")
+    host = host_state_dict()
+    host["global_step"] = 2
+    io.save(state, host)
+
+    t4 = Trainer(bundle=bundle, optimizer=opt,
+                 plan=make_plan("fsdp",
+                                make_mesh(devices=jax.devices()[:4], fsdp=4)),
+                 donate=False)
+    restored, host2 = io.restore(abstract_train_state(t4))
+    assert host2["global_step"] == 2
+    leaf = jax.tree.leaves(restored.params)[0]
+    assert len(leaf.sharding.mesh.devices.ravel()) == 4  # really resharded
+    _, cont = step(t4, restored, 2)
+    np.testing.assert_allclose(first + cont, golden, rtol=2e-4)
+
+
 def test_async_checkpoint(tmp_path, eight_devices):
     """Async save: state.json publishes only at finalize; an unflushed save
     is invisible (the previous checkpoint stays resumable)."""
