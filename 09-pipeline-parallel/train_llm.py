@@ -50,6 +50,11 @@ def main():
                         help="manual-tp size inside the pipeline shard_map "
                              "(megatron layer shards + vocab-parallel "
                              "embed/head; all model families)")
+    parser.add_argument("--context-parallel", type=int, default=1,
+                        help="cp size alongside pp: long-context attention "
+                             "(--context-impl ring|ulysses, chapter 08) "
+                             "nested inside the pipeline; the schedule runs "
+                             "fully masked (bubble becomes FLOPs)")
     args = parser.parse_args()
     maybe_initialize_distributed()
 
@@ -59,7 +64,8 @@ def main():
                     else "pp_tp" if tp > 1
                     else "pp_fsdp" if fsdp > 1 else "pp")
         return make_plan(strategy,
-                         make_mesh(pp=args.pipeline_parallel, tp=tp, fsdp=fsdp))
+                         make_mesh(pp=args.pipeline_parallel, tp=tp, fsdp=fsdp,
+                                   cp=args.context_parallel))
 
     run_training(args, plan_factory, pp_microbatches=args.pp_microbatches)
 

@@ -232,14 +232,14 @@ class Trainer:
         act_sharding = self.plan.activation_sharding()
 
         attn_impl = self.attn_impl
+        # under pp the attention wrapper runs INSIDE the pp-manual region:
+        # heads arrive pre-sharded as manual megatron shards (declare no tp
+        # axis there), and its shard_map nests against the context mesh —
+        # the one head-sharding policy for the CP and flash branches below
+        under_pp = self.plan.mesh.shape["pp"] > 1
+        plan_head_axis = ("tp" if not under_pp
+                          and self.plan.rules.get("heads") == "tp" else None)
         if self.plan.mesh.shape["cp"] > 1 and not callable(attn_impl):
-            # under pp the CP callable runs INSIDE the pp-manual region:
-            # heads arrive pre-sharded as manual megatron shards (declare no
-            # tp axis), and the CP shard_map nests against the context mesh
-            under_pp = self.plan.mesh.shape["pp"] > 1
-            plan_head_axis = ("tp" if not under_pp
-                              and self.plan.rules.get("heads") == "tp"
-                              else None)
             if self.context_impl == "ulysses":
                 # all-to-all CP: heads shard over cp (x tp) during
                 # attention, full sequence per device — see
@@ -288,11 +288,9 @@ class Trainer:
             # (the dispatcher resolves to the partitionable XLA path).
             from ..ops.flash_attention import make_sharded_flash_attention
 
-            under_pp = self.plan.mesh.shape["pp"] > 1
             wrapped = make_sharded_flash_attention(
                 self.plan.mesh, batch_axes=self.plan.data_axes,
-                head_axis=("tp" if not under_pp
-                           and self.plan.rules.get("heads") == "tp" else None),
+                head_axis=plan_head_axis,
                 forced=attn_impl == "flash")
             if wrapped is not None:
                 attn_impl = wrapped

@@ -160,6 +160,33 @@ def test_pp_composes_with_cp(golden, eight_devices, context_impl):
                                err_msg=context_impl)
 
 
+def test_pp_cp_moe_aux_masking(eight_devices):
+    """MoE under pp x cp pins the fully-masked schedule's router-aux
+    cotangent path (daux * valid-mask): the dense pp x cp test never sets
+    aux_coef > 0, so without this a broken masked-daux scaling would pass
+    the whole suite while aux grads silently drift."""
+    bundle = get_model("moe-debug", dtype=jnp.float32)
+    ids = np.random.RandomState(0).randint(0, 512, (GB, SEQ))
+
+    def run_moe(plan, **kw):
+        t = Trainer(bundle=bundle, optimizer=adamw_cosine(1e-3), plan=plan,
+                    donate=False, **kw)
+        state = t.init_state(0)
+        batch = {k: jax.device_put(jnp.asarray(ids), t.batch_shardings()[k])
+                 for k in ("input_ids", "labels")}
+        losses = []
+        for _ in range(2):
+            state, m = t.step_fn(state, batch)
+            losses.append(float(m["loss"]))
+        return losses
+
+    golden = run_moe(make_plan("single", make_mesh(devices=jax.devices()[:1])),
+                     attn_impl="xla")
+    pp_cp = run_moe(make_plan("pp", make_mesh(pp=2, cp=2)),
+                    pp_microbatches=2, context_impl="ring")
+    np.testing.assert_allclose(pp_cp, golden, rtol=2e-4)
+
+
 def test_pp_gpt2_family(eight_devices):
     # gpt2 exercises tied embeddings + learned position embeddings through
     # the embed/head vjp paths; under pp x tp also the column-sharded fused
