@@ -443,8 +443,12 @@ def make_sharded_flash_attention(
 
     The custom_vjp sits OUTSIDE the two shard_maps, like the ring's: grad
     cannot transpose through a partial-manual shard_map, so forward and
-    backward are each a plain non-differentiated shard_map and the lse/o
-    residuals ride between them with explicit specs.
+    backward are each a plain non-differentiated shard_map. Residuals are
+    the RAW inputs plus the (checkpoint_name-tagged) primal output and lse
+    — nothing residual-only leaves the fwd map, because a shard_map eqn is
+    atomic under jax.checkpoint's partial-eval and rebuilding any such
+    output would re-run the kernel (vjp_bwd re-derives the kernel layouts
+    by transposing outside the map).
     """
     from jax.sharding import PartitionSpec as P
 
@@ -460,7 +464,14 @@ def make_sharded_flash_attention(
     def fwd_body(q, k, v):
         qt, kt, vt = (x.transpose(0, 2, 1, 3) for x in (q, k, v))
         o, lse = _flash_fwd(qt, kt, vt, causal, block_q, block_k, interpret)
-        return o.transpose(0, 2, 1, 3), (qt, kt, vt, o, lse)
+        # ONLY the primal output + lse leave the map: a shard_map eqn is
+        # atomic under jax.checkpoint's partial-eval, so any residual-only
+        # output (the in-map transposes, or a separate kernel-layout o)
+        # would force the whole map — pallas call included — to re-run in
+        # backward just to rebuild values that are a transpose away.
+        # vjp_fwd keeps the raw inputs + tagged outputs as residuals and
+        # vjp_bwd re-transposes outside the map.
+        return o.transpose(0, 2, 1, 3), lse
 
     def bwd_body(qt, kt, vt, o, lse, do):
         dq, dk, dv = _flash_bwd(causal, block_q, block_k, interpret,
@@ -480,7 +491,7 @@ def make_sharded_flash_attention(
         sm = functools.partial(jax.shard_map, mesh=m, axis_names=manual,
                                check_vma=False)
         fwd = sm(fwd_body, in_specs=(spec_bshd,) * 3,
-                 out_specs=(spec_bshd, res_specs))
+                 out_specs=(spec_bshd, spec_bhs))
         bwd = sm(bwd_body, in_specs=(*res_specs, spec_bshd),
                  out_specs=(spec_bshd,) * 3)
         return fwd, bwd
@@ -490,16 +501,21 @@ def make_sharded_flash_attention(
         return _maps()[0](q, k, v)[0]
 
     def vjp_fwd(q, k, v):
-        out, (qt, kt, vt, o, lse) = _maps()[0](q, k, v)
+        out, lse = _maps()[0](q, k, v)
         # same remat tags as the plain path (_flash_vjp_fwd): a
-        # REMAT_POLICIES["attn"] policy keeps the kernel output + lse so
-        # backward never re-runs the forward kernel
-        o = checkpoint_name(o, "flash_out")
+        # REMAT_POLICIES["attn"] policy keeps the attention output + lse so
+        # backward never re-runs the forward kernel. The tag sits on the
+        # PRIMAL output (the kernel-layout residual is a transpose of it,
+        # rebuilt in vjp_bwd) — tagging a residual-only map output instead
+        # would leave `out` unsaved and drag the map into the recompute
+        out = checkpoint_name(out, "flash_out")
         lse = checkpoint_name(lse, "flash_lse")
-        return out, (qt, kt, vt, o, lse)
+        return out, (q, k, v, out, lse)
 
     def vjp_bwd(res, do):
-        return _maps()[1](*res, do)
+        q, k, v, out, lse = res
+        qt, kt, vt, o = (x.transpose(0, 2, 1, 3) for x in (q, k, v, out))
+        return _maps()[1](qt, kt, vt, o, lse, do)
 
     sharded_flash.defvjp(vjp_fwd, vjp_bwd)
     # partial-manual shard_map resolves auto-axis shardings only under jit,

@@ -51,6 +51,7 @@ from typing import Callable
 
 import jax
 import jax.numpy as jnp
+from jax.ad_checkpoint import checkpoint_name
 from jax.sharding import Mesh, PartitionSpec as P
 
 from .flash_attention import _flash_fwd, flash_bwd_with_stats
@@ -192,12 +193,30 @@ def _build_ring(axis_name: str, cp: int, causal: bool, interpret: bool):
 
         out = _from_zigzag(o.astype(q.dtype).transpose(1, 0, 3, 2, 4),
                            idx, axis_name, cp)
-        return out, qz, kz, vz, o, lse
+        # ONLY the primal output + seq-layout lse leave the map (cf. the
+        # sharded-flash wrapper): a shard_map eqn is atomic under
+        # jax.checkpoint's partial-eval, so zigzag-layout residual outputs
+        # would force the whole fwd ring — cp-1 kv rotations and every
+        # flash kernel — to re-run in backward just to rebuild relayouts.
+        # The bwd body re-zigzags from the raw inputs + saved outputs
+        # instead (a few ppermutes), which is what lets the
+        # REMAT_POLICIES["attn"] tags actually skip the fwd ring.
+        lse_seq = _from_zigzag(lse.transpose(1, 0, 3, 2), idx, axis_name, cp)
+        return out, lse_seq
 
-    def ring_bwd_body(member, qz, kz, vz, o, lse, do):
-        in_dtype = qz.dtype
+    def ring_bwd_body(member, q, k, v, out, lse_seq, do):
+        in_dtype = q.dtype
         idx = member[0]
         my_chunks = (idx, 2 * cp - 1 - idx)
+
+        # rebuild the zigzag/kernel layouts the fwd used (cheap ppermutes;
+        # see the fwd-body note on why these are not residuals)
+        qz = _to_zigzag(q, idx, axis_name, cp).transpose(1, 0, 3, 2, 4)
+        kz = _to_zigzag(k, idx, axis_name, cp).transpose(1, 0, 3, 2, 4)
+        vz = _to_zigzag(v, idx, axis_name, cp).transpose(1, 0, 3, 2, 4)
+        o = (_to_zigzag(out, idx, axis_name, cp).transpose(1, 0, 3, 2, 4)
+             .astype(jnp.float32))
+        lse = _to_zigzag(lse_seq, idx, axis_name, cp).transpose(1, 0, 3, 2)
 
         doz = _to_zigzag(do, idx, axis_name, cp).transpose(1, 0, 3, 2, 4)
         doz = doz.astype(jnp.float32)
@@ -285,10 +304,7 @@ def make_ring_attention(mesh: Mesh, *, axis_name: str = "cp",
     manual = manual | {axis_name}
     interpret = jax.default_backend() != "tpu"
     spec = P(b_spec, axis_name, head_axis, None)   # [B, S_loc, H, D]
-    # residual layouts: zigzag chunk tensors; the S_c dim carries the cp
-    # sharding so the residuals round-trip between the fwd and bwd shard_maps
-    chunk5 = P(None, b_spec, head_axis, axis_name, None)  # [2, B, H, S_c, D]
-    chunk4 = P(None, b_spec, head_axis, axis_name)        # [2, B, H, S_c]
+    lse_spec = P(b_spec, axis_name, head_axis)     # [B, S_loc, H]
 
     fwd_body, bwd_body = _build_ring(axis_name, cp, causal, interpret)
 
@@ -307,10 +323,9 @@ def make_ring_attention(mesh: Mesh, *, axis_name: str = "cp",
                                check_vma=False)
         member = P(axis_name)   # [cp] iota -> each member's ring position
         fwd = sm(fwd_body, in_specs=(member, spec, spec, spec),
-                 out_specs=(spec, chunk5, chunk5, chunk5, chunk5, chunk4))
+                 out_specs=(spec, lse_spec))
         bwd = sm(bwd_body,
-                 in_specs=(member, chunk5, chunk5, chunk5, chunk5, chunk4,
-                           spec),
+                 in_specs=(member, spec, spec, spec, spec, lse_spec, spec),
                  out_specs=(spec, spec, spec))
         return fwd, bwd
 
@@ -324,8 +339,12 @@ def make_ring_attention(mesh: Mesh, *, axis_name: str = "cp",
 
     def ring_vjp_fwd(q, k, v):
         members = jnp.arange(cp, dtype=jnp.int32)
-        out, *res = _maps()[0](members, q, k, v)
-        return out, tuple(res)
+        out, lse_seq = _maps()[0](members, q, k, v)
+        # the REMAT_POLICIES["attn"] tags, as in the flash wrappers: with
+        # these saved, backward runs only the bwd ring — never the fwd one
+        out = checkpoint_name(out, "flash_out")
+        lse_seq = checkpoint_name(lse_seq, "flash_lse")
+        return out, (q, k, v, out, lse_seq)
 
     def ring_vjp_bwd(res, do):
         members = jnp.arange(cp, dtype=jnp.int32)

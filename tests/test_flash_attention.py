@@ -178,3 +178,42 @@ def test_attn_remat_policy_through_flash_vjp():
 
     saved, recompute = n_pallas(REMAT_POLICIES["attn"]), n_pallas(REMAT_POLICIES["all"])
     assert saved < recompute, (saved, recompute)
+
+
+def test_attn_remat_policy_through_sharded_wrapper(eight_devices):
+    """Same mechanism pin for the SHARDED wrapper (the multi-chip path): the
+    attn policy must save the tagged output + lse so backward runs 3 pallas
+    calls, not 4. This regressed invisibly before: the fwd shard_map
+    returned residual-only outputs (in-map transposes / kernel-layout o),
+    and since a shard_map eqn is atomic under jax.checkpoint's partial-eval,
+    rebuilding ANY of them re-ran the whole map — kernel included — making
+    the policy silent full-recompute on every sharded mesh."""
+    from jax.sharding import Mesh
+
+    from distributed_training_guide_tpu.ops.flash_attention import (
+        make_sharded_flash_attention)
+    from distributed_training_guide_tpu.train.step import REMAT_POLICIES
+
+    mesh = Mesh(np.array(eight_devices).reshape(8, 1), ("dp", "tp"))
+    attn = make_sharded_flash_attention(mesh, batch_axes=("dp",),
+                                        head_axis=None, forced=True)
+    q, k, v = make_qkv(8, 64, 4, 2, 32, seed=7)
+
+    def f(q, k, v):
+        o = attn(q, k, v)
+        return jnp.sum(o.astype(jnp.float32) ** 2)
+
+    ref = jax.grad(f, argnums=(0, 1, 2))(q, k, v)
+    got = jax.grad(jax.checkpoint(f, policy=REMAT_POLICIES["attn"]),
+                   argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(ref, got):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-5, atol=1e-5)
+
+    def n_pallas(policy):
+        jaxpr = jax.make_jaxpr(
+            jax.grad(jax.checkpoint(f, policy=REMAT_POLICIES[policy])))(q, k, v)
+        return str(jaxpr).count("pallas_call")
+
+    assert n_pallas("attn") < n_pallas("all"), \
+        (n_pallas("attn"), n_pallas("all"))

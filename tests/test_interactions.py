@@ -79,11 +79,34 @@ def test_pp_with_attn_remat_policy(golden, eight_devices):
 
 
 def test_cp_with_attn_remat_policy(golden, eight_devices):
-    """Under context parallelism attention runs the ring custom_vjp (no
-    flash_out tags inside) — the attn policy must degrade gracefully to
-    plain recompute, not crash or change numerics."""
+    """Under context parallelism the ring's vjp_fwd tags its output + lse
+    (flash_out / flash_lse) like the flash wrappers, so the attn policy
+    skips the fwd ring in backward — numerics must match, AND the backward
+    jaxpr must contain fewer pallas calls than full recompute (the fwd
+    ring re-running would double the ring's kernel count)."""
     losses = run("ddp", {"cp": 4}, remat=True, remat_policy="attn")
     np.testing.assert_allclose(losses, golden, rtol=2e-4)
+
+    from distributed_training_guide_tpu.ops.ring_attention import (
+        make_ring_attention)
+    from distributed_training_guide_tpu.parallel import make_mesh
+    from distributed_training_guide_tpu.train.step import REMAT_POLICIES
+
+    ring = make_ring_attention(make_mesh(cp=2, devices=jax.devices()[:2]),
+                               data_axes=("dp",), head_axis=None)
+    ks = jax.random.split(jax.random.key(0), 3)
+    q = jax.random.normal(ks[0], (1, 32, 4, 16), jnp.float32)
+    k = jax.random.normal(ks[1], (1, 32, 2, 16), jnp.float32)
+    v = jax.random.normal(ks[2], (1, 32, 2, 16), jnp.float32)
+
+    def n_pallas(policy):
+        f = jax.checkpoint(
+            lambda q: jnp.sum(ring(q, k, v).astype(jnp.float32) ** 2),
+            policy=REMAT_POLICIES[policy])
+        return str(jax.make_jaxpr(jax.grad(f))(q)).count("pallas_call")
+
+    assert n_pallas("attn") < n_pallas("all"), \
+        (n_pallas("attn"), n_pallas("all"))
 
 
 def test_pp_with_adafactor(eight_devices):
