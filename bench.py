@@ -321,6 +321,14 @@ SWEEP_QUEUE = [
          remat=True, remat_policy="attn", optimizer="adafactor"),
     dict(name="adafactor_b24", model="llama-650m", batch=24, seq=2048,
          remat=True, remat_policy="attn", optimizer="adafactor"),
+    # cross-products: adafactor's freed 5.2 GB can pay for the attn_mlp
+    # policy's bigger saved set at a bigger batch — the likeliest
+    # combination to beat both single-lever results
+    dict(name="adafactor_attnmlp_b16", model="llama-650m", batch=16,
+         seq=2048, remat=True, remat_policy="attn_mlp",
+         optimizer="adafactor"),
+    dict(name="adafactor_attnmlp_b8", model="llama-650m", batch=8, seq=2048,
+         remat=True, remat_policy="attn_mlp", optimizer="adafactor"),
     dict(name="fence4", model="llama-650m", batch=8, seq=2048,
          remat=True, remat_policy="attn", fence_every=4),
     dict(name="lion_b16", model="llama-650m", batch=16, seq=2048,

@@ -128,7 +128,8 @@ def _relation(kv_chunk, q_chunk, causal):
                      jnp.where(kv_chunk < q_chunk, 0, 2))
 
 
-def _build_ring(axis_name: str, cp: int, causal: bool, interpret: bool):
+def _build_ring(axis_name: str, cp: int, causal: bool, interpret: bool,
+                use_scan: bool):
     """Per-shard fwd/bwd ring bodies (flash kernel per chunk pair). The
     custom_vjp pairing them lives OUTSIDE the shard_map (make_ring_attention)
     so shard_map's own transpose machinery is never engaged.
@@ -138,7 +139,40 @@ def _build_ring(axis_name: str, cp: int, causal: bool, interpret: bool):
     inside the pipeline's pp-manual region, Shardy lowers axis_index of an
     auto-queried axis as a manual computation over the *complement* axes —
     which re-binds pp and is rejected ("already bound by a parent"). A
-    sharded iota argument carries the same value with no such lowering."""
+    sharded iota argument carries the same value with no such lowering.
+
+    ``use_scan``: roll the cp hops into one ``lax.scan`` iteration instead
+    of Python-unrolling them. The per-pair relation codes are traced values
+    either way (they derive from the member index), so the two forms are
+    op-for-op identical per hop — the scan form just makes program size and
+    trace/compile time O(1) in cp instead of O(cp), at the cost of one
+    extra (unused) kv rotation on the final hop. ``make_ring_attention``
+    picks scan automatically at large cp."""
+    ring = [(i, (i + 1) % cp) for i in range(cp)]
+
+    def _fwd_pairs(qz, k_blk, v_blk, o, lse, my_chunks, kv_chunks):
+        """The 4 (q-chunk, kv-chunk) flash calls of one hop, merged into
+        the running (o, lse). Future pairs skip inside the cond — merge
+        included — so they issue no work."""
+        for a in range(2):
+            for c in range(2):
+                rel = _relation(kv_chunks[c], my_chunks[a], causal)
+                qa, kc, vc = qz[a], k_blk[c], v_blk[c]
+                o_a, lse_a = o[a], lse[a]
+
+                def live(masked, qa=qa, kc=kc, vc=vc, o_a=o_a, lse_a=lse_a):
+                    o_i, lse_i = _flash_fwd(qa, kc, vc, masked, 512, 512,
+                                            interpret)
+                    return _merge(o_a, lse_a, o_i.astype(jnp.float32), lse_i)
+
+                o_a, lse_a = jax.lax.cond(
+                    rel >= 2, lambda: (o_a, lse_a),
+                    lambda: jax.lax.cond(rel == 1,
+                                         functools.partial(live, True),
+                                         functools.partial(live, False)))
+                o = o.at[a].set(o_a)
+                lse = lse.at[a].set(lse_a)
+        return o, lse
 
     def ring_fwd_body(member, q, k, v):
         idx = member[0]
@@ -159,37 +193,29 @@ def _build_ring(axis_name: str, cp: int, causal: bool, interpret: bool):
         o = jnp.zeros((2, b, hq, s_c, d), jnp.float32)
         lse = jnp.full((2, b, hq, s_c), NEG_INF, jnp.float32)
 
-        ring = [(i, (i + 1) % cp) for i in range(cp)]
-        k_blk, v_blk = kz, vz
-        for i in range(cp):
-            src = (idx - i) % cp
-            if i < cp - 1:
-                k_nxt = jax.lax.ppermute(k_blk, axis_name, ring)
-                v_nxt = jax.lax.ppermute(v_blk, axis_name, ring)
-            kv_chunks = (src, 2 * cp - 1 - src)
-            for a in range(2):
-                for c in range(2):
-                    rel = _relation(kv_chunks[c], my_chunks[a], causal)
-                    qa, kc, vc = qz[a], k_blk[c], v_blk[c]
-                    o_a, lse_a = o[a], lse[a]
+        if use_scan:
+            def hop(carry, i):
+                k_blk, v_blk, o, lse = carry
+                src = (idx - i) % cp
+                o, lse = _fwd_pairs(qz, k_blk, v_blk, o, lse, my_chunks,
+                                    (src, 2 * cp - 1 - src))
+                k_blk = jax.lax.ppermute(k_blk, axis_name, ring)
+                v_blk = jax.lax.ppermute(v_blk, axis_name, ring)
+                return (k_blk, v_blk, o, lse), None
 
-                    # merge runs INSIDE the cond so skipped pairs issue no
-                    # elementwise work either
-                    def live(masked, qa=qa, kc=kc, vc=vc, o_a=o_a, lse_a=lse_a):
-                        o_i, lse_i = _flash_fwd(qa, kc, vc, masked, 512, 512,
-                                                interpret)
-                        return _merge(o_a, lse_a, o_i.astype(jnp.float32),
-                                      lse_i)
-
-                    o_a, lse_a = jax.lax.cond(
-                        rel >= 2, lambda: (o_a, lse_a),
-                        lambda: jax.lax.cond(rel == 1,
-                                             functools.partial(live, True),
-                                             functools.partial(live, False)))
-                    o = o.at[a].set(o_a)
-                    lse = lse.at[a].set(lse_a)
-            if i < cp - 1:
-                k_blk, v_blk = k_nxt, v_nxt
+            (_, _, o, lse), _ = jax.lax.scan(hop, (kz, vz, o, lse),
+                                             jnp.arange(cp))
+        else:
+            k_blk, v_blk = kz, vz
+            for i in range(cp):
+                src = (idx - i) % cp
+                if i < cp - 1:
+                    k_nxt = jax.lax.ppermute(k_blk, axis_name, ring)
+                    v_nxt = jax.lax.ppermute(v_blk, axis_name, ring)
+                o, lse = _fwd_pairs(qz, k_blk, v_blk, o, lse, my_chunks,
+                                    (src, 2 * cp - 1 - src))
+                if i < cp - 1:
+                    k_blk, v_blk = k_nxt, v_nxt
 
         out = _from_zigzag(o.astype(q.dtype).transpose(1, 0, 3, 2, 4),
                            idx, axis_name, cp)
@@ -229,14 +255,9 @@ def _build_ring(axis_name: str, cp: int, causal: bool, interpret: bool):
         dk = jnp.zeros(kz.shape, jnp.float32)
         dv = jnp.zeros(vz.shape, jnp.float32)
 
-        ring_perm = [(i, (i + 1) % cp) for i in range(cp)]
-        k_blk, v_blk = kz, vz
-        for i in range(cp):
-            src = (idx - i) % cp
-            if i < cp - 1:
-                k_nxt = jax.lax.ppermute(k_blk, axis_name, ring_perm)
-                v_nxt = jax.lax.ppermute(v_blk, axis_name, ring_perm)
-            kv_chunks = (src, 2 * cp - 1 - src)
+        def _bwd_pairs(k_blk, v_blk, dq, dk, dv, kv_chunks):
+            """One hop's 4 flash-bwd calls; accumulation runs INSIDE the
+            cond so skipped pairs cost nothing in the backward either."""
             for a in range(2):
                 for c in range(2):
                     rel = _relation(kv_chunks[c], my_chunks[a], causal)
@@ -244,8 +265,6 @@ def _build_ring(axis_name: str, cp: int, causal: bool, interpret: bool):
                     doa, lsea, dta = doz[a], lse[a], delta[a]
                     dq_a, dk_c, dv_c = dq[a], dk[c], dv[c]
 
-                    # accumulation runs INSIDE the cond: skipped pairs cost
-                    # nothing in the backward either
                     def live(masked, qa=qa, kc=kc, vc=vc, doa=doa, lsea=lsea,
                              dta=dta, dq_a=dq_a, dk_c=dk_c, dv_c=dv_c):
                         dq_i, dk_i, dv_i = flash_bwd_with_stats(
@@ -263,12 +282,39 @@ def _build_ring(axis_name: str, cp: int, causal: bool, interpret: bool):
                     dq = dq.at[a].set(dq_a)
                     dk = dk.at[c].set(dk_c)
                     dv = dv.at[c].set(dv_c)
-            # dk/dv travel with their K/V blocks: after the final compute one
-            # more hop completes the cycle and delivers them to their owners
-            dk = jax.lax.ppermute(dk, axis_name, ring_perm)
-            dv = jax.lax.ppermute(dv, axis_name, ring_perm)
-            if i < cp - 1:
-                k_blk, v_blk = k_nxt, v_nxt
+            return dq, dk, dv
+
+        if use_scan:
+            def hop(carry, i):
+                k_blk, v_blk, dq, dk, dv = carry
+                src = (idx - i) % cp
+                dq, dk, dv = _bwd_pairs(k_blk, v_blk, dq, dk, dv,
+                                        (src, 2 * cp - 1 - src))
+                # dk/dv travel with their K/V blocks: after the final
+                # compute one more hop completes the cycle and delivers
+                # them to their owners
+                dk = jax.lax.ppermute(dk, axis_name, ring)
+                dv = jax.lax.ppermute(dv, axis_name, ring)
+                k_blk = jax.lax.ppermute(k_blk, axis_name, ring)
+                v_blk = jax.lax.ppermute(v_blk, axis_name, ring)
+                return (k_blk, v_blk, dq, dk, dv), None
+
+            (_, _, dq, dk, dv), _ = jax.lax.scan(hop, (kz, vz, dq, dk, dv),
+                                                 jnp.arange(cp))
+        else:
+            k_blk, v_blk = kz, vz
+            for i in range(cp):
+                src = (idx - i) % cp
+                if i < cp - 1:
+                    k_nxt = jax.lax.ppermute(k_blk, axis_name, ring)
+                    v_nxt = jax.lax.ppermute(v_blk, axis_name, ring)
+                dq, dk, dv = _bwd_pairs(k_blk, v_blk, dq, dk, dv,
+                                        (src, 2 * cp - 1 - src))
+                # dk/dv travel with their K/V blocks (see the scan form)
+                dk = jax.lax.ppermute(dk, axis_name, ring)
+                dv = jax.lax.ppermute(dv, axis_name, ring)
+                if i < cp - 1:
+                    k_blk, v_blk = k_nxt, v_nxt
 
         def back(x):
             return _from_zigzag(x.astype(in_dtype).transpose(1, 0, 3, 2, 4),
@@ -281,7 +327,8 @@ def _build_ring(axis_name: str, cp: int, causal: bool, interpret: bool):
 
 def make_ring_attention(mesh: Mesh, *, axis_name: str = "cp",
                         data_axes=("dp", "fsdp", "ep"), head_axis: str = "tp",
-                        causal: bool = True) -> Callable:
+                        causal: bool = True,
+                        hop_loop: str = "auto") -> Callable:
     """Returns an attention callable with the ``multihead_attention``
     signature, internally a shard_map ring over ``axis_name``.
 
@@ -306,7 +353,16 @@ def make_ring_attention(mesh: Mesh, *, axis_name: str = "cp",
     spec = P(b_spec, axis_name, head_axis, None)   # [B, S_loc, H, D]
     lse_spec = P(b_spec, axis_name, head_axis)     # [B, S_loc, H]
 
-    fwd_body, bwd_body = _build_ring(axis_name, cp, causal, interpret)
+    if hop_loop not in ("auto", "scan", "unrolled"):
+        raise ValueError(f"hop_loop must be 'auto', 'scan', or 'unrolled'; "
+                         f"got {hop_loop!r}")
+    # program size (and trace/compile time) of the unrolled hops is O(cp) —
+    # measured ~2x per cp doubling (08-context-parallel/README.md). The
+    # scan form is O(1); per hop the two are op-for-op identical, so at
+    # large cp scan is strictly better and 'auto' switches over.
+    use_scan = cp >= 8 if hop_loop == "auto" else hop_loop == "scan"
+    fwd_body, bwd_body = _build_ring(axis_name, cp, causal, interpret,
+                                     use_scan)
 
     def _maps():
         # resolved at TRACE time, like the sharded-flash wrapper: inside the

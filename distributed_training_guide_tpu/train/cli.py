@@ -73,6 +73,11 @@ def get_parser() -> argparse.ArgumentParser:
                              "ring (any head count, any length); ulysses = "
                              "all-to-all head sharding during attention "
                              "(cheaper comms, needs kv_heads %% (cp*tp) == 0)")
+    parser.add_argument("--cp-hop-loop", default="auto",
+                        choices=["auto", "scan", "unrolled"],
+                        help="ring hop-loop form: scan = O(1) program size "
+                             "(auto at cp >= 8), unrolled = O(cp); per hop "
+                             "the two are op-for-op identical")
     parser.add_argument("--max-steps", default=None, type=int)
     parser.add_argument("--native-loader", action="store_true",
                         help="assemble batches with the C++ mmap/prefetch loader (csrc/)")
@@ -142,6 +147,7 @@ def run_training(args, plan_factory: Callable, *, extra_log: Optional[dict] = No
         loss_chunks=args.loss_chunks,
         attn_impl=args.attn_impl,
         context_impl=getattr(args, "context_impl", "ring"),
+        cp_hop_loop=getattr(args, "cp_hop_loop", "auto"),
         offload_opt_state=offload_opt_state,
         offload_params=offload_params,
         pp_microbatches=pp_microbatches,

@@ -165,6 +165,7 @@ class TrainingEngine:
             remat_policy=remat_policy,
             attn_impl=config.get("attn_impl", "auto"),
             context_impl=config.get("context_impl", "ring"),
+            cp_hop_loop=config.get("cp_hop_loop", "auto"),
             loss_chunks=config.get("loss_chunks", 0),
             pp_microbatches=config.get("pp_microbatches"),
             offload_opt_state=config.get("offload_optimizer", False),

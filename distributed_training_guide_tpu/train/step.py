@@ -110,6 +110,7 @@ class Trainer:
     loss_chunks: int = 0  # >0: chunked CE from hidden states (no [B,S,V] logits)
     attn_impl: str = "auto"
     context_impl: str = "ring"  # cp>1 attention: "ring" or "ulysses"
+    cp_hop_loop: str = "auto"  # ring hop loop: "auto"/"scan"/"unrolled"
     loss_fn: Callable = causal_lm_loss
     donate: bool = True
     offload_opt_state: bool = False
@@ -269,7 +270,7 @@ class Trainer:
 
                 attn_impl = make_ring_attention(
                     self.plan.mesh, data_axes=self.plan.data_axes,
-                    head_axis=plan_head_axis)
+                    head_axis=plan_head_axis, hop_loop=self.cp_hop_loop)
             else:
                 raise ValueError(f"unknown context_impl "
                                  f"{self.context_impl!r}; use 'ring' or "
