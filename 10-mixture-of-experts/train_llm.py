@@ -3,9 +3,13 @@
 The reference's parallelism scorecard ends at 2D; expert parallelism is
 "absent entirely" (SURVEY.md §2). This chapter trains a Mixtral-style MoE
 (``models/moe.py``): top-2 router, stacked expert FFNs, Switch-style
-load-balance aux loss — with the expert dim sharded over the ``ep`` mesh axis.
-The GShard dispatch/combine einsums are what GSPMD partitions into the token
-all-to-all; no hand-written collectives anywhere.
+load-balance aux loss — with the expert dim sharded over the ``ep`` mesh
+axis. GSPMD partitions the index-based dispatch scatter and expert einsums
+over ep without replicating buffers or weights (HLO-verified,
+``tests/test_moe.py``); no hand-written collectives anywhere.
+
+``--pretrained`` loads converted HF Mixtral weights (the same streaming
+safetensors->memmap converter as chapter 05; ``models/hf_convert.py``).
 
 Smoke:
     JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
@@ -32,6 +36,9 @@ def main():
                         help="ep size (default: all devices)")
     parser.add_argument("--fsdp", type=int, default=1,
                         help="fsdp size alongside ep")
+    parser.add_argument("--pretrained", default=None,
+                        help="directory produced by convert_hf_checkpoint "
+                             "on an HF Mixtral checkpoint")
     args = parser.parse_args()
     maybe_initialize_distributed()
 
@@ -40,7 +47,7 @@ def main():
         strategy = "ep_fsdp" if args.fsdp > 1 else "ep"
         return make_plan(strategy, make_mesh(ep=ep, fsdp=args.fsdp))
 
-    run_training(args, plan_factory)
+    run_training(args, plan_factory, pretrained_dir=args.pretrained)
 
 
 if __name__ == "__main__":

@@ -16,9 +16,11 @@ materialization and the broadcast:
    all-buffer special case (the reference must hand-broadcast non-persistent
    buffers, ``05:131-139``; we have no buffers outside the pytree).
 
-Name mapping covers the Llama and GPT-2 families (HF ``LlamaForCausalLM`` /
-``GPT2LMHeadModel`` conventions; torch Linear stores [out, in] so most leaves
-transpose, GPT-2's Conv1D stores [in, out] so they don't).
+Name mapping covers the Llama, GPT-2, and MoE families (HF
+``LlamaForCausalLM`` / ``GPT2LMHeadModel`` / ``MixtralForCausalLM``
+conventions; torch Linear stores [out, in] so most leaves transpose,
+GPT-2's Conv1D stores [in, out] so they don't; Mixtral's per-expert
+Linears stack onto the [L, E, ...] expert dim).
 """
 from __future__ import annotations
 
@@ -105,7 +107,30 @@ def _map_gpt2(name: str):
     return None
 
 
-_FAMILY_MAPS: dict[str, Callable] = {"llama": _map_llama, "gpt2": _map_gpt2}
+def _map_mixtral(name: str):
+    """HF ``MixtralForCausalLM`` -> the MoE family layout (models/moe.py).
+    Only the MoE-specific tensors are handled here — per-expert Linears
+    stack onto the [L, E, ...] expert dim via a (layer, expert) index pair
+    (w1=gate, w3=up, w2=down in HF's SwiGLU naming), plus the router
+    Linear. Everything else (attention, norms, embed/head) shares Llama's
+    names and layout, so it delegates to ``_map_llama`` — one copy of the
+    shared table."""
+    m = re.match(r"model\.layers\.(\d+)\.block_sparse_moe\.(.+)", name)
+    if m:
+        idx, rest = int(m.group(1)), m.group(2)
+        e = re.match(r"experts\.(\d+)\.(w[123])\.weight", rest)
+        if e:
+            leaf = {"w1": "layers.moe.gate", "w2": "layers.moe.down",
+                    "w3": "layers.moe.up"}[e.group(2)]
+            return leaf, (idx, int(e.group(1))), True
+        if rest == "gate.weight":
+            return "layers.moe.router", idx, True
+        return None
+    return _map_llama(name)
+
+
+_FAMILY_MAPS: dict[str, Callable] = {"llama": _map_llama, "gpt2": _map_gpt2,
+                                     "moe": _map_mixtral}
 
 
 # ---------------------------------------------------------------------------
@@ -171,7 +196,12 @@ def convert_hf_checkpoint(hf_dir: str | Path, out_dir: str | Path,
                 if transpose:
                     tensor = tensor.T
                 mm = leaf_mm(leaf)
-                target = mm.shape if layer is None else mm.shape[1:]
+                # layer is None (whole leaf), an int (stacked [L, ...]
+                # leaf), or an index tuple (e.g. Mixtral's (layer, expert)
+                # into a [L, E, ...] expert stack)
+                if layer is not None and not isinstance(layer, tuple):
+                    layer = (layer,)
+                target = mm.shape if layer is None else mm.shape[len(layer):]
                 if tensor.shape != tuple(target):
                     # only re-factor TRAILING dims (same data, finer
                     # factoring — e.g. gpt2's fused QKV is [E, 3E] in HF but

@@ -76,3 +76,36 @@ def test_gpt2_parity(tmp_path):
     with torch.no_grad():
         theirs = model(torch.tensor(ids)).logits.float().numpy()
     np.testing.assert_allclose(ours, theirs, rtol=2e-4, atol=2e-4)
+
+
+def test_mixtral_parity(tmp_path):
+    """The MoE family against HF MixtralForCausalLM: same softmax-all ->
+    top-k -> renormalize routing, so with capacity_factor = E (zero
+    capacity drops) the two forwards must agree. Pins the (layer, expert)
+    stacked conversion of the per-expert w1/w2/w3 Linears."""
+    hf_cfg = transformers.MixtralConfig(
+        vocab_size=128, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        num_local_experts=4, num_experts_per_tok=2,
+        max_position_embeddings=256, rope_theta=10000.0, rms_norm_eps=1e-5,
+        sliding_window=None, tie_word_embeddings=False)
+    torch.manual_seed(0)
+    model = transformers.MixtralForCausalLM(hf_cfg).eval()
+    model.save_pretrained(tmp_path / "hf", safe_serialization=True)
+
+    # capacity_factor = num_experts guarantees no token is ever dropped
+    # (worst case: every token routes both choices to one expert), so the
+    # capacity mechanism cannot diverge from HF's dense dispatch
+    bundle = get_model("moe-debug", vocab_size=128, dtype=jnp.float32,
+                       capacity_factor=4.0)
+    convert_hf_checkpoint(tmp_path / "hf", tmp_path / "conv", bundle=bundle)
+    plan = make_plan("single", make_mesh(devices=jax.devices()[:1]))
+    params = load_pretrained(bundle, _replicated_shardings(bundle, plan),
+                             tmp_path / "conv")
+
+    ids = np.random.RandomState(0).randint(0, 128, (2, 24))
+    ours = np.asarray(bundle.apply(bundle.config, params, jnp.asarray(ids),
+                                   attn_impl="xla"))
+    with torch.no_grad():
+        theirs = model(torch.tensor(ids)).logits.float().numpy()
+    np.testing.assert_allclose(ours, theirs, rtol=2e-4, atol=2e-4)
