@@ -37,6 +37,17 @@ def test_run_training_ddp(tmp_path, eight_devices):
     assert out["last_info"]["tokens_per_s"] > 0
 
 
+def test_run_training_profile_trace(tmp_path, eight_devices):
+    """--profile-dir captures a steady-state jax.profiler window (steps
+    10-15, the C22 diagnostics surface) — never exercised by the other
+    smokes, whose max_steps stops before the trace starts."""
+    args = make_args(tmp_path, profile_dir=str(tmp_path / "prof"),
+                     max_steps=15)
+    run_training(args, lambda: make_plan("ddp", make_mesh()))
+    produced = [p for p in (tmp_path / "prof").rglob("*") if p.is_file()]
+    assert produced, "profiler trace directory is empty"
+
+
 def test_run_training_tp_fsdp_with_accum(tmp_path, eight_devices):
     args = make_args(tmp_path, grad_accum=2, batch_size=2,
                      checkpoint_activations=True)

@@ -160,6 +160,16 @@ def test_pp_composes_with_cp(golden, eight_devices, context_impl):
                                err_msg=context_impl)
 
 
+def test_pp_tp_cp_three_axis(golden, eight_devices):
+    """pp x tp x cp on all 8 devices: manual-tp megatron shards + the
+    vocab-parallel head inside the pipeline, the ring's cp-manual shard_map
+    nested under both, fully-masked ticks — the deepest manual-axis
+    composition in the tree. Trajectory must match single-device."""
+    losses, _ = run("pp_tp", {"pp": 2, "tp": 2, "cp": 2}, pp_microbatches=2,
+                    context_impl="ring")
+    np.testing.assert_allclose(losses, golden[0], rtol=2e-4)
+
+
 def test_pp_cp_moe_aux_masking(eight_devices):
     """MoE under pp x cp pins the fully-masked schedule's router-aux
     cotangent path (daux * valid-mask): the dense pp x cp test never sets
