@@ -82,7 +82,8 @@ def _save_last_good(final: dict) -> dict | None:
         "utc": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
         "config": {k: detail[k] for k in
                    ("model", "seq", "global_batch", "step_ms", "remat",
-                    "remat_policy", "optimizer", "n_chips", "device",
+                    "remat_policy", "optimizer", "param_dtype",
+                    "loss_chunks", "fence_every", "n_chips", "device",
                     "steps_timed", "tokens_per_s_per_chip")
                    if k in detail},
     }
@@ -136,7 +137,10 @@ def run_rung(rung: dict) -> None:
 
     devices = jax.devices()
     n = len(devices)
-    bundle = get_model(rung["model"])
+    overrides = {}
+    if rung.get("param_dtype"):  # e.g. "bfloat16": pure-low-precision state
+        overrides["param_dtype"] = getattr(jnp, rung["param_dtype"])
+    bundle = get_model(rung["model"], **overrides)
     cfg = bundle.config
     seq = min(rung["seq"], cfg.max_position_embeddings)
     batch = rung["batch"]
@@ -181,6 +185,8 @@ def run_rung(rung: dict) -> None:
                 "remat": remat,
                 "remat_policy": rung.get("remat_policy", "all"),
                 "optimizer": rung.get("optimizer", "adamw"),
+                **({"param_dtype": rung["param_dtype"]}
+                   if rung.get("param_dtype") else {}),
                 **({"loss_chunks": rung["loss_chunks"]}
                    if rung.get("loss_chunks") else {}),
                 **({"fence_every": rung["fence_every"]}
@@ -329,6 +335,11 @@ SWEEP_QUEUE = [
          optimizer="adafactor"),
     dict(name="adafactor_attnmlp_b8", model="llama-650m", batch=8, seq=2048,
          remat=True, remat_policy="attn_mlp", optimizer="adafactor"),
+    # pure bf16 state (params + Adam moments in bf16): frees ~3.9 GB of the
+    # 650M fp32 state — the deepest memory lever, at a documented numerics
+    # trade (the reference's MixedPrecisionPolicy keeps fp32 shards)
+    dict(name="bf16_params_b16", model="llama-650m", batch=16, seq=2048,
+         remat=True, remat_policy="attn", param_dtype="bfloat16"),
     dict(name="fence4", model="llama-650m", batch=8, seq=2048,
          remat=True, remat_policy="attn", fence_every=4),
     dict(name="lion_b16", model="llama-650m", batch=16, seq=2048,

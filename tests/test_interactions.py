@@ -109,6 +109,25 @@ def test_cp_with_attn_remat_policy(golden, eight_devices):
         (n_pallas("attn"), n_pallas("all"))
 
 
+def test_pp_with_host_offload(golden, eight_devices):
+    """C5 x pp: optimizer state in pinned host memory while the pipeline's
+    hand-differentiated schedule owns the step — the offload wrapper's
+    fetch/update cycle must not perturb the trajectory."""
+    losses = run("pp", {"pp": 2}, pp_microbatches=2, offload_opt_state=True)
+    np.testing.assert_allclose(losses, golden, rtol=2e-4)
+
+
+def test_pp_with_grad_accum_matches_single_device(golden, eight_devices):
+    """C24 x pp against the SINGLE-DEVICE golden (the sibling
+    test_pp_with_grad_accum compares accum=2 vs accum=1 under pp, which
+    would miss a bias common to both): each accum step runs the full 1F1B
+    schedule and the summed-then-averaged grads must reproduce the plain
+    big-batch trajectory exactly."""
+    losses = run("pp", {"pp": 2, "devices": jax.devices()[:4]},
+                 pp_microbatches=2, grad_accum=2)
+    np.testing.assert_allclose(losses, golden, rtol=2e-4)
+
+
 def test_pp_with_adafactor(eight_devices):
     """Optimizer state for pp-sharded layer params follows the generic
     opt-state sharding machinery; adafactor's factored leaves must not
