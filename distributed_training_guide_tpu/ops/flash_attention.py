@@ -362,6 +362,16 @@ def _in_manual_context() -> bool:
         t == jax.sharding.AxisType.Manual for t in m.axis_types)
 
 
+def resolve_wrapper_mesh(mesh):
+    """Mesh an attention wrapper's shard_maps must be built against, resolved
+    at TRACE time: inside another manual region (the pp pipeline) the context
+    AbstractMesh marks pp/tp Manual and shard_map insists on an exact mesh
+    match — nesting works iff the inner maps are built against that context
+    mesh (their own manual axes stay the still-auto ones). At top level the
+    context mesh is empty and the factory's concrete mesh applies."""
+    return jax.sharding.get_abstract_mesh() if _in_manual_context() else mesh
+
+
 def resolve_attention_manual_axes(mesh, batch_axes, head_axis):
     """Shared preamble for the manual-axes attention wrappers (this module's
     sharded flash, ``ring_attention``, and the Ulysses wrapper): keep only
@@ -481,15 +491,8 @@ def make_sharded_flash_attention(
     res_specs = (spec_bhsd, spec_bhsd, spec_bhsd, spec_bhsd, spec_bhs)
 
     def _maps():
-        # resolved at TRACE time: inside another manual region (the pp
-        # pipeline) the context AbstractMesh marks pp/tp Manual and shard_map
-        # insists on an exact mesh match — nesting works iff the inner maps
-        # are built against that context mesh (their own manual axes stay
-        # the auto dp/fsdp ones). At top level the context mesh is empty.
-        m = (jax.sharding.get_abstract_mesh() if _in_manual_context()
-             else mesh)
-        sm = functools.partial(jax.shard_map, mesh=m, axis_names=manual,
-                               check_vma=False)
+        sm = functools.partial(jax.shard_map, mesh=resolve_wrapper_mesh(mesh),
+                               axis_names=manual, check_vma=False)
         fwd = sm(fwd_body, in_specs=(spec_bshd,) * 3,
                  out_specs=(spec_bshd, spec_bhs))
         bwd = sm(bwd_body, in_specs=(*res_specs, spec_bshd),

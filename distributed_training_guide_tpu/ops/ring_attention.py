@@ -343,7 +343,8 @@ def make_ring_attention(mesh: Mesh, *, axis_name: str = "cp",
     manual region — q/k/v here are activations, already projected."""
     from .flash_attention import (_in_manual_context,
                                   attention_divisibility_error,
-                                  resolve_attention_manual_axes)
+                                  resolve_attention_manual_axes,
+                                  resolve_wrapper_mesh)
 
     cp = mesh.shape[axis_name]
     batch_axes, head_axis, tp, batch_div, b_spec, manual = \
@@ -365,18 +366,11 @@ def make_ring_attention(mesh: Mesh, *, axis_name: str = "cp",
                                      use_scan)
 
     def _maps():
-        # resolved at TRACE time, like the sharded-flash wrapper: inside the
-        # pipeline's pp-manual region the context AbstractMesh marks pp/tp
-        # Manual and shard_map insists on an exact mesh match — the ring
-        # nests there iff built against that context mesh (its own manual
-        # axes, cp + batch, are still auto in the pp region)
-        m = (jax.sharding.get_abstract_mesh() if _in_manual_context()
-             else mesh)
         # check_vma=False: pallas interpret mode (the CPU test path) trips
         # the vma checker inside its own lowering ("dynamic_slice requires
         # varying manual axes to match")
-        sm = functools.partial(jax.shard_map, mesh=m, axis_names=manual,
-                               check_vma=False)
+        sm = functools.partial(jax.shard_map, mesh=resolve_wrapper_mesh(mesh),
+                               axis_names=manual, check_vma=False)
         member = P(axis_name)   # [cp] iota -> each member's ring position
         fwd = sm(fwd_body, in_specs=(member, spec, spec, spec),
                  out_specs=(spec, lse_spec))
