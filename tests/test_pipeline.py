@@ -13,8 +13,8 @@ SEQ = 32
 
 
 def run(strategy, mesh_kw, pp_microbatches=None, steps=2, n_devices=None,
-        **trainer_kw):
-    bundle = get_model("llama-debug", dtype=jnp.float32)
+        bundle=None, **trainer_kw):
+    bundle = bundle or get_model("llama-debug", dtype=jnp.float32)
     if strategy == "single":
         mesh = make_mesh(devices=jax.devices()[:1])
     else:
@@ -195,6 +195,20 @@ def test_pp_cp_moe_aux_masking(eight_devices):
     pp_cp = run_moe(make_plan("pp", make_mesh(pp=2, cp=2)),
                     pp_microbatches=2, context_impl="ring")
     np.testing.assert_allclose(pp_cp, golden, rtol=2e-4)
+
+
+def test_pp_four_stages(eight_devices):
+    """pp=4 (all other pp tests run pp=2): exercises the non-degenerate
+    saved-input ring buffer (K = 2pp-1 = 7 > C at small M is clamped),
+    longer fill/drain bubbles, and 3-hop ppermute chains — both alone and
+    with the cp-masked schedule nested inside."""
+    bundle4 = get_model("llama-debug", dtype=jnp.float32, num_layers=4)
+    golden4, _ = run("single", {}, bundle=bundle4)
+    losses, _ = run("pp", {"pp": 4}, pp_microbatches=4, bundle=bundle4)
+    np.testing.assert_allclose(losses, golden4, rtol=2e-4)
+    losses, _ = run("pp", {"pp": 4, "cp": 2}, pp_microbatches=4,
+                    bundle=bundle4, context_impl="ring")
+    np.testing.assert_allclose(losses, golden4, rtol=2e-4)
 
 
 def test_pp_gpt2_family(eight_devices):
