@@ -140,6 +140,8 @@ def run_rung(rung: dict) -> None:
     overrides = {}
     if rung.get("param_dtype"):  # e.g. "bfloat16": pure-low-precision state
         overrides["param_dtype"] = getattr(jnp, rung["param_dtype"])
+    if rung.get("max_position"):  # raise the RoPE table past the preset's
+        overrides["max_position_embeddings"] = rung["max_position"]
     bundle = get_model(rung["model"], **overrides)
     cfg = bundle.config
     seq = min(rung["seq"], cfg.max_position_embeddings)
@@ -346,6 +348,16 @@ SWEEP_QUEUE = [
          remat=True, remat_policy="attn", optimizer="lion"),
     dict(name="loss_chunks8", model="llama-650m", batch=8, seq=2048,
          remat=True, remat_policy="attn", loss_chunks=8),
+    # long-context single-chip rungs: the flash kernel's O(S) memory is the
+    # whole story at seq 8k (the 2026-07-29 sweep measured 47.5% at 4096/b4).
+    # max_position raises llama-650m's RoPE table past its 4096 preset —
+    # without it run_rung's seq = min(seq, max_position_embeddings) clamp
+    # would silently re-measure 4096 under an 8k name
+    dict(name="seq8k_b2", model="llama-650m", batch=2, seq=8192,
+         max_position=8192, remat=True, remat_policy="attn"),
+    dict(name="seq8k_adafactor_b4", model="llama-650m", batch=4, seq=8192,
+         max_position=8192, remat=True, remat_policy="attn",
+         optimizer="adafactor"),
     dict(name="tinyllama_adafactor_lc8", model="tinyllama-1.1b", batch=8,
          seq=2048, remat=True, remat_policy="attn", optimizer="adafactor",
          loss_chunks=8),
