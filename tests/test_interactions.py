@@ -13,8 +13,8 @@ GB, SEQ = 8, 32
 
 
 def run(strategy, mesh_kw, steps=2, sequence_sharded=None, gb=GB,
-        optimizer=None, **trainer_kw):
-    bundle = get_model("llama-debug", dtype=jnp.float32)
+        optimizer=None, model="llama-debug", **trainer_kw):
+    bundle = get_model(model, dtype=jnp.float32)
     mesh = (make_mesh(devices=jax.devices()[:1]) if strategy == "single"
             else make_mesh(**mesh_kw))
     plan = make_plan(strategy, mesh, sequence_sharded=sequence_sharded)
@@ -63,6 +63,25 @@ def test_pp_with_grad_accum(eight_devices):
 def test_cp_with_remat_and_chunked_loss(golden, eight_devices):
     losses = run("ddp", {"cp": 4}, remat=True, loss_chunks=4)
     np.testing.assert_allclose(losses, golden, rtol=2e-4)
+
+
+def test_zero2_with_cp(golden, eight_devices):
+    """ZeRO-2 (grads + opt state sharded over the data axes) under context
+    parallelism: cp is NOT a data axis, so the reduce-scattered grad-accum
+    buffer must coexist with the ring's cp-manual attention. grad_accum=2
+    engages the buffer — at accum=1 the zero2 path is ZeRO-1-equivalent
+    and the reduce-scatter never runs."""
+    losses = run("zero2", {"cp": 2}, grad_accum=2)
+    np.testing.assert_allclose(losses, golden, rtol=2e-4)
+
+
+def test_ep_with_cp(eight_devices):
+    """Expert parallelism x context parallelism: ep shards experts, cp
+    shards the sequence through the ring, and the MoE router sees the full
+    (cp-gathered-at-dispatch) token set identically on every member."""
+    g = run("single", {}, model="moe-debug", attn_impl="xla")
+    got = run("ep", {"ep": 2, "cp": 2}, model="moe-debug", attn_impl="xla")
+    np.testing.assert_allclose(got, g, rtol=2e-4)
 
 
 def test_pp_with_attn_remat_policy(golden, eight_devices):
