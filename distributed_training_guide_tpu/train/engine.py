@@ -111,8 +111,8 @@ class TrainingEngine:
 
         opt_type = config.get("optimizer", {}).get("type", "AdamW").lower()
         opt_cfg = config.get("optimizer", {}).get("params", {})
-        known = {"adamw": {"lr", "betas", "weight_decay"},
-                 "adam": {"lr", "betas", "weight_decay"},
+        known = {"adamw": {"lr", "betas", "eps", "weight_decay"},
+                 "adam": {"lr", "betas", "eps", "weight_decay"},
                  "adafactor": {"lr", "weight_decay"},
                  "lion": {"lr", "betas", "weight_decay"}}.get(opt_type)
         unknown = set(opt_cfg) - known if known is not None else set()
@@ -136,6 +136,7 @@ class TrainingEngine:
                 opt_cfg.get("lr", 3e-5),
                 b1=opt_cfg.get("betas", [0.9, 0.999])[0],
                 b2=opt_cfg.get("betas", [0.9, 0.999])[1],
+                eps=opt_cfg.get("eps", 1e-8),
                 **common)
         elif opt_type == "adafactor":
             optimizer = adafactor_cosine(opt_cfg.get("lr", 3e-5), **common)

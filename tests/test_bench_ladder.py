@@ -26,12 +26,21 @@ def _mfu(value, steps=10, partial=False, **detail):
     return out
 
 
+_real_git_head = bench._git_head
+_real_commit_in_history = bench._commit_in_history
+
+
 @pytest.fixture(autouse=True)
 def _isolated_caches(tmp_path, monkeypatch):
     """Keep test runs away from the REAL evidence cache (.bench_last_good.json
-    holds the measured headline; a fake 0.52 must never clobber it)."""
+    holds the measured headline; a fake 0.52 must never clobber it). The git
+    provenance helpers are stubbed: they shell out to git, whose subprocess
+    wait loop calls the time.sleep these tests monkeypatch to count probe
+    gating."""
     monkeypatch.setattr(bench, "LAST_GOOD_PATH", str(tmp_path / "last_good.json"))
     monkeypatch.setattr(bench, "SWEEP_LOG_PATH", str(tmp_path / "sweep.jsonl"))
+    monkeypatch.setattr(bench, "_git_head", lambda: "f" * 40)
+    monkeypatch.setattr(bench, "_commit_in_history", lambda c: c == "f" * 40)
 
 
 class FakeChildren:
@@ -187,6 +196,7 @@ def test_outage_zero_carries_last_good_evidence(monkeypatch, capsys):
     the official record is never evidence-free."""
     seeded = {"value": 0.505, "unit": "fraction_of_peak_bf16", "ts": 1.0,
               "utc": "2026-07-29T14:20:00Z",
+              "git_commit": bench._git_head(),
               "config": {"model": "llama-650m", "step_ms": 695.0}}
     with open(bench.LAST_GOOD_PATH, "w") as f:
         json.dump(seeded, f)
@@ -212,6 +222,115 @@ def test_success_persists_last_good_and_never_degrades(monkeypatch, capsys):
     fake = FakeChildren([([_mfu(0.52)], "ok"), ([_mfu(0.48)], "ok")])
     _run_main(monkeypatch, capsys, fake)
     assert bench._load_last_good()["value"] == 0.52
+
+
+def test_last_good_provenance_gates_attachment(monkeypatch):
+    """ADVICE r3 (medium): the evidence cache must not resurface in a tree or
+    on hardware it was not measured in. Unstamped legacy records, records
+    stamped with a commit outside this tree's history, and records from a
+    different device kind all fail closed; a matching record attaches."""
+    base = {"value": 0.505, "unit": "fraction_of_peak_bf16", "ts": 1.0,
+            "config": {"model": "llama-650m", "device": "TPU v5 lite"}}
+    zero = lambda: {"metric": "mfu", "value": 0.0, "detail": {}}
+
+    def seed(**overrides):
+        with open(bench.LAST_GOOD_PATH, "w") as f:
+            json.dump({**base, **overrides}, f)
+
+    seed()  # legacy: no git_commit at all
+    assert "last_good" not in bench._attach_last_good(zero())["detail"]
+    seed(git_commit="0" * 40)  # commit not in this tree's history
+    assert "last_good" not in bench._attach_last_good(zero())["detail"]
+    seed(git_commit=bench._git_head())
+    assert bench._attach_last_good(zero())["detail"]["last_good"]["value"] == 0.505
+    # same valid commit, but the current line ran on different hardware
+    out = {"metric": "mfu", "value": 0.1, "detail": {"device": "H100"}}
+    assert "last_good" not in bench._attach_last_good(out)["detail"]
+    # ...and on matching hardware it attaches
+    out = {"metric": "mfu", "value": 0.1, "detail": {"device": "TPU v5 lite"}}
+    assert bench._attach_last_good(out)["detail"]["last_good"]["value"] == 0.505
+
+
+def test_foreign_commit_cache_is_displaced_not_wedged():
+    """A record stamped with a commit outside this tree's history could never
+    attach anywhere here — it must not block legitimate new saves."""
+    with open(bench.LAST_GOOD_PATH, "w") as f:
+        json.dump({"value": 0.505, "git_commit": "0" * 40,
+                   "config": {"model": "llama-650m"}}, f)
+    rec = bench._save_last_good(_mfu(0.35, model="llama-650m",
+                                     device="TPU v5 lite"))
+    assert rec["value"] == 0.35           # displaced the unattachable 0.505
+    assert bench._load_last_good()["value"] == 0.35
+    # a VALID higher cache still wins over a lower new result
+    rec2 = bench._save_last_good(_mfu(0.30, device="TPU v5 lite"))
+    assert rec2["value"] == 0.35
+
+
+def test_other_hardware_run_never_touches_the_headline_cache():
+    """A valid-commit record from different hardware is still the evidence
+    for the driver's TPU bench: a CPU dev-box run must neither destroy it
+    (even with a tiny value) nor overwrite it (even with a bigger one)."""
+    with open(bench.LAST_GOOD_PATH, "w") as f:
+        json.dump({"value": 0.505, "git_commit": bench._git_head(),
+                   "config": {"device": "TPU v5 lite"}}, f)
+    for value in (0.0008, 0.9):
+        rec = bench._save_last_good(_mfu(value, device="cpu"))
+        assert rec["value"] == 0.505
+        assert bench._load_last_good()["config"]["device"] == "TPU v5 lite"
+
+
+def test_git_helpers_against_real_repo():
+    """The unstubbed helpers: HEAD resolves to a 40-hex commit that is in its
+    own history; an all-zeros hash is not."""
+    head = _real_git_head()
+    assert head and len(head) == 40
+    assert _real_commit_in_history(head)
+    assert not _real_commit_in_history("0" * 40)
+
+
+def test_save_last_good_stamps_commit_and_rejects_partial(monkeypatch):
+    """ADVICE r3 (low): a mid-kill partial measurement must never become the
+    persisted best-evidence record; complete saves are stamped with HEAD."""
+    rec = bench._save_last_good(_mfu(0.44, model="llama-650m"))
+    assert rec["value"] == 0.44 and rec["git_commit"] == bench._git_head()
+    assert bench._save_last_good(_mfu(0.60, partial=True))["value"] == 0.44
+    assert bench._load_last_good()["value"] == 0.44
+
+
+def test_watchdog_never_persists_partial_best(monkeypatch):
+    """The watchdog emission path strips the partial flag for the final line;
+    the strip must happen AFTER the persistence decision."""
+    import threading
+    # monkeypatch (not bare assignment) so the fakes are restored even when
+    # an assertion fails — _Best is module-global state shared across tests
+    monkeypatch.setattr(bench._Best, "result", dict(_mfu(0.58, steps=2,
+                                                         partial=True)))
+    monkeypatch.setattr(bench._Best, "emitted", False)
+    monkeypatch.setattr(bench._Best, "ladder", [])
+    emitted = []
+
+    class _Exit(Exception):
+        pass
+
+    def fake_exit(code):   # stop on_timeout like the real _exit would
+        raise _Exit(code)
+
+    monkeypatch.setattr(bench.os, "_exit", fake_exit)
+    monkeypatch.setattr(bench, "_emit", emitted.append)
+    captured = {}
+
+    def fake_timer(seconds, fn):
+        captured["fn"] = fn
+        return type("T", (), {"daemon": True, "start": lambda self: None})()
+
+    monkeypatch.setattr(threading, "Timer", fake_timer)
+    bench._install_parent_watchdog(0.0)
+    with pytest.raises(_Exit):
+        captured["fn"]()   # fire the watchdog synchronously
+    assert bench._load_last_good() is None     # partial never persisted
+    assert len(emitted) == 1                   # only the best-result line
+    assert "partial" not in emitted[0]         # ...emitted with the flag stripped
+    assert emitted[0]["value"] == 0.58
 
 
 def test_sweep_is_probe_gated_and_resumable(monkeypatch, capsys):

@@ -115,6 +115,18 @@ def test_engine_optimizer_type_dispatch(eight_devices):
     assert "ScaleByLionState" in lion_names
     with pytest.raises(ValueError, match="optimizer.type"):
         initialize({"model": "llama-debug", "optimizer": {"type": "SGD"}})
+    # 'eps' is in virtually every DeepSpeed-ported AdamW config (ADVICE r3):
+    # it must load — and actually reach optax — not hard-error as unknown
+    eps_engine = initialize({"model": "llama-debug",
+                             "optimizer": {"type": "AdamW",
+                                           "params": {"lr": 1e-3,
+                                                      "eps": 1e-6}}})
+    assert eps_engine is not None
+    # ...but eps stays rejected for optimizers that have no such knob
+    with pytest.raises(ValueError, match="eps"):
+        initialize({"model": "llama-debug",
+                    "optimizer": {"type": "Lion",
+                                  "params": {"lr": 1e-4, "eps": 1e-6}}})
 
 
 def test_engine_full_strategy_space(tmp_path, eight_devices):
