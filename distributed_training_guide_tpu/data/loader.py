@@ -71,16 +71,60 @@ class ShardedBatchLoader:
             np.random.RandomState(self.seed + 1000003 * self.epoch).shuffle(order)
         return order
 
-    def _make_global_array(self, np_batch: np.ndarray) -> jax.Array:
+    def _leading_shape(self) -> tuple:
         if self.grad_accum > 1:
-            b = self.global_batch_size // self.grad_accum
-            np_batch = np_batch.reshape(self.grad_accum, b, np_batch.shape[-1])
+            return (self.grad_accum, self.global_batch_size // self.grad_accum)
+        return (self.global_batch_size,)
+
+    def _make_global_array(self, np_batch: np.ndarray) -> jax.Array:
+        """Global array from an already-assembled host batch (the native
+        path: the C++ loader hands back the full batch by contract)."""
+        np_batch = np_batch.reshape(self._leading_shape() + np_batch.shape[-1:])
         return jax.make_array_from_callback(
             np_batch.shape, self.sharding, lambda idx: np_batch[idx])
 
+    def _assemble_batch(self, idx: np.ndarray) -> jax.Array:
+        """Global array materializing ONLY the rows this process's devices
+        own (reference C26, ``related-topics/optimizing-data-loading/
+        README.md:24-102``): the callback fancy-indexes the — possibly
+        disk-backed — dataset per addressable shard, so per-host RAM is the
+        local share of each batch, never the global batch (and never the
+        corpus, when the dataset is a memmap)."""
+        # sorted for memmap read locality only: which sequences form the
+        # batch is shuffled (the caller's epoch order); their within-batch
+        # order is deliberately left ascending — example->device-slot
+        # assignment carries no semantics (grads sum over the batch)
+        idx_nd = np.sort(idx).reshape(self._leading_shape())
+        seq = self.dataset.shape[1]
+
+        def fetch(shard_index):
+            sel = idx_nd[shard_index[:-1]]
+            rows = np.asarray(self.dataset[sel.ravel()], dtype=np.int32)
+            return rows.reshape(sel.shape + (seq,))[..., shard_index[-1]]
+
+        return jax.make_array_from_callback(
+            idx_nd.shape + (seq,), self.sharding, fetch)
+
+    def _native_compatible_backing(self):
+        """Path of the dataset's own backing file when the C++ loader can
+        mmap it directly (raw int32 token-file layout covering the whole
+        file) — the zero-copy path; None forces a temp-file copy."""
+        import os
+
+        ds = self.dataset
+        filename = getattr(ds, "filename", None)
+        if (isinstance(ds, np.memmap) and filename
+                and ds.dtype == np.int32 and ds.flags["C_CONTIGUOUS"]
+                and getattr(ds, "offset", 1) == 0
+                and ds.size * 4 == os.path.getsize(filename)):
+            return filename
+        return None
+
     def _make_native(self):
         """Back batch assembly with the C++ loader (csrc/token_loader.cpp):
-        mmap + worker threads + bounded prefetch, no GIL."""
+        mmap + worker threads + bounded prefetch, no GIL. A memmap dataset in
+        the raw token-file layout (``--mmap-data``) is mmap'd IN PLACE — no
+        second on-disk copy of the corpus (reference C26)."""
         import tempfile
 
         from .native_loader import NativeTokenLoader, native_available, write_token_file
@@ -91,11 +135,14 @@ class ShardedBatchLoader:
             logging.getLogger(__name__).warning(
                 "native loader unavailable (no g++); using python assembly")
             return None
-        tmp = tempfile.NamedTemporaryFile(suffix=".tokens.bin", delete=False)
-        tmp.close()  # the C++ side reopens by path; don't leak the fd
-        self._native_path = tmp.name
-        write_token_file(self.dataset, tmp.name)
-        return NativeTokenLoader(tmp.name, seq_len=self.dataset.shape[1],
+        path = self._native_compatible_backing()
+        if path is None:
+            tmp = tempfile.NamedTemporaryFile(suffix=".tokens.bin", delete=False)
+            tmp.close()  # the C++ side reopens by path; don't leak the fd
+            self._native_path = tmp.name   # ours: unlinked on close()
+            write_token_file(self.dataset, tmp.name)
+            path = tmp.name
+        return NativeTokenLoader(path, seq_len=self.dataset.shape[1],
                                  batch=self.global_batch_size, seed=self.seed,
                                  prefetch=max(self.prefetch, 2))
 
@@ -137,12 +184,7 @@ class ShardedBatchLoader:
         pending: list[dict] = []
         for step in range(start_step, n):
             idx = order[step * self.global_batch_size:(step + 1) * self.global_batch_size]
-            # sorted for memmap read locality only: which sequences form the
-            # batch is shuffled (order above); their within-batch order is
-            # deliberately left ascending — example->device-slot assignment
-            # carries no semantics in this loop (grads sum over the batch)
-            np_batch = self.dataset[np.sort(idx)]
-            ids = self._make_global_array(np_batch)
+            ids = self._assemble_batch(idx)
             pending.append({"input_ids": ids, "labels": ids})
             if len(pending) > self.prefetch:
                 yield pending.pop(0)

@@ -17,6 +17,7 @@ from __future__ import annotations
 
 import json
 import logging
+import re
 from pathlib import Path
 
 import numpy as np
@@ -76,6 +77,32 @@ def _from_hf(dataset_name: str, subset, tokenizer, seq_length: int) -> np.ndarra
     return _chunk(stream, seq_length)
 
 
+def _spill_to_memmap(arr: np.ndarray, mmap_dir: str | Path,
+                     cache_key: str) -> np.ndarray:
+    """Write the corpus once as a raw int32 token file and hand back a
+    read-only memmap view: training-time host RAM holds only the batch rows
+    actually fetched (data/loader.py fetches per addressable shard), and the
+    native loader mmaps this same file zero-copy. The raw layout (no .npy
+    header) is deliberate — it is csrc/token_loader.cpp's format."""
+    import os
+
+    mmap_dir = Path(mmap_dir)
+    mmap_dir.mkdir(parents=True, exist_ok=True)
+    path = mmap_dir / f"{cache_key}.tokens.bin"
+    expect = arr.size * 4
+    if not path.exists() or path.stat().st_size != expect:
+        # pid-unique tmp: concurrent writers (a gang's ranks, or hosts on
+        # shared storage) each complete their own atomic replace of the
+        # SAME deterministic content — duplicated work, never a torn file.
+        # For corpora big enough for that duplication to hurt, wrap the
+        # call in procguards.process0_first().
+        tmp = path.with_name(f"{path.name}.{os.getpid()}.tmp")
+        np.ascontiguousarray(arr, dtype=np.int32).tofile(tmp)
+        tmp.replace(path)   # atomic: a crashed writer never leaves a torso
+        LOGGER.info(f"spilled corpus to {path} ({expect >> 20} MiB)")
+    return np.memmap(path, dtype=np.int32, mode="r", shape=arr.shape)
+
+
 def load_and_preprocess_data(
     dataset_name: str,
     tokenizer,
@@ -84,8 +111,14 @@ def load_and_preprocess_data(
     dataset_subset: str | None = None,
     max_position_embeddings: int | None = None,
     seed: int = 0,
+    mmap_dir: str | Path | None = None,
 ) -> np.ndarray:
-    """Returns [num_sequences, seq_length] int32."""
+    """Returns [num_sequences, seq_length] int32.
+
+    With ``mmap_dir`` the token array is disk-backed (built once, reused
+    across runs keyed on dataset/seq/seed): each host's RAM then holds only
+    the batch-shard rows its devices consume, not the corpus — the footprint
+    VERDICT r3 flagged for the 405B recipe's data path."""
     if max_position_embeddings:
         # clamp to what the model can attend to (cf. 01-single-gpu/train_llm.py:216-218)
         seq_length = min(seq_length, max_position_embeddings)
@@ -95,10 +128,25 @@ def load_and_preprocess_data(
         if ":" in dataset_name:
             n_tokens = int(dataset_name.split(":", 1)[1])
         vocab = getattr(tokenizer, "vocab_size", 259)
-        return synthetic_dataset(n_tokens, vocab, seq_length, seed)
+        data = synthetic_dataset(n_tokens, vocab, seq_length, seed)
+    else:
+        path = Path(dataset_name)
+        if path.exists():
+            data = _from_local_file(path, tokenizer, seq_length)
+        else:
+            data = _from_hf(dataset_name, dataset_subset, tokenizer, seq_length)
 
-    path = Path(dataset_name)
-    if path.exists():
-        return _from_local_file(path, tokenizer, seq_length)
-
-    return _from_hf(dataset_name, dataset_subset, tokenizer, seq_length)
+    if mmap_dir is not None:
+        # the key must pin everything that changes token CONTENT — subset
+        # and tokenizer identity included, since num_sequences (and thus
+        # file size, the only other staleness check) can collide across
+        # corpora at the same seq_length
+        if tokenizer is None:
+            tok_id = "none"
+        else:
+            tok_id = getattr(tokenizer, "name_or_path", None) or type(tokenizer).__name__
+        key = re.sub(r"[^A-Za-z0-9._-]+", "_",
+                     f"{dataset_name}-{dataset_subset or ''}-{tok_id}"
+                     f"-s{seq_length}-r{seed}")
+        data = _spill_to_memmap(data, mmap_dir, key)
+    return data

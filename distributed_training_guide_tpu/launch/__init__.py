@@ -1,3 +1,4 @@
 from .distributed import maybe_initialize_distributed
+from .local import launch_gang
 
-__all__ = ["maybe_initialize_distributed"]
+__all__ = ["maybe_initialize_distributed", "launch_gang"]

@@ -20,7 +20,11 @@ Name mapping covers the Llama, GPT-2, and MoE families (HF
 ``LlamaForCausalLM`` / ``GPT2LMHeadModel`` / ``MixtralForCausalLM``
 conventions; torch Linear stores [out, in] so most leaves transpose,
 GPT-2's Conv1D stores [in, out] so they don't; Mixtral's per-expert
-Linears stack onto the [L, E, ...] expert dim).
+Linears stack onto the [L, E, ...] expert dim). Mistral and Qwen2 dense
+checkpoints ride the Llama map unchanged — Mistral shares the tensor
+names exactly, Qwen2 adds the QKV bias rows (narrowing the reference's
+``AutoModelForCausalLM`` any-architecture surface,
+``01-single-gpu/train_llm.py:57``, one real family at a time).
 """
 from __future__ import annotations
 
@@ -56,6 +60,10 @@ def _map_llama(name: str):
             "mlp.down_proj.weight": ("layers.mlp.down", True),
             "input_layernorm.weight": ("layers.input_norm", False),
             "post_attention_layernorm.weight": ("layers.post_attn_norm", False),
+            # Qwen2-style QKV biases (absent in Llama/Mistral checkpoints)
+            "self_attn.q_proj.bias": ("layers.attn.bq", False),
+            "self_attn.k_proj.bias": ("layers.attn.bk", False),
+            "self_attn.v_proj.bias": ("layers.attn.bv", False),
         }
         if rest in table:
             leaf, t = table[rest]

@@ -48,6 +48,7 @@ class LlamaConfig:
     rope_theta: float = 10000.0
     rms_norm_eps: float = 1e-5
     tie_word_embeddings: bool = False
+    attn_bias: bool = False         # QKV projection biases (Qwen2-style)
     dtype: Any = jnp.bfloat16       # activation/compute dtype
     param_dtype: Any = jnp.float32  # storage dtype
 
@@ -60,6 +61,8 @@ class LlamaConfig:
         hq = self.num_heads * self.head_size
         hkv = self.num_kv_heads * self.head_size
         per_layer = e * hq + 2 * e * hkv + hq * e + 3 * e * f + 2 * e
+        if self.attn_bias:
+            per_layer += hq + 2 * hkv
         head = 0 if self.tie_word_embeddings else e * v
         return v * e + self.num_layers * per_layer + e + head
 
@@ -75,15 +78,20 @@ def init(config: LlamaConfig, rng: jax.Array) -> dict:
     def dense(key, shape):
         return (0.02 * jax.random.normal(key, shape, jnp.float32)).astype(config.param_dtype)
 
+    attn = {
+        "wq": dense(next(keys), (l, e, hq)),
+        "wk": dense(next(keys), (l, e, hkv)),
+        "wv": dense(next(keys), (l, e, hkv)),
+        "wo": dense(next(keys), (l, hq, e)),
+    }
+    if config.attn_bias:  # Qwen2-style QKV biases (zeros, like HF init)
+        attn.update(bq=jnp.zeros((l, hq), config.param_dtype),
+                    bk=jnp.zeros((l, hkv), config.param_dtype),
+                    bv=jnp.zeros((l, hkv), config.param_dtype))
     params = {
         "embed": {"embedding": dense(next(keys), (v, e))},
         "layers": {
-            "attn": {
-                "wq": dense(next(keys), (l, e, hq)),
-                "wk": dense(next(keys), (l, e, hkv)),
-                "wv": dense(next(keys), (l, e, hkv)),
-                "wo": dense(next(keys), (l, hq, e)),
-            },
+            "attn": attn,
             "mlp": {
                 "gate": dense(next(keys), (l, e, f)),
                 "up": dense(next(keys), (l, e, f)),
@@ -105,15 +113,19 @@ def param_logical_axes(config: LlamaConfig) -> dict:
     Names: vocab, embed, heads (fused q-heads x head_dim), kv (fused kv-heads),
     mlp, layers (the scan axis). ``None`` = never sharded on that dim.
     """
+    attn_axes = {
+        "wq": ("layers", "embed", "heads"),
+        "wk": ("layers", "embed", "kv"),
+        "wv": ("layers", "embed", "kv"),
+        "wo": ("layers", "heads", "embed"),
+    }
+    if config.attn_bias:  # biases shard with the head dim they add onto
+        attn_axes.update(bq=("layers", "heads"), bk=("layers", "kv"),
+                         bv=("layers", "kv"))
     axes = {
         "embed": {"embedding": ("vocab", "embed")},
         "layers": {
-            "attn": {
-                "wq": ("layers", "embed", "heads"),
-                "wk": ("layers", "embed", "kv"),
-                "wv": ("layers", "embed", "kv"),
-                "wo": ("layers", "heads", "embed"),
-            },
+            "attn": attn_axes,
             "mlp": {
                 "gate": ("layers", "embed", "mlp"),
                 "up": ("layers", "embed", "mlp"),
@@ -155,9 +167,14 @@ def attention_sublayer(config, x: jnp.ndarray, attn_params: dict, norm_scale,
     d = config.head_size
     cdt = config.dtype
     h = _rmsnorm(x, norm_scale, config.rms_norm_eps)
-    q = (h @ attn_params["wq"].astype(cdt)).reshape(b, s, -1, d)
-    k = (h @ attn_params["wk"].astype(cdt)).reshape(b, s, -1, d)
-    v = (h @ attn_params["wv"].astype(cdt)).reshape(b, s, -1, d)
+    q, k, v = (h @ attn_params[w].astype(cdt) for w in ("wq", "wk", "wv"))
+    if "bq" in attn_params:  # Qwen2-style QKV biases; shard-local under
+        q = q + attn_params["bq"].astype(cdt)  # manual tp (bias carries the
+        k = k + attn_params["bk"].astype(cdt)  # same heads/kv logical axis
+        v = v + attn_params["bv"].astype(cdt)  # as its matmul output)
+    q = q.reshape(b, s, -1, d)
+    k = k.reshape(b, s, -1, d)
+    v = v.reshape(b, s, -1, d)
     q = apply_rope(q, positions, config.rope_theta)
     k = apply_rope(k, positions, config.rope_theta)
     if callable(attn_impl):  # e.g. ring attention under context parallelism
@@ -315,4 +332,20 @@ PRESETS = {
     "llama-3.1-405b": LlamaConfig(vocab_size=128256, hidden_size=16384, intermediate_size=53248,
                                   num_layers=126, num_heads=128, num_kv_heads=8,
                                   rope_theta=500000.0, max_position_embeddings=8192),
+    # Mistral dense is llama-architecture exactly (HF MistralForCausalLM uses
+    # the same tensor names/layouts as LlamaForCausalLM); shapes are the
+    # v0.3 card (no sliding window, 32768-token vocab)
+    "mistral-7b": LlamaConfig(vocab_size=32768, hidden_size=4096, intermediate_size=14336,
+                              num_layers=32, num_heads=32, num_kv_heads=8,
+                              rope_theta=1e6, max_position_embeddings=32768),
+    # Qwen2.5 dense = llama + QKV biases (attn_bias); small cards tie embeddings
+    "qwen2.5-0.5b": LlamaConfig(vocab_size=151936, hidden_size=896, intermediate_size=4864,
+                                num_layers=24, num_heads=14, num_kv_heads=2,
+                                rope_theta=1e6, rms_norm_eps=1e-6, attn_bias=True,
+                                tie_word_embeddings=True,
+                                max_position_embeddings=32768),
+    "qwen2.5-7b": LlamaConfig(vocab_size=152064, hidden_size=3584, intermediate_size=18944,
+                              num_layers=28, num_heads=28, num_kv_heads=4,
+                              rope_theta=1e6, rms_norm_eps=1e-6, attn_bias=True,
+                              max_position_embeddings=32768),
 }

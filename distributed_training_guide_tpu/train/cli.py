@@ -81,6 +81,13 @@ def get_parser() -> argparse.ArgumentParser:
     parser.add_argument("--max-steps", default=None, type=int)
     parser.add_argument("--native-loader", action="store_true",
                         help="assemble batches with the C++ mmap/prefetch loader (csrc/)")
+    parser.add_argument("--mmap-data", default=None, metavar="DIR",
+                        help="spill the token array to a raw token file under "
+                             "DIR (built once, reused across runs) and train "
+                             "from a read-only memmap: host RAM holds only "
+                             "each batch's local shard rows, not the corpus; "
+                             "--native-loader then mmaps the same file "
+                             "zero-copy")
     parser.add_argument("--async-checkpoint", action="store_true",
                         help="overlap checkpoint writes with training (Orbax "
                              "async; state.json publishes when the write commits)")
@@ -96,6 +103,12 @@ def get_parser() -> argparse.ArgumentParser:
     parser.add_argument("--wandb-per-host", action="store_true",
                         help="grouped per-host runs instead of one process-0 "
                              "run (wandb-configurations pattern 2)")
+    parser.add_argument("--timer-sync", action="store_true",
+                        help="device-fence the per-phase timers (reference "
+                             "LocalTimer/cuda.synchronize semantics) instead "
+                             "of relying on the loss host-read; use on "
+                             "healthy pools — see BENCH.md on why the fence "
+                             "is not the default here")
     parser.add_argument("--profile-dir", default=None,
                         help="capture a jax.profiler trace of steps 10-15 into this dir "
                              "(view with xprof/tensorboard; see diagnosing-errors/)")
@@ -165,7 +178,8 @@ def run_training(args, plan_factory: Callable, *, extra_log: Optional[dict] = No
     dataset = load_and_preprocess_data(
         args.dataset_name, tokenizer, seq_length,
         dataset_subset=args.dataset_subset,
-        max_position_embeddings=cfg.max_position_embeddings, seed=args.seed)
+        max_position_embeddings=cfg.max_position_embeddings, seed=args.seed,
+        mmap_dir=getattr(args, "mmap_data", None))
     LOGGER.info(f"{len(dataset)} training sequences of length {seq_length}")
     loader = ShardedBatchLoader(
         dataset, global_batch,
@@ -210,7 +224,11 @@ def run_training(args, plan_factory: Callable, *, extra_log: Optional[dict] = No
         args, mode="per-host" if getattr(args, "wandb_per_host", False) else "process0",
         exp_dir=exp_dir if is_experiment else None, config=vars(args))
 
-    timers = {k: LocalTimer() for k in ["data", "step"]}
+    sync_fn = None
+    if getattr(args, "timer_sync", False):
+        from ..utils.timers import device_sync
+        sync_fn = device_sync
+    timers = {k: LocalTimer(sync_fn=sync_fn) for k in ["data", "step"]}
     flops_per_token = transformer_flops_per_token(
         bundle.num_active_params(), cfg.num_layers, cfg.hidden_size, seq_length,
         vocab_size=cfg.vocab_size)

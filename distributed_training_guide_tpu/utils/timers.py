@@ -16,6 +16,23 @@ from typing import Callable, Optional
 import jax
 
 
+def device_sync() -> None:
+    """Device fence for ``LocalTimer(sync_fn=...)`` — the reference C17
+    semantics (``01-single-gpu/train_llm.py:260-286``, cuda.synchronize).
+
+    Enqueues a trivial computation on every local device and blocks on it:
+    the runtime executes programs in launch order per device, so the fence
+    completes only after all previously dispatched work. Default timers use
+    the loss host-read instead (see ``_default_sync``) because on some
+    remote TPU pools ``block_until_ready`` returns early (BENCH.md "pool
+    timeline"); ``--timer-sync`` restores this per-phase mode on healthy
+    hardware."""
+    import jax.numpy as jnp
+
+    jax.block_until_ready([jnp.zeros((), jnp.int32, device=d) + 1
+                           for d in jax.local_devices()])
+
+
 def _default_sync() -> None:
     # Intentionally a no-op. JAX has no global device fence (dispatch queues
     # are per-array, and on some remote TPU platforms even block_until_ready

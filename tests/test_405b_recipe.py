@@ -15,6 +15,7 @@ import sys
 
 import jax
 import numpy as np
+import pytest
 
 from distributed_training_guide_tpu.checkpoint import abstract_train_state
 from distributed_training_guide_tpu.models import get_model
@@ -44,6 +45,158 @@ def test_405b_train_step_lowers(eight_devices):
     assert text.count("sdy.sharding") > 100  # every param leaf is annotated
     n_params = sum(int(np.prod(s.shape)) for s in jax.tree.leaves(state.params))
     assert abs(n_params - 405.8e9) / 405.8e9 < 0.01
+
+
+def test_405b_weight_logistics_at_reduced_scale(tmp_path, eight_devices):
+    """The 405B recipe's weight logistics exercised END TO END at reduced
+    scale (VERDICT r3 item 4): a multi-file sharded safetensors checkpoint
+    (>=4 shards, like the real 191-file 405B export) through the REAL
+    ``convert_llama.py`` CLI, loaded via the REAL chapter-05 entry point's
+    ``--pretrained`` on the fsdp x tp mesh — plus logits parity of the
+    sharded load against torch. Reference counterpart:
+    ``05-training-llama-405b/train_llm.py:74-146`` (download + rank-0 load +
+    broadcast)."""
+    transformers = pytest.importorskip("transformers")
+    torch = pytest.importorskip("torch")
+
+    # HF twin of the llama-debug registry preset
+    hf_cfg = transformers.LlamaConfig(
+        vocab_size=512, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=256, rope_theta=10000.0, rms_norm_eps=1e-5,
+        tie_word_embeddings=False)
+    torch.manual_seed(0)
+    model = transformers.LlamaForCausalLM(hf_cfg).eval()
+    model.save_pretrained(tmp_path / "hf", safe_serialization=True,
+                          max_shard_size="100KB")
+    shards = sorted((tmp_path / "hf").glob("*.safetensors"))
+    assert len(shards) >= 4, [s.name for s in shards]     # genuinely multi-file
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    conv = subprocess.run(
+        [sys.executable, os.path.join(repo, "05-training-llama-405b",
+                                      "convert_llama.py"),
+         str(tmp_path / "hf"), str(tmp_path / "conv"), "llama-debug"],
+        capture_output=True, text=True, timeout=600, env=dict(
+            os.environ, JAX_PLATFORMS="cpu"))
+    assert conv.returncode == 0, conv.stderr[-3000:]
+    assert (tmp_path / "conv" / "manifest.json").exists()
+
+    # sharded load on the chapter's fsdp x tp mesh: logits parity vs torch
+    from distributed_training_guide_tpu.models.hf_convert import load_pretrained
+
+    bundle = get_model("llama-debug", dtype=np.float32)
+    plan = make_plan("tp_fsdp", make_mesh(tp=2, fsdp=4))
+    shapes = jax.eval_shape(lambda: bundle.init(bundle.config, jax.random.key(0)))
+    shardings = plan.param_shardings(bundle.param_logical_axes(bundle.config),
+                                     shapes)
+    params = load_pretrained(bundle, shardings, tmp_path / "conv")
+    wq = params["layers"]["attn"]["wq"]
+    assert any(s is not None for s in wq.sharding.spec)   # actually sharded
+    ids = np.random.RandomState(0).randint(0, 512, (2, 24))
+    ours = np.asarray(bundle.apply(bundle.config, params, ids))
+    with torch.no_grad():
+        theirs = model(torch.tensor(ids)).logits.float().numpy()
+    np.testing.assert_allclose(ours, theirs, rtol=2e-4, atol=2e-4)
+
+    # the real chapter-05 entry: --pretrained + training steps on that mesh
+    run = subprocess.run(
+        [sys.executable, os.path.join(repo, "05-training-llama-405b",
+                                      "train_llm.py"),
+         "-m", "llama-debug", "-d", "synthetic:60000", "-s", "64", "-b", "1",
+         "--tensor-parallel", "2", "--num-epochs", "1", "--log-freq", "1",
+         "--max-steps", "2", "--save-dir", str(tmp_path / "out"),
+         "--pretrained", str(tmp_path / "conv")],
+        capture_output=True, text=True, timeout=600, cwd=repo,
+        env=dict(os.environ, JAX_PLATFORMS="cpu",
+                 XLA_FLAGS="--xla_force_host_platform_device_count=8"))
+    out = run.stdout + run.stderr
+    assert run.returncode == 0, out[-3000:]
+    assert "Loading pretrained weights" in out
+    assert "running_loss" in out
+
+
+_RSS_SCRIPT = """
+import gc, json, os, threading, time
+
+import numpy as np
+import torch
+import transformers
+
+import distributed_training_guide_tpu  # asserts cpu platform
+from distributed_training_guide_tpu.models import get_model
+from distributed_training_guide_tpu.models.hf_convert import convert_hf_checkpoint
+
+OUT = os.environ["RSS_TMP"]
+
+# ~190 MB of fp32 weights; largest single tensor ~12.6 MB (embed/lm_head)
+kw = dict(vocab_size=4096, hidden_size=768, intermediate_size=2048,
+          num_layers=6, num_heads=8, num_kv_heads=4,
+          max_position_embeddings=256)
+hf_cfg = transformers.LlamaConfig(
+    num_hidden_layers=kw["num_layers"], num_attention_heads=kw["num_heads"],
+    num_key_value_heads=kw["num_kv_heads"], tie_word_embeddings=False,
+    **{k: kw[k] for k in ("vocab_size", "hidden_size", "intermediate_size",
+                          "max_position_embeddings")})
+torch.manual_seed(0)
+model = transformers.LlamaForCausalLM(hf_cfg)
+model.save_pretrained(os.path.join(OUT, "hf"), safe_serialization=True)
+total_bytes = sum(p.numel() * 4 for p in model.parameters())
+del model
+gc.collect()
+
+
+def rss_anon() -> int:
+    with open("/proc/self/status") as f:
+        for line in f:
+            if line.startswith("RssAnon"):
+                return int(line.split()[1]) * 1024
+    return -1
+
+
+baseline = rss_anon()
+peak = [baseline]
+stop = threading.Event()
+
+
+def sampler():
+    while not stop.is_set():
+        peak[0] = max(peak[0], rss_anon())
+        time.sleep(0.005)
+
+
+t = threading.Thread(target=sampler, daemon=True)
+t.start()
+bundle = get_model("llama-debug", **kw)
+convert_hf_checkpoint(os.path.join(OUT, "hf"), os.path.join(OUT, "conv"),
+                      bundle=bundle)
+stop.set()
+t.join()
+print("RSS:" + json.dumps({"total_bytes": total_bytes, "baseline": baseline,
+                           "peak_delta": peak[0] - baseline}))
+"""
+
+
+def test_405b_conversion_streams_one_tensor_at_a_time(tmp_path):
+    """The converter's 'peak host RAM is one tensor' claim, measured: over a
+    ~190 MB model, peak ANON rss during conversion grows by no more than a
+    few tensors (<60 MB) — never the model. (Anon rss is the right meter:
+    the output memmap's dirty pages are file-backed and reclaimable; the
+    reference's rank-0 full state dict is anonymous RAM, all 764 GB of it.)"""
+    env = dict(os.environ, JAX_PLATFORMS="cpu", RSS_TMP=str(tmp_path))
+    proc = subprocess.run([sys.executable, "-c", _RSS_SCRIPT], env=env,
+                          capture_output=True, text=True, timeout=900,
+                          cwd=os.path.dirname(os.path.dirname(
+                              os.path.abspath(__file__))))
+    assert proc.returncode == 0, proc.stderr[-3000:]
+    line = next(l for l in proc.stdout.splitlines() if l.startswith("RSS:"))
+    rss = json.loads(line[len("RSS:"):])
+    assert rss["total_bytes"] > 150e6          # the model really is ~190 MB
+    assert rss["baseline"] > 0                 # RssAnon available
+    assert rss["peak_delta"] < 60e6, (
+        f"conversion peaked {rss['peak_delta'] / 1e6:.0f} MB anon over "
+        f"baseline for a {rss['total_bytes'] / 1e6:.0f} MB model — "
+        f"streaming is broken")
 
 
 _POD_SCRIPT = """

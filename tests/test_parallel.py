@@ -102,6 +102,37 @@ def test_gpt2_tp_matches_single_device(eight_devices):
         np.testing.assert_allclose(got, golden, rtol=1e-4, err_msg=strategy)
 
 
+def test_qwen_bias_tp_matches_single_device(eight_devices):
+    """Qwen2-style attn_bias under tensor parallelism: the bq/bk/bv leaves
+    carry the heads/kv logical axes, so tp shards them column-wise with
+    their matmuls — trajectory must still match single-device."""
+    bundle = get_model("qwen2.5-0.5b", vocab_size=512, hidden_size=64,
+                       intermediate_size=128, num_layers=2, num_heads=4,
+                       num_kv_heads=2, max_position_embeddings=256,
+                       dtype=jnp.float32)
+    assert bundle.config.attn_bias
+
+    def run(strategy, mesh):
+        t = Trainer(bundle=bundle, optimizer=adamw_cosine(1e-3),
+                    plan=make_plan(strategy, mesh), donate=False)
+        state = t.init_state(0)
+        if strategy == "tp":   # bias shards over its only (heads) dim
+            bq = state.params["layers"]["attn"]["bq"]
+            assert "tp" in jax.tree.leaves(tuple(bq.sharding.spec)), bq.sharding
+        ids = np.random.RandomState(0).randint(0, 512, (GLOBAL_BATCH, SEQ))
+        batch = {k: jax.device_put(jnp.asarray(ids), t.batch_shardings()[k])
+                 for k in ("input_ids", "labels")}
+        losses = []
+        for _ in range(2):
+            state, m = t.step_fn(state, batch)
+            losses.append(float(m["loss"]))
+        return losses
+
+    golden = run("single", make_mesh(devices=jax.devices()[:1]))
+    got = run("tp", make_mesh(tp=4))
+    np.testing.assert_allclose(got, golden, rtol=1e-4)
+
+
 def test_params_actually_sharded(eight_devices):
     trainer = make_trainer("fsdp", fsdp=8)
     state = trainer.init_state(0)
