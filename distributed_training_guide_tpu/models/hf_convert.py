@@ -20,10 +20,12 @@ Name mapping covers the Llama, GPT-2, and MoE families (HF
 ``LlamaForCausalLM`` / ``GPT2LMHeadModel`` / ``MixtralForCausalLM``
 conventions; torch Linear stores [out, in] so most leaves transpose,
 GPT-2's Conv1D stores [in, out] so they don't; Mixtral's per-expert
-Linears stack onto the [L, E, ...] expert dim). Mistral and Qwen2 dense
+Linears stack onto the [L, E, ...] expert dim). Mistral, Qwen2, and Gemma
 checkpoints ride the Llama map unchanged — Mistral shares the tensor
-names exactly, Qwen2 adds the QKV bias rows (narrowing the reference's
-``AutoModelForCausalLM`` any-architecture surface,
+names exactly, Qwen2 adds the QKV bias rows, Gemma's differences (GeGLU,
+(1+w) norms, sqrt(E)-scaled embeddings, MQA, explicit head_dim, tied
+head) are all config knobs, not tensor-layout changes (narrowing the
+reference's ``AutoModelForCausalLM`` any-architecture surface,
 ``01-single-gpu/train_llm.py:57``, one real family at a time).
 """
 from __future__ import annotations

@@ -49,6 +49,9 @@ class LlamaConfig:
     rms_norm_eps: float = 1e-5
     tie_word_embeddings: bool = False
     attn_bias: bool = False         # QKV projection biases (Qwen2-style)
+    act_fn: str = "silu"            # MLP gate activation: silu | gelu_tanh (Gemma)
+    norm_plus_one: bool = False     # RMSNorm scales by (1 + w) (Gemma)
+    scale_embed: bool = False       # multiply embeddings by sqrt(hidden) (Gemma)
     dtype: Any = jnp.bfloat16       # activation/compute dtype
     param_dtype: Any = jnp.float32  # storage dtype
 
@@ -141,12 +144,22 @@ def param_logical_axes(config: LlamaConfig) -> dict:
     return axes
 
 
-def _rmsnorm(x: jnp.ndarray, scale: jnp.ndarray, eps: float) -> jnp.ndarray:
+ACT_FNS = {
+    "silu": jax.nn.silu,
+    "gelu_tanh": partial(jax.nn.gelu, approximate=True),  # HF gelu_pytorch_tanh
+}
+
+
+def _rmsnorm(x: jnp.ndarray, scale: jnp.ndarray, eps: float,
+             plus_one: bool = False) -> jnp.ndarray:
     dtype = x.dtype
     x = x.astype(jnp.float32)
     var = jnp.mean(x * x, axis=-1, keepdims=True)
     x = x * jax.lax.rsqrt(var + eps)
-    return (x * scale.astype(jnp.float32)).astype(dtype)
+    scale = scale.astype(jnp.float32)
+    if plus_one:            # Gemma stores w, applies (1 + w)
+        scale = scale + 1.0
+    return (x * scale).astype(dtype)
 
 
 def attention_sublayer(config, x: jnp.ndarray, attn_params: dict, norm_scale,
@@ -166,7 +179,8 @@ def attention_sublayer(config, x: jnp.ndarray, attn_params: dict, norm_scale,
     b, s, e = x.shape
     d = config.head_size
     cdt = config.dtype
-    h = _rmsnorm(x, norm_scale, config.rms_norm_eps)
+    h = _rmsnorm(x, norm_scale, config.rms_norm_eps,
+                 getattr(config, "norm_plus_one", False))
     q, k, v = (h @ attn_params[w].astype(cdt) for w in ("wq", "wk", "wv"))
     if "bq" in attn_params:  # Qwen2-style QKV biases; shard-local under
         q = q + attn_params["bq"].astype(cdt)  # manual tp (bias carries the
@@ -205,12 +219,14 @@ def _block(config: LlamaConfig, x: jnp.ndarray, layer: dict,
                               positions, attn_impl, standard_layout, tp_axis)
     x = constrain(x + attn)
 
-    h = _rmsnorm(x, layer["post_attn_norm"], config.rms_norm_eps)
+    h = _rmsnorm(x, layer["post_attn_norm"], config.rms_norm_eps,
+                 getattr(config, "norm_plus_one", False))
     gate = h @ layer["mlp"]["gate"].astype(cdt)
     up = h @ layer["mlp"]["up"].astype(cdt)
+    act_fn = ACT_FNS[getattr(config, "act_fn", "silu")]
     # tagged for REMAT_POLICIES["attn_mlp"]: saving the [B,S,I] inner
     # activation skips the gate/up matmul recompute in backward
-    act = checkpoint_name(jax.nn.silu(gate) * up, "mlp_act")
+    act = checkpoint_name(act_fn(gate) * up, "mlp_act")
     down = act @ layer["mlp"]["down"].astype(cdt)
     if tp_axis is not None:  # megatron Rowwise: down-proj partial sums
         down = _psum(down, tp_axis)
@@ -221,7 +237,10 @@ def embed_tokens(config: LlamaConfig, params: dict, input_ids: jnp.ndarray,
                  positions: jnp.ndarray) -> jnp.ndarray:
     """Embedding sub-forward (pipeline stage-0 entry)."""
     del positions  # rope is applied inside blocks
-    return jnp.take(params["embed"]["embedding"], input_ids, axis=0).astype(config.dtype)
+    x = jnp.take(params["embed"]["embedding"], input_ids, axis=0).astype(config.dtype)
+    if getattr(config, "scale_embed", False):   # Gemma's sqrt(E) normalizer
+        x = x * jnp.asarray(config.hidden_size ** 0.5, config.dtype)
+    return x
 
 
 def output_weights(config: LlamaConfig, params: dict) -> jnp.ndarray:
@@ -238,13 +257,17 @@ def tp_embed(config: LlamaConfig, params: dict, input_ids: jnp.ndarray,
     del positions  # rope is applied inside blocks
     from ..ops.vocab_parallel import vocab_parallel_embed
 
-    return vocab_parallel_embed(params["embed"]["embedding"].astype(config.dtype),
-                                input_ids, axis)
+    x = vocab_parallel_embed(params["embed"]["embedding"].astype(config.dtype),
+                             input_ids, axis)
+    if getattr(config, "scale_embed", False):   # Gemma's sqrt(E) normalizer
+        x = x * jnp.asarray(config.hidden_size ** 0.5, config.dtype)
+    return x
 
 
 def final_hidden(config: LlamaConfig, params: dict, x: jnp.ndarray) -> jnp.ndarray:
     """Final norm only — pair with ``output_weights`` for chunked losses."""
-    return _rmsnorm(x, params["final_norm"], config.rms_norm_eps)
+    return _rmsnorm(x, params["final_norm"], config.rms_norm_eps,
+                    getattr(config, "norm_plus_one", False))
 
 
 def lm_head_logits(config: LlamaConfig, params: dict, x: jnp.ndarray) -> jnp.ndarray:
@@ -338,6 +361,18 @@ PRESETS = {
     "mistral-7b": LlamaConfig(vocab_size=32768, hidden_size=4096, intermediate_size=14336,
                               num_layers=32, num_heads=32, num_kv_heads=8,
                               rope_theta=1e6, max_position_embeddings=32768),
+    # Gemma = llama + GeGLU + (1+w) RMSNorm + sqrt(E)-scaled embeddings,
+    # explicit head_dim 256, always-tied embeddings (gemma-2b is MQA: kv=1)
+    "gemma-2b": LlamaConfig(vocab_size=256000, hidden_size=2048, intermediate_size=16384,
+                            num_layers=18, num_heads=8, num_kv_heads=1, head_dim=256,
+                            act_fn="gelu_tanh", norm_plus_one=True, scale_embed=True,
+                            rms_norm_eps=1e-6, tie_word_embeddings=True,
+                            max_position_embeddings=8192),
+    "gemma-7b": LlamaConfig(vocab_size=256000, hidden_size=3072, intermediate_size=24576,
+                            num_layers=28, num_heads=16, num_kv_heads=16, head_dim=256,
+                            act_fn="gelu_tanh", norm_plus_one=True, scale_embed=True,
+                            rms_norm_eps=1e-6, tie_word_embeddings=True,
+                            max_position_embeddings=8192),
     # Qwen2.5 dense = llama + QKV biases (attn_bias); small cards tie embeddings
     "qwen2.5-0.5b": LlamaConfig(vocab_size=151936, hidden_size=896, intermediate_size=4864,
                                 num_layers=24, num_heads=14, num_kv_heads=2,

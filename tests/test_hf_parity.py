@@ -25,6 +25,20 @@ def _replicated_shardings(bundle, plan):
     return plan.param_shardings(bundle.param_logical_axes(bundle.config), shapes)
 
 
+def _one_train_step(bundle, plan, params, ids):
+    """Pretrained params -> fresh TrainState -> one optimizer step (the
+    reference 05:118-126 path); returns the scalar loss."""
+    from distributed_training_guide_tpu.train import Trainer, adamw_cosine
+
+    trainer = Trainer(bundle=bundle, optimizer=adamw_cosine(1e-4), plan=plan,
+                      donate=False)
+    state = trainer.init_state_from_params(params)
+    batch = {k: jax.device_put(jnp.asarray(ids), trainer.batch_shardings()[k])
+             for k in ("input_ids", "labels")}
+    _, metrics = trainer.step_fn(state, batch)
+    return float(metrics["loss"])
+
+
 def test_llama_parity(tmp_path):
     hf_cfg = transformers.LlamaConfig(
         vocab_size=128, hidden_size=64, intermediate_size=128,
@@ -46,16 +60,7 @@ def test_llama_parity(tmp_path):
         theirs = model(torch.tensor(ids)).logits.float().numpy()
     np.testing.assert_allclose(ours, theirs, rtol=2e-4, atol=2e-4)
 
-    # pretrained params -> fresh TrainState -> one step (reference 05:118-126 path)
-    from distributed_training_guide_tpu.train import Trainer, adamw_cosine
-
-    trainer = Trainer(bundle=bundle, optimizer=adamw_cosine(1e-4), plan=plan,
-                      donate=False)
-    state = trainer.init_state_from_params(params)
-    batch = {k: jax.device_put(jnp.asarray(ids), trainer.batch_shardings()[k])
-             for k in ("input_ids", "labels")}
-    _, metrics = trainer.step_fn(state, batch)
-    assert np.isfinite(float(metrics["loss"]))
+    assert np.isfinite(_one_train_step(bundle, plan, params, ids))
 
 
 def test_gpt2_parity(tmp_path):
@@ -145,6 +150,41 @@ def test_qwen2_parity(tmp_path):
     with torch.no_grad():
         theirs = model(torch.tensor(ids)).logits.float().numpy()
     np.testing.assert_allclose(ours, theirs, rtol=2e-4, atol=2e-4)
+
+
+def test_gemma_parity(tmp_path):
+    """Gemma = llama + three real architecture knobs: GeGLU (tanh-gelu
+    gate), (1+w) RMSNorm scaling, sqrt(hidden)-scaled embeddings — plus MQA
+    (kv_heads=1), explicit head_dim != hidden/heads, and always-tied
+    embeddings. Pins all of them through conversion end to end."""
+    hf_cfg = transformers.GemmaConfig(
+        vocab_size=128, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=1,
+        head_dim=32, max_position_embeddings=256, rope_theta=10000.0,
+        rms_norm_eps=1e-6, hidden_act="gelu_pytorch_tanh",
+        tie_word_embeddings=True)
+    torch.manual_seed(0)
+    model = transformers.GemmaForCausalLM(hf_cfg).eval()
+    model.save_pretrained(tmp_path / "hf", safe_serialization=True)
+
+    bundle = get_model("gemma-2b", vocab_size=128, hidden_size=64,
+                       intermediate_size=128, num_layers=2, num_heads=4,
+                       num_kv_heads=1, head_dim=32,
+                       max_position_embeddings=256, dtype=jnp.float32)
+    assert bundle.config.norm_plus_one and bundle.config.scale_embed
+    convert_hf_checkpoint(tmp_path / "hf", tmp_path / "conv", bundle=bundle)
+    plan = make_plan("single", make_mesh(devices=jax.devices()[:1]))
+    params = load_pretrained(bundle, _replicated_shardings(bundle, plan),
+                             tmp_path / "conv")
+
+    ids = np.random.RandomState(0).randint(0, 128, (2, 24))
+    ours = np.asarray(bundle.apply(bundle.config, params, jnp.asarray(ids)))
+    with torch.no_grad():
+        theirs = model(torch.tensor(ids)).logits.float().numpy()
+    np.testing.assert_allclose(ours, theirs, rtol=2e-4, atol=2e-4)
+
+    # pretrained -> one training step (MQA + GeGLU through the optimizer path)
+    assert np.isfinite(_one_train_step(bundle, plan, params, ids))
 
 
 def test_mixtral_parity(tmp_path):

@@ -154,6 +154,23 @@ def test_gang_fsdp_trains_with_cross_process_shards(tmp_path, warm_cache):
     assert "strategy=fsdp" in rank0
 
 
+def test_gang_tp_spans_process_boundary(tmp_path, warm_cache):
+    """tp=8 on a 2-process x 4-device gang: every tensor-parallel group
+    crosses the process boundary, so the per-layer megatron all-reduces run
+    over the inter-process transport (the DCN analogue) — the sharding
+    regime chapter 6 documents but no single-process test can produce."""
+    worker = [sys.executable, str(REPO / "06-tensor-parallel" / "train_llm.py"),
+              *TRAIN_FLAGS, "--max-steps", "3", "--tensor-parallel", "8",
+              "--save-dir", str(tmp_path / "out")]
+    rc, rank0, (rank1,) = run_gang(worker, log_dir=str(tmp_path / "logs"))
+    assert rc == 0, rank0[-3000:]
+    losses = losses_by_step(rank0)
+    assert set(losses) == {1, 2, 3}
+    assert all(5.0 < v < 7.5 for v in losses.values()), losses
+    assert losses_by_step(rank1) == losses
+    assert "'tp': 8" in rank0
+
+
 def test_gang_checkpoint_resume_bitexact(tmp_path, warm_cache):
     """Multihost Orbax save (every process writes its shards, process 0
     swings state.json behind a barrier) + restore in a FRESH gang, compared
