@@ -47,8 +47,8 @@ from distributed_training_guide_tpu.train.cli import get_parser, run_training
 def main():
     parser = get_parser()
     parser.add_argument("--tensor-parallel", type=int, default=1)
-    parser.add_argument("--pretrained", default=None,
-                        help="directory produced by convert_llama.py")
+    # --pretrained lives in the shared parser (every chapter can start from
+    # converted weights, reference 01:57)
     parser.add_argument("--offload-params", action="store_true",
                         help="params live in pinned host memory between steps "
                              "(fetch per step); pairs with --offload-opt-state "

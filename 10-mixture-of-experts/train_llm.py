@@ -36,9 +36,8 @@ def main():
                         help="ep size (default: all devices)")
     parser.add_argument("--fsdp", type=int, default=1,
                         help="fsdp size alongside ep")
-    parser.add_argument("--pretrained", default=None,
-                        help="directory produced by convert_hf_checkpoint "
-                             "on an HF Mixtral checkpoint")
+    # --pretrained comes from the shared parser (works for HF Mixtral
+    # checkpoints through the same streaming converter)
     args = parser.parse_args()
     maybe_initialize_distributed()
 

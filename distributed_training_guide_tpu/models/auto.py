@@ -1,0 +1,131 @@
+"""AutoModel-style config ingestion: HF ``config.json`` -> a native bundle.
+
+The reference trains *any* HF causal LM via ``AutoModelForCausalLM``
+(``01-single-gpu/train_llm.py:57``). The native families here cover six HF
+architectures; this module removes the remaining friction — needing a
+registry preset for every size variant. ``-m hf:<dir>`` (or
+``get_model("hf:<dir>")``) reads the checkpoint's own ``config.json``,
+recognizes the architecture, and builds the exact family config — so any
+Llama/Mistral/Qwen2/Gemma/GPT-2/Mixtral checkpoint trains (and converts,
+``models/hf_convert.py``) without touching the registry:
+
+    python convert_llama.py <hf-dir> <conv> hf:<hf-dir>
+    python train_llm.py -m hf:<hf-dir> --pretrained <conv> ...
+
+Unsupported architectures fail loudly with the supported list.
+"""
+from __future__ import annotations
+
+import json
+from pathlib import Path
+
+
+def _llama_kwargs(cfg: dict) -> dict:
+    kw = dict(
+        vocab_size=cfg["vocab_size"],
+        hidden_size=cfg["hidden_size"],
+        intermediate_size=cfg["intermediate_size"],
+        num_layers=cfg["num_hidden_layers"],
+        num_heads=cfg["num_attention_heads"],
+        num_kv_heads=cfg.get("num_key_value_heads",
+                             cfg["num_attention_heads"]),
+        max_position_embeddings=cfg.get("max_position_embeddings", 4096),
+        rope_theta=cfg.get("rope_theta", 10000.0),
+        rms_norm_eps=cfg.get("rms_norm_eps", 1e-5),
+        tie_word_embeddings=cfg.get("tie_word_embeddings", False),
+    )
+    if cfg.get("head_dim"):
+        kw["head_dim"] = cfg["head_dim"]
+    return kw
+
+
+_HF_ACTS = {"silu": "silu", "gelu_pytorch_tanh": "gelu_tanh",
+            "gelu_tanh": "gelu_tanh"}   # exact 'gelu' is NOT implemented
+
+
+def _build_llama(cfg: dict, arch: str):
+    import logging
+
+    from .llama import LlamaConfig
+
+    kw = _llama_kwargs(cfg)
+    if arch == "Qwen2ForCausalLM":
+        # default True: older Qwen2 configs omit the key because bias was
+        # unconditional
+        kw["attn_bias"] = cfg.get("attention_bias", True)
+    else:
+        kw["attn_bias"] = cfg.get("attention_bias", False)
+    act = cfg.get("hidden_act", "silu")
+    if arch == "GemmaForCausalLM":
+        kw.update(norm_plus_one=True, scale_embed=True,
+                  tie_word_embeddings=True)
+        act = "gelu_pytorch_tanh"   # HF applies tanh-gelu whatever the key says
+    if act not in _HF_ACTS:
+        raise ValueError(f"{arch}: unsupported hidden_act {act!r} "
+                         f"(supported: {sorted(_HF_ACTS)})")
+    kw["act_fn"] = _HF_ACTS[act]
+    window = cfg.get("sliding_window")
+    if window and window < kw["max_position_embeddings"]:
+        # full attention == SWA only while seq_length <= window; loud, not
+        # fatal, since short-seq training on e.g. Mistral-v0.1 is legitimate
+        logging.getLogger(__name__).warning(
+            f"{arch}: checkpoint uses sliding_window={window}; this family "
+            f"computes FULL causal attention — train/eval with seq_length "
+            f"<= {window} or logits diverge from HF")
+    return LlamaConfig(**kw)
+
+
+def _build_gpt2(cfg: dict, arch: str):
+    from .gpt2 import GPT2Config
+
+    return GPT2Config(
+        vocab_size=cfg["vocab_size"],
+        hidden_size=cfg["n_embd"],
+        num_layers=cfg["n_layer"],
+        num_heads=cfg["n_head"],
+        max_position_embeddings=cfg.get("n_positions", 1024),
+        layer_norm_eps=cfg.get("layer_norm_epsilon", 1e-5),
+    )
+
+
+def _build_mixtral(cfg: dict, arch: str):
+    from .moe import MoELlamaConfig
+
+    return MoELlamaConfig(
+        num_experts=cfg["num_local_experts"],
+        experts_per_token=cfg["num_experts_per_tok"],
+        **_llama_kwargs(cfg),
+    )
+
+
+_ARCH_BUILDERS = {
+    "LlamaForCausalLM": ("llama", _build_llama),
+    "MistralForCausalLM": ("llama", _build_llama),
+    "Qwen2ForCausalLM": ("llama", _build_llama),
+    "GemmaForCausalLM": ("llama", _build_llama),
+    "GPT2LMHeadModel": ("gpt2", _build_gpt2),
+    "MixtralForCausalLM": ("moe", _build_mixtral),
+}
+
+
+def config_from_hf(config_path: str | Path):
+    """(family, config) from an HF checkpoint dir or config.json path."""
+    path = Path(config_path)
+    if path.is_dir():
+        path = path / "config.json"
+    with open(path) as fp:
+        cfg = json.load(fp)
+    archs = cfg.get("architectures") or []
+    arch = archs[0] if archs else cfg.get("model_type", "?")
+    # accept model_type when architectures is absent (config-only exports)
+    by_type = {"llama": "LlamaForCausalLM", "mistral": "MistralForCausalLM",
+               "qwen2": "Qwen2ForCausalLM", "gemma": "GemmaForCausalLM",
+               "gpt2": "GPT2LMHeadModel", "mixtral": "MixtralForCausalLM"}
+    if arch not in _ARCH_BUILDERS and cfg.get("model_type") in by_type:
+        arch = by_type[cfg["model_type"]]
+    if arch not in _ARCH_BUILDERS:
+        raise ValueError(
+            f"unsupported architecture {arch!r} in {path}; supported: "
+            f"{', '.join(sorted(_ARCH_BUILDERS))}")
+    family, build = _ARCH_BUILDERS[arch]
+    return family, build(cfg, arch)

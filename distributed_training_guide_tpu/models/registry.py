@@ -63,6 +63,19 @@ def list_models() -> list[str]:
 
 
 def get_model(name: str, **overrides) -> ModelBundle:
+    if name.startswith("hf:"):
+        # AutoModelForCausalLM analogue (reference 01:57): build the family
+        # config from the checkpoint's own config.json (models/auto.py)
+        from .auto import config_from_hf
+
+        family, config = config_from_hf(name[3:])
+        if overrides:
+            config = dataclasses.replace(config, **overrides)
+        mod = family_module(family)
+        return ModelBundle(
+            name, config, mod.init, mod.apply, mod.param_logical_axes,
+            family=family,
+            **({"apply_with_aux": moe.apply_with_aux} if family == "moe" else {}))
     key = _HF_ALIASES.get(name.lower(), name.lower())
     if key in gpt2.PRESETS:
         config = gpt2.PRESETS[key]

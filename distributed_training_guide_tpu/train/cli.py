@@ -79,6 +79,12 @@ def get_parser() -> argparse.ArgumentParser:
                              "(auto at cp >= 8), unrolled = O(cp); per hop "
                              "the two are op-for-op identical")
     parser.add_argument("--max-steps", default=None, type=int)
+    parser.add_argument("--pretrained", default=None, metavar="DIR",
+                        help="directory produced by convert_llama.py / "
+                             "convert_hf_checkpoint: start from these weights "
+                             "instead of random init (the reference's "
+                             "from_pretrained default, 01:57); pairs with "
+                             "-m hf:<hf-dir> for checkpoints without a preset")
     parser.add_argument("--native-loader", action="store_true",
                         help="assemble batches with the C++ mmap/prefetch loader (csrc/)")
     parser.add_argument("--mmap-data", default=None, metavar="DIR",
@@ -142,6 +148,7 @@ def run_training(args, plan_factory: Callable, *, extra_log: Optional[dict] = No
     init_logging(jax.process_index(), jax.process_count())
     LOGGER.info({k: v for k, v in os.environ.items() if k.startswith(("JAX", "XLA", "TPU"))})
     LOGGER.info(vars(args))
+    pretrained_dir = pretrained_dir or getattr(args, "pretrained", None)
 
     plan = plan_factory()
     bundle = get_model(args.model_name)
@@ -174,7 +181,10 @@ def run_training(args, plan_factory: Callable, *, extra_log: Optional[dict] = No
         return run_preflight(trainer, global_batch=global_batch,
                              seq_length=seq_length)
 
-    tokenizer = get_tokenizer(args.model_name)
+    # hf:<dir> names strip to the checkpoint dir, which holds the tokenizer
+    tokenizer = get_tokenizer(args.model_name[3:]
+                              if args.model_name.startswith("hf:")
+                              else args.model_name)
     dataset = load_and_preprocess_data(
         args.dataset_name, tokenizer, seq_length,
         dataset_subset=args.dataset_subset,

@@ -437,14 +437,20 @@ class Trainer:
 
         # Offloaded storage is managed OUTSIDE the jit: pinned_host -> HBM
         # before the step, HBM -> pinned_host after, both async device_puts.
-        # In-jit memory-kind boundaries would let XLA stream leaf-by-leaf, but
-        # this jaxlib's SPMD partitioner rejects the placement annotation it
-        # emits for the rank-0 step/loss outputs whenever any boundary leaf is
-        # host-placed (spmd_partitioner.cc RET_CHECK "Side-effect HLO must
-        # have sharding"). Whole-state transfers match the reference's CPU
-        # offload semantics anyway (full grad D2H + host optimizer.step,
+        # In-jit memory-kind boundaries would let XLA stream leaf-by-leaf;
+        # re-verified blocked on jax 0.9 (round 4) in every variant: (a)
+        # replicated/scalar outputs lose sharding on their placement
+        # annotation (spmd_partitioner.cc:5743 RET_CHECK "Side-effect HLO
+        # must have sharding") whether the metrics are device- or host-
+        # placed; (b) tiling the metrics over the mesh instead trips
+        # "Side-effect ops cannot be replicated" on the host-placed state
+        # outputs; (c) a 1-device mesh sidesteps SPMD but the CPU backend
+        # has no runtime for annotate_device_placement, so the path is
+        # untestable off-TPU. Whole-state transfers match the reference's
+        # CPU offload semantics anyway (full grad D2H + host optimizer.step,
         # 05/README.md:191-224); HBM still only holds params/opt state for
-        # the duration of the step.
+        # the duration of the step. The sweep's offload_opt_b8 rung measures
+        # the actual round-trip cost on the real chip.
         def step_and_offload(state, batch):
             state = jax.device_put(state, self._device_state_shardings)
             new_state, metrics = jitted(state, batch)

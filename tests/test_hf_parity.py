@@ -187,6 +187,84 @@ def test_gemma_parity(tmp_path):
     assert np.isfinite(_one_train_step(bundle, plan, params, ids))
 
 
+def test_auto_hf_config_ingestion(tmp_path):
+    """The AutoModelForCausalLM analogue (reference 01:57): ``-m hf:<dir>``
+    builds the family config from the checkpoint's own config.json. Pins the
+    arch dispatch for all six supported architectures, full convert+logits
+    parity through an hf: bundle, and the loud unsupported-arch failure."""
+    from distributed_training_guide_tpu.models.auto import config_from_hf
+
+    # arch dispatch + field mapping, one per family flavor
+    cases = [
+        (transformers.MistralConfig(vocab_size=64, hidden_size=32,
+                                    intermediate_size=64, num_hidden_layers=2,
+                                    num_attention_heads=4, num_key_value_heads=2,
+                                    sliding_window=None), "llama",
+         lambda c: c.num_kv_heads == 2 and not c.attn_bias),
+        (transformers.Qwen2Config(vocab_size=64, hidden_size=32,
+                                  intermediate_size=64, num_hidden_layers=2,
+                                  num_attention_heads=4, num_key_value_heads=2),
+         "llama", lambda c: c.attn_bias),
+        (transformers.GemmaConfig(vocab_size=64, hidden_size=32,
+                                  intermediate_size=64, num_hidden_layers=2,
+                                  num_attention_heads=4, num_key_value_heads=1,
+                                  head_dim=16), "llama",
+         lambda c: c.norm_plus_one and c.scale_embed and c.head_dim == 16),
+        (transformers.GPT2Config(vocab_size=64, n_embd=32, n_layer=2,
+                                 n_head=4), "gpt2",
+         lambda c: c.num_layers == 2),
+        # Llama-arch checkpoints CAN carry QKV biases (attention_bias=true):
+        # they must not be silently dropped
+        (transformers.LlamaConfig(vocab_size=64, hidden_size=32,
+                                  intermediate_size=64, num_hidden_layers=2,
+                                  num_attention_heads=4, num_key_value_heads=2,
+                                  attention_bias=True), "llama",
+         lambda c: c.attn_bias),
+        (transformers.MixtralConfig(vocab_size=64, hidden_size=32,
+                                    intermediate_size=64, num_hidden_layers=2,
+                                    num_attention_heads=4, num_key_value_heads=2,
+                                    num_local_experts=4, num_experts_per_tok=2),
+         "moe", lambda c: c.num_experts == 4 and c.experts_per_token == 2),
+    ]
+    for i, (hf_cfg, want_family, check) in enumerate(cases):
+        d = tmp_path / f"cfg{i}"
+        d.mkdir()
+        hf_cfg.save_pretrained(d)
+        family, config = config_from_hf(d)
+        assert family == want_family, hf_cfg.architectures
+        assert config.vocab_size == 64 and check(config), config
+
+    # end-to-end: save real weights, build the bundle via hf:, convert, match
+    hf_cfg = transformers.Qwen2Config(
+        vocab_size=128, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=256, rope_theta=10000.0, rms_norm_eps=1e-5,
+        tie_word_embeddings=False)
+    torch.manual_seed(0)
+    model = transformers.Qwen2ForCausalLM(hf_cfg).eval()
+    model.save_pretrained(tmp_path / "hf", safe_serialization=True)
+
+    bundle = get_model(f"hf:{tmp_path / 'hf'}", dtype=jnp.float32)
+    assert bundle.config.attn_bias and bundle.config.hidden_size == 64
+    convert_hf_checkpoint(tmp_path / "hf", tmp_path / "conv", bundle=bundle)
+    plan = make_plan("single", make_mesh(devices=jax.devices()[:1]))
+    params = load_pretrained(bundle, _replicated_shardings(bundle, plan),
+                             tmp_path / "conv")
+    ids = np.random.RandomState(0).randint(0, 128, (2, 16))
+    ours = np.asarray(bundle.apply(bundle.config, params, jnp.asarray(ids)))
+    with torch.no_grad():
+        theirs = model(torch.tensor(ids)).logits.float().numpy()
+    np.testing.assert_allclose(ours, theirs, rtol=2e-4, atol=2e-4)
+
+    # loud failure on an unsupported architecture
+    bad = tmp_path / "bad"
+    bad.mkdir()
+    (bad / "config.json").write_text(
+        '{"architectures": ["FalconForCausalLM"], "model_type": "falcon"}')
+    with pytest.raises(ValueError, match="unsupported architecture"):
+        config_from_hf(bad)
+
+
 def test_mixtral_parity(tmp_path):
     """The MoE family against HF MixtralForCausalLM: same softmax-all ->
     top-k -> renormalize routing, so with capacity_factor = E (zero
