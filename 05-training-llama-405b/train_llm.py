@@ -67,7 +67,7 @@ def main():
         strategy = "tp_fsdp" if tp > 1 else "fsdp"
         return make_plan(strategy, make_mesh(tp=tp, fsdp=n // tp))
 
-    run_training(args, plan_factory, pretrained_dir=args.pretrained,
+    run_training(args, plan_factory,
                  offload_opt_state=args.offload_opt_state,
                  offload_params=args.offload_params)
 

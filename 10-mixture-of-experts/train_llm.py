@@ -46,7 +46,7 @@ def main():
         strategy = "ep_fsdp" if args.fsdp > 1 else "ep"
         return make_plan(strategy, make_mesh(ep=ep, fsdp=args.fsdp))
 
-    run_training(args, plan_factory, pretrained_dir=args.pretrained)
+    run_training(args, plan_factory)
 
 
 if __name__ == "__main__":

@@ -30,7 +30,13 @@ class ByteTokenizer:
 
 
 def get_tokenizer(model_name: str):
-    """HF tokenizer if locally cached, else the byte fallback."""
+    """HF tokenizer if locally cached, else the byte fallback.
+
+    ``hf:<dir>`` model names resolve to the checkpoint dir itself, which
+    holds tokenizer.json — handled here so every caller (chapter CLIs, the
+    engine) gets the right tokenizer without knowing about the prefix."""
+    if model_name.startswith("hf:"):
+        model_name = model_name[3:]
     try:
         from transformers import AutoTokenizer
 

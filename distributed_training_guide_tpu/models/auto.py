@@ -20,6 +20,29 @@ import json
 from pathlib import Path
 
 
+def _warn_unsupported_attention_extras(cfg: dict, arch: str) -> None:
+    """Loud notes for config features this family computes differently —
+    warnings, not errors, because training the architecture from scratch
+    (or within the unaffected regime) is legitimate."""
+    import logging
+
+    log = logging.getLogger(__name__)
+    window = cfg.get("sliding_window")
+    if window and window < cfg.get("max_position_embeddings", 4096):
+        # full attention == SWA only while seq_length <= window
+        log.warning(
+            f"{arch}: checkpoint uses sliding_window={window}; this family "
+            f"computes FULL causal attention — train/eval with seq_length "
+            f"<= {window} or logits diverge from HF")
+    if cfg.get("rope_scaling"):
+        log.warning(
+            f"{arch}: rope_scaling={cfg['rope_scaling']!r} is NOT "
+            f"implemented (plain RoPE at theta={cfg.get('rope_theta')}); "
+            f"logits will diverge from HF on long-context checkpoints — "
+            f"the registry's llama-3.1 presets cap max_position at 8192 "
+            f"for exactly this reason")
+
+
 def _llama_kwargs(cfg: dict) -> dict:
     kw = dict(
         vocab_size=cfg["vocab_size"],
@@ -44,10 +67,9 @@ _HF_ACTS = {"silu": "silu", "gelu_pytorch_tanh": "gelu_tanh",
 
 
 def _build_llama(cfg: dict, arch: str):
-    import logging
-
     from .llama import LlamaConfig
 
+    _warn_unsupported_attention_extras(cfg, arch)
     kw = _llama_kwargs(cfg)
     if arch == "Qwen2ForCausalLM":
         # default True: older Qwen2 configs omit the key because bias was
@@ -64,14 +86,6 @@ def _build_llama(cfg: dict, arch: str):
         raise ValueError(f"{arch}: unsupported hidden_act {act!r} "
                          f"(supported: {sorted(_HF_ACTS)})")
     kw["act_fn"] = _HF_ACTS[act]
-    window = cfg.get("sliding_window")
-    if window and window < kw["max_position_embeddings"]:
-        # full attention == SWA only while seq_length <= window; loud, not
-        # fatal, since short-seq training on e.g. Mistral-v0.1 is legitimate
-        logging.getLogger(__name__).warning(
-            f"{arch}: checkpoint uses sliding_window={window}; this family "
-            f"computes FULL causal attention — train/eval with seq_length "
-            f"<= {window} or logits diverge from HF")
     return LlamaConfig(**kw)
 
 
@@ -91,11 +105,15 @@ def _build_gpt2(cfg: dict, arch: str):
 def _build_mixtral(cfg: dict, arch: str):
     from .moe import MoELlamaConfig
 
-    return MoELlamaConfig(
+    _warn_unsupported_attention_extras(cfg, arch)
+    kw = dict(
         num_experts=cfg["num_local_experts"],
         experts_per_token=cfg["num_experts_per_tok"],
         **_llama_kwargs(cfg),
     )
+    if "router_aux_loss_coef" in cfg:   # HF Mixtral ships 0.02, not our 0.01
+        kw["router_aux_coef"] = cfg["router_aux_loss_coef"]
+    return MoELlamaConfig(**kw)
 
 
 _ARCH_BUILDERS = {
@@ -117,11 +135,13 @@ def config_from_hf(config_path: str | Path):
         cfg = json.load(fp)
     archs = cfg.get("architectures") or []
     arch = archs[0] if archs else cfg.get("model_type", "?")
-    # accept model_type when architectures is absent (config-only exports)
+    # accept model_type ONLY when architectures is absent (config-only
+    # exports) — a present-but-unsupported arch (e.g. a classification
+    # head) must hit the loud failure, not get remapped to causal LM
     by_type = {"llama": "LlamaForCausalLM", "mistral": "MistralForCausalLM",
                "qwen2": "Qwen2ForCausalLM", "gemma": "GemmaForCausalLM",
                "gpt2": "GPT2LMHeadModel", "mixtral": "MixtralForCausalLM"}
-    if arch not in _ARCH_BUILDERS and cfg.get("model_type") in by_type:
+    if not archs and cfg.get("model_type") in by_type:
         arch = by_type[cfg["model_type"]]
     if arch not in _ARCH_BUILDERS:
         raise ValueError(

@@ -181,10 +181,7 @@ def run_training(args, plan_factory: Callable, *, extra_log: Optional[dict] = No
         return run_preflight(trainer, global_batch=global_batch,
                              seq_length=seq_length)
 
-    # hf:<dir> names strip to the checkpoint dir, which holds the tokenizer
-    tokenizer = get_tokenizer(args.model_name[3:]
-                              if args.model_name.startswith("hf:")
-                              else args.model_name)
+    tokenizer = get_tokenizer(args.model_name)
     dataset = load_and_preprocess_data(
         args.dataset_name, tokenizer, seq_length,
         dataset_subset=args.dataset_subset,

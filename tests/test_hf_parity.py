@@ -223,8 +223,10 @@ def test_auto_hf_config_ingestion(tmp_path):
         (transformers.MixtralConfig(vocab_size=64, hidden_size=32,
                                     intermediate_size=64, num_hidden_layers=2,
                                     num_attention_heads=4, num_key_value_heads=2,
-                                    num_local_experts=4, num_experts_per_tok=2),
-         "moe", lambda c: c.num_experts == 4 and c.experts_per_token == 2),
+                                    num_local_experts=4, num_experts_per_tok=2,
+                                    router_aux_loss_coef=0.02),
+         "moe", lambda c: (c.num_experts == 4 and c.experts_per_token == 2
+                           and c.router_aux_coef == 0.02)),
     ]
     for i, (hf_cfg, want_family, check) in enumerate(cases):
         d = tmp_path / f"cfg{i}"
@@ -263,6 +265,15 @@ def test_auto_hf_config_ingestion(tmp_path):
         '{"architectures": ["FalconForCausalLM"], "model_type": "falcon"}')
     with pytest.raises(ValueError, match="unsupported architecture"):
         config_from_hf(bad)
+    # ...including a supported model_type with an UNsupported head: the
+    # model_type fallback must not remap a classification checkpoint
+    bad2 = tmp_path / "bad2"
+    bad2.mkdir()
+    (bad2 / "config.json").write_text(
+        '{"architectures": ["LlamaForSequenceClassification"], '
+        '"model_type": "llama"}')
+    with pytest.raises(ValueError, match="unsupported architecture"):
+        config_from_hf(bad2)
 
 
 def test_mixtral_parity(tmp_path):
