@@ -187,7 +187,7 @@ def test_gemma_parity(tmp_path):
     assert np.isfinite(_one_train_step(bundle, plan, params, ids))
 
 
-def test_auto_hf_config_ingestion(tmp_path):
+def test_auto_hf_config_ingestion(tmp_path, caplog):
     """The AutoModelForCausalLM analogue (reference 01:57): ``-m hf:<dir>``
     builds the family config from the checkpoint's own config.json. Pins the
     arch dispatch for all six supported architectures, full convert+logits
@@ -257,6 +257,30 @@ def test_auto_hf_config_ingestion(tmp_path):
     with torch.no_grad():
         theirs = model(torch.tensor(ids)).logits.float().numpy()
     np.testing.assert_allclose(ours, theirs, rtol=2e-4, atol=2e-4)
+
+    # unimplemented attention extras warn LOUDLY (not silently diverge):
+    # sliding_window narrower than the context, and rope_scaling
+    mist = tmp_path / "mist_swa"
+    mist.mkdir()
+    transformers.MistralConfig(
+        vocab_size=64, hidden_size=32, intermediate_size=64,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        sliding_window=4096, max_position_embeddings=32768).save_pretrained(mist)
+    rope = tmp_path / "llama_rope"
+    rope.mkdir()
+    transformers.LlamaConfig(
+        vocab_size=64, hidden_size=32, intermediate_size=64,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        rope_scaling={"rope_type": "llama3", "factor": 8.0,
+                      "original_max_position_embeddings": 8192,
+                      "low_freq_factor": 1.0, "high_freq_factor": 4.0},
+        max_position_embeddings=131072).save_pretrained(rope)
+    with caplog.at_level("WARNING",
+                         logger="distributed_training_guide_tpu.models.auto"):
+        config_from_hf(mist)
+        config_from_hf(rope)
+    assert "sliding_window=4096" in caplog.text
+    assert "rope_scaling" in caplog.text
 
     # loud failure on an unsupported architecture
     bad = tmp_path / "bad"
