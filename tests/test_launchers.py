@@ -1,0 +1,132 @@
+"""Unit tests for the process-runtime layer: the gang launcher's fail-fast /
+cleanup semantics and the supervisor's restart + hang-detection loop, driven
+with plain subprocesses (no jax, fast). The full-integration versions — real
+jax.distributed gangs through the chapter CLIs — live in
+test_multiprocess.py; these pin the launcher mechanics themselves, including
+paths the integration tests can't reach (launcher crash mid-spawn, heartbeat
+kill).
+"""
+import subprocess
+import sys
+import time
+
+import pytest
+
+from distributed_training_guide_tpu.launch.local import launch_gang
+from distributed_training_guide_tpu.launch.supervisor import run_supervised
+
+PY = sys.executable
+
+
+def test_gang_all_ranks_zero_exit():
+    rc = launch_gang([PY, "-c", "import os; exit(0)"], nproc=3)
+    assert rc == 0
+
+
+def test_gang_failfast_terminates_survivors(tmp_path):
+    """Rank 1 exits 7 immediately; rank 0 would sleep for 60 s — the gang
+    must come down with rc 7 in seconds, not minutes."""
+    marker = tmp_path / "r0_alive"
+    cmd = [PY, "-c", (
+        "import os, sys, time, pathlib\n"
+        f"marker = pathlib.Path({str(marker)!r})\n"
+        "if os.environ['RANK'] == '1':\n"
+        "    while not marker.exists():\n"   # rank 0 provably started first
+        "        time.sleep(0.05)\n"
+        "    sys.exit(7)\n"
+        "marker.write_text(os.environ['MASTER_PORT'])\n"
+        "time.sleep(60)\n")]
+    t0 = time.time()
+    rc = launch_gang(cmd, nproc=2, poll_interval=0.05)
+    assert rc == 7
+    assert time.time() - t0 < 30          # nowhere near rank 0's sleep
+    assert marker.exists()                # rank 0 really had started
+
+
+def test_gang_env_contract_and_per_rank_error_files(tmp_path):
+    """Every rank sees MASTER_ADDR/PORT + WORLD_SIZE + its RANK, and an
+    inherited ERROR_FILE is suffixed per rank (torchelastic convention)."""
+    out = tmp_path / "env"
+    out.mkdir()
+    cmd = [PY, "-c", (
+        "import os, pathlib\n"
+        f"d = pathlib.Path({str(out)!r})\n"
+        "(d / os.environ['RANK']).write_text(\n"
+        "    ','.join([os.environ['MASTER_ADDR'], os.environ['MASTER_PORT'],\n"
+        "              os.environ['WORLD_SIZE'], os.environ['ERROR_FILE']]))\n")]
+    rc = launch_gang(cmd, nproc=2,
+                     env_extra={"ERROR_FILE": str(tmp_path / "err.json")})
+    assert rc == 0
+    r0 = (out / "0").read_text().split(",")
+    r1 = (out / "1").read_text().split(",")
+    assert r0[0] == "127.0.0.1" and r0[:3] == r1[:3]   # same rendezvous
+    assert r0[3].endswith("err.json.rank0") and r1[3].endswith("err.json.rank1")
+
+
+def test_gang_cleans_up_when_launcher_itself_fails(tmp_path):
+    """A spawn failure mid-gang must not orphan already-started ranks
+    blocked waiting for peers (the finally-path _terminate_survivors)."""
+    import uuid
+
+    token = f"GANG_ORPHAN_TEST_{uuid.uuid4().hex}"
+    cmd = [PY, "-c", f"import time\n{token!r}\ntime.sleep(60)\n"]
+    # rank1.out pre-created as a DIRECTORY: rank 0 (stdout=None) spawns
+    # fine, then rank 1's log open("ab") raises IsADirectoryError — a spawn
+    # failure strictly after a rank is already running
+    log_dir = tmp_path / "logs"
+    (log_dir / "rank1.out").mkdir(parents=True)
+    with pytest.raises(OSError):
+        launch_gang(cmd, nproc=2, log_dir=str(log_dir))
+    # no process carrying the token may survive the finally-path cleanup
+    deadline = time.time() + 15
+    while time.time() < deadline:
+        alive = subprocess.run(["pgrep", "-f", token],
+                               capture_output=True).returncode == 0
+        if not alive:
+            return
+        time.sleep(0.2)
+    subprocess.run(["pkill", "-9", "-f", token])
+    pytest.fail("rank 0 orphaned after launcher failure")
+
+
+def test_supervisor_restarts_then_succeeds(tmp_path):
+    """Exit 3 on the first attempt (no sentinel), 0 on the second —
+    run_supervised must restart once and return 0, keeping per-attempt
+    logs and the ERROR_FILE env contract."""
+    sentinel = tmp_path / "ran_once"
+    cmd = [PY, "-c", (
+        "import os, pathlib, sys\n"
+        f"s = pathlib.Path({str(sentinel)!r})\n"
+        "print('attempt with ERROR_FILE', os.environ['ERROR_FILE'], flush=True)\n"
+        "if s.exists():\n"
+        "    sys.exit(0)\n"
+        "s.write_text('x')\n"
+        "sys.exit(3)\n")]
+    rc = run_supervised(cmd, max_restarts=2, log_dir=tmp_path / "logs")
+    assert rc == 0
+    out0 = (tmp_path / "logs" / "attempt_0" / "stdout.log").read_text()
+    out1 = (tmp_path / "logs" / "attempt_1" / "stdout.log").read_text()
+    assert "attempt_0" in out0 and "attempt_1" in out1   # per-attempt files
+
+
+def test_supervisor_exhausts_restarts(tmp_path):
+    rc = run_supervised([PY, "-c", "import sys; sys.exit(5)"],
+                        max_restarts=1, log_dir=tmp_path / "logs")
+    assert rc == 5
+    assert (tmp_path / "logs" / "attempt_1").is_dir()   # restarted once
+
+
+def test_supervisor_heartbeat_kills_hung_worker(tmp_path):
+    """A worker that stops producing output gets SIGKILLed after the
+    heartbeat timeout — the collective-stall case where the process never
+    exits (diagnosing-errors/README.md power-draw heuristic, in process
+    form)."""
+    cmd = [PY, "-c", (
+        "import time\n"
+        "print('alive', flush=True)\n"
+        "time.sleep(600)\n")]
+    t0 = time.time()
+    rc = run_supervised(cmd, max_restarts=0, log_dir=tmp_path / "logs",
+                        heartbeat_timeout=2.0)
+    assert rc != 0
+    assert time.time() - t0 < 120         # killed by heartbeat, not 600s
