@@ -43,6 +43,20 @@ def test_loader_deterministic_and_resume(eight_devices):
     assert not np.array_equal(a[0], np.asarray(d["input_ids"]))
 
 
+def test_loader_grad_accum_reshape_matches_flat(eight_devices):
+    """grad_accum>1 reshapes each global batch to [A, B/A, S] with a
+    leading scanned microbatch axis — same rows, same order as the flat
+    batch, just refactored (pins _assemble_batch's leading-shape path)."""
+    plan = make_plan("ddp", make_mesh())
+    flat = _loader(plan, gb=16, accum=1)
+    accum = _loader(plan, gb=16, accum=2)   # microbatch 8 = dp size
+    for fb, ab in zip(flat.epoch_batches(), accum.epoch_batches()):
+        f = np.asarray(fb["input_ids"])
+        a = np.asarray(ab["input_ids"])
+        assert a.shape == (2, 8, 16)
+        np.testing.assert_array_equal(a.reshape(16, 16), f)
+
+
 def test_loader_sharded_batch(eight_devices):
     plan = make_plan("ddp", make_mesh())
     loader = _loader(plan)
