@@ -171,6 +171,22 @@ def test_gang_tp_spans_process_boundary(tmp_path, warm_cache):
     assert "'tp': 8" in rank0
 
 
+def test_gang_ring_cp_spans_process_boundary(tmp_path, warm_cache):
+    """cp=8 on a 2-process x 4-device gang: the zigzag ring's ppermute hops
+    cross the process boundary every cycle — the long-context regime a
+    real pod runs (ring over ICI/DCN), never reachable single-process."""
+    worker = [sys.executable, str(REPO / "08-context-parallel" / "train_llm.py"),
+              *TRAIN_FLAGS, "--max-steps", "3", "--context-parallel", "8",
+              "--attn-impl", "xla", "--save-dir", str(tmp_path / "out")]
+    rc, rank0, (rank1,) = run_gang(worker, log_dir=str(tmp_path / "logs"))
+    assert rc == 0, rank0[-3000:]
+    losses = losses_by_step(rank0)
+    assert set(losses) == {1, 2, 3}
+    assert all(5.0 < v < 7.5 for v in losses.values()), losses
+    assert losses_by_step(rank1) == losses
+    assert "'cp': 8" in rank0
+
+
 def test_gang_checkpoint_resume_bitexact(tmp_path, warm_cache):
     """Multihost Orbax save (every process writes its shards, process 0
     swings state.json behind a barrier) + restore in a FRESH gang, compared
