@@ -187,6 +187,27 @@ def test_gang_ring_cp_spans_process_boundary(tmp_path, warm_cache):
     assert "'cp': 8" in rank0
 
 
+def test_gang_moe_ep_spans_process_boundary(tmp_path, warm_cache):
+    """ep=8 on a 2-process x 4-device gang: the MoE token all-to-all
+    dispatches across the process boundary (each process hosts half the
+    experts). With ddp/fsdp (all-reduce/all-gather), tp (per-layer
+    reductions), and ring cp (ppermute) above, this completes the
+    cross-process coverage of every collective family the framework emits."""
+    worker = [sys.executable,
+              str(REPO / "10-mixture-of-experts" / "train_llm.py"),
+              "-m", "moe-debug", "-d", "synthetic:60000", "-s", "64",
+              "-b", "1", "--num-epochs", "2", "--log-freq", "1",
+              "--max-steps", "3", "--expert-parallel", "8",
+              "--save-dir", str(tmp_path / "out")]
+    rc, rank0, (rank1,) = run_gang(worker, log_dir=str(tmp_path / "logs"))
+    assert rc == 0, rank0[-3000:]
+    losses = losses_by_step(rank0)
+    assert set(losses) == {1, 2, 3}
+    assert all(5.0 < v < 7.5 for v in losses.values()), losses
+    assert losses_by_step(rank1) == losses
+    assert "'ep': 8" in rank0
+
+
 def test_gang_checkpoint_resume_bitexact(tmp_path, warm_cache):
     """Multihost Orbax save (every process writes its shards, process 0
     swings state.json behind a barrier) + restore in a FRESH gang, compared
