@@ -187,6 +187,24 @@ def test_gang_ring_cp_spans_process_boundary(tmp_path, warm_cache):
     assert "'cp': 8" in rank0
 
 
+def test_gang_pipeline_stage_per_process(tmp_path, warm_cache):
+    """pp=2 on a 2-process x 4-device gang with the pp axis outermost:
+    each pipeline stage lives on one process, so every 1F1B activation /
+    cotangent handoff crosses the process boundary — how a pod actually
+    runs pipeline parallelism (stages over DCN)."""
+    worker = [sys.executable, str(REPO / "09-pipeline-parallel" / "train_llm.py"),
+              *TRAIN_FLAGS, "-b", "4",   # microbatch (gb/4) must cover dp=4
+              "--max-steps", "3", "--pipeline-parallel", "2",
+              "--save-dir", str(tmp_path / "out")]
+    rc, rank0, (rank1,) = run_gang(worker, log_dir=str(tmp_path / "logs"))
+    assert rc == 0, rank0[-3000:]
+    losses = losses_by_step(rank0)
+    assert set(losses) == {1, 2, 3}
+    assert all(5.0 < v < 7.5 for v in losses.values()), losses
+    assert losses_by_step(rank1) == losses
+    assert "'pp': 2" in rank0
+
+
 def test_gang_moe_ep_spans_process_boundary(tmp_path, warm_cache):
     """ep=8 on a 2-process x 4-device gang: the MoE token all-to-all
     dispatches across the process boundary (each process hosts half the
