@@ -28,6 +28,7 @@ from __future__ import annotations
 
 import argparse
 import functools
+import hashlib
 import json
 import os
 import subprocess
@@ -412,8 +413,6 @@ SWEEP_QUEUE = [
     # trade (the reference's MixedPrecisionPolicy keeps fp32 shards)
     dict(name="bf16_params_b16", model="llama-650m", batch=16, seq=2048,
          remat=True, remat_policy="attn", param_dtype="bfloat16"),
-    dict(name="fence4", model="llama-650m", batch=8, seq=2048,
-         remat=True, remat_policy="attn", fence_every=4),
     dict(name="lion_b16", model="llama-650m", batch=16, seq=2048,
          remat=True, remat_policy="attn", optimizer="lion"),
     dict(name="loss_chunks8", model="llama-650m", batch=8, seq=2048,
@@ -437,15 +436,67 @@ SWEEP_QUEUE = [
     # reference's 405B recipe pays ~4 s/step for (its README:274).
     dict(name="offload_opt_b8", model="llama-650m", batch=8, seq=2048,
          remat=True, remat_policy="attn", offload_opt_state=True),
+    # --- round-4 follow-ups, informed by the 2026-07-31 on-chip results:
+    # adafactor fits b16 (52.8%) but OOMs at b24; attn_mlp+adafactor fits b8
+    # (52.4%) but OOMs at b16; bf16 state fits b16 (53.1%). Probe the
+    # boundaries and the remaining crosses.
+    dict(name="adafactor_b20", model="llama-650m", batch=20, seq=2048,
+         remat=True, remat_policy="attn", optimizer="adafactor"),
+    dict(name="adafactor_attnmlp_b12", model="llama-650m", batch=12, seq=2048,
+         remat=True, remat_policy="attn_mlp", optimizer="adafactor"),
+    dict(name="bf16_adafactor_b24", model="llama-650m", batch=24, seq=2048,
+         remat=True, remat_policy="attn", optimizer="adafactor",
+         param_dtype="bfloat16"),
+    dict(name="bf16_b20", model="llama-650m", batch=20, seq=2048,
+         remat=True, remat_policy="attn", param_dtype="bfloat16"),
+    dict(name="seq4k_adafactor_b8", model="llama-650m", batch=8, seq=4096,
+         remat=True, remat_policy="attn", optimizer="adafactor"),
+    dict(name="lion_b8", model="llama-650m", batch=8, seq=2048,
+         remat=True, remat_policy="attn", optimizer="lion"),
+    # beyond-parity: single-chip MoE throughput (the reference has no MoE
+    # chapter at all). MFU here is vs *active* params (num_active_params),
+    # the standard MoE accounting.
+    dict(name="moe1b_adafactor_b8", model="moe-1b-8e", batch=8, seq=2048,
+         remat=True, remat_policy="attn", optimizer="adafactor"),
+    # LAST on purpose: fence_every=4 dispatches 4 steps ahead, the exact
+    # pattern this pool's documented failure mode punishes — its first
+    # attempt (2026-07-31 03:50) stalled and the pool went down with it.
+    # Keep it queued (the lever matters on healthy pods) but never let it
+    # run ahead of unmeasured experiments again.
+    dict(name="fence4", model="llama-650m", batch=8, seq=2048,
+         remat=True, remat_policy="attn", fence_every=4),
 ]
 
 
+def _append_sweep_log(rec: dict) -> None:
+    """Durably record + emit one sweep-log line (best-effort on disk)."""
+    try:
+        with open(SWEEP_LOG_PATH, "a") as f:
+            f.write(json.dumps(rec) + "\n")
+    except OSError:
+        pass
+    _emit(rec)
+
+
+def _exp_hash(exp: dict) -> str:
+    """Stable fingerprint of a sweep experiment's config (name excluded):
+    sweep-log records bind to it so results/OOMs from an older config under
+    a reused name never satisfy or retire the current experiment."""
+    spec = {k: v for k, v in exp.items() if k != "name"}
+    blob = json.dumps(spec, sort_keys=True, default=str)
+    return hashlib.sha1(blob.encode()).hexdigest()[:12]
+
+
 def run_sweep(watchdog: int) -> None:
-    """Probe-gated experiment queue. Resumable: experiments whose name already
-    has a complete result in SWEEP_LOG_PATH are skipped; a rung that stalls
-    mid-run is retried once after the pool answers a probe again."""
+    """Probe-gated experiment queue. Resumable: an experiment is skipped when
+    SWEEP_LOG_PATH holds a complete result for its (name, config hash), or is
+    retired (`retired_oom`) after two recorded device-OOMs at that exact
+    hash; a rung that stalls mid-run is retried once after the pool answers
+    a probe again, and bare pool-capacity rejections back off on their own
+    budget without consuming either attempt."""
     deadline = time.time() + (watchdog if watchdog else 7 * 86400)
     done = set()
+    oom_counts = {}
     try:
         with open(SWEEP_LOG_PATH) as f:
             for line in f:
@@ -454,8 +505,16 @@ def run_sweep(watchdog: int) -> None:
                 except ValueError:
                     continue
                 res = rec.get("result") or {}
+                # all skip decisions key by (name, config hash): a record
+                # from an older config under a reused name must not satisfy
+                # or retire the new experiment. (Every record in the log
+                # carries a hash — pre-hash-era records were backfilled from
+                # their then-current configs, 2026-07-31.)
+                key = (rec.get("name"), rec.get("config_hash"))
                 if res.get("value", 0) > 0 and not res.get("partial"):
-                    done.add(rec.get("name"))
+                    done.add(key)
+                elif rec.get("kind") == "oom":
+                    oom_counts[key] = oom_counts.get(key, 0) + 1
     except OSError:
         pass
 
@@ -465,9 +524,20 @@ def run_sweep(watchdog: int) -> None:
         return kind == "ok" and bool(lines)
 
     for exp in SWEEP_QUEUE:
-        if exp["name"] in done:
+        h = _exp_hash(exp)
+        if (exp["name"], h) in done:
             continue
-        for attempt in (1, 2):
+        # an OOM at fixed config is deterministic (compile-time HBM
+        # exhaustion): two recorded OOM attempts at THIS exact config settle
+        # the experiment — don't re-burn healthy window re-proving it on
+        # every worker relaunch. Emit the decision so the log distinguishes
+        # "retired by policy" from "never reached".
+        if oom_counts.get((exp["name"], h), 0) >= 2:
+            _emit({"sweep": exp["name"], "status": "retired_oom",
+                   "config_hash": h})
+            continue
+        attempt, backoffs = 0, 0
+        while attempt < 2:
             while time.time() < deadline and not pool_up():
                 _emit({"sweep": exp["name"], "status": "pool_down",
                        "utc": time.strftime("%H:%M:%SZ", time.gmtime())})
@@ -485,24 +555,41 @@ def run_sweep(watchdog: int) -> None:
             if budget < 90:
                 return
             lines, kind = _run_child(["--rung", json.dumps(spec)], budget=budget)
+            if kind == "pool_exhausted" and not any(
+                    r.get("metric") == "mfu" and r["value"] > 0 for r in lines):
+                # transient pool-capacity rejection (NOT device OOM, NOT a
+                # crash): the tiny --probe child can pass while a full rung's
+                # allocation is refused, so the pool_up() gate never engages.
+                # Back off on a budget of its own — a backoff must neither
+                # consume one of the two real attempts nor starve them.
+                backoffs += 1
+                if backoffs > 4:
+                    _append_sweep_log(
+                        {"name": exp["name"], "kind": "gave_up_pool_exhausted",
+                         "config_hash": h, "attempts_used": attempt,
+                         "utc": time.strftime("%Y-%m-%dT%H:%M:%SZ",
+                                              time.gmtime()),
+                         "result": None})
+                    break
+                _emit({"sweep": exp["name"], "status": "pool_exhausted_backoff",
+                       "utc": time.strftime("%H:%M:%SZ", time.gmtime())})
+                time.sleep(min(180, max(1, deadline - time.time())))
+                continue
+            attempt += 1
             results = [r for r in lines
                        if r.get("metric") == "mfu" and r["value"] > 0]
             best = results[-1] if results else None
-            rec = {"name": exp["name"], "attempt": attempt, "kind": kind,
-                   "utc": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
-                   "result": best}
-            try:
-                with open(SWEEP_LOG_PATH, "a") as f:
-                    f.write(json.dumps(rec) + "\n")
-            except OSError:
-                pass
-            _emit(rec)
+            _append_sweep_log(
+                {"name": exp["name"], "attempt": attempt, "kind": kind,
+                 "config_hash": h,
+                 "utc": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+                 "result": best})
             if best is not None and not best.get("partial"):
                 _save_last_good(best)
                 break   # complete result: next experiment
             if kind == "ok":
                 break   # clean exit without a number: don't burn a retry
-        # else: stalled/crashed twice — move on
+        # two stalled/crashed attempts, or gave up on capacity — move on
 
 def _run_child(mode_args: list, budget: float) -> tuple:
     """Run this script in child mode; return (parsed JSON lines from stdout,
@@ -516,9 +603,18 @@ def _run_child(mode_args: list, budget: float) -> tuple:
         out, err = proc.communicate(timeout=budget)
         if proc.returncode == 0:
             kind = "ok"
-        elif "RESOURCE_EXHAUSTED" in err or "Out of memory" in err \
-                or "Largest program allocations" in err:
+        elif ("Out of memory" in err or "Largest program allocations" in err
+                or "Error allocating device buffer" in err):
+            # device HBM exhaustion only, by XLA's canonical markers:
+            # compile-time OOM carries an allocation dump, runtime buffer
+            # OOM says "Error allocating device buffer". Deliberately
+            # strict — an oom record can permanently retire a sweep config
+            # (>=2 rule in run_sweep), so a transient pool-capacity
+            # RESOURCE_EXHAUSTED must never land here; the reverse
+            # misclassification only costs a retry.
             kind = "oom"
+        elif "RESOURCE_EXHAUSTED" in err:
+            kind = "pool_exhausted"
         else:
             kind = f"crashed_rc_{proc.returncode}"
     except subprocess.TimeoutExpired:
@@ -672,14 +768,17 @@ def main() -> None:
                        **({"fence_every": args.fence_every}
                           if args.fence_every else {}))]
     elif platform == "tpu":
-        # headline: remat_policy="attn" keeps only attention outputs + flash
-        # lse, so backward never re-runs the attention kernel (measured
-        # 50.5% vs 48.5% MFU for "all" on v5e, 2026-07-29); rung 2 is the
-        # min-memory "all" fallback at the same shape
+        # headline: adafactor frees the two fp32 Adam moments (~5.2 GB at
+        # 650M), buying batch 16 under remat_policy="attn" — measured 52.8%
+        # MFU on v5e, 2026-07-31 (sweep `adafactor_b16`), vs 50.5% for the
+        # prior adamw/b8 recipe (rung 2) and 48.5% for policy "all" (rung 3)
         ladder = [
+            dict(model="llama-650m", batch=16, seq=2048, steps=args.steps,
+                 warmup=args.warmup, remat=True, remat_policy="attn",
+                 optimizer="adafactor", attn_impl=args.attn_impl, budget=600),
             dict(model="llama-650m", batch=8, seq=2048, steps=args.steps,
                  warmup=args.warmup, remat=True, remat_policy="attn",
-                 attn_impl=args.attn_impl, budget=600),
+                 attn_impl=args.attn_impl, budget=480),
             dict(model="llama-650m", batch=8, seq=2048, steps=args.steps,
                  warmup=args.warmup, remat=True, attn_impl=args.attn_impl,
                  budget=420),
@@ -759,13 +858,18 @@ def main() -> None:
                 break
 
     # bonus pass: the HEADLINE rung fully succeeded (pool is demonstrably
-    # healthy) — measure the min-memory "all" policy at the same shape so
-    # every healthy run records the attn-vs-all delta. ("dots" is NOT
-    # retried: BENCH.md records it OOMing at this shape on the 16 GB chip.)
-    # Only the A/B run's own COMPLETE result may displace the verified one.
-    if (top_rung_ok and platform == "tpu" and len(ladder) > 1
+    # healthy) — measure the min-memory "all" policy rung so every healthy
+    # run records the remat-policy delta. Selected by predicate, NOT by
+    # ladder index: rung order changes with each retuned headline. ("dots"
+    # is NOT retried: BENCH.md records it OOMing at this shape on the 16 GB
+    # chip.) Only the A/B run's own COMPLETE result may displace the
+    # verified one.
+    ab_rung = next((r for r in ladder[1:]
+                    if "remat_policy" not in r and r["model"] == "llama-650m"),
+                   None)
+    if (top_rung_ok and platform == "tpu" and ab_rung is not None
             and deadline - time.time() > 420):
-        tuned_res = try_rung(dict(ladder[1], budget=360), attempt=1)
+        tuned_res = try_rung(dict(ab_rung, budget=360), attempt=1)
         if (tuned_res is not None and not tuned_res.get("partial")
                 and tuned_res["value"] > final["value"]):
             final = dict(tuned_res)

@@ -320,6 +320,13 @@ PRESETS = {
     "moe-debug": MoELlamaConfig(vocab_size=512, hidden_size=64, intermediate_size=128,
                                 num_layers=2, num_heads=4, num_kv_heads=2,
                                 num_experts=4, max_position_embeddings=256),
+    # single-chip benchable MoE: ~0.9B total / ~0.3B active (top-2 of 8),
+    # llama-650m-family dims scaled so fp32 state + remat fits 16 GB HBM
+    "moe-1b-8e": MoELlamaConfig(vocab_size=32000, hidden_size=1024,
+                                intermediate_size=2816, num_layers=12,
+                                num_heads=16, num_kv_heads=4, num_experts=8,
+                                experts_per_token=2,
+                                max_position_embeddings=4096),
     # Mixtral-8x7B-shaped (public model card dims)
     "mixtral-8x7b": MoELlamaConfig(vocab_size=32000, hidden_size=4096,
                                    intermediate_size=14336, num_layers=32,

@@ -342,7 +342,9 @@ def test_sweep_is_probe_gated_and_resumable(monkeypatch, capsys):
                   remat=True, remat_policy="attn", optimizer="adafactor")]
     monkeypatch.setattr(bench, "SWEEP_QUEUE", queue)
     with open(bench.SWEEP_LOG_PATH, "w") as f:   # exp_a already done
-        f.write(json.dumps({"name": "exp_a", "result": _mfu(0.49)}) + "\n")
+        f.write(json.dumps({"name": "exp_a",
+                            "config_hash": bench._exp_hash(queue[0]),
+                            "result": _mfu(0.49)}) + "\n")
     sleeps = []
     monkeypatch.setattr(bench.time, "sleep", sleeps.append)
     fake = FakeChildren([([_mfu(0.52)], "ok")], probe_responses=[False, True])
@@ -357,6 +359,67 @@ def test_sweep_is_probe_gated_and_resumable(monkeypatch, capsys):
         recs = [json.loads(l) for l in f]
     assert recs[-1]["name"] == "exp_b" and recs[-1]["result"]["value"] == 0.52
     assert bench._load_last_good()["value"] == 0.52
+
+
+def test_sweep_hash_binding_and_oom_retirement(monkeypatch, capsys):
+    """Records bind to their config hash: a complete result from an OLDER
+    config under a reused name does not skip the current experiment, and two
+    OOMs at the exact current config retire it (emitting retired_oom)."""
+    queue = [dict(name="exp_a", model="llama-650m", batch=8, seq=2048,
+                  remat=True, remat_policy="attn_mlp"),
+             dict(name="exp_b", model="llama-650m", batch=16, seq=2048,
+                  remat=True, remat_policy="attn")]
+    monkeypatch.setattr(bench, "SWEEP_QUEUE", queue)
+    stale_exp_a = dict(queue[0], batch=4)      # older config, same name
+    with open(bench.SWEEP_LOG_PATH, "w") as f:
+        f.write(json.dumps({"name": "exp_a",
+                            "config_hash": bench._exp_hash(stale_exp_a),
+                            "result": _mfu(0.40)}) + "\n")
+        for _ in (1, 2):                       # exp_b: deterministic OOM x2
+            f.write(json.dumps({"name": "exp_b", "kind": "oom",
+                                "config_hash": bench._exp_hash(queue[1]),
+                                "result": None}) + "\n")
+    fake = FakeChildren([([_mfu(0.50)], "ok")])
+    monkeypatch.setattr(bench, "_run_child", fake)
+    monkeypatch.setattr(sys, "argv", ["bench.py", "--watchdog", "0", "--sweep"])
+    try:
+        bench.main()
+    except SystemExit as e:
+        assert (e.code or 0) == 0
+    out_lines = [json.loads(l) for l in capsys.readouterr().out.splitlines()
+                 if l.startswith("{")]
+    rung_calls = [c for c in fake.calls if c[0] == "--rung"]
+    assert len(rung_calls) == 1                  # exp_a re-run, exp_b skipped
+    assert json.loads(rung_calls[0][1])["remat_policy"] == "attn_mlp"
+    retired = [l for l in out_lines if l.get("status") == "retired_oom"]
+    assert [l["sweep"] for l in retired] == ["exp_b"]
+
+
+def test_sweep_pool_exhausted_backs_off_without_burning_attempts(
+        monkeypatch, capsys):
+    """A bare-capacity rejection (pool_exhausted) sleeps and relaunches
+    instead of consuming one of the two real attempts."""
+    queue = [dict(name="exp_a", model="llama-650m", batch=8, seq=2048,
+                  remat=True, remat_policy="attn")]
+    monkeypatch.setattr(bench, "SWEEP_QUEUE", queue)
+    open(bench.SWEEP_LOG_PATH, "w").close()
+    sleeps = []
+    monkeypatch.setattr(bench.time, "sleep", sleeps.append)
+    fake = FakeChildren([([], "pool_exhausted"), ([], "pool_exhausted"),
+                         ([_mfu(0.51)], "ok")])
+    monkeypatch.setattr(bench, "_run_child", fake)
+    monkeypatch.setattr(sys, "argv", ["bench.py", "--watchdog", "0", "--sweep"])
+    try:
+        bench.main()
+    except SystemExit as e:
+        assert (e.code or 0) == 0
+    rung_calls = [c for c in fake.calls if c[0] == "--rung"]
+    assert len(rung_calls) == 3        # 2 backoffs + the real (first) attempt
+    assert len(sleeps) == 2            # one backoff sleep per rejection
+    with open(bench.SWEEP_LOG_PATH) as f:
+        recs = [json.loads(l) for l in f]
+    assert recs[-1]["attempt"] == 1    # backoffs did not consume attempts
+    assert recs[-1]["result"]["value"] == 0.51
 
 
 def test_explicit_flags_build_single_rung(monkeypatch, capsys):
