@@ -503,6 +503,24 @@ SWEEP_QUEUE = [
     dict(name="fence4_adafactor_attnmlp_seq4k_b8", model="llama-650m",
          batch=8, seq=4096, remat=True, remat_policy="attn_mlp",
          optimizer="adafactor", fence_every=4),
+    # --- tinyllama diagnosis: 1.1b measured a suspicious 33.6%
+    # (tinyllama_adafactor_lc8) where a bigger model should have HIGHER
+    # arithmetic intensity than 650m. Hypothesis: fp32 params (4.4 GB) +
+    # fp32 grads + activations sit at the 16 GB ceiling -> XLA spills.
+    # bf16 params halve the resident params; attn_mlp shrinks activations;
+    # chunked CE already on. If the 1.1b recipe beats 56.8%, it becomes
+    # the headline candidate for round 5.
+    dict(name="tinyllama_bf16_adafactor_attnmlp_fence4_b8",
+         model="tinyllama-1.1b", batch=8, seq=2048, remat=True,
+         remat_policy="attn_mlp", optimizer="adafactor",
+         param_dtype="bfloat16", fence_every=4, loss_chunks=8),
+    dict(name="tinyllama_bf16_adafactor_fence4_b4",
+         model="tinyllama-1.1b", batch=4, seq=2048, remat=True,
+         remat_policy="attn", optimizer="adafactor",
+         param_dtype="bfloat16", fence_every=4, loss_chunks=8),
+    dict(name="tinyllama_adafactor_fence4_b4", model="tinyllama-1.1b",
+         batch=4, seq=2048, remat=True, remat_policy="attn",
+         optimizer="adafactor", fence_every=4, loss_chunks=8),
 ]
 
 

@@ -1,13 +1,14 @@
 """AutoModel-style config ingestion: HF ``config.json`` -> a native bundle.
 
 The reference trains *any* HF causal LM via ``AutoModelForCausalLM``
-(``01-single-gpu/train_llm.py:57``). The native families here cover six HF
-architectures; this module removes the remaining friction — needing a
+(``01-single-gpu/train_llm.py:57``). The native families here cover seven
+HF architectures; this module removes the remaining friction — needing a
 registry preset for every size variant. ``-m hf:<dir>`` (or
 ``get_model("hf:<dir>")``) reads the checkpoint's own ``config.json``,
 recognizes the architecture, and builds the exact family config — so any
-Llama/Mistral/Qwen2/Gemma/GPT-2/Mixtral checkpoint trains (and converts,
-``models/hf_convert.py``) without touching the registry:
+Llama/Mistral/Qwen2/Gemma/GPT-2/Mixtral/GPT-NeoX(Pythia) checkpoint
+trains (and converts, ``models/hf_convert.py``) without touching the
+registry:
 
     python convert_llama.py <hf-dir> <conv> hf:<hf-dir>
     python train_llm.py -m hf:<hf-dir> --pretrained <conv> ...
@@ -116,6 +117,31 @@ def _build_mixtral(cfg: dict, arch: str):
     return MoELlamaConfig(**kw)
 
 
+def _build_neox(cfg: dict, arch: str):
+    from .neox import NeoXConfig
+
+    _warn_unsupported_attention_extras(cfg, arch)  # rope_scaling, notably
+    act = cfg.get("hidden_act", "gelu")
+    acts = {"gelu": "gelu", "gelu_new": "gelu_tanh",
+            "gelu_pytorch_tanh": "gelu_tanh"}
+    if act not in acts:
+        raise ValueError(f"{arch}: unsupported hidden_act {act!r} "
+                         f"(supported: {sorted(acts)})")
+    return NeoXConfig(
+        vocab_size=cfg["vocab_size"],
+        hidden_size=cfg["hidden_size"],
+        intermediate_size=cfg["intermediate_size"],
+        num_layers=cfg["num_hidden_layers"],
+        num_heads=cfg["num_attention_heads"],
+        max_position_embeddings=cfg.get("max_position_embeddings", 2048),
+        rotary_pct=cfg.get("rotary_pct", 0.25),
+        rope_theta=cfg.get("rotary_emb_base", 10000.0),
+        layer_norm_eps=cfg.get("layer_norm_eps", 1e-5),
+        use_parallel_residual=cfg.get("use_parallel_residual", True),
+        act_fn=acts[act],
+    )
+
+
 _ARCH_BUILDERS = {
     "LlamaForCausalLM": ("llama", _build_llama),
     "MistralForCausalLM": ("llama", _build_llama),
@@ -123,6 +149,7 @@ _ARCH_BUILDERS = {
     "GemmaForCausalLM": ("llama", _build_llama),
     "GPT2LMHeadModel": ("gpt2", _build_gpt2),
     "MixtralForCausalLM": ("moe", _build_mixtral),
+    "GPTNeoXForCausalLM": ("neox", _build_neox),
 }
 
 
@@ -140,7 +167,8 @@ def config_from_hf(config_path: str | Path):
     # head) must hit the loud failure, not get remapped to causal LM
     by_type = {"llama": "LlamaForCausalLM", "mistral": "MistralForCausalLM",
                "qwen2": "Qwen2ForCausalLM", "gemma": "GemmaForCausalLM",
-               "gpt2": "GPT2LMHeadModel", "mixtral": "MixtralForCausalLM"}
+               "gpt2": "GPT2LMHeadModel", "mixtral": "MixtralForCausalLM",
+               "gpt_neox": "GPTNeoXForCausalLM"}
     if not archs and cfg.get("model_type") in by_type:
         arch = by_type[cfg["model_type"]]
     if arch not in _ARCH_BUILDERS:

@@ -16,11 +16,12 @@ materialization and the broadcast:
    all-buffer special case (the reference must hand-broadcast non-persistent
    buffers, ``05:131-139``; we have no buffers outside the pytree).
 
-Name mapping covers the Llama, GPT-2, and MoE families (HF
-``LlamaForCausalLM`` / ``GPT2LMHeadModel`` / ``MixtralForCausalLM``
-conventions; torch Linear stores [out, in] so most leaves transpose,
-GPT-2's Conv1D stores [in, out] so they don't; Mixtral's per-expert
-Linears stack onto the [L, E, ...] expert dim). Mistral, Qwen2, and Gemma
+Name mapping covers the Llama, GPT-2, MoE, and GPT-NeoX families (HF
+``LlamaForCausalLM`` / ``GPT2LMHeadModel`` / ``MixtralForCausalLM`` /
+``GPTNeoXForCausalLM`` conventions; torch Linear stores [out, in] so most
+leaves transpose, GPT-2's Conv1D stores [in, out] so they don't; Mixtral's
+per-expert Linears stack onto the [L, E, ...] expert dim; NeoX's fused QKV
+de-interleaves from per-head [h, 3, d] to the tp-shardable [E, 3, h*d]). Mistral, Qwen2, and Gemma
 checkpoints ride the Llama map unchanged — Mistral shares the tensor
 names exactly, Qwen2 adds the QKV bias rows, Gemma's differences (GeGLU,
 (1+w) norms, sqrt(E)-scaled embeddings, MQA, explicit head_dim, tied
@@ -139,8 +140,70 @@ def _map_mixtral(name: str):
     return _map_llama(name)
 
 
-_FAMILY_MAPS: dict[str, Callable] = {"llama": _map_llama, "gpt2": _map_gpt2,
-                                     "moe": _map_mixtral}
+def _make_map_neox(config):
+    """HF ``GPTNeoXForCausalLM`` -> the NeoX family layout (models/neox.py).
+
+    The fused ``query_key_value`` Linear interleaves PER HEAD on its out
+    dim — ``[heads, 3, head_dim]`` flattened — while the native layout is
+    ``[E, 3, heads*head_dim]`` (trailing head dim shards over tp, see
+    models/gpt2.py). The mapper therefore returns a *callable* transform
+    (not just a transpose flag) that de-interleaves; it needs the head
+    shape, hence the config-taking factory."""
+    h, d = config.num_heads, config.head_size
+
+    def deinterleave_qkv_w(w):   # [3e, e] Linear [out, in], out = (h, 3, d)
+        e = w.shape[1]
+        return w.reshape(h, 3, d, e).transpose(3, 1, 0, 2).reshape(e, 3, h * d)
+
+    def deinterleave_qkv_b(b):   # [3e] = (h, 3, d)
+        return b.reshape(h, 3, d).transpose(1, 0, 2).reshape(3, h * d)
+
+    def mapper(name: str):
+        if name == "embed_out.weight":   # untied head, outside gpt_neox.*
+            return "embed_out", None, True
+        name = name.removeprefix("gpt_neox.")
+        m = re.match(r"layers\.(\d+)\.(.+)", name)
+        if m:
+            idx, rest = int(m.group(1)), m.group(2)
+            table = {
+                "input_layernorm.weight": ("layers.ln1.scale", False),
+                "input_layernorm.bias": ("layers.ln1.bias", False),
+                "post_attention_layernorm.weight": ("layers.ln2.scale", False),
+                "post_attention_layernorm.bias": ("layers.ln2.bias", False),
+                "attention.query_key_value.weight":
+                    ("layers.attn.wqkv", deinterleave_qkv_w),
+                "attention.query_key_value.bias":
+                    ("layers.attn.bqkv", deinterleave_qkv_b),
+                "attention.dense.weight": ("layers.attn.wo", True),
+                "attention.dense.bias": ("layers.attn.bo", False),
+                "mlp.dense_h_to_4h.weight": ("layers.mlp.wi", True),
+                "mlp.dense_h_to_4h.bias": ("layers.mlp.bi", False),
+                "mlp.dense_4h_to_h.weight": ("layers.mlp.wo", True),
+                "mlp.dense_4h_to_h.bias": ("layers.mlp.bo", False),
+            }
+            if rest in table:
+                leaf, t = table[rest]
+                return leaf, idx, t
+            return None   # attention.bias mask buffers, rotary inv_freq
+        table = {
+            "embed_in.weight": ("embed_in", False),
+            "final_layer_norm.weight": ("lnf.scale", False),
+            "final_layer_norm.bias": ("lnf.bias", False),
+        }
+        if name in table:
+            leaf, t = table[name]
+            return leaf, None, t
+        return None
+
+    return mapper
+
+
+# family -> mapper factory(config). Most maps don't need the config; NeoX
+# does (head shape for the QKV de-interleave).
+_FAMILY_MAPS: dict[str, Callable] = {"llama": lambda cfg: _map_llama,
+                                     "gpt2": lambda cfg: _map_gpt2,
+                                     "moe": lambda cfg: _map_mixtral,
+                                     "neox": _make_map_neox}
 
 
 # ---------------------------------------------------------------------------
@@ -170,7 +233,7 @@ def convert_hf_checkpoint(hf_dir: str | Path, out_dir: str | Path,
     if bundle is None:
         bundle = get_model(model_name)
     model_name = model_name or bundle.name
-    mapper = _FAMILY_MAPS[bundle.family]
+    mapper = _FAMILY_MAPS[bundle.family](bundle.config)
     shapes = _flatten_with_paths(
         __import__("jax").eval_shape(lambda: bundle.init(bundle.config,
                                                          __import__("jax").random.key(0))))
@@ -203,7 +266,9 @@ def convert_hf_checkpoint(hf_dir: str | Path, out_dir: str | Path,
                 tensor = sf.get_tensor(name)
                 if tensor.dtype == np.dtype("uint16"):  # bf16 via numpy view
                     tensor = _bf16_to_f32(tensor)
-                if transpose:
+                if callable(transpose):   # family-specific layout transform
+                    tensor = transpose(tensor)
+                elif transpose:
                     tensor = tensor.T
                 mm = leaf_mm(leaf)
                 # layer is None (whole leaf), an int (stacked [L, ...]

@@ -11,7 +11,7 @@ from __future__ import annotations
 import dataclasses
 from typing import Any, Callable, Optional
 
-from . import gpt2, llama, moe
+from . import gpt2, llama, moe, neox
 
 
 @dataclasses.dataclass(frozen=True)
@@ -46,20 +46,27 @@ _HF_ALIASES = {
     "meta-llama/llama-3.1-70b": "llama-3.1-70b",
     "meta-llama/llama-3.1-405b": "llama-3.1-405b",
     "meta-llama/meta-llama-3.1-405b": "llama-3.1-405b",
+    "eleutherai/pythia-70m": "pythia-70m",
+    "eleutherai/pythia-160m": "pythia-160m",
+    "eleutherai/pythia-410m": "pythia-410m",
+    "eleutherai/pythia-1.4b": "pythia-1.4b",
+    "eleutherai/pythia-6.9b": "pythia-6.9b",
+    "eleutherai/gpt-neox-20b": "gpt-neox-20b",
 }
 
 
 def family_module(family: str):
     """The module implementing a model family (block/embed/head helpers used
     by the pipeline schedule and chunked losses)."""
-    mods = {"llama": llama, "gpt2": gpt2, "moe": moe}
+    mods = {"llama": llama, "gpt2": gpt2, "moe": moe, "neox": neox}
     if family not in mods:
         raise KeyError(f"unknown model family {family!r}")
     return mods[family]
 
 
 def list_models() -> list[str]:
-    return sorted(gpt2.PRESETS) + sorted(llama.PRESETS) + sorted(moe.PRESETS)
+    return (sorted(gpt2.PRESETS) + sorted(llama.PRESETS) + sorted(moe.PRESETS)
+            + sorted(neox.PRESETS))
 
 
 def get_model(name: str, **overrides) -> ModelBundle:
@@ -96,6 +103,12 @@ def get_model(name: str, **overrides) -> ModelBundle:
         return ModelBundle(key, config, moe.init, moe.apply,
                            moe.param_logical_axes, family="moe",
                            apply_with_aux=moe.apply_with_aux)
+    if key in neox.PRESETS:
+        config = neox.PRESETS[key]
+        if overrides:
+            config = dataclasses.replace(config, **overrides)
+        return ModelBundle(key, config, neox.init, neox.apply,
+                           neox.param_logical_axes, family="neox")
     raise ValueError(
         f"Unknown model {name!r}. Available: {', '.join(list_models())} "
         f"(HF aliases: {', '.join(sorted(_HF_ALIASES))})"

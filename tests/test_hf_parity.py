@@ -187,10 +187,45 @@ def test_gemma_parity(tmp_path):
     assert np.isfinite(_one_train_step(bundle, plan, params, ids))
 
 
+@pytest.mark.parametrize("parallel_residual", [True, False])
+def test_neox_parity(tmp_path, parallel_residual):
+    """GPT-NeoX/Pythia: the parallel-residual block (x + attn(ln1 x) +
+    mlp(ln2 x)), partial rotary (rotary_pct=0.25), fused per-head-interleaved
+    QKV (de-interleaved at conversion to the tp-shardable [E,3,h*d] layout),
+    exact-gelu MLP, untied embed_in/embed_out. Pins both residual wirings
+    end to end through stream-convert -> sharded-load -> logits."""
+    hf_cfg = transformers.GPTNeoXConfig(
+        vocab_size=512, hidden_size=64, intermediate_size=256,
+        num_hidden_layers=2, num_attention_heads=4,
+        max_position_embeddings=256, rotary_pct=0.25, rotary_emb_base=10000,
+        layer_norm_eps=1e-5, hidden_act="gelu",
+        use_parallel_residual=parallel_residual, tie_word_embeddings=False)
+    torch.manual_seed(0)
+    model = transformers.GPTNeoXForCausalLM(hf_cfg).eval()
+    model.save_pretrained(tmp_path / "hf", safe_serialization=True)
+
+    bundle = get_model("neox-debug", use_parallel_residual=parallel_residual,
+                       dtype=jnp.float32)
+    assert bundle.config.rotary_ndims == 4  # 0.25 * head_size(16)
+    convert_hf_checkpoint(tmp_path / "hf", tmp_path / "conv", bundle=bundle)
+    plan = make_plan("single", make_mesh(devices=jax.devices()[:1]))
+    params = load_pretrained(bundle, _replicated_shardings(bundle, plan),
+                             tmp_path / "conv")
+
+    ids = np.random.RandomState(0).randint(0, 512, (2, 24))
+    ours = np.asarray(bundle.apply(bundle.config, params, jnp.asarray(ids)))
+    with torch.no_grad():
+        theirs = model(torch.tensor(ids)).logits.float().numpy()
+    np.testing.assert_allclose(ours, theirs, rtol=2e-4, atol=2e-4)
+
+    if parallel_residual:  # pretrained -> one optimizer step, once
+        assert np.isfinite(_one_train_step(bundle, plan, params, ids))
+
+
 def test_auto_hf_config_ingestion(tmp_path, caplog):
     """The AutoModelForCausalLM analogue (reference 01:57): ``-m hf:<dir>``
     builds the family config from the checkpoint's own config.json. Pins the
-    arch dispatch for all six supported architectures, full convert+logits
+    arch dispatch for all seven supported architectures, full convert+logits
     parity through an hf: bundle, and the loud unsupported-arch failure."""
     from distributed_training_guide_tpu.models.auto import config_from_hf
 
@@ -227,6 +262,12 @@ def test_auto_hf_config_ingestion(tmp_path, caplog):
                                     router_aux_loss_coef=0.02),
          "moe", lambda c: (c.num_experts == 4 and c.experts_per_token == 2
                            and c.router_aux_coef == 0.02)),
+        (transformers.GPTNeoXConfig(vocab_size=64, hidden_size=32,
+                                    intermediate_size=64, num_hidden_layers=2,
+                                    num_attention_heads=4, rotary_pct=0.25,
+                                    use_parallel_residual=True),
+         "neox", lambda c: (c.use_parallel_residual and c.rotary_pct == 0.25
+                            and c.act_fn == "gelu")),
     ]
     for i, (hf_cfg, want_family, check) in enumerate(cases):
         d = tmp_path / f"cfg{i}"
@@ -275,12 +316,21 @@ def test_auto_hf_config_ingestion(tmp_path, caplog):
                       "original_max_position_embeddings": 8192,
                       "low_freq_factor": 1.0, "high_freq_factor": 4.0},
         max_position_embeddings=131072).save_pretrained(rope)
+    neox_rope = tmp_path / "neox_rope"
+    neox_rope.mkdir()
+    transformers.GPTNeoXConfig(
+        vocab_size=64, hidden_size=32, intermediate_size=64,
+        num_hidden_layers=2, num_attention_heads=4,
+        rope_scaling={"rope_type": "linear", "factor": 2.0}).save_pretrained(
+            neox_rope)
     with caplog.at_level("WARNING",
                          logger="distributed_training_guide_tpu.models.auto"):
         config_from_hf(mist)
         config_from_hf(rope)
+        config_from_hf(neox_rope)
     assert "sliding_window=4096" in caplog.text
     assert "rope_scaling" in caplog.text
+    assert "GPTNeoXForCausalLM: rope_scaling" in caplog.text
 
     # loud failure on an unsupported architecture
     bad = tmp_path / "bad"

@@ -8,7 +8,7 @@ from distributed_training_guide_tpu.models import get_model
 from distributed_training_guide_tpu.ops import causal_lm_loss
 
 
-@pytest.mark.parametrize("name", ["gpt2-debug", "llama-debug"])
+@pytest.mark.parametrize("name", ["gpt2-debug", "llama-debug", "neox-debug"])
 def test_forward_shapes_and_determinism(name):
     bundle = get_model(name)
     params = bundle.init(bundle.config, jax.random.key(0))
@@ -20,7 +20,7 @@ def test_forward_shapes_and_determinism(name):
     np.testing.assert_array_equal(np.asarray(logits), np.asarray(logits2))
 
 
-@pytest.mark.parametrize("name", ["gpt2-debug", "llama-debug"])
+@pytest.mark.parametrize("name", ["gpt2-debug", "llama-debug", "neox-debug"])
 def test_causality(name):
     """Changing a future token must not affect past logits."""
     bundle = get_model(name)
@@ -32,7 +32,7 @@ def test_causality(name):
     np.testing.assert_allclose(np.asarray(a[:, :-1]), np.asarray(b[:, :-1]), atol=2e-2)
 
 
-@pytest.mark.parametrize("name", ["gpt2-debug", "llama-debug"])
+@pytest.mark.parametrize("name", ["gpt2-debug", "llama-debug", "neox-debug"])
 def test_grads_nonzero(name):
     bundle = get_model(name)
     params = bundle.init(bundle.config, jax.random.key(0))
@@ -48,10 +48,11 @@ def test_grads_nonzero(name):
     assert sum(n > 0 for n in norms) >= len(norms) - 2  # norms may be ~0 early
 
 
-@pytest.mark.parametrize("name", ["gpt2", "llama-3.1-8b", "llama-3.1-405b"])
+@pytest.mark.parametrize("name", ["gpt2", "llama-3.1-8b", "llama-3.1-405b", "pythia-1.4b", "gpt-neox-20b"])
 def test_param_count_formula(name):
     """num_params() formula matches the known public sizes within 1%."""
-    known = {"gpt2": 124e6, "llama-3.1-8b": 8.03e9, "llama-3.1-405b": 405.8e9}
+    known = {"gpt2": 124e6, "llama-3.1-8b": 8.03e9, "llama-3.1-405b": 405.8e9,
+             "pythia-1.4b": 1.41e9, "gpt-neox-20b": 20.6e9}
     bundle = get_model(name)
     assert abs(bundle.num_params() - known[name]) / known[name] < 0.01
 

@@ -102,6 +102,34 @@ def test_gpt2_tp_matches_single_device(eight_devices):
         np.testing.assert_allclose(got, golden, rtol=1e-4, err_msg=strategy)
 
 
+def test_neox_tp_fsdp_matches_single_device(eight_devices):
+    """NeoX under auto (GSPMD) tensor parallelism: the parallel-residual
+    block sums the attention and MLP row-parallel outputs into ONE residual
+    update, and partial rotary (rotary_pct) slices each head's dims — the
+    trajectory must still match single-device exactly."""
+    bundle = get_model("neox-debug", dtype=jnp.float32)
+    assert bundle.config.use_parallel_residual
+
+    def run(strategy, mesh):
+        t = Trainer(bundle=bundle, optimizer=adamw_cosine(1e-3),
+                    plan=make_plan(strategy, mesh), donate=False)
+        state = t.init_state(0)
+        ids = np.random.RandomState(0).randint(0, 512, (GLOBAL_BATCH, SEQ))
+        batch = {k: jax.device_put(jnp.asarray(ids), t.batch_shardings()[k])
+                 for k in ("input_ids", "labels")}
+        losses = []
+        for _ in range(2):
+            state, m = t.step_fn(state, batch)
+            losses.append(float(m["loss"]))
+        return losses
+
+    golden = run("single", make_mesh(devices=jax.devices()[:1]))
+    for strategy, mesh_kw in (("fsdp", {"fsdp": 8}), ("tp", {"tp": 4}),
+                              ("tp_fsdp", {"fsdp": 2, "tp": 2})):
+        got = run(strategy, make_mesh(**mesh_kw))
+        np.testing.assert_allclose(got, golden, rtol=1e-4, err_msg=strategy)
+
+
 def test_qwen_bias_tp_matches_single_device(eight_devices):
     """Qwen2-style attn_bias under tensor parallelism: the bq/bk/bv leaves
     carry the heads/kv logical axes, so tp shards them column-wise with

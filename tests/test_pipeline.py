@@ -236,6 +236,32 @@ def test_pp_gpt2_family(eight_devices):
         np.testing.assert_allclose(losses, glosses, rtol=2e-4, err_msg=strategy)
 
 
+def test_pp_neox_family(eight_devices):
+    """NeoX under the 1F1B schedule: the parallel-residual block inside a
+    pipeline stage, and under pp x tp the manual-tp path where BOTH
+    row-parallel partial sums (attention out-proj + MLP down-proj) share a
+    single psum — plus the untied vocab-parallel head."""
+    bundle = get_model("neox-debug", dtype=jnp.float32)
+    golden_t = Trainer(bundle=bundle, optimizer=adamw_cosine(1e-3),
+                       plan=make_plan("single", make_mesh(devices=jax.devices()[:1])),
+                       donate=False)
+    gstate = golden_t.init_state(0)
+    ids = np.random.RandomState(0).randint(0, 512, (GB, SEQ))
+    gbatch = {k: jax.device_put(jnp.asarray(ids), golden_t.batch_shardings()[k])
+              for k in ("input_ids", "labels")}
+    glosses = [float(golden_t.step_fn(gstate, gbatch)[1]["loss"])]
+
+    for strategy, mesh_kw in (("pp", {"pp": 2}), ("pp_tp", {"pp": 2, "tp": 2})):
+        t = Trainer(bundle=bundle, optimizer=adamw_cosine(1e-3),
+                    plan=make_plan(strategy, make_mesh(**mesh_kw)), donate=False,
+                    pp_microbatches=2)
+        state = t.init_state(0)
+        batch = {k: jax.device_put(jnp.asarray(ids), t.batch_shardings()[k])
+                 for k in ("input_ids", "labels")}
+        losses = [float(t.step_fn(state, batch)[1]["loss"])]
+        np.testing.assert_allclose(losses, glosses, rtol=2e-4, err_msg=strategy)
+
+
 def test_pp_moe_family(eight_devices):
     """MoE under the 1F1B schedule: router aux loss flows through the
     per-tick vjp (cotangent on the stage's aux output) and the trajectory
