@@ -521,6 +521,25 @@ SWEEP_QUEUE = [
     dict(name="tinyllama_adafactor_fence4_b4", model="tinyllama-1.1b",
          batch=4, seq=2048, remat=True, remat_policy="attn",
          optimizer="adafactor", fence_every=4, loss_chunks=8),
+    # --- no-remat rungs: remat trades FLOPs for memory; at a batch small
+    # enough to hold ALL activations the backward recomputes nothing. MFU
+    # counts model FLOPs (6ND), so if ms/token drops below the b8 attn_mlp
+    # recipe this wins the headline outright.
+    dict(name="fence4_noremat_adafactor_b4", model="llama-650m", batch=4,
+         seq=2048, remat=False, optimizer="adafactor", fence_every=4),
+    dict(name="fence4_noremat_adafactor_b6", model="llama-650m", batch=6,
+         seq=2048, remat=False, optimizer="adafactor", fence_every=4),
+    dict(name="fence4_noremat_b4", model="llama-650m", batch=4, seq=2048,
+         remat=False, fence_every=4),
+    # --- gather-only MoE dispatch (models/moe.py, 2026-07-31): same config
+    # as the 20%-MFU moe1b_adafactor_b8 measurement but the row scatters are
+    # gone (dispatch = int32 slot-map inversion + row gather; combine =
+    # reshape+sum). New name so the resumable queue re-measures it.
+    dict(name="moe1b_adafactor_b8_gather", model="moe-1b-8e", batch=8,
+         seq=2048, remat=True, remat_policy="attn", optimizer="adafactor"),
+    dict(name="moe1b_adafactor_fence4_b8_gather", model="moe-1b-8e", batch=8,
+         seq=2048, remat=True, remat_policy="attn", optimizer="adafactor",
+         fence_every=4),
 ]
 
 

@@ -157,12 +157,16 @@ def param_logical_axes(config: MoELlamaConfig) -> dict:
 
 def _moe_ffn(config: MoELlamaConfig, x: jnp.ndarray, moe: dict,
              tp_axis: Optional[str] = None):
-    """Top-k routed FFN with index-based (sort/gather) dispatch. x: [B, S, D].
+    """Top-k routed FFN with index-based, gather-only dispatch. x: [B, S, D].
     Returns (y, aux_loss, dropped_frac).
 
     Dispatch is O(k*T) index arrays + [E, C, D] expert buffers — the round-1
     one-hot formulation materialized [T, E, C] dispatch/combine tensors
     (O(T^2 * k) floats at fixed capacity factor, ~640 MB at T=8k, k=2).
+    Row data moves by GATHER only (the single scatter is the int32 slot-map
+    inversion; the combine is a reshape+sum, exploiting the choice-rank-major
+    pair layout) — TPU scatters serialize on write hazards and dominated the
+    first on-chip MoE measurement (BENCH.md, 20% MFU).
     Capacity priority is greedy by choice rank then token order (all rank-0
     choices before any rank-1), identical to the old sequential assignment.
 
@@ -171,7 +175,7 @@ def _moe_ffn(config: MoELlamaConfig, x: jnp.ndarray, moe: dict,
     member computes identical dispatch indices; gate/up/down arrive as
     megatron mlp-dim shards and the combined output is a partial sum —
     combine is linear in the expert outputs, so one psum of y at the end is
-    exact (commutes with the gather/scatter-add).
+    exact (commutes with the gather and the reshape+sum combine).
     """
     b, s, d = x.shape
     t = b * s
@@ -191,7 +195,6 @@ def _moe_ffn(config: MoELlamaConfig, x: jnp.ndarray, moe: dict,
     # flatten (token, choice) pairs choice-rank-major -> greedy priority
     expert_flat = topk_idx.T.reshape(k * t)                      # [kT]
     weight_flat = topk_probs.T.reshape(k * t)
-    token_flat = jnp.tile(jnp.arange(t), k)
 
     # slot within each expert's buffer = rank of this pair among same-expert
     # pairs (stable sort keeps greedy priority order within a group)
@@ -203,12 +206,24 @@ def _moe_ffn(config: MoELlamaConfig, x: jnp.ndarray, moe: dict,
 
     keep = pos_flat < capacity
     dropped_frac = 1.0 - jnp.mean(keep.astype(jnp.float32))
-    # overflow pairs scatter to a sacrificial row that is sliced off
+    # overflow pairs target a sacrificial slot that is sliced off
     dest = jnp.where(keep, expert_flat * capacity + pos_flat, ex * capacity)
 
-    buf = jnp.zeros((ex * capacity + 1, d), cdt)
-    expert_in = buf.at[dest].set(xt[token_flat].astype(cdt))[:-1]
-    expert_in = expert_in.reshape(ex, capacity, d)
+    # Fill the [E, C, D] buffers by GATHER, not by scattering rows: TPU
+    # scatters serialize on write hazards, and the original formulation paid
+    # two of them per layer on [kT, D] row data (dispatch .at[dest].set and
+    # the combine .at[token].add — the round-4 MoE bench rung measured 20%
+    # MFU with dispatch dominating). The only scatter left is int32: invert
+    # the slot map (which pair fills slot (e, c)?), then gather rows. Slots
+    # nobody fills keep the sentinel kT and gather the appended zero row —
+    # identical buffers to the scatter formulation.
+    inv = (jnp.full((ex * capacity + 1,), k * t, jnp.int32)
+           .at[dest].set(jnp.arange(k * t, dtype=jnp.int32), mode="drop")[:-1])
+    # pair i is token (i mod t) (choice-rank-major layout): gather straight
+    # from xt — no k-fold tiled copy — and mask empty slots (the sentinel
+    # k*t gathers row 0, then zeroes) to reproduce the zero-filled buffers
+    expert_in = jnp.where((inv < k * t)[:, None],
+                          xt[inv % t].astype(cdt), 0).reshape(ex, capacity, d)
 
     h = jax.nn.silu(jnp.einsum("ecd,edf->ecf", expert_in, moe["gate"].astype(cdt)))
     h = h * jnp.einsum("ecd,edf->ecf", expert_in, moe["up"].astype(cdt))
@@ -220,8 +235,11 @@ def _moe_ffn(config: MoELlamaConfig, x: jnp.ndarray, moe: dict,
     out_flat = expert_out.reshape(ex * capacity, d)
     y_choice = out_flat[jnp.clip(dest, 0, ex * capacity - 1)]
     y_choice = jnp.where(keep[:, None], y_choice, 0)
-    y = jnp.zeros((t, d), cdt).at[token_flat].add(
-        y_choice * weight_flat[:, None].astype(cdt))
+    # un-route without a scatter-add: pair i is token (i mod t), so the k
+    # contributions of each token are exactly the k rows of the
+    # choice-rank-major layout — a reshape and a dense sum
+    y = jnp.sum((y_choice * weight_flat[:, None].astype(cdt))
+                .reshape(k, t, d), axis=0)
     if tp_axis is not None:
         y = _psum(y, tp_axis)
 

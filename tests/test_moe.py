@@ -122,10 +122,14 @@ def test_ep_dispatch_stays_local(eight_devices):
                   cfg.num_layers)
     C = max(int(math.ceil(cfg.capacity_factor * cfg.experts_per_token
                           * b * s / E)), 1)
-    # local (E/ep = 1) expert compute is present...
-    assert f"f32[1,{C},{D}]" in hlo, "no ep-local expert buffer in HLO"
-    # ...and no device ever materializes the full-E dispatch buffer or the
-    # full expert-weight stacks (params, grads, or optimizer moments)
+    # local (E/ep = 1) expert compute is present — the [1, C, F] inner
+    # activation must materialize around the silu*up elementwise. (The
+    # [1, C, D] INPUT buffer is no longer asserted: the gather-only
+    # dispatch fuses it into the expert einsum, so it never exists as a
+    # standalone tensor — that fusion is the point of the formulation.)
+    assert f"f32[1,{C},{F}]" in hlo, "no ep-local expert activation in HLO"
+    # ...and no device ever materializes the full-E dispatch/activation
+    # buffers or the full expert-weight stacks (params, grads, or moments)
     for full in (f"f32[{E},{C},{D}]", f"f32[{E},{C},{F}]",
                  f"f32[{L},{E},{D},{F}]", f"f32[{L},{E},{F},{D}]"):
         assert full not in hlo, f"full-E tensor {full} in compiled HLO"
