@@ -38,6 +38,7 @@ def _isolated_caches(tmp_path, monkeypatch):
     wait loop calls the time.sleep these tests monkeypatch to count probe
     gating."""
     monkeypatch.setattr(bench, "LAST_GOOD_PATH", str(tmp_path / "last_good.json"))
+    monkeypatch.setattr(bench, "FLASH_GOOD_PATH", str(tmp_path / "flash_good.json"))
     monkeypatch.setattr(bench, "SWEEP_LOG_PATH", str(tmp_path / "sweep.jsonl"))
     monkeypatch.setattr(bench, "_git_head", lambda: "f" * 40)
     monkeypatch.setattr(bench, "_commit_in_history", lambda c: c == "f" * 40)
@@ -97,6 +98,43 @@ def test_headline_success_records_ab_and_flash(monkeypatch, capsys):
     assert final["detail"]["flash_check"]["ok"] is True
     # never reached rungs 3/4: 1 probe + 2 rungs + 1 flash check
     assert len(fake.calls) == 4
+
+
+def test_stalled_flash_check_attaches_cached_record(monkeypatch, capsys):
+    """The flash A/B runs LAST on leftover budget, so it is the likeliest
+    child to stall; a clean earlier record (commit-stamped, same device)
+    must back the failed run instead of evidence silently vanishing."""
+    healthy = FakeChildren([([_mfu(0.50)], "ok"), ([_mfu(0.48)], "ok")])
+    _run_main(monkeypatch, capsys, healthy)
+    assert bench._load_flash_good()["ok"] is True  # cache written
+
+    class FlashStalls(FakeChildren):
+        def __call__(self, mode_args, budget):
+            if mode_args == ["--check-flash"]:
+                self.calls.append(mode_args)
+                return [], "stalled"
+            return super().__call__(mode_args, budget)
+
+    stalled = FlashStalls([([_mfu(0.51)], "ok"), ([_mfu(0.48)], "ok")])
+    final, code = _run_main(monkeypatch, capsys, stalled)
+    fc = final["detail"]["flash_check"]
+    assert code == 0 and fc["error"] == "stalled"
+    assert fc["last_good"]["ok"] is True
+    assert fc["last_good"]["git_commit"] == "f" * 40
+
+    # a COMPLETED check whose numerics failed is reported fresh but must
+    # never overwrite the cached healthy evidence
+    class FlashNumericsFail(FakeChildren):
+        def __call__(self, mode_args, budget):
+            if mode_args == ["--check-flash"]:
+                self.calls.append(mode_args)
+                return [{"flash_ms": 70.0, "xla_ms": 95.0, "ok": False}], "ok"
+            return super().__call__(mode_args, budget)
+
+    bad = FlashNumericsFail([([_mfu(0.52)], "ok"), ([_mfu(0.48)], "ok")])
+    final, _ = _run_main(monkeypatch, capsys, bad)
+    assert final["detail"]["flash_check"]["ok"] is False  # reported honestly
+    assert bench._load_flash_good()["ok"] is True         # cache untouched
 
 
 def test_ab_result_displaces_only_when_complete_and_better(monkeypatch, capsys):
