@@ -572,6 +572,16 @@ SWEEP_QUEUE = [
     dict(name="moe1b_adafactor_fence4_b8_gather", model="moe-1b-8e", batch=8,
          seq=2048, remat=True, remat_policy="attn", optimizer="adafactor",
          fence_every=4),
+    # --- single-chip long-context ceiling: flash's O(S) memory + the attn
+    # policy carried 8k at 55.9%; push to 16k/32k (same token budget per
+    # step as the 8k rungs, longer rows). max_position raises the RoPE
+    # table; loss_chunks caps the [B,S,V] logits at 32k.
+    dict(name="fence4_seq16k_adafactor_b2", model="llama-650m", batch=2,
+         seq=16384, max_position=16384, remat=True, remat_policy="attn",
+         optimizer="adafactor", fence_every=4),
+    dict(name="fence4_seq32k_adafactor_b1_lc8", model="llama-650m", batch=1,
+         seq=32768, max_position=32768, remat=True, remat_policy="attn",
+         optimizer="adafactor", fence_every=4, loss_chunks=8),
 ]
 
 

@@ -70,21 +70,26 @@ def test_ring_output_keeps_batch_and_head_shardings(eight_devices):
     assert "dp" in str(spec) and "tp" in str(spec), spec
 
 
+def _run_losses(bundle, plan, ids, steps=2):
+    """Shared trainer-loop harness for the cp goldens below."""
+    t = Trainer(bundle=bundle, optimizer=adamw_cosine(1e-3), plan=plan,
+                donate=False)
+    state = t.init_state(0)
+    batch = {k: jax.device_put(jnp.asarray(ids), t.batch_shardings()[k])
+             for k in ("input_ids", "labels")}
+    losses = []
+    for _ in range(steps):
+        state, m = t.step_fn(state, batch)
+        losses.append(float(m["loss"]))
+    return losses
+
+
 def test_cp_training_matches_single_device(eight_devices):
     bundle = get_model("llama-debug", dtype=jnp.float32)
-    opt = adamw_cosine(1e-3)
     ids = np.random.RandomState(0).randint(0, 512, (8, 32))
 
     def run(plan):
-        t = Trainer(bundle=bundle, optimizer=opt, plan=plan, donate=False)
-        state = t.init_state(0)
-        batch = {k: jax.device_put(jnp.asarray(ids), t.batch_shardings()[k])
-                 for k in ("input_ids", "labels")}
-        losses = []
-        for _ in range(2):
-            state, m = t.step_fn(state, batch)
-            losses.append(float(m["loss"]))
-        return losses
+        return _run_losses(bundle, plan, ids)
 
     golden = run(make_plan("single", make_mesh(devices=jax.devices()[:1])))
     cp = run(make_plan("ddp", make_mesh(cp=4)))
@@ -99,6 +104,21 @@ def test_cp_training_matches_single_device(eight_devices):
     # layout minus pp)
     cp_tp_fsdp = run(make_plan("tp_fsdp", make_mesh(cp=2, tp=2, fsdp=2)))
     np.testing.assert_allclose(cp_tp_fsdp, golden, rtol=2e-4)
+
+
+def test_cp_neox_matches_single_device(eight_devices):
+    """Ring context parallelism with the NeoX family: partial rotary takes
+    the EXPLICIT per-shard positions path (each cp member holds a sequence
+    slice), and the parallel-residual block feeds the ring attention as a
+    callable attn_impl — trajectory must match single-device."""
+    bundle = get_model("neox-debug", dtype=jnp.float32)
+    ids = np.random.RandomState(1).randint(0, 512, (8, 32))
+
+    golden = _run_losses(bundle,
+                         make_plan("single", make_mesh(devices=jax.devices()[:1])),
+                         ids)
+    cp = _run_losses(bundle, make_plan("ddp", make_mesh(cp=4)), ids)
+    np.testing.assert_allclose(cp, golden, rtol=2e-4)
 
 
 def test_ulysses_attention_matches_dense(eight_devices):
