@@ -1,0 +1,172 @@
+#!/usr/bin/env python3
+"""Walk the measured lever ladder (README.md here) on YOUR model and report
+the winning flag set.
+
+The reference tunes these knobs by hand, chapter by chapter (batch in its
+``02``, activation checkpointing + offload in ``04``/``05``); this walks
+them automatically the way the round-4 bench sweep was run: every probe in
+a kill-able subprocess (an OOM or a pool stall costs one probe, never the
+walk), keep a lever only if measured time-per-token improves, re-walk batch
+last because every earlier lever moves the HBM knee.
+
+    python related-topics/performance-tuning/autotune.py -m llama-650m -s 2048
+    python related-topics/performance-tuning/autotune.py -m hf:/ckpts/my-model --dry-run
+
+Output: one JSON line per probe, then a final ``best`` line whose ``flags``
+paste directly onto any chapter's ``train_llm.py`` command.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import re
+import subprocess
+import sys
+import tempfile
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+RUNNER = os.path.join(REPO, "01-single-chip", "train_llm.py")
+
+# the measured-order ladder (README.md table); each entry: (name, extra flags)
+REMAT_LADDER = ["all", "attn", "attn_mlp"]
+
+
+def parse_step_ms(out: str) -> float | None:
+    """Last logged per-step walltime (the loop logs `'time/total': <ms>` per
+    log window; the LAST window is post-compile, post-warmup)."""
+    hits = re.findall(r"'time/total': ([0-9.]+)", out)
+    return float(hits[-1]) if hits else None
+
+
+def parse_mfu(out: str) -> float | None:
+    hits = re.findall(r"'mfu': ([0-9.eE+-]+)", out)
+    return float(hits[-1]) if hits else None
+
+
+def classify_failure(err: str) -> str:
+    """Same canonical XLA markers as bench.py's child classifier: device HBM
+    exhaustion is retire-the-config, pool-capacity rejection is retryable."""
+    if ("Out of memory" in err or "Largest program allocations" in err
+            or "Error allocating device buffer" in err):
+        return "oom"
+    if "RESOURCE_EXHAUSTED" in err:
+        return "pool_exhausted"
+    return "failed"
+
+
+def probe_cmd(args, batch: int, flags: list[str], save_dir: str) -> list[str]:
+    tokens = batch * args.seq * (args.steps + 2)
+    # log-freq 4 everywhere: the loop drains banked losses at every log
+    # boundary, so a smaller log window would silently cap --fence-every 4
+    # at depth 2 — the probe must RUN at the depth it recommends
+    return [sys.executable, RUNNER, "-m", args.model,
+            "-d", f"synthetic:{max(tokens * 2, 20000)}",
+            "-s", str(args.seq), "-b", str(batch),
+            "--num-epochs", "1", "--max-steps", str(args.steps),
+            "--log-freq", "4", "--save-dir", save_dir, *flags]
+
+
+def run_probe(args, batch: int, flags: list[str]) -> dict:
+    """One config in a kill-able subprocess -> {ms, mfu} | {error}."""
+    with tempfile.TemporaryDirectory() as d:
+        t0 = time.time()
+        try:
+            proc = subprocess.run(
+                probe_cmd(args, batch, flags, d), capture_output=True,
+                text=True, timeout=args.budget)
+        except subprocess.TimeoutExpired:
+            return {"error": "stalled"}
+        out = proc.stdout + proc.stderr
+        if proc.returncode != 0:
+            return {"error": classify_failure(out)}
+        ms = parse_step_ms(out)
+        if ms is None:
+            return {"error": "no_result"}
+        return {"ms": ms, "mfu": parse_mfu(out),
+                "wall_s": round(time.time() - t0, 1)}
+
+
+def plan_walk(args) -> list[dict]:
+    """The probe sequence, data only (what --dry-run prints). Each entry:
+    {name, batch, flags}. The walk evaluates them statefully — a lever is
+    kept only if it improved — so later entries here show the flags they
+    would add, not the final composition."""
+    steps = [{"name": "baseline", "batch": args.batch, "flags": []}]
+    steps.append({"name": "fence4", "batch": args.batch,
+                  "flags": ["--fence-every", "4"]})
+    for policy in REMAT_LADDER:
+        steps.append({"name": f"remat_{policy}", "batch": args.batch,
+                      "flags": ["--checkpoint-activations",
+                                "--remat-policy", policy]})
+    steps.append({"name": "adafactor", "batch": args.batch,
+                  "flags": ["--optimizer", "adafactor"]})
+    steps.append({"name": "loss_chunks8", "batch": args.batch,
+                  "flags": ["--loss-chunks", "8"]})
+    b = args.batch
+    while b < args.batch * 4:
+        b *= 2
+        steps.append({"name": f"batch_{b}", "batch": b, "flags": []})
+    return steps
+
+
+def main() -> None:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("-m", "--model", required=True)
+    p.add_argument("-s", "--seq", type=int, default=2048)
+    p.add_argument("-b", "--batch", type=int, default=8)
+    p.add_argument("--steps", type=int, default=12,
+                   help="training steps per probe; the LAST 4-step log "
+                        "window is what gets measured (post-compile, "
+                        "post-warmup), so keep this a multiple of 4 >= 12")
+    p.add_argument("--budget", type=int, default=600,
+                   help="seconds per probe before it is killed (compile "
+                        "included)")
+    p.add_argument("--dry-run", action="store_true")
+    args = p.parse_args()
+
+    plan = plan_walk(args)
+    if args.dry_run:
+        for s in plan:
+            print(json.dumps(s))
+        return
+
+    def emit(rec):
+        print(json.dumps(rec), flush=True)
+
+    best = None        # (time-per-token, record)
+    kept_flags: list[str] = []
+    kept_batch = args.batch
+
+    def tpt(ms, batch):
+        return ms / (batch * args.seq)
+
+    for step in plan:
+        name, batch = step["name"], max(step["batch"], kept_batch)
+        if step["name"].startswith("batch_"):
+            batch = step["batch"]
+        flags = kept_flags + step["flags"]
+        # remat rungs replace the previous policy, not stack with it
+        if name.startswith("remat_") and "--remat-policy" in kept_flags:
+            i = kept_flags.index("--checkpoint-activations")
+            flags = kept_flags[:i] + step["flags"]
+        res = run_probe(args, batch, flags)
+        rec = {"probe": name, "batch": batch, "flags": flags, **res}
+        emit(rec)
+        if "error" in res:
+            continue
+        score = tpt(res["ms"], batch)
+        if best is None or score < best[0]:
+            best = (score, rec)
+            kept_flags, kept_batch = flags, batch
+    if best is None:
+        emit({"best": None, "error": "no probe produced a result"})
+        sys.exit(2)
+    emit({"best": best[1]["probe"], "batch": best[1]["batch"],
+          "flags": " ".join(best[1]["flags"]),
+          "step_ms": best[1]["ms"], "mfu": best[1].get("mfu")})
+
+
+if __name__ == "__main__":
+    main()

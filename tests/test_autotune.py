@@ -1,0 +1,54 @@
+"""Unit tests for the performance-tuning walk's pure pieces (the probe
+subprocess itself is exercised by running the script; see
+related-topics/performance-tuning/README.md)."""
+import importlib.util
+import pathlib
+
+spec = importlib.util.spec_from_file_location(
+    "autotune",
+    pathlib.Path(__file__).parent.parent
+    / "related-topics" / "performance-tuning" / "autotune.py")
+autotune = importlib.util.module_from_spec(spec)
+spec.loader.exec_module(autotune)
+
+
+def test_parse_step_ms_takes_last_window():
+    out = ("INFO:{'global_step': 2, 'time/total': 3400.0, 'mfu': 0.001}\n"
+           "INFO:{'global_step': 4, 'time/total': 75.2, 'mfu': 0.5}\n")
+    assert autotune.parse_step_ms(out) == 75.2   # post-compile window
+    assert autotune.parse_mfu(out) == 0.5
+    assert autotune.parse_step_ms("no logs here") is None
+
+
+def test_classify_failure_matches_bench_markers():
+    assert autotune.classify_failure("... Out of memory while ...") == "oom"
+    assert autotune.classify_failure("Largest program allocations: ...") == "oom"
+    assert autotune.classify_failure("RESOURCE_EXHAUSTED: pool") == "pool_exhausted"
+    assert autotune.classify_failure("Traceback ...") == "failed"
+
+
+def test_plan_walk_order_and_batch_ladder():
+    import argparse
+    args = argparse.Namespace(batch=8, seq=2048)
+    plan = autotune.plan_walk(args)
+    names = [s["name"] for s in plan]
+    # the README's measured order: fence first, remat ladder, optimizer,
+    # chunks, batch LAST (every earlier lever moves the HBM knee)
+    assert names[:2] == ["baseline", "fence4"]
+    assert names[2:5] == ["remat_all", "remat_attn", "remat_attn_mlp"]
+    assert names[5:7] == ["adafactor", "loss_chunks8"]
+    assert names[7:] == ["batch_16", "batch_32"]
+    assert all("--fence-every" in s["flags"] for s in plan if s["name"] == "fence4")
+
+
+def test_probe_cmd_builds_runner_invocation(tmp_path):
+    import argparse
+    args = argparse.Namespace(model="llama-debug", seq=128, steps=12)
+    cmd = autotune.probe_cmd(args, batch=2,
+                             flags=["--fence-every", "4"], save_dir=str(tmp_path))
+    assert cmd[1].endswith("01-single-chip/train_llm.py")
+    assert "--max-steps" in cmd and cmd[cmd.index("--max-steps") + 1] == "12"
+    assert cmd[-2:] == ["--fence-every", "4"]
+    # the log window must be >= the fence depth the walk recommends —
+    # smaller would silently cap --fence-every 4 at the log boundary
+    assert cmd[cmd.index("--log-freq") + 1] == "4"
