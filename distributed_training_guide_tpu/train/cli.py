@@ -116,6 +116,15 @@ def get_parser() -> argparse.ArgumentParser:
     parser.add_argument("--wandb-per-host", action="store_true",
                         help="grouped per-host runs instead of one process-0 "
                              "run (wandb-configurations pattern 2)")
+    parser.add_argument("--param-dtype", default="float32",
+                        choices=["float32", "bfloat16"],
+                        help="parameter STORAGE dtype (compute is bf16 "
+                             "either way). bfloat16 halves resident param "
+                             "memory and also stores the optimizer moments "
+                             "in bf16 — a measured throughput lever with a "
+                             "documented numerics trade (BENCH.md's "
+                             "bf16-state note); fp32 (default) is the "
+                             "reference's mixed-precision policy")
     parser.add_argument("--fence-every", type=_positive_int, default=1,
                         metavar="N",
                         help="host-read the loss every N steps instead of "
@@ -173,7 +182,12 @@ def run_training(args, plan_factory: Callable, *, extra_log: Optional[dict] = No
     pretrained_dir = pretrained_dir or getattr(args, "pretrained", None)
 
     plan = plan_factory()
-    bundle = get_model(args.model_name)
+    overrides = {}
+    if getattr(args, "param_dtype", None) and args.param_dtype != "float32":
+        import jax.numpy as jnp
+        overrides["param_dtype"] = {"bfloat16": jnp.bfloat16,
+                                    "float32": jnp.float32}[args.param_dtype]
+    bundle = get_model(args.model_name, **overrides)
     cfg = bundle.config
     LOGGER.info(f"Training {bundle.num_params():,} model parameters "
                 f"on mesh {dict(plan.mesh.shape)} strategy={plan.strategy}")

@@ -33,11 +33,16 @@ def test_plan_walk_order_and_batch_ladder():
     plan = autotune.plan_walk(args)
     names = [s["name"] for s in plan]
     # the README's measured order: fence first, remat ladder, optimizer,
-    # chunks, batch LAST (every earlier lever moves the HBM knee)
+    # the remat RETRY (the headline's attn_mlp only fits after adafactor
+    # frees the moments), chunks, batch LAST (every earlier lever moves
+    # the HBM knee)
     assert names[:2] == ["baseline", "fence4"]
     assert names[2:5] == ["remat_all", "remat_attn", "remat_attn_mlp"]
-    assert names[5:7] == ["adafactor", "loss_chunks8"]
-    assert names[7:] == ["batch_16", "batch_32"]
+    assert names[5] == "adafactor"
+    assert names[6:8] == ["remat_attn_after_adafactor",
+                          "remat_attn_mlp_after_adafactor"]
+    assert names[8] == "loss_chunks8"
+    assert names[9:] == ["batch_16", "batch_32"]
     assert all("--fence-every" in s["flags"] for s in plan if s["name"] == "fence4")
 
 

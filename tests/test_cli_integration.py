@@ -62,6 +62,18 @@ def test_run_training_fence_every_matches_per_step(tmp_path, eight_devices):
     assert out3["host_state"]["global_step"] == out1["host_state"]["global_step"]
 
 
+def test_run_training_param_dtype_bf16(tmp_path, eight_devices):
+    """--param-dtype bfloat16 (the bench sweep's bf16-state lever as a
+    product flag): params AND the mirrored optimizer moments store in bf16."""
+    import jax.numpy as jnp
+
+    args = make_args(tmp_path, param_dtype="bfloat16")
+    out = run_training(args, lambda: make_plan("ddp", make_mesh()))
+    assert out["host_state"]["global_step"] == 4
+    assert all(l.dtype == jnp.bfloat16
+               for l in jax.tree.leaves(out["state"].params))
+
+
 def test_run_training_fence_every_rejects_zero(tmp_path, eight_devices):
     with pytest.raises(SystemExit):
         run_training(make_args(tmp_path, fence_every=0),
