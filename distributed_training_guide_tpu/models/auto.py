@@ -1,13 +1,13 @@
 """AutoModel-style config ingestion: HF ``config.json`` -> a native bundle.
 
 The reference trains *any* HF causal LM via ``AutoModelForCausalLM``
-(``01-single-gpu/train_llm.py:57``). The native families here cover seven
+(``01-single-gpu/train_llm.py:57``). The native families here cover eight
 HF architectures; this module removes the remaining friction — needing a
 registry preset for every size variant. ``-m hf:<dir>`` (or
 ``get_model("hf:<dir>")``) reads the checkpoint's own ``config.json``,
 recognizes the architecture, and builds the exact family config — so any
-Llama/Mistral/Qwen2/Gemma/GPT-2/Mixtral/GPT-NeoX(Pythia) checkpoint
-trains (and converts, ``models/hf_convert.py``) without touching the
+Llama/Mistral/Qwen2/Gemma/Phi-3/GPT-2/Mixtral/GPT-NeoX(Pythia)
+checkpoint trains (and converts, ``models/hf_convert.py``) without touching the
 registry:
 
     python convert_llama.py <hf-dir> <conv> hf:<hf-dir>
@@ -150,6 +150,10 @@ _ARCH_BUILDERS = {
     "GPT2LMHeadModel": ("gpt2", _build_gpt2),
     "MixtralForCausalLM": ("moe", _build_mixtral),
     "GPTNeoXForCausalLM": ("neox", _build_neox),
+    # Phi-3 is llama-math with fused checkpoint tensors (qkv_proj,
+    # gate_up_proj) — the conversion splits them (hf_convert._make_map_llama);
+    # longrope rope_scaling and the 4k sliding_window hit the loud warnings
+    "Phi3ForCausalLM": ("llama", _build_llama),
 }
 
 
@@ -168,7 +172,7 @@ def config_from_hf(config_path: str | Path):
     by_type = {"llama": "LlamaForCausalLM", "mistral": "MistralForCausalLM",
                "qwen2": "Qwen2ForCausalLM", "gemma": "GemmaForCausalLM",
                "gpt2": "GPT2LMHeadModel", "mixtral": "MixtralForCausalLM",
-               "gpt_neox": "GPTNeoXForCausalLM"}
+               "gpt_neox": "GPTNeoXForCausalLM", "phi3": "Phi3ForCausalLM"}
     if not archs and cfg.get("model_type") in by_type:
         arch = by_type[cfg["model_type"]]
     if arch not in _ARCH_BUILDERS:

@@ -198,9 +198,37 @@ def _make_map_neox(config):
     return mapper
 
 
-# family -> mapper factory(config). Most maps don't need the config; NeoX
-# does (head shape for the QKV de-interleave).
-_FAMILY_MAPS: dict[str, Callable] = {"llama": lambda cfg: _map_llama,
+def _make_map_llama(config):
+    """Llama-family mapper, extended with the Phi-3 fused layouts: HF
+    ``Phi3ForCausalLM`` stores QKV as one ``qkv_proj`` ([hq+2*hkv, E] rows)
+    and the SwiGLU gate/up as one ``gate_up_proj`` ([2F, E]) — a mapper may
+    therefore return a LIST of (leaf, layer, transform) entries, one fused
+    source tensor filling several native leaves. Plain Llama/Mistral/Qwen2/
+    Gemma names fall through to the shared table."""
+    d = config.head_size
+    hq, hkv = config.num_heads * d, config.num_kv_heads * d
+    f = config.intermediate_size
+
+    def mapper(name: str):
+        m = re.match(r"model\.layers\.(\d+)\.(.+)", name)
+        if m:
+            idx, rest = int(m.group(1)), m.group(2)
+            if rest == "self_attn.qkv_proj.weight":
+                return [("layers.attn.wq", idx, lambda w: w[:hq].T),
+                        ("layers.attn.wk", idx, lambda w: w[hq:hq + hkv].T),
+                        ("layers.attn.wv", idx, lambda w: w[hq + hkv:].T)]
+            if rest == "mlp.gate_up_proj.weight":
+                return [("layers.mlp.gate", idx, lambda w: w[:f].T),
+                        ("layers.mlp.up", idx, lambda w: w[f:].T)]
+        return _map_llama(name)
+
+    return mapper
+
+
+# family -> mapper factory(config). gpt2/moe don't need the config; NeoX
+# does (head shape for the QKV de-interleave), llama does (split points of
+# Phi-3's fused tensors).
+_FAMILY_MAPS: dict[str, Callable] = {"llama": _make_map_llama,
                                      "gpt2": lambda cfg: _map_gpt2,
                                      "moe": lambda cfg: _map_mixtral,
                                      "neox": _make_map_neox}
@@ -260,42 +288,22 @@ def convert_hf_checkpoint(hf_dir: str | Path, out_dir: str | Path,
                 if mapped is None:
                     LOGGER.info(f"skipping unmapped tensor {name}")
                     continue
-                leaf, layer, transpose = mapped
-                if leaf not in shapes:
-                    continue  # e.g. lm_head when tied
-                tensor = sf.get_tensor(name)
-                if tensor.dtype == np.dtype("uint16"):  # bf16 via numpy view
-                    tensor = _bf16_to_f32(tensor)
-                if callable(transpose):   # family-specific layout transform
-                    tensor = transpose(tensor)
-                elif transpose:
-                    tensor = tensor.T
-                mm = leaf_mm(leaf)
-                # layer is None (whole leaf), an int (stacked [L, ...]
-                # leaf), or an index tuple (e.g. Mixtral's (layer, expert)
-                # into a [L, E, ...] expert stack)
-                if layer is not None and not isinstance(layer, tuple):
-                    layer = (layer,)
-                target = mm.shape if layer is None else mm.shape[len(layer):]
-                if tensor.shape != tuple(target):
-                    # only re-factor TRAILING dims (same data, finer
-                    # factoring — e.g. gpt2's fused QKV is [E, 3E] in HF but
-                    # [E, 3, E] here so the head dim shards on its own,
-                    # models/gpt2.py). Leading-dim mismatches (e.g. a
-                    # transposed Linear-vs-Conv1D layout) must stay loud:
-                    # an unconditional reshape would silently scramble them.
-                    if tensor.ndim > 1 and tensor.shape[:1] != tuple(target[:1]):
-                        raise ValueError(
-                            f"{name}: shape {tensor.shape} does not match "
-                            f"target {tuple(target)} for leaf {leaf!r} "
-                            f"(transposed source layout?)")
-                    tensor = tensor.reshape(target)
-                if layer is None:
-                    mm[...] = tensor.astype(mm.dtype)
-                else:
-                    mm[layer] = tensor.astype(mm.dtype)
-                seen.add((leaf, layer))
-                del tensor
+                # a fused source tensor (Phi-3 qkv_proj/gate_up_proj) maps
+                # to SEVERAL leaves: normalize to a list of triples
+                entries = mapped if isinstance(mapped, list) else [mapped]
+                source = sf.get_tensor(name)
+                if source.dtype == np.dtype("uint16"):  # bf16 via numpy view
+                    source = _bf16_to_f32(source)
+                for leaf, layer, transpose in entries:
+                    if leaf not in shapes:
+                        continue  # e.g. lm_head when tied
+                    tensor = source
+                    if callable(transpose):  # family layout transform
+                        tensor = transpose(tensor)
+                    elif transpose:
+                        tensor = tensor.T
+                    _write_leaf(name, tensor, leaf, layer, leaf_mm, seen)
+                del source
     for mm in memmaps.values():
         mm.flush()
     with open(out_dir / "manifest.json", "w") as fp:
@@ -303,6 +311,34 @@ def convert_hf_checkpoint(hf_dir: str | Path, out_dir: str | Path,
                    "leaves": sorted(memmaps)}, fp, indent=2)
     LOGGER.info(f"converted {len(seen)} tensors -> {out_dir}")
     return out_dir
+
+
+def _write_leaf(name: str, tensor: np.ndarray, leaf: str, layer,
+                leaf_mm, seen: set) -> None:
+    """Place one (possibly transformed) tensor into its leaf memmap slot."""
+    mm = leaf_mm(leaf)
+    # layer is None (whole leaf), an int (stacked [L, ...] leaf), or an
+    # index tuple (e.g. Mixtral's (layer, expert) into a [L, E, ...] stack)
+    if layer is not None and not isinstance(layer, tuple):
+        layer = (layer,)
+    target = mm.shape if layer is None else mm.shape[len(layer):]
+    if tensor.shape != tuple(target):
+        # only re-factor TRAILING dims (same data, finer factoring — e.g.
+        # gpt2's fused QKV is [E, 3E] in HF but [E, 3, E] here so the head
+        # dim shards on its own, models/gpt2.py). Leading-dim mismatches
+        # (e.g. a transposed Linear-vs-Conv1D layout) must stay loud: an
+        # unconditional reshape would silently scramble them.
+        if tensor.ndim > 1 and tensor.shape[:1] != tuple(target[:1]):
+            raise ValueError(
+                f"{name}: shape {tensor.shape} does not match "
+                f"target {tuple(target)} for leaf {leaf!r} "
+                f"(transposed source layout?)")
+        tensor = tensor.reshape(target)
+    if layer is None:
+        mm[...] = tensor.astype(mm.dtype)
+    else:
+        mm[layer] = tensor.astype(mm.dtype)
+    seen.add((leaf, layer))
 
 
 def _bf16_to_f32(arr: np.ndarray) -> np.ndarray:

@@ -235,6 +235,12 @@ def _hf_config(bundle) -> dict:
                     attention_bias=False)
         if c.head_dim:
             base["head_dim"] = c.head_dim
+    # without this a gelu-gated llama-family model reloads with transformers'
+    # default silu MLP — silently divergent logits
+    if "hidden_act" not in base:
+        base["hidden_act"] = {"silu": "silu",
+                              "gelu_tanh": "gelu_pytorch_tanh"}[
+                                  getattr(c, "act_fn", "silu")]
     return base
 
 
@@ -283,7 +289,8 @@ def main(argv=None) -> None:
                         help="experiment dir holding checkpoint-*/ + state.json")
     parser.add_argument("-o", "--out-dir", required=True)
     parser.add_argument("--optimizer", default="adamw",
-                        help="optimizer the run used (adamw/adafactor/lion)")
+                        choices=["adamw", "adafactor", "lion"],
+                        help="optimizer the run used")
     parser.add_argument("--dtype", default="float32",
                         choices=["float32", "bfloat16", "float16"])
     args = parser.parse_args(argv)

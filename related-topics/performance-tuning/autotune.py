@@ -127,6 +127,22 @@ def plan_walk(args) -> list[dict]:
     return steps
 
 
+def compose_flags(kept: list[str], step_name: str,
+                  step_flags: list[str]) -> list[str]:
+    """Compose a probe's flag set from the kept levers plus the step's.
+
+    Remat rungs REPLACE the kept policy, not stack with it: strip the kept
+    3-token segment (``--checkpoint-activations --remat-policy <p>``)
+    wherever it sits and keep everything around it — truncating at the
+    segment would silently drop levers kept after it (e.g. adafactor,
+    turning the post-adafactor attn_mlp retry into a mislabeled re-probe
+    of the config that already OOMed)."""
+    if step_name.startswith("remat_") and "--remat-policy" in kept:
+        i = kept.index("--checkpoint-activations")
+        return kept[:i] + kept[i + 3:] + step_flags
+    return kept + step_flags
+
+
 def main() -> None:
     p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     p.add_argument("-m", "--model", required=True)
@@ -163,11 +179,7 @@ def main() -> None:
         name, batch = step["name"], max(step["batch"], kept_batch)
         if step["name"].startswith("batch_"):
             batch = step["batch"]
-        flags = kept_flags + step["flags"]
-        # remat rungs replace the previous policy, not stack with it
-        if name.startswith("remat_") and "--remat-policy" in kept_flags:
-            i = kept_flags.index("--checkpoint-activations")
-            flags = kept_flags[:i] + step["flags"]
+        flags = compose_flags(kept_flags, name, step["flags"])
         key = (tuple(flags), batch)
         if key in probed:   # e.g. a post-adafactor remat retry that already won
             emit({"probe": name, "status": "skipped_already_measured"})

@@ -46,6 +46,22 @@ def test_plan_walk_order_and_batch_ladder():
     assert all("--fence-every" in s["flags"] for s in plan if s["name"] == "fence4")
 
 
+def test_compose_flags_remat_retry_keeps_later_levers():
+    """The post-adafactor attn_mlp retry must probe attn_mlp WITH adafactor
+    — replacing the kept policy segment must preserve levers kept after it."""
+    kept = ["--fence-every", "4", "--checkpoint-activations",
+            "--remat-policy", "attn", "--optimizer", "adafactor"]
+    out = autotune.compose_flags(
+        kept, "remat_attn_mlp_after_adafactor",
+        ["--checkpoint-activations", "--remat-policy", "attn_mlp"])
+    assert out == ["--fence-every", "4", "--optimizer", "adafactor",
+                   "--checkpoint-activations", "--remat-policy", "attn_mlp"]
+    # non-remat steps simply append
+    assert autotune.compose_flags(["--fence-every", "4"], "adafactor",
+                                  ["--optimizer", "adafactor"]) == \
+        ["--fence-every", "4", "--optimizer", "adafactor"]
+
+
 def test_probe_cmd_builds_runner_invocation(tmp_path):
     import argparse
     args = argparse.Namespace(model="llama-debug", seq=128, steps=12)

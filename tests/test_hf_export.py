@@ -71,6 +71,38 @@ def test_export_qwen_bias_roundtrip(tmp_path):
     _roundtrip(tmp_path, model, bundle, 128)
 
 
+def test_export_tied_llama_roundtrip(tmp_path):
+    """tie_word_embeddings=True: the emitter must OMIT lm_head (HF re-ties
+    from the embedding) and the reloaded logits still match."""
+    hf_cfg = transformers.LlamaConfig(
+        vocab_size=128, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=256, rms_norm_eps=1e-5,
+        tie_word_embeddings=True)
+    torch.manual_seed(0)
+    model = transformers.LlamaForCausalLM(hf_cfg).eval()
+    bundle = get_model("llama-debug", vocab_size=128,
+                       tie_word_embeddings=True, dtype=jnp.float32)
+    _roundtrip(tmp_path, model, bundle, 128)
+
+
+def test_export_gemma_roundtrip(tmp_path):
+    """The Gemma config inversion ((1+w) norms, scaled embeddings, MQA,
+    explicit head_dim, forced tie) through transformers reload."""
+    hf_cfg = transformers.GemmaConfig(
+        vocab_size=128, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=1,
+        head_dim=32, max_position_embeddings=256, rms_norm_eps=1e-6,
+        hidden_act="gelu_pytorch_tanh", tie_word_embeddings=True)
+    torch.manual_seed(0)
+    model = transformers.GemmaForCausalLM(hf_cfg).eval()
+    bundle = get_model("gemma-2b", vocab_size=128, hidden_size=64,
+                       intermediate_size=128, num_layers=2, num_heads=4,
+                       num_kv_heads=1, head_dim=32,
+                       max_position_embeddings=256, dtype=jnp.float32)
+    _roundtrip(tmp_path, model, bundle, 128)
+
+
 def test_export_gpt2_roundtrip(tmp_path):
     hf_cfg = transformers.GPT2Config(vocab_size=160, n_embd=64, n_layer=2,
                                      n_head=4, n_positions=128)

@@ -222,6 +222,35 @@ def test_neox_parity(tmp_path, parallel_residual):
         assert np.isfinite(_one_train_step(bundle, plan, params, ids))
 
 
+def test_phi3_parity(tmp_path):
+    """Phi-3 = llama math with FUSED checkpoint tensors: one qkv_proj
+    ([hq+2*hkv, E] rows) and one gate_up_proj ([2F, E]). Pins the
+    multi-leaf split path in the converter (one source tensor filling
+    three/two native leaves), end to end via hf: ingestion."""
+    hf_cfg = transformers.Phi3Config(
+        vocab_size=128, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=256, rope_theta=10000.0, rms_norm_eps=1e-5,
+        sliding_window=None, tie_word_embeddings=False,
+        pad_token_id=0, bos_token_id=1, eos_token_id=2)
+    torch.manual_seed(0)
+    model = transformers.Phi3ForCausalLM(hf_cfg).eval()
+    model.save_pretrained(tmp_path / "hf", safe_serialization=True)
+
+    bundle = get_model(f"hf:{tmp_path / 'hf'}", dtype=jnp.float32)
+    assert bundle.family == "llama" and not bundle.config.attn_bias
+    convert_hf_checkpoint(tmp_path / "hf", tmp_path / "conv", bundle=bundle)
+    plan = make_plan("single", make_mesh(devices=jax.devices()[:1]))
+    params = load_pretrained(bundle, _replicated_shardings(bundle, plan),
+                             tmp_path / "conv")
+
+    ids = np.random.RandomState(0).randint(0, 128, (2, 24))
+    ours = np.asarray(bundle.apply(bundle.config, params, jnp.asarray(ids)))
+    with torch.no_grad():
+        theirs = model(torch.tensor(ids)).logits.float().numpy()
+    np.testing.assert_allclose(ours, theirs, rtol=2e-4, atol=2e-4)
+
+
 def test_auto_hf_config_ingestion(tmp_path, caplog):
     """The AutoModelForCausalLM analogue (reference 01:57): ``-m hf:<dir>``
     builds the family config from the checkpoint's own config.json. Pins the
