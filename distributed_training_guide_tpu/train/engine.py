@@ -50,6 +50,14 @@ import numpy as np
 _STAGE_TO_STRATEGY = {0: "ddp", 1: "zero1", 2: "zero2", 3: "fsdp"}
 
 
+def _ds_offload_enabled(v) -> bool:
+    """DeepSpeed offload values: bool, or {"device": "cpu"/"nvme"/"none"}
+    — the dict with device "none" is the canonical DISABLE spelling."""
+    if isinstance(v, dict):
+        return v.get("device", "none") not in ("none", None)
+    return bool(v)
+
+
 class TrainingEngine:
     def __init__(self, config: dict | str | Path):
         from ..models import get_model
@@ -124,6 +132,31 @@ class TrainingEngine:
                 f"optimizer.type {opt_type!r} (supported: {sorted(known)}); "
                 f"remove them or switch type")
         sched = config.get("scheduler", {})
+        if "type" in sched or "params" in sched:
+            # canonical DeepSpeed spelling (the reference's ds_config.json:
+            # {"type": "WarmupCosineLR", "params": {total_num_steps,
+            # warmup_num_steps, cos_min_ratio}}). Fail-loud policy, same as
+            # optimizer.params: only the cosine schedule exists here, and a
+            # param this engine would drop (e.g. warmup_max_lr) means the
+            # run would use different dynamics than the config states.
+            stype = sched.get("type", "WarmupCosineLR")
+            if stype != "WarmupCosineLR":
+                raise ValueError(
+                    f"scheduler.type {stype!r} is not supported (cosine "
+                    f"only: WarmupCosineLR); or use the flat native "
+                    f"spelling {{t_max, eta_min_ratio, warmup_steps}}")
+            p = sched.get("params", {})
+            known = {"total_num_steps", "warmup_num_steps", "cos_min_ratio"}
+            unknown = set(p) - known
+            if unknown:
+                raise ValueError(
+                    f"scheduler.params {sorted(unknown)} are not supported "
+                    f"(supported: {sorted(known)}); remove them or port the "
+                    f"values to the flat native spelling")
+            sched = {"t_max": p.get("total_num_steps", 1000),
+                     "warmup_steps": p.get("warmup_num_steps", 0),
+                     "eta_min_ratio": p.get("cos_min_ratio", 0.01)}
+        self.scheduler_config = sched  # post-normalization (tests pin this)
         common = dict(
             weight_decay=opt_cfg.get("weight_decay", 0.01),
             t_max=sched.get("t_max", 1000),
@@ -169,7 +202,20 @@ class TrainingEngine:
             cp_hop_loop=config.get("cp_hop_loop", "auto"),
             loss_chunks=config.get("loss_chunks", 0),
             pp_microbatches=config.get("pp_microbatches"),
-            offload_opt_state=config.get("offload_optimizer", False),
+            # both spellings: our top-level key, and DeepSpeed's nested
+            # zero_optimization.offload_optimizer/offload_param — there a
+            # bool, or a dict whose device decides ({"device": "none"} is
+            # the canonical DISABLE spelling, so bool(dict) would invert it)
+            offload_opt_state=bool(
+                config.get("offload_optimizer", False)
+                or _ds_offload_enabled(
+                    config.get("zero_optimization", {}).get(
+                        "offload_optimizer", False))),
+            offload_params=bool(
+                config.get("offload_params", False)
+                or _ds_offload_enabled(
+                    config.get("zero_optimization", {}).get(
+                        "offload_param", False))),
         )
         self.state = self.trainer.init_state(config.get("seed", 0))
         self._io = None
