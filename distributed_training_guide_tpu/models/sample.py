@@ -1,0 +1,123 @@
+"""Minimal text sampling from any model in the zoo — a qualitative check
+for trained / converted checkpoints.
+
+Neither the reference nor this guide is an inference framework; this is
+the smallest honest sampler: one jit-compiled step re-runs the FULL
+forward over a fixed-size buffer and writes one token (static shapes, one
+compile for the whole generation — no KV cache, so cost is
+``steps x forward(prompt+steps)``; fine for eyeballing a checkpoint,
+wrong tool for serving).
+
+    # hermetic (no tokenizer): raw token ids in, ids out
+    python -m distributed_training_guide_tpu.models.sample \\
+        -m llama-debug --prompt-ids 3,17,42 --steps 16
+    # with a tokenizer cache: text in, text out
+    python -m distributed_training_guide_tpu.models.sample \\
+        -m gpt2 --pretrained /ckpts/gpt2-conv --prompt "The TPU" --steps 32
+"""
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+
+def make_sampler(bundle, temperature: float = 0.0):
+    """One compiled decode step: full forward over the fixed buffer, write
+    the token at ``pos``. Greedy when ``temperature == 0`` (the branch is
+    a Python constant, so each mode is its own single compile)."""
+
+    @partial(jax.jit, donate_argnums=(1,))
+    def decode_step(params, buf, pos, key):
+        logits = bundle.apply(bundle.config, params, buf)
+        logit = jax.lax.dynamic_index_in_dim(logits[0], pos - 1, axis=0,
+                                             keepdims=False)
+        if temperature == 0.0:
+            nxt = jnp.argmax(logit)
+        else:
+            nxt = jax.random.categorical(key, logit / temperature)
+        return jax.lax.dynamic_update_index_in_dim(
+            buf, nxt.astype(buf.dtype)[None], pos, axis=1)
+
+    def sample(params, prompt_ids, steps: int,
+               rng: Optional[jax.Array] = None) -> list[int]:
+        rng = rng if rng is not None else jax.random.key(0)
+        n = len(prompt_ids)
+        buf = jnp.zeros((1, n + steps), jnp.int32)
+        buf = buf.at[0, :n].set(jnp.asarray(prompt_ids, jnp.int32))
+        for t in range(n, n + steps):
+            rng, key = jax.random.split(rng)
+            buf = decode_step(params, buf, jnp.asarray(t), key)
+        return [int(x) for x in buf[0]]
+
+    return sample
+
+
+def main(argv=None) -> None:
+    import argparse
+
+    parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    parser.add_argument("-m", "--model-name", required=True)
+    parser.add_argument("--prompt", default=None,
+                        help="text prompt (needs the model's HF tokenizer "
+                             "in the local cache)")
+    parser.add_argument("--prompt-ids", default=None,
+                        help="comma-separated token ids — the hermetic path")
+    parser.add_argument("--steps", type=int, default=32)
+    parser.add_argument("--temperature", type=float, default=0.0)
+    parser.add_argument("--seed", type=int, default=0)
+    parser.add_argument("--pretrained", default=None, metavar="DIR",
+                        help="converted checkpoint dir (models/hf_convert); "
+                             "random init otherwise")
+    args = parser.parse_args(argv)
+    if (args.prompt is None) == (args.prompt_ids is None):
+        raise SystemExit("pass exactly one of --prompt / --prompt-ids")
+
+    from ..parallel import make_mesh, make_plan
+    from .registry import get_model
+
+    bundle = get_model(args.model_name, dtype=jnp.float32)
+    tokenizer = None
+    if args.prompt is not None:
+        from ..data import get_tokenizer
+
+        tokenizer = get_tokenizer(args.model_name)
+        prompt_ids = tokenizer(args.prompt)["input_ids"]
+        if prompt_ids and isinstance(prompt_ids[0], list):
+            prompt_ids = prompt_ids[0]  # batched tokenizers (ByteTokenizer)
+    else:
+        prompt_ids = [int(t) for t in args.prompt_ids.split(",")]
+
+    max_pos = getattr(bundle.config, "max_position_embeddings", None)
+    if max_pos and len(prompt_ids) + args.steps > max_pos:
+        # gpt2's learned table clamps out-of-range positions under jit —
+        # silent garbage, so refuse instead
+        raise SystemExit(
+            f"prompt ({len(prompt_ids)}) + steps ({args.steps}) exceeds the "
+            f"model's max_position_embeddings ({max_pos})")
+
+    if args.pretrained:
+        from .hf_convert import load_pretrained
+
+        plan = make_plan("single", make_mesh(devices=jax.devices()[:1]))
+        shapes = jax.eval_shape(
+            lambda: bundle.init(bundle.config, jax.random.key(0)))
+        shardings = plan.param_shardings(
+            bundle.param_logical_axes(bundle.config), shapes)
+        params = load_pretrained(bundle, shardings, args.pretrained)
+    else:
+        params = bundle.init(bundle.config, jax.random.key(args.seed))
+
+    sample = make_sampler(bundle, temperature=args.temperature)
+    out = sample(params, prompt_ids, args.steps,
+                 rng=jax.random.key(args.seed))
+    if tokenizer is not None:
+        print(tokenizer.decode(out))
+    else:
+        print(",".join(str(t) for t in out))
+
+
+if __name__ == "__main__":
+    main()
