@@ -138,6 +138,33 @@ def test_gang_ddp_matches_single_process(tmp_path, warm_cache):
         assert abs(loss - sp_losses[step]) < 1e-4, (step, loss, sp_losses[step])
 
 
+def test_gang_fence_every_matches_per_step(tmp_path, warm_cache):
+    """--fence-every across a REAL process boundary: each process banks its
+    own device-loss reads and drains at the (log-freq) boundary; the logged
+    running_loss windows must equal a per-step-fenced single-process run.
+    log-freq 3 (not 1) so the fence group actually runs at depth 3."""
+    assert TRAIN_FLAGS[-2:] == ["--log-freq", "1"]
+    flags = TRAIN_FLAGS[:-1] + ["3"]
+    worker = [sys.executable, str(CH02), *flags, "--max-steps", "6",
+              "--fence-every", "3", "--save-dir", str(tmp_path / "mp")]
+    rc, rank0, (rank1,) = run_gang(worker, log_dir=str(tmp_path / "logs"))
+    assert rc == 0, rank0[-3000:]
+    mp_losses = losses_by_step(rank0)
+    assert set(mp_losses) == {3, 6}
+    assert losses_by_step(rank1) == mp_losses
+
+    sp = subprocess.run(
+        [sys.executable, str(CH02), *flags, "--max-steps", "6",
+         "--save-dir", str(tmp_path / "sp")],
+        capture_output=True, text=True, timeout=600, cwd=REPO,
+        env=_clean_env(JAX_PLATFORMS="cpu",
+                       XLA_FLAGS="--xla_force_host_platform_device_count=8"))
+    assert sp.returncode == 0, (sp.stdout + sp.stderr)[-3000:]
+    sp_losses = losses_by_step(sp.stdout + sp.stderr)
+    for step, loss in mp_losses.items():
+        assert abs(loss - sp_losses[step]) < 1e-4, (step, loss, sp_losses)
+
+
 def test_gang_fsdp_trains_with_cross_process_shards(tmp_path, warm_cache):
     """fsdp shards every parameter over all 8 devices, i.e. ACROSS the two
     processes: init, step collectives, and the loader all have to handle
