@@ -106,6 +106,17 @@ def warm_cache():
     os.makedirs(MP_COMPILE_CACHE, exist_ok=True)
 
 
+def single_process_losses(script, flags: list, save_dir) -> dict:
+    """Golden: the same chapter entry on 1 process x 8 virtual devices."""
+    sp = subprocess.run(
+        [sys.executable, str(script), *flags, "--save-dir", str(save_dir)],
+        capture_output=True, text=True, timeout=600, cwd=REPO,
+        env=_clean_env(JAX_PLATFORMS="cpu",
+                       XLA_FLAGS="--xla_force_host_platform_device_count=8"))
+    assert sp.returncode == 0, (sp.stdout + sp.stderr)[-3000:]
+    return losses_by_step(sp.stdout + sp.stderr)
+
+
 def test_gang_ddp_matches_single_process(tmp_path, warm_cache):
     """2 procs x 4 devices and 1 proc x 8 devices build the same dp=8 mesh
     over the same global batch: the logged loss trajectory must agree. This
@@ -124,14 +135,8 @@ def test_gang_ddp_matches_single_process(tmp_path, warm_cache):
     assert rank1_losses == mp_losses
 
     # single-process golden at the same global config
-    sp = subprocess.run(
-        [sys.executable, str(CH02), *TRAIN_FLAGS, "--max-steps", "6",
-         "--save-dir", str(tmp_path / "sp")],
-        capture_output=True, text=True, timeout=600, cwd=REPO,
-        env=_clean_env(JAX_PLATFORMS="cpu",
-                       XLA_FLAGS="--xla_force_host_platform_device_count=8"))
-    assert sp.returncode == 0, (sp.stdout + sp.stderr)[-3000:]
-    sp_losses = losses_by_step(sp.stdout + sp.stderr)
+    sp_losses = single_process_losses(
+        CH02, [*TRAIN_FLAGS, "--max-steps", "6"], tmp_path / "sp")
     assert set(sp_losses) == set(mp_losses)
     for step, loss in mp_losses.items():
         # identical global math; only collective reduction order may differ
@@ -153,14 +158,9 @@ def test_gang_fence_every_matches_per_step(tmp_path, warm_cache):
     assert set(mp_losses) == {3, 6}
     assert losses_by_step(rank1) == mp_losses
 
-    sp = subprocess.run(
-        [sys.executable, str(CH02), *flags, "--max-steps", "6",
-         "--save-dir", str(tmp_path / "sp")],
-        capture_output=True, text=True, timeout=600, cwd=REPO,
-        env=_clean_env(JAX_PLATFORMS="cpu",
-                       XLA_FLAGS="--xla_force_host_platform_device_count=8"))
-    assert sp.returncode == 0, (sp.stdout + sp.stderr)[-3000:]
-    sp_losses = losses_by_step(sp.stdout + sp.stderr)
+    sp_losses = single_process_losses(
+        CH02, [*flags, "--max-steps", "6"], tmp_path / "sp")
+    assert set(sp_losses) == set(mp_losses)
     for step, loss in mp_losses.items():
         assert abs(loss - sp_losses[step]) < 1e-4, (step, loss, sp_losses)
 
