@@ -1,0 +1,16 @@
+#!/usr/bin/env python3
+"""Root-level entry for the cluster monitor — the same spot the reference
+keeps its ``top-cluster.py`` (reference repo root), so the muscle-memory
+command ports unchanged:
+
+    python top-cluster.py --hosts hosts.txt
+    python top-cluster.py --local
+
+Implementation: ``distributed_training_guide_tpu/monitor/top_cluster.py``
+(per-host HBM/allocator sampling with allocator-churn stall alerts — the
+TPU analogue of the reference's nvidia-smi power-draw hang detection).
+"""
+from distributed_training_guide_tpu.monitor.top_cluster import main
+
+if __name__ == "__main__":
+    main()
