@@ -140,28 +140,42 @@ class TrainingEngine:
             # param this engine would drop (e.g. warmup_max_lr) means the
             # run would use different dynamics than the config states.
             stype = sched.get("type", "WarmupCosineLR")
-            if stype != "WarmupCosineLR":
+            if stype not in ("WarmupCosineLR", "WarmupDecayLR"):
                 raise ValueError(
-                    f"scheduler.type {stype!r} is not supported (cosine "
-                    f"only: WarmupCosineLR); or use the flat native "
-                    f"spelling {{t_max, eta_min_ratio, warmup_steps}}")
+                    f"scheduler.type {stype!r} is not supported "
+                    f"(WarmupCosineLR or WarmupDecayLR); or use the flat "
+                    f"native spelling {{t_max, eta_min_ratio, warmup_steps,"
+                    f" decay}}")
             p = sched.get("params", {})
-            known = {"total_num_steps", "warmup_num_steps", "cos_min_ratio"}
+            known = {"total_num_steps", "warmup_num_steps"}
+            if stype == "WarmupCosineLR":
+                known.add("cos_min_ratio")
             unknown = set(p) - known
             if unknown:
                 raise ValueError(
                     f"scheduler.params {sorted(unknown)} are not supported "
-                    f"(supported: {sorted(known)}); remove them or port the "
-                    f"values to the flat native spelling")
-            sched = {"t_max": p.get("total_num_steps", 1000),
-                     "warmup_steps": p.get("warmup_num_steps", 0),
-                     "eta_min_ratio": p.get("cos_min_ratio", 0.01)}
+                    f"for {stype} (supported: {sorted(known)}); remove them "
+                    f"or port the values to the flat native spelling")
+            total = p.get("total_num_steps", 1000)
+            warmup = p.get("warmup_num_steps", 0)
+            # DS semantics: the decay ENDS at total_num_steps. The native
+            # schedule decays over t_max steps AFTER warmup, so the DS
+            # spelling maps to t_max = total - warmup (keeping t_max=total
+            # would hit the floor warmup steps late, at a shallower slope)
+            sched = {"t_max": max(total - warmup, 1),
+                     "warmup_steps": warmup,
+                     # WarmupDecayLR decays LINEARLY to zero in DeepSpeed
+                     "eta_min_ratio": (p.get("cos_min_ratio", 0.01)
+                                       if stype == "WarmupCosineLR" else 0.0),
+                     "decay": ("cosine" if stype == "WarmupCosineLR"
+                               else "linear")}
         self.scheduler_config = sched  # post-normalization (tests pin this)
         common = dict(
             weight_decay=opt_cfg.get("weight_decay", 0.01),
             t_max=sched.get("t_max", 1000),
             eta_min_ratio=sched.get("eta_min_ratio", 0.01),
             warmup_steps=sched.get("warmup_steps", 0),
+            decay=sched.get("decay", "cosine"),
             grad_clip=config.get("gradient_clipping"),
         )
         if opt_type in ("adamw", "adam"):

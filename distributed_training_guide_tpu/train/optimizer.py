@@ -18,8 +18,15 @@ from typing import Optional
 import optax
 
 
-def cosine_schedule(lr: float, t_max: int = 1000, eta_min_ratio: float = 0.01,
-                    warmup_steps: int = 0) -> optax.Schedule:
+def make_schedule(lr: float, t_max: int = 1000, eta_min_ratio: float = 0.01,
+                  warmup_steps: int = 0,
+                  decay: str = "cosine") -> optax.Schedule:
+    """Warmup + decay-to-``lr*eta_min_ratio`` over ``t_max`` steps, flat
+    after. ``decay``: "cosine" (the reference's CosineAnnealingLR shape) or
+    "linear" (DeepSpeed's WarmupDecayLR shape — pair with
+    ``eta_min_ratio=0.0`` for its decay-to-zero semantics)."""
+    if decay not in ("cosine", "linear"):
+        raise ValueError(f"decay must be cosine|linear, got {decay!r}")
     eta_min = lr * eta_min_ratio
 
     def schedule(step):
@@ -27,10 +34,18 @@ def cosine_schedule(lr: float, t_max: int = 1000, eta_min_ratio: float = 0.01,
 
         warm = jnp.minimum(step / max(warmup_steps, 1), 1.0) if warmup_steps else 1.0
         t = jnp.clip(step - warmup_steps, 0, t_max)
-        cos = eta_min + (lr - eta_min) * 0.5 * (1 + jnp.cos(jnp.pi * t / t_max))
-        return warm * cos
+        if decay == "cosine":
+            val = eta_min + (lr - eta_min) * 0.5 * (1 + jnp.cos(jnp.pi * t / t_max))
+        else:
+            val = eta_min + (lr - eta_min) * (1 - t / t_max)
+        return warm * val
 
     return schedule
+
+
+def cosine_schedule(lr: float, t_max: int = 1000, eta_min_ratio: float = 0.01,
+                    warmup_steps: int = 0) -> optax.Schedule:
+    return make_schedule(lr, t_max, eta_min_ratio, warmup_steps, "cosine")
 
 
 def adamw_cosine(
@@ -44,9 +59,11 @@ def adamw_cosine(
     b2: float = 0.999,
     eps: float = 1e-8,
     grad_clip: Optional[float] = None,
+    decay: str = "cosine",
 ) -> optax.GradientTransformation:
     tx = optax.adamw(
-        learning_rate=cosine_schedule(lr, t_max, eta_min_ratio, warmup_steps),
+        learning_rate=make_schedule(lr, t_max, eta_min_ratio, warmup_steps,
+                                    decay),
         b1=b1, b2=b2, eps=eps, weight_decay=weight_decay,
     )
     if grad_clip:
@@ -63,6 +80,7 @@ def adafactor_cosine(
     weight_decay: float = 0.01,
     grad_clip: Optional[float] = None,
     min_dim_size_to_factor: int = 128,
+    decay: str = "cosine",
 ) -> optax.GradientTransformation:
     """Adafactor with the same cosine schedule as ``adamw_cosine``.
 
@@ -80,7 +98,7 @@ def adafactor_cosine(
     ``scale_by_learning_rate`` so the update is ``-lr_t * (rms_grad + wd*p)``,
     matching ``optax.adamw``'s semantics and schedule exactly.
     """
-    schedule = cosine_schedule(lr, t_max, eta_min_ratio, warmup_steps)
+    schedule = make_schedule(lr, t_max, eta_min_ratio, warmup_steps, decay)
     steps = [
         optax.scale_by_factored_rms(min_dim_size_to_factor=min_dim_size_to_factor),
         optax.clip_by_block_rms(1.0),
@@ -103,6 +121,7 @@ def lion_cosine(
     b1: float = 0.9,
     b2: float = 0.99,
     grad_clip: Optional[float] = None,
+    decay: str = "cosine",
 ) -> optax.GradientTransformation:
     """Lion (Chen et al. 2023) with the shared cosine schedule.
 
@@ -114,7 +133,8 @@ def lion_cosine(
     ``optax.adamw``), so no re-chaining is needed here.
     """
     tx = optax.lion(
-        learning_rate=cosine_schedule(lr, t_max, eta_min_ratio, warmup_steps),
+        learning_rate=make_schedule(lr, t_max, eta_min_ratio, warmup_steps,
+                                    decay),
         b1=b1, b2=b2, weight_decay=weight_decay,
     )
     if grad_clip:

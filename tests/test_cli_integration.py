@@ -200,8 +200,10 @@ def test_engine_accepts_canonical_deepspeed_config(eight_devices):
                               "offload_optimizer": False},
     }
     engine = initialize(config)
-    assert engine.scheduler_config == {"t_max": 777, "warmup_steps": 5,
-                                       "eta_min_ratio": 1e-2}
+    assert engine.scheduler_config == {"t_max": 772, "warmup_steps": 5,
+                                       "eta_min_ratio": 1e-2,
+                                       "decay": "cosine"}  # 777 - 5 warmup:
+    # DS decay ENDS at total_num_steps; native t_max counts post-warmup
     assert not engine.trainer.offload_opt_state
     ids = np.random.RandomState(0).randint(0, 512, (engine.global_batch_size, 32))
     batch_sh = engine.trainer.batch_shardings()
@@ -220,15 +222,23 @@ def test_engine_accepts_canonical_deepspeed_config(eight_devices):
     with pytest.raises(ValueError, match="scheduler.type"):
         initialize({"model": "llama-debug",
                     "scheduler": {"type": "OneCycle", "params": {}}})
-    with pytest.raises(ValueError, match="scheduler.type"):
-        # type checked even without params; WarmupDecayLR is LINEAR decay
-        # in DS — mapping it onto cosine would run different dynamics
-        initialize({"model": "llama-debug",
-                    "scheduler": {"type": "WarmupDecayLR"}})
     with pytest.raises(ValueError, match="scheduler.params"):
         initialize({"model": "llama-debug",
                     "scheduler": {"type": "WarmupCosineLR",
                                   "params": {"warmup_max_lr": 1e-4}}})
+
+    # WarmupDecayLR = DS's linear decay-to-zero; it maps to the linear
+    # schedule (NOT silently onto cosine), and cos_min_ratio is invalid there
+    lin = initialize({"model": "llama-debug",
+                      "scheduler": {"type": "WarmupDecayLR",
+                                    "params": {"total_num_steps": 500,
+                                               "warmup_num_steps": 10}}})
+    assert lin.scheduler_config == {"t_max": 490, "warmup_steps": 10,
+                                    "eta_min_ratio": 0.0, "decay": "linear"}
+    with pytest.raises(ValueError, match="scheduler.params"):
+        initialize({"model": "llama-debug",
+                    "scheduler": {"type": "WarmupDecayLR",
+                                  "params": {"cos_min_ratio": 0.1}}})
 
 
 def test_engine_optimizer_type_dispatch(eight_devices):

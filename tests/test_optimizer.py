@@ -119,3 +119,28 @@ def test_adafactor_checkpoint_roundtrip(tmp_path):
     s_live, m_live = t.step_fn(state, batch)
     s_rest, m_rest = t.step_fn(restored, batch)
     assert float(m_live["loss"]) == float(m_rest["loss"])
+
+
+def test_make_schedule_shapes():
+    """Cosine (the reference's CosineAnnealingLR) and linear (DeepSpeed's
+    WarmupDecayLR) schedules: endpoints, midpoints, post-t_max flatness,
+    warmup ramp, and the loud unknown-decay rejection."""
+    import pytest
+
+    from distributed_training_guide_tpu.train.optimizer import make_schedule
+
+    lin = make_schedule(1e-3, t_max=100, eta_min_ratio=0.0, decay="linear")
+    np.testing.assert_allclose([float(lin(s)) for s in (0, 50, 100, 150)],
+                               [1e-3, 5e-4, 0.0, 0.0], rtol=1e-6, atol=1e-12)
+
+    cos = make_schedule(1e-3, t_max=100, eta_min_ratio=0.01, decay="cosine")
+    np.testing.assert_allclose(float(cos(0)), 1e-3, rtol=1e-6)
+    np.testing.assert_allclose(float(cos(50)), (1e-3 + 1e-5) / 2, rtol=1e-6)
+    np.testing.assert_allclose(float(cos(100)), 1e-5, rtol=1e-6)
+    np.testing.assert_allclose(float(cos(200)), 1e-5, rtol=1e-6)
+
+    warm = make_schedule(1e-3, t_max=100, warmup_steps=10, decay="linear")
+    assert float(warm(5)) < float(warm(10))
+
+    with pytest.raises(ValueError, match="decay"):
+        make_schedule(1e-3, decay="onecycle")
