@@ -572,6 +572,21 @@ SWEEP_QUEUE = [
     dict(name="moe1b_adafactor_fence4_b8_gather", model="moe-1b-8e", batch=8,
          seq=2048, remat=True, remat_policy="attn", optimizer="adafactor",
          fence_every=4),
+    # --- the head-dim experiment: llama-1b-hd128 is tinyllama's size with
+    # 16x128 heads instead of 32x64. If the 33.6% tinyllama measurement was
+    # the half-width MXU tiles, these should land near the 650m numbers —
+    # and a 1B model at ~55% would be a stronger headline than 650m.
+    dict(name="l1bhd128_adafactor_fence4_b4", model="llama-1b-hd128",
+         batch=4, seq=2048, remat=True, remat_policy="attn",
+         optimizer="adafactor", fence_every=4),
+    dict(name="l1bhd128_bf16_adafactor_attnmlp_fence4_b8",
+         model="llama-1b-hd128", batch=8, seq=2048, remat=True,
+         remat_policy="attn_mlp", optimizer="adafactor",
+         param_dtype="bfloat16", fence_every=4, loss_chunks=8),
+    dict(name="l1bhd128_adafactor_attnmlp_fence4_b4",
+         model="llama-1b-hd128", batch=4, seq=2048, remat=True,
+         remat_policy="attn_mlp", optimizer="adafactor", fence_every=4,
+         loss_chunks=8),
     # --- single-chip long-context ceiling: flash's O(S) memory + the attn
     # policy carried 8k at 55.9%; push to 16k/32k (same token budget per
     # step as the 8k rungs, longer rows). max_position raises the RoPE

@@ -338,6 +338,14 @@ PRESETS = {
     "llama-650m": LlamaConfig(vocab_size=32000, hidden_size=1536, intermediate_size=6144,
                               num_layers=16, num_heads=12, num_kv_heads=4,
                               max_position_embeddings=4096),
+    # the 1B-class experiment behind tinyllama's 33.6% MFU measurement
+    # (BENCH.md): same param count, but 16 heads x 128 where tinyllama runs
+    # 32 x 64 — half-width head tiles waste half of every 128x128 MXU pass,
+    # so this preset isolates the head-dim lever at 1B scale
+    "llama-1b-hd128": LlamaConfig(vocab_size=32000, hidden_size=2048,
+                                  intermediate_size=8192, num_layers=16,
+                                  num_heads=16, num_kv_heads=4,
+                                  max_position_embeddings=4096),
     "llama-3.2-1b": LlamaConfig(vocab_size=128256, hidden_size=2048, intermediate_size=8192,
                                 num_layers=16, num_heads=32, num_kv_heads=8,
                                 rope_theta=500000.0, max_position_embeddings=8192,
