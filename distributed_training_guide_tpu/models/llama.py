@@ -165,7 +165,8 @@ def _rmsnorm(x: jnp.ndarray, scale: jnp.ndarray, eps: float,
 def attention_sublayer(config, x: jnp.ndarray, attn_params: dict, norm_scale,
                        positions: jnp.ndarray, attn_impl,
                        standard_layout: bool = True,
-                       tp_axis: Optional[str] = None) -> jnp.ndarray:
+                       tp_axis: Optional[str] = None,
+                       kv_cache=None, return_kv: bool = False):
     """norm -> rope'd GQA attention -> output proj (residual added by caller).
 
     Shared by the dense Llama block and the MoE family (config is duck-typed:
@@ -175,7 +176,15 @@ def attention_sublayer(config, x: jnp.ndarray, attn_params: dict, norm_scale,
     *manual* axis (the pipeline schedule) — weights arrive as per-member head
     shards (head counts are inferred from the weight shapes, not the config)
     and the output projection's partial sum is psum'd explicitly, the
-    megatron Rowwise reduction GSPMD otherwise inserts."""
+    megatron Rowwise reduction GSPMD otherwise inserts.
+
+    Decode support (the sampler's KV cache, ``models/sample.py``):
+    ``kv_cache=(cached_k, cached_v, pos)`` writes this call's rope'd k/v at
+    ``pos`` into the caches and attends q over the FULL cache (explicit
+    kv_positions keep the causal mask exact; zero rows beyond ``pos`` are
+    masked out by it). ``return_kv=True`` additionally returns the (rope'd,
+    possibly cache-merged) k/v. Both default off — the training path is
+    untouched."""
     b, s, e = x.shape
     d = config.head_size
     cdt = config.dtype
@@ -191,7 +200,16 @@ def attention_sublayer(config, x: jnp.ndarray, attn_params: dict, norm_scale,
     v = v.reshape(b, s, -1, d)
     q = apply_rope(q, positions, config.rope_theta)
     k = apply_rope(k, positions, config.rope_theta)
-    if callable(attn_impl):  # e.g. ring attention under context parallelism
+    if kv_cache is not None:
+        ck, cv, pos = kv_cache
+        k = jax.lax.dynamic_update_slice(ck, k.astype(ck.dtype), (0, pos, 0, 0))
+        v = jax.lax.dynamic_update_slice(cv, v.astype(cv.dtype), (0, pos, 0, 0))
+        kv_pos = jnp.broadcast_to(jnp.arange(ck.shape[1])[None, :],
+                                  (b, ck.shape[1]))
+        attn = multihead_attention(q, k, v, causal=True, positions=positions,
+                                   kv_positions=kv_pos, impl="xla",
+                                   standard_layout=False)
+    elif callable(attn_impl):  # e.g. ring attention under context parallelism
         attn = attn_impl(q, k, v, standard_layout=standard_layout)
     else:
         attn = multihead_attention(q, k, v, causal=True, positions=positions,
@@ -200,25 +218,15 @@ def attention_sublayer(config, x: jnp.ndarray, attn_params: dict, norm_scale,
     out = attn.reshape(b, s, -1) @ attn_params["wo"].astype(cdt)
     if tp_axis is not None:
         out = _psum(out, tp_axis)
+    if return_kv:
+        return out, (k, v)
     return out
 
 
-def _block(config: LlamaConfig, x: jnp.ndarray, layer: dict,
-           positions: jnp.ndarray, attn_impl: str,
-           activation_sharding: Optional[Any] = None,
-           standard_layout: bool = True,
-           tp_axis: Optional[str] = None) -> jnp.ndarray:
+def mlp_sublayer(config, x: jnp.ndarray, layer: dict,
+                 tp_axis: Optional[str] = None) -> jnp.ndarray:
+    """post-attn norm -> gated MLP (residual added by caller)."""
     cdt = config.dtype
-
-    def constrain(y):
-        if activation_sharding is not None:
-            return jax.lax.with_sharding_constraint(y, activation_sharding)
-        return y
-
-    attn = attention_sublayer(config, x, layer["attn"], layer["input_norm"],
-                              positions, attn_impl, standard_layout, tp_axis)
-    x = constrain(x + attn)
-
     h = _rmsnorm(x, layer["post_attn_norm"], config.rms_norm_eps,
                  getattr(config, "norm_plus_one", False))
     gate = h @ layer["mlp"]["gate"].astype(cdt)
@@ -230,7 +238,23 @@ def _block(config: LlamaConfig, x: jnp.ndarray, layer: dict,
     down = act @ layer["mlp"]["down"].astype(cdt)
     if tp_axis is not None:  # megatron Rowwise: down-proj partial sums
         down = _psum(down, tp_axis)
-    return constrain(x + down)
+    return down
+
+
+def _block(config: LlamaConfig, x: jnp.ndarray, layer: dict,
+           positions: jnp.ndarray, attn_impl: str,
+           activation_sharding: Optional[Any] = None,
+           standard_layout: bool = True,
+           tp_axis: Optional[str] = None) -> jnp.ndarray:
+    def constrain(y):
+        if activation_sharding is not None:
+            return jax.lax.with_sharding_constraint(y, activation_sharding)
+        return y
+
+    attn = attention_sublayer(config, x, layer["attn"], layer["input_norm"],
+                              positions, attn_impl, standard_layout, tp_axis)
+    x = constrain(x + attn)
+    return constrain(x + mlp_sublayer(config, x, layer, tp_axis))
 
 
 def embed_tokens(config: LlamaConfig, params: dict, input_ids: jnp.ndarray,
@@ -320,6 +344,72 @@ def apply(
     if return_hidden:
         return final_hidden(config, params, x)
     return lm_head_logits(config, params, x)
+
+
+# ---------------------------------------------------------------------------
+# KV-cached decode (the sampler's fast path, models/sample.py). Single-device
+# utility: the cache is a functional pytree carried through lax.scan over
+# layers — each decode step is one compiled program touching one token.
+# Training paths are unaffected (separate entry points).
+# ---------------------------------------------------------------------------
+
+def init_cache(config: LlamaConfig, batch: int, max_len: int) -> dict:
+    """Zeroed per-layer KV cache, [L, B, max_len, kv_heads, head_dim]."""
+    shape = (config.num_layers, batch, max_len, config.num_kv_heads,
+             config.head_size)
+    return {"k": jnp.zeros(shape, config.dtype),
+            "v": jnp.zeros(shape, config.dtype)}
+
+
+def prefill(config: LlamaConfig, params: dict, input_ids: jnp.ndarray,
+            cache: dict):
+    """Causal forward over the prompt, writing each layer's rope'd k/v into
+    cache[:, :, :prompt_len]. Returns (last-position logits [B, V], cache)."""
+    b, p = input_ids.shape
+    positions = jnp.broadcast_to(jnp.arange(p)[None, :], (b, p))
+    x = embed_tokens(config, params, input_ids, positions)
+
+    def body(x, inputs):
+        layer, ck, cv = inputs
+        attn, (k, v) = attention_sublayer(
+            config, x, layer["attn"], layer["input_norm"], positions,
+            "xla", return_kv=True)
+        x = x + attn
+        x = x + mlp_sublayer(config, x, layer)
+        nk = jax.lax.dynamic_update_slice(ck, k.astype(ck.dtype), (0, 0, 0, 0))
+        nv = jax.lax.dynamic_update_slice(cv, v.astype(cv.dtype), (0, 0, 0, 0))
+        return x, (nk, nv)
+
+    x, (ks, vs) = jax.lax.scan(body, x, (params["layers"],
+                                         cache["k"], cache["v"]))
+    # slice BEFORE the head: projecting all P positions to [B, P, V] fp32
+    # only to keep one row would cost P x the lm_head matmul and a
+    # prompt-length-scaled logits buffer (norm + projection are per-position)
+    return (lm_head_logits(config, params, x[:, -1:])[:, 0],
+            {"k": ks, "v": vs})
+
+
+def decode_step(config: LlamaConfig, params: dict, token_ids: jnp.ndarray,
+                pos, cache: dict):
+    """One cached decode step: ``token_ids`` [B, 1] at position ``pos``
+    (traced scalar — one compile serves the whole generation). Returns
+    (logits [B, V], updated cache)."""
+    b = token_ids.shape[0]
+    positions = jnp.broadcast_to(jnp.asarray(pos)[None, None], (b, 1))
+    x = embed_tokens(config, params, token_ids, positions)
+
+    def body(x, inputs):
+        layer, ck, cv = inputs
+        attn, (nk, nv) = attention_sublayer(
+            config, x, layer["attn"], layer["input_norm"], positions,
+            "xla", kv_cache=(ck, cv, pos), return_kv=True)
+        x = x + attn
+        x = x + mlp_sublayer(config, x, layer)
+        return x, (nk, nv)
+
+    x, (ks, vs) = jax.lax.scan(body, x, (params["layers"],
+                                         cache["k"], cache["v"]))
+    return lm_head_logits(config, params, x)[:, -1], {"k": ks, "v": vs}
 
 
 # ---------------------------------------------------------------------------

@@ -2,11 +2,12 @@
 for trained / converted checkpoints.
 
 Neither the reference nor this guide is an inference framework; this is
-the smallest honest sampler: one jit-compiled step re-runs the FULL
-forward over a fixed-size buffer and writes one token (static shapes, one
-compile for the whole generation — no KV cache, so cost is
-``steps x forward(prompt+steps)``; fine for eyeballing a checkpoint,
-wrong tool for serving).
+the smallest honest sampler. Default mode re-runs the FULL forward over a
+fixed-size buffer per token (any family, one compile); ``--kv-cache``
+switches to prefill + single-token decode steps over a functional KV
+cache carried through the layer scan (llama family; same tokens, pinned
+by test). Either way: a qualitative check for checkpoints, not a serving
+path.
 
     # hermetic (no tokenizer): raw token ids in, ids out
     python -m distributed_training_guide_tpu.models.sample \\
@@ -24,22 +25,63 @@ import jax
 import jax.numpy as jnp
 
 
-def make_sampler(bundle, temperature: float = 0.0):
-    """One compiled decode step: full forward over the fixed buffer, write
-    the token at ``pos``. Greedy when ``temperature == 0`` (the branch is
-    a Python constant, so each mode is its own single compile)."""
+def make_sampler(bundle, temperature: float = 0.0, kv_cache: bool = False):
+    """One compiled decode step per generation. Two modes:
+
+    - recompute (default, any family): the full forward re-runs over a
+      fixed buffer and the token at ``pos`` is written — O(steps x
+      forward(prompt+steps));
+    - ``kv_cache=True`` (families exporting ``init_cache``/``prefill``/
+      ``decode_step`` — the llama family): one prefill over the prompt,
+      then one single-token program per step attending over the cache —
+      O(forward(prompt) + steps x token).
+
+    Greedy when ``temperature == 0`` (a Python constant — each mode is its
+    own single compile)."""
+
+    def pick(logit, key):
+        if temperature == 0.0:
+            return jnp.argmax(logit)
+        return jax.random.categorical(key, logit / temperature)
+
+    if kv_cache:
+        from .registry import family_module
+
+        mod = family_module(bundle.family)
+        if not hasattr(mod, "decode_step"):
+            raise ValueError(f"family {bundle.family!r} has no KV-cached "
+                             f"decode; use kv_cache=False")
+        prefill_j = jax.jit(partial(mod.prefill, bundle.config))
+        step_j = jax.jit(partial(mod.decode_step, bundle.config),
+                         donate_argnums=(3,))
+
+        def sample(params, prompt_ids, steps: int,
+                   rng: Optional[jax.Array] = None) -> list[int]:
+            rng = rng if rng is not None else jax.random.key(0)
+            n = len(prompt_ids)
+            cache = mod.init_cache(bundle.config, 1, n + steps)
+            ids = jnp.asarray(prompt_ids, jnp.int32)[None, :]
+            logit, cache = prefill_j(params, ids, cache)
+            out = list(prompt_ids)
+            for t in range(n, n + steps):
+                rng, key = jax.random.split(rng)
+                nxt = pick(logit[0], key)
+                out.append(int(nxt))
+                if t + 1 == n + steps:
+                    break
+                logit, cache = step_j(params, nxt.astype(jnp.int32)[None, None],
+                                      jnp.asarray(t), cache)
+            return out
+
+        return sample
 
     @partial(jax.jit, donate_argnums=(1,))
     def decode_step(params, buf, pos, key):
         logits = bundle.apply(bundle.config, params, buf)
         logit = jax.lax.dynamic_index_in_dim(logits[0], pos - 1, axis=0,
                                              keepdims=False)
-        if temperature == 0.0:
-            nxt = jnp.argmax(logit)
-        else:
-            nxt = jax.random.categorical(key, logit / temperature)
         return jax.lax.dynamic_update_index_in_dim(
-            buf, nxt.astype(buf.dtype)[None], pos, axis=1)
+            buf, pick(logit, key).astype(buf.dtype)[None], pos, axis=1)
 
     def sample(params, prompt_ids, steps: int,
                rng: Optional[jax.Array] = None) -> list[int]:
@@ -67,6 +109,9 @@ def main(argv=None) -> None:
                         help="comma-separated token ids — the hermetic path")
     parser.add_argument("--steps", type=int, default=32)
     parser.add_argument("--temperature", type=float, default=0.0)
+    parser.add_argument("--kv-cache", action="store_true",
+                        help="prefill + cached one-token decode steps "
+                             "(llama family) instead of full recompute")
     parser.add_argument("--seed", type=int, default=0)
     parser.add_argument("--pretrained", default=None, metavar="DIR",
                         help="converted checkpoint dir (models/hf_convert); "
@@ -110,7 +155,8 @@ def main(argv=None) -> None:
     else:
         params = bundle.init(bundle.config, jax.random.key(args.seed))
 
-    sample = make_sampler(bundle, temperature=args.temperature)
+    sample = make_sampler(bundle, temperature=args.temperature,
+                          kv_cache=args.kv_cache)
     out = sample(params, prompt_ids, args.steps,
                  rng=jax.random.key(args.seed))
     if tokenizer is not None:

@@ -39,6 +39,55 @@ def test_temperature_sampling_is_seeded_and_in_vocab():
     assert all(0 <= t < bundle.config.vocab_size for t in a)
 
 
+def test_kv_cache_matches_recompute():
+    """The cached decode (prefill + one-token steps over the cache) must
+    produce the same greedy tokens as the full-recompute sampler, and the
+    prefill logits must match the plain forward's last position."""
+    bundle = get_model("llama-debug", dtype=jnp.float32)
+    params = bundle.init(bundle.config, jax.random.key(0))
+    prompt = [3, 17, 42, 7]
+    steps = 6
+
+    slow = make_sampler(bundle)(params, prompt, steps)
+    fast = make_sampler(bundle, kv_cache=True)(params, prompt, steps)
+    assert fast == slow
+
+    from distributed_training_guide_tpu.models import llama
+
+    cache = llama.init_cache(bundle.config, 1, len(prompt) + steps)
+    ids = jnp.asarray(prompt, jnp.int32)[None, :]
+    logit, cache = llama.prefill(bundle.config, params, ids, cache)
+    full = bundle.apply(bundle.config, params, ids)
+    np.testing.assert_allclose(np.asarray(logit), np.asarray(full[:, -1]),
+                               rtol=1e-5, atol=1e-5)
+    assert cache["k"].shape == (2, 1, len(prompt) + steps,
+                                bundle.config.num_kv_heads,
+                                bundle.config.head_size)
+
+
+def test_kv_cache_gqa_qwen_bias_family():
+    """The cache path through a GQA + QKV-bias config (the biases ride the
+    projections before rope; kv_heads < heads exercises grouped attention
+    over the cache)."""
+    bundle = get_model("qwen2.5-0.5b", vocab_size=256, hidden_size=64,
+                       intermediate_size=128, num_layers=2, num_heads=4,
+                       num_kv_heads=2, max_position_embeddings=128,
+                       dtype=jnp.float32)
+    params = bundle.init(bundle.config, jax.random.key(2))
+    prompt = [9, 11]
+    slow = make_sampler(bundle)(params, prompt, 5)
+    fast = make_sampler(bundle, kv_cache=True)(params, prompt, 5)
+    assert fast == slow
+
+
+def test_kv_cache_unsupported_family_refuses():
+    import pytest
+
+    bundle = get_model("gpt2-debug", dtype=jnp.float32)
+    with pytest.raises(ValueError, match="no KV-cached decode"):
+        make_sampler(bundle, kv_cache=True)
+
+
 def test_cli_hermetic_path(capsys):
     main(["-m", "llama-debug", "--prompt-ids", "1,2,3", "--steps", "4"])
     out = capsys.readouterr().out.strip().split(",")
