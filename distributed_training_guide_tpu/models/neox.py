@@ -157,6 +157,56 @@ def _rope_partial(x: jnp.ndarray, positions: jnp.ndarray, theta: float,
                            axis=-1)
 
 
+def _attn_branch(config, y, layer, positions, attn_impl,
+                 standard_layout=True, kv_cache=None, return_kv=False):
+    """ln'd input -> fused QKV -> partial rope -> attention -> out proj
+    (no residual, no psum — the block owns those). ``kv_cache``/
+    ``return_kv`` follow llama.attention_sublayer's decode contract."""
+    b, s, e = y.shape
+    d = config.head_size
+    cdt = config.dtype
+    wqkv = layer["attn"]["wqkv"]          # [e, 3, e/tp] under manual tp
+    e_loc = wqkv.shape[-1]
+    h_loc = e_loc // d
+    qkv = (jnp.einsum("bse,eqh->bsqh", y, wqkv.astype(cdt))
+           + layer["attn"]["bqkv"].astype(cdt))
+    q = qkv[:, :, 0].reshape(b, s, h_loc, d)
+    k = qkv[:, :, 1].reshape(b, s, h_loc, d)
+    v = qkv[:, :, 2].reshape(b, s, h_loc, d)
+    q = _rope_partial(q, positions, config.rope_theta, config.rotary_ndims)
+    k = _rope_partial(k, positions, config.rope_theta, config.rotary_ndims)
+    if kv_cache is not None:
+        ck, cv, pos = kv_cache
+        k = jax.lax.dynamic_update_slice(ck, k.astype(ck.dtype), (0, pos, 0, 0))
+        v = jax.lax.dynamic_update_slice(cv, v.astype(cv.dtype), (0, pos, 0, 0))
+        kv_pos = jnp.broadcast_to(jnp.arange(ck.shape[1])[None, :],
+                                  (b, ck.shape[1]))
+        attn = multihead_attention(q, k, v, causal=True, positions=positions,
+                                   kv_positions=kv_pos, impl="xla",
+                                   standard_layout=False)
+    elif callable(attn_impl):  # e.g. ring attention under context parallelism
+        attn = attn_impl(q, k, v, standard_layout=standard_layout)
+    else:
+        attn = multihead_attention(q, k, v, causal=True, positions=positions,
+                                   kv_positions=positions, impl=attn_impl,
+                                   standard_layout=standard_layout)
+    out = attn.reshape(b, s, e_loc) @ layer["attn"]["wo"].astype(cdt)
+    if return_kv:
+        return out, (k, v)
+    return out
+
+
+def _mlp_branch(config, y, layer):
+    """ln'd input -> gelu MLP (no residual, no psum, no row bias)."""
+    cdt = config.dtype
+    act_fn = ACT_FNS[config.act_fn]
+    y = act_fn(y @ layer["mlp"]["wi"].astype(cdt)
+               + layer["mlp"]["bi"].astype(cdt))
+    # tagged for REMAT_POLICIES["attn_mlp"] (same role as llama's mlp_act)
+    y = checkpoint_name(y, "mlp_act")
+    return y @ layer["mlp"]["wo"].astype(cdt)
+
+
 def _block(config: NeoXConfig, x, layer, positions, attn_impl,
            standard_layout=True, tp_axis=None):
     """One parallel-residual block (or sequential when the config says so).
@@ -166,36 +216,14 @@ def _block(config: NeoXConfig, x, layer, positions, attn_impl,
     head / mlp slices, inferred from shapes), wo / mlp wo row-sharded. In
     the parallel-residual case the two row-parallel partial sums are added
     BEFORE one psum — the block's structural communication advantage."""
-    b, s, e = x.shape
-    d = config.head_size
     cdt = config.dtype
-    wqkv = layer["attn"]["wqkv"]          # [e, 3, e/tp] under manual tp
-    e_loc = wqkv.shape[-1]
-    h_loc = e_loc // d
 
     def attn_branch(y):
-        qkv = (jnp.einsum("bse,eqh->bsqh", y, wqkv.astype(cdt))
-               + layer["attn"]["bqkv"].astype(cdt))
-        q = qkv[:, :, 0].reshape(b, s, h_loc, d)
-        k = qkv[:, :, 1].reshape(b, s, h_loc, d)
-        v = qkv[:, :, 2].reshape(b, s, h_loc, d)
-        q = _rope_partial(q, positions, config.rope_theta, config.rotary_ndims)
-        k = _rope_partial(k, positions, config.rope_theta, config.rotary_ndims)
-        if callable(attn_impl):  # e.g. ring attention under context parallelism
-            attn = attn_impl(q, k, v, standard_layout=standard_layout)
-        else:
-            attn = multihead_attention(q, k, v, causal=True, positions=positions,
-                                       kv_positions=positions, impl=attn_impl,
-                                       standard_layout=standard_layout)
-        return attn.reshape(b, s, e_loc) @ layer["attn"]["wo"].astype(cdt)
+        return _attn_branch(config, y, layer, positions, attn_impl,
+                            standard_layout)
 
     def mlp_branch(y):
-        act_fn = ACT_FNS[config.act_fn]
-        y = act_fn(y @ layer["mlp"]["wi"].astype(cdt)
-                   + layer["mlp"]["bi"].astype(cdt))
-        # tagged for REMAT_POLICIES["attn_mlp"] (same role as llama's mlp_act)
-        y = checkpoint_name(y, "mlp_act")
-        return y @ layer["mlp"]["wo"].astype(cdt)
+        return _mlp_branch(config, y, layer)
 
     biases = (layer["attn"]["bo"].astype(cdt) + layer["mlp"]["bo"].astype(cdt))
     if config.use_parallel_residual:
@@ -291,6 +319,78 @@ def apply(
     if return_hidden:
         return final_hidden(config, params, x)
     return lm_head_logits(config, params, x)
+
+
+# ---------------------------------------------------------------------------
+# KV-cached decode (models/sample.py fast path) — same functional-cache
+# contract as llama.init_cache/prefill/decode_step; the block math here is
+# the parallel residual (x + attn + mlp in ONE update) with partial rope.
+# ---------------------------------------------------------------------------
+
+def init_cache(config: NeoXConfig, batch: int, max_len: int) -> dict:
+    shape = (config.num_layers, batch, max_len, config.num_heads,
+             config.head_size)
+    return {"k": jnp.zeros(shape, config.dtype),
+            "v": jnp.zeros(shape, config.dtype)}
+
+
+def _cached_block(config, x, layer, positions, kv_cache):
+    """Parallel- or sequential-residual block through the cache path;
+    returns (x, (k, v))."""
+    eps = config.layer_norm_eps
+    cdt = config.dtype
+    attn, kv = _attn_branch(config, _layernorm(x, layer["ln1"], eps),
+                            layer, positions, "xla", kv_cache=kv_cache,
+                            return_kv=True)
+    if config.use_parallel_residual:
+        update = attn + _mlp_branch(config, _layernorm(x, layer["ln2"], eps),
+                                    layer)
+        biases = (layer["attn"]["bo"].astype(cdt)
+                  + layer["mlp"]["bo"].astype(cdt))
+        return x + update + biases, kv
+    x = x + attn + layer["attn"]["bo"].astype(cdt)
+    mlp = _mlp_branch(config, _layernorm(x, layer["ln2"], eps), layer)
+    return x + mlp + layer["mlp"]["bo"].astype(cdt), kv
+
+
+def prefill(config: NeoXConfig, params: dict, input_ids: jnp.ndarray,
+            cache: dict):
+    """Causal forward over the prompt, filling cache[:, :, :prompt_len];
+    returns (last-position logits [B, V], cache)."""
+    b, p = input_ids.shape
+    positions = jnp.broadcast_to(jnp.arange(p)[None, :], (b, p))
+    x = embed_tokens(config, params, input_ids, positions)
+
+    def body(x, inputs):
+        layer, ck, cv = inputs
+        x, (k, v) = _cached_block(config, x, layer, positions, None)
+        nk = jax.lax.dynamic_update_slice(ck, k.astype(ck.dtype), (0, 0, 0, 0))
+        nv = jax.lax.dynamic_update_slice(cv, v.astype(cv.dtype), (0, 0, 0, 0))
+        return x, (nk, nv)
+
+    x, (ks, vs) = jax.lax.scan(body, x, (params["layers"],
+                                         cache["k"], cache["v"]))
+    return (lm_head_logits(config, params, x[:, -1:])[:, 0],
+            {"k": ks, "v": vs})
+
+
+def decode_step(config: NeoXConfig, params: dict, token_ids: jnp.ndarray,
+                pos, cache: dict):
+    """One cached decode step (traced ``pos`` — one compile per generation);
+    returns (logits [B, V], updated cache)."""
+    b = token_ids.shape[0]
+    positions = jnp.broadcast_to(jnp.asarray(pos)[None, None], (b, 1))
+    x = embed_tokens(config, params, token_ids, positions)
+
+    def body(x, inputs):
+        layer, ck, cv = inputs
+        x, (nk, nv) = _cached_block(config, x, layer, positions,
+                                    (ck, cv, pos))
+        return x, (nk, nv)
+
+    x, (ks, vs) = jax.lax.scan(body, x, (params["layers"],
+                                         cache["k"], cache["v"]))
+    return lm_head_logits(config, params, x)[:, -1], {"k": ks, "v": vs}
 
 
 # ---------------------------------------------------------------------------

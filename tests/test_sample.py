@@ -80,6 +80,27 @@ def test_kv_cache_gqa_qwen_bias_family():
     assert fast == slow
 
 
+def test_kv_cache_neox_matches_recompute():
+    """The NeoX cache path: parallel-residual blocks and PARTIAL rotary
+    (only the first rotary_ndims of each head rotate) through prefill +
+    cached decode must reproduce the recompute sampler's greedy tokens."""
+    bundle = get_model("neox-debug", dtype=jnp.float32)
+    assert 0 < bundle.config.rotary_ndims < bundle.config.head_size
+    params = bundle.init(bundle.config, jax.random.key(3))
+    prompt = [8, 21, 5]
+    slow = make_sampler(bundle)(params, prompt, 6)
+    fast = make_sampler(bundle, kv_cache=True)(params, prompt, 6)
+    assert fast == slow
+
+    # sequential-residual wiring too
+    seq_bundle = get_model("neox-debug", use_parallel_residual=False,
+                           dtype=jnp.float32)
+    seq_params = seq_bundle.init(seq_bundle.config, jax.random.key(4))
+    slow = make_sampler(seq_bundle)(seq_params, prompt, 4)
+    fast = make_sampler(seq_bundle, kv_cache=True)(seq_params, prompt, 4)
+    assert fast == slow
+
+
 def test_kv_cache_unsupported_family_refuses():
     import pytest
 
