@@ -115,24 +115,18 @@ def _layernorm(x, p, eps):
     return (y * p["scale"].astype(jnp.float32) + p["bias"].astype(jnp.float32)).astype(dtype)
 
 
-def _block(config: GPT2Config, x, layer, positions, attn_impl,
-           standard_layout=True, tp_axis=None):
-    """One pre-LN transformer block.
-
-    ``tp_axis``: set inside a shard_map region where tp is a *manual* axis
-    (the pipeline schedule, ``parallel/pipeline.py``): wqkv/bqkv/wi/bi arrive
-    column-sharded (local head / mlp slices, inferred from shapes), wo / mlp
-    wo row-sharded with an explicit psum of the partial sums, and the
-    replicated row biases are added once, after the psum."""
-    b, s, e = x.shape
+def _attn_sublayer(config, y, layer, positions, attn_impl,
+                   standard_layout=True, kv_cache=None, return_kv=False):
+    """ln'd input -> fused QKV -> attention -> out proj (no residual, no
+    psum, no row bias — the block owns those). ``kv_cache``/``return_kv``
+    follow llama.attention_sublayer's decode contract (no rope here: gpt2's
+    positions are the learned table applied at embed time)."""
+    b, s, e = y.shape
     d = config.head_size
     cdt = config.dtype
     wqkv = layer["attn"]["wqkv"]          # [e, 3, e/tp] under manual tp
     e_loc = wqkv.shape[-1]
     h_loc = e_loc // d
-
-    y = _layernorm(x, {"scale": layer["ln1"]["scale"], "bias": layer["ln1"]["bias"]},
-                   config.layer_norm_eps)
     # project WITHOUT flattening [3, e_loc] into 3*e_loc: the trailing head
     # dim may be tp-sharded, and GSPMD cannot represent the strided tiling a
     # merged 3e dim would need — it would all-gather the QKV weight on the
@@ -142,24 +136,57 @@ def _block(config: GPT2Config, x, layer, positions, attn_impl,
     q = qkv[:, :, 0].reshape(b, s, h_loc, d)
     k = qkv[:, :, 1].reshape(b, s, h_loc, d)
     v = qkv[:, :, 2].reshape(b, s, h_loc, d)
-    if callable(attn_impl):  # e.g. ring attention under context parallelism
+    if kv_cache is not None:
+        ck, cv, pos = kv_cache
+        k = jax.lax.dynamic_update_slice(ck, k.astype(ck.dtype), (0, pos, 0, 0))
+        v = jax.lax.dynamic_update_slice(cv, v.astype(cv.dtype), (0, pos, 0, 0))
+        kv_pos = jnp.broadcast_to(jnp.arange(ck.shape[1])[None, :],
+                                  (b, ck.shape[1]))
+        attn = multihead_attention(q, k, v, causal=True, positions=positions,
+                                   kv_positions=kv_pos, impl="xla",
+                                   standard_layout=False)
+    elif callable(attn_impl):  # e.g. ring attention under context parallelism
         attn = attn_impl(q, k, v, standard_layout=standard_layout)
     else:
         attn = multihead_attention(q, k, v, causal=True, positions=positions,
                                    kv_positions=positions, impl=attn_impl,
                                    standard_layout=standard_layout)
-    attn = attn.reshape(b, s, e_loc) @ layer["attn"]["wo"].astype(cdt)
+    out = attn.reshape(b, s, e_loc) @ layer["attn"]["wo"].astype(cdt)
+    if return_kv:
+        return out, (k, v)
+    return out
+
+
+def _mlp_sublayer(config, y, layer):
+    """ln2'd input -> gelu MLP (no residual, no psum, no row bias)."""
+    cdt = config.dtype
+    y = jax.nn.gelu(y @ layer["mlp"]["wi"].astype(cdt)
+                    + layer["mlp"]["bi"].astype(cdt), approximate=True)
+    # tagged for REMAT_POLICIES["attn_mlp"] (same role as llama's mlp_act)
+    y = checkpoint_name(y, "mlp_act")
+    return y @ layer["mlp"]["wo"].astype(cdt)
+
+
+def _block(config: GPT2Config, x, layer, positions, attn_impl,
+           standard_layout=True, tp_axis=None):
+    """One pre-LN transformer block.
+
+    ``tp_axis``: set inside a shard_map region where tp is a *manual* axis
+    (the pipeline schedule, ``parallel/pipeline.py``): wqkv/bqkv/wi/bi arrive
+    column-sharded (local head / mlp slices, inferred from shapes), wo / mlp
+    wo row-sharded with an explicit psum of the partial sums, and the
+    replicated row biases are added once, after the psum."""
+    cdt = config.dtype
+
+    y = _layernorm(x, layer["ln1"], config.layer_norm_eps)
+    attn = _attn_sublayer(config, y, layer, positions, attn_impl,
+                          standard_layout)
     if tp_axis is not None:  # megatron Rowwise: out-proj partial sums
         attn = _psum(attn, tp_axis)
     x = x + attn + layer["attn"]["bo"].astype(cdt)
 
-    y = _layernorm(x, {"scale": layer["ln2"]["scale"], "bias": layer["ln2"]["bias"]},
-                   config.layer_norm_eps)
-    y = jax.nn.gelu(y @ layer["mlp"]["wi"].astype(cdt) + layer["mlp"]["bi"].astype(cdt),
-                    approximate=True)
-    # tagged for REMAT_POLICIES["attn_mlp"] (same role as llama's mlp_act)
-    y = checkpoint_name(y, "mlp_act")
-    y = y @ layer["mlp"]["wo"].astype(cdt)
+    y = _mlp_sublayer(config, _layernorm(x, layer["ln2"],
+                                         config.layer_norm_eps), layer)
     if tp_axis is not None:
         y = _psum(y, tp_axis)
     return x + y + layer["mlp"]["bo"].astype(cdt)
@@ -234,6 +261,71 @@ def apply(
     if return_hidden:
         return final_hidden(config, params, x)
     return lm_head_logits(config, params, x)
+
+
+# ---------------------------------------------------------------------------
+# KV-cached decode (models/sample.py fast path) — same functional-cache
+# contract as llama/neox. The simplest case of the three: no rope, the
+# learned position row is added at embed time, so cached k/v are exactly
+# the projections.
+# ---------------------------------------------------------------------------
+
+def init_cache(config: GPT2Config, batch: int, max_len: int) -> dict:
+    shape = (config.num_layers, batch, max_len, config.num_heads,
+             config.head_size)
+    return {"k": jnp.zeros(shape, config.dtype),
+            "v": jnp.zeros(shape, config.dtype)}
+
+
+def _cached_block(config, x, layer, positions, kv_cache):
+    cdt = config.dtype
+    y = _layernorm(x, layer["ln1"], config.layer_norm_eps)
+    attn, kv = _attn_sublayer(config, y, layer, positions, "xla",
+                              kv_cache=kv_cache, return_kv=True)
+    x = x + attn + layer["attn"]["bo"].astype(cdt)
+    y = _mlp_sublayer(config, _layernorm(x, layer["ln2"],
+                                         config.layer_norm_eps), layer)
+    return x + y + layer["mlp"]["bo"].astype(cdt), kv
+
+
+def prefill(config: GPT2Config, params: dict, input_ids: jnp.ndarray,
+            cache: dict):
+    """Causal forward over the prompt, filling cache[:, :, :prompt_len];
+    returns (last-position logits [B, V], cache)."""
+    b, p = input_ids.shape
+    positions = jnp.broadcast_to(jnp.arange(p)[None, :], (b, p))
+    x = embed_tokens(config, params, input_ids, positions)
+
+    def body(x, inputs):
+        layer, ck, cv = inputs
+        x, (k, v) = _cached_block(config, x, layer, positions, None)
+        nk = jax.lax.dynamic_update_slice(ck, k.astype(ck.dtype), (0, 0, 0, 0))
+        nv = jax.lax.dynamic_update_slice(cv, v.astype(cv.dtype), (0, 0, 0, 0))
+        return x, (nk, nv)
+
+    x, (ks, vs) = jax.lax.scan(body, x, (params["layers"],
+                                         cache["k"], cache["v"]))
+    return (lm_head_logits(config, params, x[:, -1:])[:, 0],
+            {"k": ks, "v": vs})
+
+
+def decode_step(config: GPT2Config, params: dict, token_ids: jnp.ndarray,
+                pos, cache: dict):
+    """One cached decode step (traced ``pos`` — one compile per generation);
+    returns (logits [B, V], updated cache)."""
+    b = token_ids.shape[0]
+    positions = jnp.broadcast_to(jnp.asarray(pos)[None, None], (b, 1))
+    x = embed_tokens(config, params, token_ids, positions)
+
+    def body(x, inputs):
+        layer, ck, cv = inputs
+        x, (nk, nv) = _cached_block(config, x, layer, positions,
+                                    (ck, cv, pos))
+        return x, (nk, nv)
+
+    x, (ks, vs) = jax.lax.scan(body, x, (params["layers"],
+                                         cache["k"], cache["v"]))
+    return lm_head_logits(config, params, x)[:, -1], {"k": ks, "v": vs}
 
 
 PRESETS = {

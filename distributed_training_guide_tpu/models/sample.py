@@ -5,9 +5,9 @@ Neither the reference nor this guide is an inference framework; this is
 the smallest honest sampler. Default mode re-runs the FULL forward over a
 fixed-size buffer per token (any family, one compile); ``--kv-cache``
 switches to prefill + single-token decode steps over a functional KV
-cache carried through the layer scan (llama + neox families; same
-tokens, pinned by test). Either way: a qualitative check for
-checkpoints, not a serving path.
+cache carried through the layer scan (the dense families: llama, gpt2,
+neox; same tokens, pinned per family by test). Either way: a qualitative
+check for checkpoints, not a serving path.
 
     # hermetic (no tokenizer): raw token ids in, ids out
     python -m distributed_training_guide_tpu.models.sample \\
@@ -32,7 +32,7 @@ def make_sampler(bundle, temperature: float = 0.0, kv_cache: bool = False):
       fixed buffer and the token at ``pos`` is written — O(steps x
       forward(prompt+steps));
     - ``kv_cache=True`` (families exporting ``init_cache``/``prefill``/
-      ``decode_step`` — llama and neox): one prefill over the prompt,
+      ``decode_step`` — llama, gpt2, neox): one prefill over the prompt,
       then one single-token program per step attending over the cache —
       O(forward(prompt) + steps x token).
 
@@ -111,7 +111,7 @@ def main(argv=None) -> None:
     parser.add_argument("--temperature", type=float, default=0.0)
     parser.add_argument("--kv-cache", action="store_true",
                         help="prefill + cached one-token decode steps "
-                             "(llama/neox families) instead of full recompute")
+                             "(dense families) instead of full recompute")
     parser.add_argument("--seed", type=int, default=0)
     parser.add_argument("--pretrained", default=None, metavar="DIR",
                         help="converted checkpoint dir (models/hf_convert); "

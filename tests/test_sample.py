@@ -101,10 +101,22 @@ def test_kv_cache_neox_matches_recompute():
     assert fast == slow
 
 
+def test_kv_cache_gpt2_matches_recompute():
+    """gpt2's cache path: no rope (the learned position row is added at
+    embed, including for the single decode token) — cached greedy tokens
+    must equal the recompute sampler's."""
+    bundle = get_model("gpt2-debug", dtype=jnp.float32)
+    params = bundle.init(bundle.config, jax.random.key(5))
+    prompt = [7, 19]
+    slow = make_sampler(bundle)(params, prompt, 6)
+    fast = make_sampler(bundle, kv_cache=True)(params, prompt, 6)
+    assert fast == slow
+
+
 def test_kv_cache_unsupported_family_refuses():
     import pytest
 
-    bundle = get_model("gpt2-debug", dtype=jnp.float32)
+    bundle = get_model("moe-debug", dtype=jnp.float32)
     with pytest.raises(ValueError, match="no KV-cached decode"):
         make_sampler(bundle, kv_cache=True)
 
