@@ -1,0 +1,15 @@
+#!/bin/bash
+# End-to-end on-chip training evidence: waits for the sweep worker to drain
+# its queue (it owns the chip while running), probes for a healthy pool,
+# then runs 200 real steps of the chapter-01 CLI at the bench headline
+# config. Appends the log to onchip_650m_200step.log for BENCH.md.
+cd "$(dirname "$0")"
+while pgrep -f "[b]ench.py --sweep" >/dev/null; do sleep 60; done
+until timeout 90 python bench.py --probe >/dev/null 2>&1; do sleep 240; done
+echo "pool healthy at $(date -u +%H:%M:%SZ); starting 200-step run" >> onchip_650m_200step.log
+timeout 1200 python 01-single-chip/train_llm.py -m llama-650m \
+  -d synthetic:3500000 -s 2048 -b 8 --num-epochs 1 --max-steps 200 \
+  --log-freq 20 --fence-every 4 --optimizer adafactor \
+  --checkpoint-activations --remat-policy attn_mlp --attn-impl flash \
+  --save-dir /tmp/onchip-650m >> onchip_650m_200step.log 2>&1
+echo "run finished rc=$? at $(date -u +%H:%M:%SZ)" >> onchip_650m_200step.log
