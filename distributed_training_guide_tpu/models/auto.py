@@ -21,27 +21,56 @@ import json
 from pathlib import Path
 
 
-def _warn_unsupported_attention_extras(cfg: dict, arch: str) -> None:
-    """Loud notes for config features this family computes differently —
-    warnings, not errors, because training the architecture from scratch
-    (or within the unaffected regime) is legitimate."""
-    import logging
-
-    log = logging.getLogger(__name__)
+def _sliding_window_kw(cfg: dict, arch: str) -> dict:
+    """``sliding_window`` from an HF config dict. Qwen2-style configs gate
+    it behind ``use_sliding_window`` (default False — the key is present on
+    every Qwen2 config but usually inert); everywhere else a non-null value
+    is live. Values >= max_position are dropped (the band never binds)."""
     window = cfg.get("sliding_window")
-    if window and window < cfg.get("max_position_embeddings", 4096):
-        # full attention == SWA only while seq_length <= window
-        log.warning(
-            f"{arch}: checkpoint uses sliding_window={window}; this family "
-            f"computes FULL causal attention — train/eval with seq_length "
-            f"<= {window} or logits diverge from HF")
-    if cfg.get("rope_scaling"):
-        log.warning(
-            f"{arch}: rope_scaling={cfg['rope_scaling']!r} is NOT "
-            f"implemented (plain RoPE at theta={cfg.get('rope_theta')}); "
-            f"logits will diverge from HF on long-context checkpoints — "
-            f"the registry's llama-3.1 presets cap max_position at 8192 "
-            f"for exactly this reason")
+    if not window:
+        return {}
+    if arch == "Qwen2ForCausalLM":
+        if not cfg.get("use_sliding_window"):
+            return {}
+        # HF additionally keeps the FIRST max_window_layers layers on full
+        # attention (layer_types = full*mwl + sliding*rest); the native
+        # config has ONE global window — a mixed-layer checkpoint must fail
+        # loudly here, not silently band every layer
+        mwl = cfg.get("max_window_layers", cfg["num_hidden_layers"])
+        if mwl and mwl < cfg["num_hidden_layers"]:
+            raise ValueError(
+                f"{arch}: max_window_layers={mwl} < num_hidden_layers="
+                f"{cfg['num_hidden_layers']} mixes full- and sliding-window "
+                f"layers, which this family does not implement (one global "
+                f"sliding_window); retrain/eval with seq <= window or use "
+                f"a uniform-window checkpoint")
+    if window >= cfg.get("max_position_embeddings", 4096):
+        return {}
+    return {"sliding_window": int(window)}
+
+
+def _rope_scaling_kw(cfg: dict, arch: str) -> dict:
+    """Frozen ``rope_scaling`` kwargs from an HF config dict, validated at
+    ingestion (an unsupported rope type must fail HERE, loudly, not produce
+    silently-divergent logits). All six HF rope types are implemented
+    (``ops/rope.py``); Phi-3-style configs keep
+    ``original_max_position_embeddings`` at the top level, so fold it into
+    the dict where longrope's short/long switch needs it."""
+    from ..ops.rope import ROPE_TYPES, freeze_rope_scaling, rope_type_of
+
+    scaling = cfg.get("rope_scaling")
+    if not scaling:
+        return {}
+    rope_type = rope_type_of(scaling)
+    if rope_type not in ROPE_TYPES:
+        raise ValueError(f"{arch}: unsupported rope_scaling type "
+                         f"{rope_type!r} (supported: {ROPE_TYPES})")
+    scaling = dict(scaling)
+    if ("original_max_position_embeddings" not in scaling
+            and cfg.get("original_max_position_embeddings")):
+        scaling["original_max_position_embeddings"] = (
+            cfg["original_max_position_embeddings"])
+    return {"rope_scaling": freeze_rope_scaling(scaling)}
 
 
 def _llama_kwargs(cfg: dict) -> dict:
@@ -60,6 +89,7 @@ def _llama_kwargs(cfg: dict) -> dict:
     )
     if cfg.get("head_dim"):
         kw["head_dim"] = cfg["head_dim"]
+    kw.update(_rope_scaling_kw(cfg, cfg.get("architectures", ["?"])[0]))
     return kw
 
 
@@ -70,8 +100,8 @@ _HF_ACTS = {"silu": "silu", "gelu_pytorch_tanh": "gelu_tanh",
 def _build_llama(cfg: dict, arch: str):
     from .llama import LlamaConfig
 
-    _warn_unsupported_attention_extras(cfg, arch)
     kw = _llama_kwargs(cfg)
+    kw.update(_sliding_window_kw(cfg, arch))
     if arch == "Qwen2ForCausalLM":
         # default True: older Qwen2 configs omit the key because bias was
         # unconditional
@@ -106,11 +136,11 @@ def _build_gpt2(cfg: dict, arch: str):
 def _build_mixtral(cfg: dict, arch: str):
     from .moe import MoELlamaConfig
 
-    _warn_unsupported_attention_extras(cfg, arch)
     kw = dict(
         num_experts=cfg["num_local_experts"],
         experts_per_token=cfg["num_experts_per_tok"],
         **_llama_kwargs(cfg),
+        **_sliding_window_kw(cfg, arch),
     )
     if "router_aux_loss_coef" in cfg:   # HF Mixtral ships 0.02, not our 0.01
         kw["router_aux_coef"] = cfg["router_aux_loss_coef"]
@@ -120,7 +150,14 @@ def _build_mixtral(cfg: dict, arch: str):
 def _build_neox(cfg: dict, arch: str):
     from .neox import NeoXConfig
 
-    _warn_unsupported_attention_extras(cfg, arch)  # rope_scaling, notably
+    if cfg.get("tie_word_embeddings"):
+        # the native NeoX family keeps embed_in/embed_out untied (every
+        # public NeoX/Pythia card unties); a tied checkpoint would otherwise
+        # surface as a confusing missing-embed_out error at LOAD time
+        raise ValueError(
+            f"{arch}: tie_word_embeddings=true is not supported by the "
+            f"NeoX family (embed_out is a separate tensor here); untie the "
+            f"checkpoint or export embed_out explicitly")
     act = cfg.get("hidden_act", "gelu")
     acts = {"gelu": "gelu", "gelu_new": "gelu_tanh",
             "gelu_pytorch_tanh": "gelu_tanh"}
@@ -139,6 +176,7 @@ def _build_neox(cfg: dict, arch: str):
         layer_norm_eps=cfg.get("layer_norm_eps", 1e-5),
         use_parallel_residual=cfg.get("use_parallel_residual", True),
         act_fn=acts[act],
+        **_rope_scaling_kw(cfg, arch),
     )
 
 
@@ -152,7 +190,8 @@ _ARCH_BUILDERS = {
     "GPTNeoXForCausalLM": ("neox", _build_neox),
     # Phi-3 is llama-math with fused checkpoint tensors (qkv_proj,
     # gate_up_proj) — the conversion splits them (hf_convert._make_map_llama);
-    # longrope rope_scaling and the 4k sliding_window hit the loud warnings
+    # its longrope rope_scaling and sliding_window both map onto the native
+    # config fields (ops/rope.py; flash kernel SWA)
     "Phi3ForCausalLM": ("llama", _build_llama),
 }
 

@@ -186,6 +186,25 @@ _EMITTERS = {"llama": _emit_llama, "gpt2": _emit_gpt2, "neox": _emit_neox,
 # config.json emitters (inverse of models/auto.py's builders)
 # ---------------------------------------------------------------------------
 
+def _rope_scaling_out(c) -> dict:
+    """Round-trip the frozen rope_scaling tuple back to HF's dict form —
+    dropping it would reload as plain RoPE: silently divergent long-context
+    logits (the exact failure the frozen field exists to prevent)."""
+    rs = getattr(c, "rope_scaling", None)
+    if not rs:
+        return {}
+    d = {k: list(v) if isinstance(v, tuple) else v for k, v in dict(rs).items()}
+    out = {"rope_scaling": d}
+    rope_type = d.get("rope_type") or d.get("type")
+    if rope_type == "longrope" and "original_max_position_embeddings" in d:
+        # HF's longrope init reads original_max from the CONFIG TOP LEVEL
+        # (Phi-3 style); leaving it only in-dict makes the exported config
+        # crash on reload (factor stays None in _compute_longrope_parameters)
+        out["original_max_position_embeddings"] = (
+            d["original_max_position_embeddings"])
+    return out
+
+
 def _hf_config(bundle) -> dict:
     c = bundle.config
     if bundle.family == "gpt2":
@@ -207,7 +226,8 @@ def _hf_config(bundle) -> dict:
                 "layer_norm_eps": c.layer_norm_eps,
                 "use_parallel_residual": c.use_parallel_residual,
                 "hidden_act": {"gelu": "gelu", "gelu_tanh": "gelu_new"}[c.act_fn],
-                "tie_word_embeddings": False}
+                "tie_word_embeddings": False,
+                **_rope_scaling_out(c)}
     base = {"vocab_size": c.vocab_size, "hidden_size": c.hidden_size,
             "intermediate_size": c.intermediate_size,
             "num_hidden_layers": c.num_layers,
@@ -215,13 +235,17 @@ def _hf_config(bundle) -> dict:
             "num_key_value_heads": c.num_kv_heads,
             "max_position_embeddings": c.max_position_embeddings,
             "rope_theta": c.rope_theta, "rms_norm_eps": c.rms_norm_eps,
-            "tie_word_embeddings": c.tie_word_embeddings}
+            "tie_word_embeddings": c.tie_word_embeddings,
+            **_rope_scaling_out(c)}
     if bundle.family == "moe":
-        return {**base, "architectures": ["MixtralForCausalLM"],
-                "model_type": "mixtral",
-                "num_local_experts": c.num_experts,
-                "num_experts_per_tok": c.experts_per_token,
-                "router_aux_loss_coef": c.router_aux_coef}
+        out = {**base, "architectures": ["MixtralForCausalLM"],
+               "model_type": "mixtral",
+               "num_local_experts": c.num_experts,
+               "num_experts_per_tok": c.experts_per_token,
+               "router_aux_loss_coef": c.router_aux_coef}
+        if getattr(c, "sliding_window", None):
+            out["sliding_window"] = c.sliding_window
+        return out
     # llama family: the config knobs decide which architecture this is
     if getattr(c, "norm_plus_one", False):
         base.update(architectures=["GemmaForCausalLM"], model_type="gemma",
@@ -230,6 +254,18 @@ def _hf_config(bundle) -> dict:
                     hidden_activation="gelu_pytorch_tanh")
     elif getattr(c, "attn_bias", False):
         base.update(architectures=["Qwen2ForCausalLM"], model_type="qwen2")
+        if c.head_dim:  # same silent-divergence risk as the llama branch:
+            base["head_dim"] = c.head_dim  # default is hidden/heads on reload
+        if getattr(c, "sliding_window", None):  # Qwen2 gates SWA on the flag
+            base.update(sliding_window=c.sliding_window,
+                        use_sliding_window=True)
+    elif getattr(c, "sliding_window", None):
+        # plain-llama math + a live window == Mistral (HF LlamaConfig has no
+        # sliding_window; exporting it as llama would silently drop the band)
+        base.update(architectures=["MistralForCausalLM"], model_type="mistral",
+                    sliding_window=c.sliding_window)
+        if c.head_dim:
+            base["head_dim"] = c.head_dim
     else:
         base.update(architectures=["LlamaForCausalLM"], model_type="llama",
                     attention_bias=False)

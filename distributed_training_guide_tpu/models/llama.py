@@ -32,7 +32,7 @@ from jax.ad_checkpoint import checkpoint_name
 
 from ..ops.attention import multihead_attention
 from ..ops.collectives import psum as _psum
-from ..ops.rope import apply_rope
+from ..ops.rope import apply_rope, freeze_rope_scaling
 
 
 @dataclasses.dataclass(frozen=True)
@@ -46,8 +46,14 @@ class LlamaConfig:
     head_dim: Optional[int] = None
     max_position_embeddings: int = 4096
     rope_theta: float = 10000.0
+    # HF rope_scaling in frozen-tuple form (ops.rope.freeze_rope_scaling);
+    # None = plain RoPE. All six HF rope types are supported (ops/rope.py)
+    rope_scaling: Optional[tuple] = None
     rms_norm_eps: float = 1e-5
     tie_word_embeddings: bool = False
+    # sliding-window attention (Mistral/Qwen2/Phi-3 checkpoints): query i
+    # attends keys with 0 <= i - j < window; None = full causal
+    sliding_window: Optional[int] = None
     attn_bias: bool = False         # QKV projection biases (Qwen2-style)
     act_fn: str = "silu"            # MLP gate activation: silu | gelu_tanh (Gemma)
     norm_plus_one: bool = False     # RMSNorm scales by (1 + w) (Gemma)
@@ -198,8 +204,12 @@ def attention_sublayer(config, x: jnp.ndarray, attn_params: dict, norm_scale,
     q = q.reshape(b, s, -1, d)
     k = k.reshape(b, s, -1, d)
     v = v.reshape(b, s, -1, d)
-    q = apply_rope(q, positions, config.rope_theta)
-    k = apply_rope(k, positions, config.rope_theta)
+    rs = getattr(config, "rope_scaling", None)
+    q = apply_rope(q, positions, config.rope_theta, rs,
+                   config.max_position_embeddings)
+    k = apply_rope(k, positions, config.rope_theta, rs,
+                   config.max_position_embeddings)
+    window = getattr(config, "sliding_window", None)
     if kv_cache is not None:
         ck, cv, pos = kv_cache
         k = jax.lax.dynamic_update_slice(ck, k.astype(ck.dtype), (0, pos, 0, 0))
@@ -208,13 +218,16 @@ def attention_sublayer(config, x: jnp.ndarray, attn_params: dict, norm_scale,
                                   (b, ck.shape[1]))
         attn = multihead_attention(q, k, v, causal=True, positions=positions,
                                    kv_positions=kv_pos, impl="xla",
-                                   standard_layout=False)
+                                   standard_layout=False, window=window)
     elif callable(attn_impl):  # e.g. ring attention under context parallelism
+        # Trainer-built wrappers carry the window themselves (the sharded
+        # flash factory) or reject it (ring/ulysses CP, Trainer validation)
         attn = attn_impl(q, k, v, standard_layout=standard_layout)
     else:
         attn = multihead_attention(q, k, v, causal=True, positions=positions,
                                    kv_positions=positions, impl=attn_impl,
-                                   standard_layout=standard_layout)
+                                   standard_layout=standard_layout,
+                                   window=window)
     out = attn.reshape(b, s, -1) @ attn_params["wo"].astype(cdt)
     if tp_axis is not None:
         out = _psum(out, tp_axis)
@@ -417,6 +430,17 @@ def decode_step(config: LlamaConfig, params: dict, token_ids: jnp.ndarray,
 # HF checkpoints — `05-training-llama-405b/README.md`, `06/README.md`).
 # ---------------------------------------------------------------------------
 
+# Llama-3.1 / 3.2 cards ship the llama3 band-wise rescale (the checkpoints'
+# config.json rope_scaling); the presets carry it so long-context numerics
+# match HF out of the box (reference trains these checkpoints through
+# AutoModelForCausalLM, 05-training-llama-405b/train_llm.py:74-146)
+_LLAMA3_ROPE_8X = freeze_rope_scaling({
+    "rope_type": "llama3", "factor": 8.0, "low_freq_factor": 1.0,
+    "high_freq_factor": 4.0, "original_max_position_embeddings": 8192})
+_LLAMA3_ROPE_32X = freeze_rope_scaling({
+    "rope_type": "llama3", "factor": 32.0, "low_freq_factor": 1.0,
+    "high_freq_factor": 4.0, "original_max_position_embeddings": 8192})
+
 PRESETS = {
     "llama-debug": LlamaConfig(vocab_size=512, hidden_size=64, intermediate_size=128,
                                num_layers=2, num_heads=4, num_kv_heads=2,
@@ -438,21 +462,26 @@ PRESETS = {
                                   max_position_embeddings=4096),
     "llama-3.2-1b": LlamaConfig(vocab_size=128256, hidden_size=2048, intermediate_size=8192,
                                 num_layers=16, num_heads=32, num_kv_heads=8,
-                                rope_theta=500000.0, max_position_embeddings=8192,
+                                rope_theta=500000.0, max_position_embeddings=131072,
+                                rope_scaling=_LLAMA3_ROPE_32X,
                                 tie_word_embeddings=True),
     "llama-3.2-3b": LlamaConfig(vocab_size=128256, hidden_size=3072, intermediate_size=8192,
                                 num_layers=28, num_heads=24, num_kv_heads=8,
-                                rope_theta=500000.0, max_position_embeddings=8192,
+                                rope_theta=500000.0, max_position_embeddings=131072,
+                                rope_scaling=_LLAMA3_ROPE_32X,
                                 tie_word_embeddings=True),
     "llama-3.1-8b": LlamaConfig(vocab_size=128256, hidden_size=4096, intermediate_size=14336,
                                 num_layers=32, num_heads=32, num_kv_heads=8,
-                                rope_theta=500000.0, max_position_embeddings=8192),
+                                rope_theta=500000.0, max_position_embeddings=131072,
+                                rope_scaling=_LLAMA3_ROPE_8X),
     "llama-3.1-70b": LlamaConfig(vocab_size=128256, hidden_size=8192, intermediate_size=28672,
                                  num_layers=80, num_heads=64, num_kv_heads=8,
-                                 rope_theta=500000.0, max_position_embeddings=8192),
+                                 rope_theta=500000.0, max_position_embeddings=131072,
+                                 rope_scaling=_LLAMA3_ROPE_8X),
     "llama-3.1-405b": LlamaConfig(vocab_size=128256, hidden_size=16384, intermediate_size=53248,
                                   num_layers=126, num_heads=128, num_kv_heads=8,
-                                  rope_theta=500000.0, max_position_embeddings=8192),
+                                  rope_theta=500000.0, max_position_embeddings=131072,
+                                  rope_scaling=_LLAMA3_ROPE_8X),
     # Mistral dense is llama-architecture exactly (HF MistralForCausalLM uses
     # the same tensor names/layouts as LlamaForCausalLM); shapes are the
     # v0.3 card (no sliding window, 32768-token vocab)

@@ -61,6 +61,8 @@ class MoELlamaConfig:
     head_dim: Optional[int] = None
     max_position_embeddings: int = 4096
     rope_theta: float = 10000.0
+    rope_scaling: Optional[tuple] = None  # frozen HF rope_scaling (ops/rope.py)
+    sliding_window: Optional[int] = None  # SWA band (Mixtral 8x7B ships 4096)
     rms_norm_eps: float = 1e-5
     tie_word_embeddings: bool = False
     dtype: Any = jnp.bfloat16
@@ -156,7 +158,7 @@ def param_logical_axes(config: MoELlamaConfig) -> dict:
 
 
 def _moe_ffn(config: MoELlamaConfig, x: jnp.ndarray, moe: dict,
-             tp_axis: Optional[str] = None):
+             tp_axis: Optional[str] = None, no_drop: bool = False):
     """Top-k routed FFN with index-based, gather-only dispatch. x: [B, S, D].
     Returns (y, aux_loss, dropped_frac).
 
@@ -180,7 +182,12 @@ def _moe_ffn(config: MoELlamaConfig, x: jnp.ndarray, moe: dict,
     b, s, d = x.shape
     t = b * s
     ex, k = config.num_experts, config.experts_per_token
-    capacity = max(int(math.ceil(config.capacity_factor * k * t / ex)), 1)
+    # no_drop: worst-case capacity (every pair to one expert) — the decode
+    # path uses it so cached generation is routing-exact vs a full recompute
+    # regardless of capacity_factor (a serving-quality knob, not a training
+    # throughput one, at t == 1 per step)
+    capacity = (k * t if no_drop
+                else max(int(math.ceil(config.capacity_factor * k * t / ex)), 1))
     cdt = config.dtype
 
     xt = x.reshape(t, d)
@@ -332,6 +339,73 @@ output_weights = llama.output_weights
 final_hidden = llama.final_hidden
 lm_head_logits = llama.lm_head_logits
 tp_embed = llama.tp_embed
+
+
+# ---------------------------------------------------------------------------
+# KV-cached decode (models/sample.py --kv-cache): same functional-cache
+# contract as the dense families (llama.init_cache shape math is duck-typed
+# on num_layers/num_kv_heads/head_size/dtype), with the routed FFN in the
+# block body. Expert dispatch runs with ``no_drop=True`` — a single decode
+# token's k choices can exceed a capacity_factor-derived capacity of 1
+# (both choices on one expert), and a qualitative sampling path must be
+# routing-exact vs the full recompute, not throughput-shaped.
+# ---------------------------------------------------------------------------
+
+init_cache = llama.init_cache
+
+
+def prefill(config: MoELlamaConfig, params: dict, input_ids: jnp.ndarray,
+            cache: dict):
+    """Causal forward over the prompt, writing each layer's rope'd k/v into
+    the cache. Returns (last-position logits [B, V], cache)."""
+    b, p = input_ids.shape
+    positions = jnp.broadcast_to(jnp.arange(p)[None, :], (b, p))
+    x = embed_tokens(config, params, input_ids, positions)
+
+    def body(x, inputs):
+        layer, ck, cv = inputs
+        attn, (k, v) = attention_sublayer(
+            config, x, layer["attn"], layer["input_norm"], positions,
+            "xla", return_kv=True)
+        x = x + attn
+        h = _rmsnorm(x, layer["post_attn_norm"], config.rms_norm_eps)
+        y, _, _ = _moe_ffn(config, h, layer["moe"], no_drop=True)
+        x = x + y
+        nk = jax.lax.dynamic_update_slice(ck, k.astype(ck.dtype), (0, 0, 0, 0))
+        nv = jax.lax.dynamic_update_slice(cv, v.astype(cv.dtype), (0, 0, 0, 0))
+        return x, (nk, nv)
+
+    x, (ks, vs) = jax.lax.scan(body, x, (params["layers"],
+                                         cache["k"], cache["v"]))
+    # slice BEFORE the head (llama.prefill rationale: don't project all P
+    # positions to [B, P, V] fp32 to keep one row)
+    return (lm_head_logits(config, params, x[:, -1:])[:, 0],
+            {"k": ks, "v": vs})
+
+
+def decode_step(config: MoELlamaConfig, params: dict, token_ids: jnp.ndarray,
+                pos, cache: dict):
+    """One cached decode step (``token_ids`` [B, 1] at traced position
+    ``pos``): attention over the full cache, routed FFN on the one token.
+    Returns (logits [B, V], updated cache)."""
+    b = token_ids.shape[0]
+    positions = jnp.broadcast_to(jnp.asarray(pos)[None, None], (b, 1))
+    x = embed_tokens(config, params, token_ids, positions)
+
+    def body(x, inputs):
+        layer, ck, cv = inputs
+        attn, (nk, nv) = attention_sublayer(
+            config, x, layer["attn"], layer["input_norm"], positions,
+            "xla", kv_cache=(ck, cv, pos), return_kv=True)
+        x = x + attn
+        h = _rmsnorm(x, layer["post_attn_norm"], config.rms_norm_eps)
+        y, _, _ = _moe_ffn(config, h, layer["moe"], no_drop=True)
+        x = x + y
+        return x, (nk, nv)
+
+    x, (ks, vs) = jax.lax.scan(body, x, (params["layers"],
+                                         cache["k"], cache["v"]))
+    return lm_head_logits(config, params, x)[:, -1], {"k": ks, "v": vs}
 
 
 PRESETS = {

@@ -51,6 +51,7 @@ class NeoXConfig:
     max_position_embeddings: int = 2048
     rotary_pct: float = 0.25
     rope_theta: float = 10000.0
+    rope_scaling: Optional[tuple] = None  # frozen HF rope_scaling (ops/rope.py)
     layer_norm_eps: float = 1e-5
     use_parallel_residual: bool = True
     act_fn: str = "gelu"            # exact erf gelu (HF hidden_act="gelu")
@@ -145,16 +146,19 @@ ACT_FNS = {
 }
 
 
-def _rope_partial(x: jnp.ndarray, positions: jnp.ndarray, theta: float,
-                  rotary_dim: int) -> jnp.ndarray:
-    """NeoX partial rotary: rotate the first ``rotary_dim`` dims of each
-    head (frequencies computed over ``rotary_dim``, matching HF
-    ``GPTNeoXRotaryEmbedding``), pass the rest through untouched."""
+def _rope_partial(x: jnp.ndarray, positions: jnp.ndarray, config) -> jnp.ndarray:
+    """NeoX partial rotary: rotate the first ``rotary_ndims`` dims of each
+    head (frequencies computed over ``rotary_ndims``, matching HF
+    ``GPTNeoXRotaryEmbedding`` — which also computes any rope_scaling at the
+    partial dim, HF's partial_rotary_factor), pass the rest through."""
+    theta, rotary_dim = config.rope_theta, config.rotary_ndims
+    rs = getattr(config, "rope_scaling", None)
+    mp = config.max_position_embeddings
     if rotary_dim >= x.shape[-1]:
-        return apply_rope(x, positions, theta)
+        return apply_rope(x, positions, theta, rs, mp)
     rot, passthrough = x[..., :rotary_dim], x[..., rotary_dim:]
-    return jnp.concatenate([apply_rope(rot, positions, theta), passthrough],
-                           axis=-1)
+    return jnp.concatenate([apply_rope(rot, positions, theta, rs, mp),
+                            passthrough], axis=-1)
 
 
 def _attn_branch(config, y, layer, positions, attn_impl,
@@ -173,8 +177,8 @@ def _attn_branch(config, y, layer, positions, attn_impl,
     q = qkv[:, :, 0].reshape(b, s, h_loc, d)
     k = qkv[:, :, 1].reshape(b, s, h_loc, d)
     v = qkv[:, :, 2].reshape(b, s, h_loc, d)
-    q = _rope_partial(q, positions, config.rope_theta, config.rotary_ndims)
-    k = _rope_partial(k, positions, config.rope_theta, config.rotary_ndims)
+    q = _rope_partial(q, positions, config)
+    k = _rope_partial(k, positions, config)
     if kv_cache is not None:
         ck, cv, pos = kv_cache
         k = jax.lax.dynamic_update_slice(ck, k.astype(ck.dtype), (0, pos, 0, 0))

@@ -5,9 +5,10 @@ Neither the reference nor this guide is an inference framework; this is
 the smallest honest sampler. Default mode re-runs the FULL forward over a
 fixed-size buffer per token (any family, one compile); ``--kv-cache``
 switches to prefill + single-token decode steps over a functional KV
-cache carried through the layer scan (the dense families: llama, gpt2,
-neox; same tokens, pinned per family by test). Either way: a qualitative
-check for checkpoints, not a serving path.
+cache carried through the layer scan (llama, gpt2, neox, and moe — the
+routed FFN runs drop-free per decoded token; same tokens, pinned per
+family by test). Either way: a qualitative check for checkpoints, not a
+serving path.
 
     # hermetic (no tokenizer): raw token ids in, ids out
     python -m distributed_training_guide_tpu.models.sample \\
@@ -32,9 +33,9 @@ def make_sampler(bundle, temperature: float = 0.0, kv_cache: bool = False):
       fixed buffer and the token at ``pos`` is written — O(steps x
       forward(prompt+steps));
     - ``kv_cache=True`` (families exporting ``init_cache``/``prefill``/
-      ``decode_step`` — llama, gpt2, neox): one prefill over the prompt,
-      then one single-token program per step attending over the cache —
-      O(forward(prompt) + steps x token).
+      ``decode_step`` — llama, gpt2, neox, moe): one prefill over the
+      prompt, then one single-token program per step attending over the
+      cache — O(forward(prompt) + steps x token).
 
     Greedy when ``temperature == 0`` (a Python constant — each mode is its
     own single compile)."""
@@ -43,6 +44,18 @@ def make_sampler(bundle, temperature: float = 0.0, kv_cache: bool = False):
         if temperature == 0.0:
             return jnp.argmax(logit)
         return jax.random.categorical(key, logit / temperature)
+
+    max_pos = getattr(bundle.config, "max_position_embeddings", None)
+
+    def check_length(n_prompt: int, steps: int) -> None:
+        # the guard lives HERE, not only in the CLI main(): as a library,
+        # an over-long generation would silently clamp gpt2's learned
+        # position table (and the cache's dynamic_update_slice) under jit —
+        # garbage tokens with no error
+        if max_pos and n_prompt + steps > max_pos:
+            raise ValueError(
+                f"prompt ({n_prompt}) + steps ({steps}) exceeds the model's "
+                f"max_position_embeddings ({max_pos})")
 
     if kv_cache:
         from .registry import family_module
@@ -59,6 +72,7 @@ def make_sampler(bundle, temperature: float = 0.0, kv_cache: bool = False):
                    rng: Optional[jax.Array] = None) -> list[int]:
             rng = rng if rng is not None else jax.random.key(0)
             n = len(prompt_ids)
+            check_length(n, steps)
             cache = mod.init_cache(bundle.config, 1, n + steps)
             ids = jnp.asarray(prompt_ids, jnp.int32)[None, :]
             logit, cache = prefill_j(params, ids, cache)
@@ -87,6 +101,7 @@ def make_sampler(bundle, temperature: float = 0.0, kv_cache: bool = False):
                rng: Optional[jax.Array] = None) -> list[int]:
         rng = rng if rng is not None else jax.random.key(0)
         n = len(prompt_ids)
+        check_length(n, steps)
         buf = jnp.zeros((1, n + steps), jnp.int32)
         buf = buf.at[0, :n].set(jnp.asarray(prompt_ids, jnp.int32))
         for t in range(n, n + steps):

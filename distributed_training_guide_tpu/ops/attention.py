@@ -25,6 +25,7 @@ def _xla_attention(
     causal: bool,
     positions: Optional[jnp.ndarray],
     kv_positions: Optional[jnp.ndarray],
+    window: Optional[int] = None,
 ) -> jnp.ndarray:
     b, sq, hq, d = q.shape
     _, sk, hkv, _ = k.shape
@@ -41,7 +42,11 @@ def _xla_attention(
             positions = jnp.arange(sq)[None, :]
         if kv_positions is None:
             kv_positions = jnp.arange(sk)[None, :]
-        mask = positions[:, None, None, :, None] >= kv_positions[:, None, None, None, :]
+        qp = positions[:, None, None, :, None]
+        kp = kv_positions[:, None, None, None, :]
+        mask = qp >= kp
+        if window is not None:  # HF sliding_window band: 0 <= i - j < window
+            mask &= (qp - kp) < window
         scores = jnp.where(mask, scores, jnp.finfo(jnp.float32).min)
 
     probs = jax.nn.softmax(scores, axis=-1)
@@ -65,6 +70,7 @@ def multihead_attention(
     kv_positions: Optional[jnp.ndarray] = None,
     impl: str = "auto",
     standard_layout: bool = True,
+    window: Optional[int] = None,
 ) -> jnp.ndarray:
     """Scaled-dot-product attention with GQA.
 
@@ -72,6 +78,8 @@ def multihead_attention(
     (flash on TPU when causal, tile-aligned, and the caller confirms the
     standard contiguous position layout via ``standard_layout`` — sequence-
     sharded/CP callers pass False and get the mask-aware xla path).
+    ``window``: sliding-window attention (both paths; the flash kernel skips
+    out-of-band kv tiles for an O(S*window) cost).
     """
     if impl == "auto":
         on_tpu = jax.default_backend() == "tpu"
@@ -81,5 +89,5 @@ def multihead_attention(
     if impl == "flash":
         from .flash_attention import flash_attention
 
-        return flash_attention(q, k, v, causal=causal)
-    return _xla_attention(q, k, v, causal, positions, kv_positions)
+        return flash_attention(q, k, v, causal=causal, window=window)
+    return _xla_attention(q, k, v, causal, positions, kv_positions, window)

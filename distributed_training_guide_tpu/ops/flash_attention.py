@@ -58,8 +58,35 @@ def _pick_block(s: int, preferred: int = 512) -> int:
 # forward
 # ---------------------------------------------------------------------------
 
+def _band_live(causal, window, iq, ik, block_q, block_k):
+    """Block-level skip predicate: False when NO (q_pos, k_pos) pair in the
+    (iq, ik) tile satisfies the causal/sliding-window band. The whole tile's
+    compute is skipped via ``pl.when`` — this is where SWA's speedup comes
+    from (tiles strictly below the band cost zero, so work is O(S*W) not
+    O(S^2) once S >> window)."""
+    if not causal:
+        return True
+    live = ik * block_k <= iq * block_q + block_q - 1
+    if window is not None:
+        # newest key in the tile still inside the OLDEST query's window
+        live &= ik * block_k + block_k - 1 >= iq * block_q - (window - 1)
+    return live
+
+
+def _band_mask(causal, window, iq, ik, block_q, block_k, shape):
+    """Element mask for a live tile (None = nothing masked)."""
+    if not causal:
+        return None
+    q_pos = iq * block_q + jax.lax.broadcasted_iota(jnp.int32, shape, 0)
+    k_pos = ik * block_k + jax.lax.broadcasted_iota(jnp.int32, shape, 1)
+    mask = q_pos >= k_pos
+    if window is not None:
+        mask &= (q_pos - k_pos) < window
+    return mask
+
+
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
-                *, scale, causal, block_q, block_k, num_kv_blocks):
+                *, scale, causal, window, block_q, block_k, num_kv_blocks):
     iq = pl.program_id(2)
     ik = pl.program_id(3)
 
@@ -69,8 +96,8 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
         l_scr[:] = jnp.zeros_like(l_scr)
         acc_scr[:] = jnp.zeros_like(acc_scr)
 
-    # causal: kv block fully in the future -> skip all compute
-    live = True if not causal else (ik * block_k <= iq * block_q + block_q - 1)
+    # band: kv block fully outside the causal/window band -> skip all compute
+    live = _band_live(causal, window, iq, ik, block_q, block_k)
 
     @pl.when(live)
     def _compute():
@@ -78,10 +105,9 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
         k = k_ref[0, 0].astype(jnp.float32)          # [BK, D]
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * scale
-        if causal:
-            q_pos = iq * block_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
-            k_pos = ik * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
-            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        mask = _band_mask(causal, window, iq, ik, block_q, block_k, s.shape)
+        if mask is not None:
+            s = jnp.where(mask, s, NEG_INF)
 
         m_prev = m_scr[:, 0:1]                        # [BQ, 1]
         l_prev = l_scr[:, 0:1]
@@ -89,6 +115,13 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
         m_new = jnp.maximum(m_prev, m_cur)
         alpha = jnp.exp(m_prev - m_new)
         p = jnp.exp(s - m_new)                        # [BQ, BK]
+        if window is not None and mask is not None:
+            # a live SWA tile can hold FULLY-masked q rows (window's lower
+            # edge crosses the tile): there m_new == NEG_INF and
+            # exp(s - m_new) == exp(0) == 1 — zero those lanes explicitly.
+            # (Pure causal never hits this: with block_q == block_k every
+            # live tile's rows keep >= 1 unmasked key.)
+            p = jnp.where(mask, p, 0.0)
         l_new = alpha * l_prev + jnp.sum(p, axis=1, keepdims=True)
         v = v_ref[0, 0].astype(jnp.float32)           # [BK, D]
         pv = jax.lax.dot_general(p, v, (((1,), (0,)), ((), ())),
@@ -106,7 +139,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
         lse_ref[0, 0] = jnp.broadcast_to(lse, lse_ref.shape[2:]).astype(jnp.float32)
 
 
-def _flash_fwd(q, k, v, causal, block_q, block_k, interpret):
+def _flash_fwd(q, k, v, causal, window, block_q, block_k, interpret):
     b, hq, sq, d = q.shape
     _, hkv, sk, _ = k.shape
     groups = hq // hkv
@@ -117,7 +150,7 @@ def _flash_fwd(q, k, v, causal, block_q, block_k, interpret):
 
     grid = (b, hq, nq, nk)
     kernel = functools.partial(
-        _fwd_kernel, scale=scale, causal=causal,
+        _fwd_kernel, scale=scale, causal=causal, window=window,
         block_q=block_q, block_k=block_k, num_kv_blocks=nk)
 
     out_shape = (
@@ -159,7 +192,7 @@ def _flash_fwd(q, k, v, causal, block_q, block_k, interpret):
 # ---------------------------------------------------------------------------
 
 def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dq_scr,
-               *, scale, causal, block_q, block_k, num_kv_blocks):
+               *, scale, causal, window, block_q, block_k, num_kv_blocks):
     iq = pl.program_id(2)
     ik = pl.program_id(3)
 
@@ -167,7 +200,7 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dq_scr,
     def _init():
         dq_scr[:] = jnp.zeros_like(dq_scr)
 
-    live = True if not causal else (ik * block_k <= iq * block_q + block_q - 1)
+    live = _band_live(causal, window, iq, ik, block_q, block_k)
 
     @pl.when(live)
     def _compute():
@@ -180,10 +213,12 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dq_scr,
 
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * scale
-        if causal:
-            q_pos = iq * block_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
-            k_pos = ik * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
-            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        mask = _band_mask(causal, window, iq, ik, block_q, block_k, s.shape)
+        if mask is not None:
+            s = jnp.where(mask, s, NEG_INF)
+        # masked lanes need no explicit zeroing here: lse is the GLOBAL
+        # logsumexp (finite — every causal row keeps its own key in-window),
+        # so exp(NEG_INF - lse) underflows to exactly 0
         p = jnp.exp(s - lse)                                  # [BQ, BK]
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
@@ -198,7 +233,8 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dq_scr,
 
 def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                 dk_ref, dv_ref, dk_scr, dv_scr,
-                *, scale, causal, block_q, block_k, num_q_blocks, groups):
+                *, scale, causal, window, block_q, block_k, num_q_blocks,
+                groups):
     # grid (b, hkv, ik, ig, iq): the kv-block ik is OUTER to the (group,
     # q-block) accumulation dims, so the scratch is initialized exactly when a
     # new dk/dv output block is first visited and flushed when last visited.
@@ -211,7 +247,7 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dk_scr[:] = jnp.zeros_like(dk_scr)
         dv_scr[:] = jnp.zeros_like(dv_scr)
 
-    live = True if not causal else (iq * block_q + block_q - 1 >= ik * block_k)
+    live = _band_live(causal, window, iq, ik, block_q, block_k)
 
     @pl.when(live)
     def _compute():
@@ -224,10 +260,9 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * scale
-        if causal:
-            q_pos = iq * block_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
-            k_pos = ik * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
-            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        mask = _band_mask(causal, window, iq, ik, block_q, block_k, s.shape)
+        if mask is not None:
+            s = jnp.where(mask, s, NEG_INF)
         p = jnp.exp(s - lse)                                  # [BQ, BK]
         dv_scr[:] += jax.lax.dot_general(p, do, (((0,), (0,)), ((), ())),
                                          preferred_element_type=jnp.float32)
@@ -243,8 +278,8 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dv_ref[0, 0] = dv_scr[:].astype(dv_ref.dtype)
 
 
-def flash_bwd_with_stats(q, k, v, do, lse, delta, *, causal, block_q=512,
-                         block_k=512, interpret=False):
+def flash_bwd_with_stats(q, k, v, do, lse, delta, *, causal, window=None,
+                         block_q=512, block_k=512, interpret=False):
     """Flash backward from caller-supplied softmax stats -> (dq, dk, dv).
 
     ``lse``/``delta`` ([B, Hq, Sq] fp32) are normally the forward's
@@ -273,6 +308,7 @@ def flash_bwd_with_stats(q, k, v, do, lse, delta, *, causal, block_q=512,
 
     dq = pl.pallas_call(
         functools.partial(_dq_kernel, scale=scale, causal=causal,
+                          window=window,
                           block_q=block_q, block_k=block_k, num_kv_blocks=nk),
         grid=(b, hq, nq, nk),
         in_specs=[q_spec, kv_spec, kv_spec, q_spec, stat_spec, stat_spec],
@@ -292,7 +328,7 @@ def flash_bwd_with_stats(q, k, v, do, lse, delta, *, causal, block_q=512,
 
     dk, dv = pl.pallas_call(
         functools.partial(_dkv_kernel, scale=scale, causal=causal,
-                          block_q=block_q, block_k=block_k,
+                          window=window, block_q=block_q, block_k=block_k,
                           num_q_blocks=nq, groups=groups),
         grid=(b, hkv, nk, groups, nq),
         in_specs=[
@@ -317,28 +353,28 @@ def flash_bwd_with_stats(q, k, v, do, lse, delta, *, causal, block_q=512,
     return dq, dk, dv
 
 
-def _flash_bwd(causal, block_q, block_k, interpret, residuals, g):
+def _flash_bwd(causal, window, block_q, block_k, interpret, residuals, g):
     q, k, v, o, lse = residuals
     do = g
     delta = jnp.einsum("bhsd,bhsd->bhs", do.astype(jnp.float32),
                        o.astype(jnp.float32))                  # [B,H,S]
     return flash_bwd_with_stats(q, k, v, do, lse, delta, causal=causal,
-                                block_q=block_q, block_k=block_k,
-                                interpret=interpret)
+                                window=window, block_q=block_q,
+                                block_k=block_k, interpret=interpret)
 
 
 # ---------------------------------------------------------------------------
 # public entry
 # ---------------------------------------------------------------------------
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
-def _flash(q, k, v, causal, block_q, block_k, interpret):
-    o, _ = _flash_fwd(q, k, v, causal, block_q, block_k, interpret)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash(q, k, v, causal, window, block_q, block_k, interpret):
+    o, _ = _flash_fwd(q, k, v, causal, window, block_q, block_k, interpret)
     return o
 
 
-def _flash_vjp_fwd(q, k, v, causal, block_q, block_k, interpret):
-    o, lse = _flash_fwd(q, k, v, causal, block_q, block_k, interpret)
+def _flash_vjp_fwd(q, k, v, causal, window, block_q, block_k, interpret):
+    o, lse = _flash_fwd(q, k, v, causal, window, block_q, block_k, interpret)
     # checkpoint_name tags let a remat policy keep the kernel's backward
     # residuals (o + lse; q/k/v are cheap projections) so the forward kernel
     # is not re-run inside the backward pass — see train/step.py
@@ -417,6 +453,7 @@ def make_sharded_flash_attention(
     batch_axes=("dp", "fsdp", "ep"),
     head_axis: Optional[str] = "tp",
     causal: bool = True,
+    window: Optional[int] = None,
     block_q: int = 512,
     block_k: int = 512,
     forced: bool = False,
@@ -473,7 +510,8 @@ def make_sharded_flash_attention(
 
     def fwd_body(q, k, v):
         qt, kt, vt = (x.transpose(0, 2, 1, 3) for x in (q, k, v))
-        o, lse = _flash_fwd(qt, kt, vt, causal, block_q, block_k, interpret)
+        o, lse = _flash_fwd(qt, kt, vt, causal, window, block_q, block_k,
+                            interpret)
         # ONLY the primal output + lse leave the map: a shard_map eqn is
         # atomic under jax.checkpoint's partial-eval, so any residual-only
         # output (the in-map transposes, or a separate kernel-layout o)
@@ -484,7 +522,7 @@ def make_sharded_flash_attention(
         return o.transpose(0, 2, 1, 3), lse
 
     def bwd_body(qt, kt, vt, o, lse, do):
-        dq, dk, dv = _flash_bwd(causal, block_q, block_k, interpret,
+        dq, dk, dv = _flash_bwd(causal, window, block_q, block_k, interpret,
                                 (qt, kt, vt, o, lse), do.transpose(0, 2, 1, 3))
         return tuple(g.transpose(0, 2, 1, 3) for g in (dq, dk, dv))
 
@@ -561,7 +599,8 @@ def make_sharded_flash_attention(
                                 **kwargs)
             from .attention import multihead_attention
 
-            return multihead_attention(q, k, v, causal=causal, impl="xla")
+            return multihead_attention(q, k, v, causal=causal, window=window,
+                                       impl="xla")
         if _in_manual_context():  # nested in the pipeline: caller's jit is
             return sharded_flash(q, k, v)  # already above us
         return sharded_flash_eager(q, k, v)
@@ -575,11 +614,22 @@ def flash_attention(
     v: jnp.ndarray,
     *,
     causal: bool = True,
+    window: Optional[int] = None,
     block_q: int = 512,
     block_k: int = 512,
     interpret: Optional[bool] = None,
 ) -> jnp.ndarray:
-    """Blockwise fused attention; returns [B, S, Hq, D] in q.dtype."""
+    """Blockwise fused attention; returns [B, S, Hq, D] in q.dtype.
+
+    ``window``: sliding-window attention (HF ``sliding_window`` semantics —
+    query i attends keys j with 0 <= i - j < window). kv tiles fully below
+    the band are SKIPPED, so cost is O(S*window) once S >> window — the
+    reference inherits the same trick from flash-attn's window_size
+    (``05-training-llama-405b/train_llm.py:93``)."""
+    if window is not None and not causal:
+        raise ValueError("window (sliding-window attention) requires causal=True")
+    if window is not None and window < 1:
+        raise ValueError(f"window must be >= 1, got {window}")
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
     d = q.shape[-1]
@@ -595,5 +645,5 @@ def flash_attention(
     qt = q.transpose(0, 2, 1, 3)
     kt = k.transpose(0, 2, 1, 3)
     vt = v.transpose(0, 2, 1, 3)
-    o = _flash(qt, kt, vt, causal, block_q, block_k, interpret)
+    o = _flash(qt, kt, vt, causal, window, block_q, block_k, interpret)
     return o.transpose(0, 2, 1, 3)

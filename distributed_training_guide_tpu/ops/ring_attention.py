@@ -161,8 +161,8 @@ def _build_ring(axis_name: str, cp: int, causal: bool, interpret: bool,
                 o_a, lse_a = o[a], lse[a]
 
                 def live(masked, qa=qa, kc=kc, vc=vc, o_a=o_a, lse_a=lse_a):
-                    o_i, lse_i = _flash_fwd(qa, kc, vc, masked, 512, 512,
-                                            interpret)
+                    o_i, lse_i = _flash_fwd(qa, kc, vc, masked, None, 512,
+                                            512, interpret)
                     return _merge(o_a, lse_a, o_i.astype(jnp.float32), lse_i)
 
                 o_a, lse_a = jax.lax.cond(

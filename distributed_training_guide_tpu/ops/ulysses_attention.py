@@ -44,7 +44,7 @@ from .flash_attention import (make_sharded_flash_attention,
 def make_ulysses_attention(mesh: Mesh, *, axis_name: str = "cp",
                            data_axes=("dp", "fsdp", "ep"),
                            head_axis="tp", causal: bool = True,
-                           impl: str = "auto"):
+                           window=None, impl: str = "auto"):
     """Attention callable (``make_ring_attention`` contract) running the
     Ulysses layout flip over ``axis_name``. ``impl`` as in
     ``multihead_attention``: 'flash' forces the manual-axes kernel wrapper,
@@ -82,14 +82,17 @@ def make_ulysses_attention(mesh: Mesh, *, axis_name: str = "cp",
 
         qc, kc, vc = (jax.lax.with_sharding_constraint(x, inner)
                       for x in (q, k, v))
-        out = multihead_attention(qc, kc, vc, causal=causal, impl="xla")
+        # window passes straight through: every device sees the FULL
+        # sequence for its head slice, so the band mask stays exact
+        out = multihead_attention(qc, kc, vc, causal=causal, window=window,
+                                  impl="xla")
         # flip back to the sequence sharding the surrounding blocks carry
         return jax.lax.with_sharding_constraint(out, outer)
 
     if impl == "flash":
         flash = make_sharded_flash_attention(
             mesh, batch_axes=data_axes, head_axis=ulysses_heads,
-            causal=causal, forced=not auto,
+            causal=causal, window=window, forced=not auto,
             fallback=attention if auto else None)
         assert flash is not None  # cp > 1 guarantees a manual axis
         return flash

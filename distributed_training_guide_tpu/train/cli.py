@@ -148,9 +148,15 @@ def get_parser() -> argparse.ArgumentParser:
     parser.add_argument("--preflight", action="store_true",
                         help="don't train: abstractly trace + SPMD-lower the "
                              "full step for this (model, mesh, flags) and "
-                             "print the per-device HBM budget, then exit — "
-                             "catches sharding/divisibility/fit problems "
-                             "without touching an accelerator")
+                             "print the per-device HBM budget + ICI comm "
+                             "roofline, then exit — catches sharding/"
+                             "divisibility/fit problems without touching an "
+                             "accelerator")
+    parser.add_argument("--preflight-target", default=None, metavar="KIND",
+                        help="chip kind the comm roofline prices (e.g. v5p, "
+                             "v5e) when preflighting a pod plan from a "
+                             "non-TPU host; default: local device on TPU, "
+                             "v5p otherwise")
     return parser
 
 
@@ -215,7 +221,9 @@ def run_training(args, plan_factory: Callable, *, extra_log: Optional[dict] = No
         from .preflight import run_preflight
 
         return run_preflight(trainer, global_batch=global_batch,
-                             seq_length=seq_length)
+                             seq_length=seq_length,
+                             target_device=getattr(args, "preflight_target",
+                                                   None))
 
     tokenizer = get_tokenizer(args.model_name)
     dataset = load_and_preprocess_data(
@@ -372,7 +380,15 @@ def run_training(args, plan_factory: Callable, *, extra_log: Optional[dict] = No
                         t.reset()
 
                 if io is not None and host_state["global_step"] % args.ckpt_freq == 0:
-                    drain_losses()  # host_state is about to be persisted
+                    # host_state is about to be persisted. Timing caveat
+                    # (deliberate): with --fence-every > 1 this drain runs
+                    # OUTSIDE the step timer while the log-boundary drain is
+                    # inside it — when ckpt_freq isn't a multiple of
+                    # log_freq, the awaited device work of this fence group
+                    # is untimed and that window's tokens_per_s/MFU reads
+                    # slightly high. Align ckpt_freq to log_freq for
+                    # benchmark-grade numbers (bench.py's harness does)
+                    drain_losses()
                     LOGGER.info("Saving checkpoint.")
                     io.save(state, host_state)
 

@@ -9,6 +9,15 @@ shape/sharding/divisibility error surfaces in seconds on a login host — and
 prints the per-device resident-bytes budget derived from the actual
 shardings (``NamedSharding.shard_shape``), so "will it fit" is answered
 before a single chip is reserved.
+
+It also prints a per-collective ICI comm model + roofline
+(``comm_roofline``): ring-collective bytes per chip per step for the plan's
+fsdp all-gathers / grad reduce-scatters / megatron tp all-reduces / dp grad
+all-reduce, divided by the target chip's ICI bandwidth, against the step's
+compute time at peak — the scaling-book first-order answer to "is the
+fsdp=32 x tp=8 405B plan compute-bound on a v5p pod". The collective KINDS
+in the model are cross-checked against the compiled HLO at small scale by
+``tests/test_405b_recipe.py``.
 """
 from __future__ import annotations
 
@@ -28,10 +37,107 @@ def _per_device_bytes(shapes_tree, shardings_tree) -> int:
     return total
 
 
-def run_preflight(trainer, *, global_batch: int, seq_length: int) -> dict:
+def comm_roofline(trainer, *, global_batch: int, seq_length: int,
+                  device_kind: str | None = None,
+                  assume_overlap: bool = True) -> dict:
+    """Analytical per-collective ICI bytes + roofline for the trainer's plan.
+
+    Ring-collective cost model (bytes crossing each chip's ICI links, one
+    direction): all-gather / reduce-scatter of a tensor of ``n`` bytes over
+    an axis of size ``k`` moves ``(k-1)/k * n``; all-reduce moves
+    ``2(k-1)/k * n``. Weight collectives count the fsdp axis only (tp keeps
+    its shard resident); activation all-reduces are the 4 megatron
+    psums/layer (attn out + mlp out, forward and backward). Counted per
+    step at ``global_batch`` x ``seq_length``; bf16 weights/activations,
+    fp32 grad reduction.
+
+    ``device_kind`` names the TARGET chip (e.g. "TPU v5p") so a CPU login
+    host can evaluate a pod plan; defaults to the local device. Returns the
+    table + derived times; does not claim overlap it can't see — both the
+    overlapped (max) and serial (sum) MFU ceilings are reported.
+    """
+    from ..utils.mfu import (device_ici_bandwidth, device_peak_flops,
+                             transformer_flops_per_token)
+
+    cfg = trainer.bundle.config
+    mesh = trainer.plan.mesh.shape
+    fsdp = mesh.get("fsdp", 1)
+    tp = mesh.get("tp", 1)
+    dp = mesh.get("dp", 1)
+    n_chips = trainer.plan.mesh.devices.size
+
+    e = cfg.hidden_size
+    n_layers = cfg.num_layers
+    d = cfg.head_size
+    hq, hkv = cfg.num_heads * d, getattr(cfg, "num_kv_heads", cfg.num_heads) * d
+    inter = getattr(cfg, "intermediate_size", 4 * e)
+    # MoE: EVERY expert's weights ride the fsdp all-gather/reduce-scatter
+    # (they are resident params), while compute below counts ACTIVE params —
+    # conflating the two misprices an MoE pod plan by ~E/k in both directions
+    n_experts = getattr(cfg, "num_experts", 1)
+    # per-layer weight bytes in the bf16 compute stream, tp-sharded resident
+    w_layer = (e * hq + 2 * e * hkv + hq * e
+               + n_experts * 3 * e * inter) * 2 / tp
+    w_embed = (cfg.vocab_size * e * 2
+               * (1 if getattr(cfg, "tie_word_embeddings", False) else 2)) / tp
+    weight_bytes = n_layers * w_layer + w_embed
+
+    rows_local = global_batch / max(dp * fsdp, 1)
+    act_bytes = rows_local * seq_length * e * 2          # [b_loc, S, E] bf16
+
+    def ag_rs(n, k):
+        return (k - 1) / k * n if k > 1 else 0.0
+
+    def ar(n, k):
+        return 2 * (k - 1) / k * n if k > 1 else 0.0
+
+    table = {
+        # fwd all-gather + bwd re-gather of every weight over fsdp
+        "fsdp_allgather_weights": 2 * ag_rs(weight_bytes, fsdp),
+        # grad reduce-scatter over fsdp, fp32 accumulation stream
+        "fsdp_reducescatter_grads": ag_rs(weight_bytes * 2, fsdp),
+        # 4 megatron all-reduces per layer on [b_loc, S, E]
+        "tp_allreduce_activations": 4 * n_layers * ar(act_bytes, tp),
+        # pure-dp grad all-reduce of the (fsdp x tp)-sharded grads
+        "dp_allreduce_grads": ar(weight_bytes * 2 / max(fsdp, 1), dp),
+    }
+    comm_bytes = sum(table.values())
+
+    ici = device_ici_bandwidth(device_kind=device_kind)
+    peak = device_peak_flops(device_kind=device_kind)
+    # active params (MoE: k of E experts), matching the trainer's own MFU
+    # accounting (cli.py) — total params would overstate compute ~E/k x
+    flops_per_token = transformer_flops_per_token(
+        trainer.bundle.num_active_params(), n_layers, e, seq_length,
+        vocab_size=cfg.vocab_size)
+    t_comp = (flops_per_token * global_batch * seq_length) / (peak * n_chips)
+    t_comm = comm_bytes / ici
+    report = {
+        "per_collective_bytes_per_chip": {k: int(v) for k, v in table.items()},
+        "comm_bytes_per_chip": int(comm_bytes),
+        "ici_bytes_per_s": ici,
+        "peak_flops_per_chip": peak,
+        "t_compute_s": t_comp,
+        "t_comm_s": t_comm,
+        "comm_to_compute": t_comm / t_comp if t_comp else float("inf"),
+        # ceilings on ACHIEVABLE MFU from comm alone (kernel efficiency
+        # excluded): overlapped = comm hides behind compute; serial = none
+        "mfu_ceiling_overlapped": t_comp / max(t_comp, t_comm) if t_comp else 0.0,
+        "mfu_ceiling_serial": t_comp / (t_comp + t_comm) if t_comp else 0.0,
+    }
+    if not assume_overlap:
+        report["mfu_ceiling_overlapped"] = report["mfu_ceiling_serial"]
+    return report
+
+
+def run_preflight(trainer, *, global_batch: int, seq_length: int,
+                  target_device: str | None = None) -> dict:
     """Lower the train step abstractly and report the per-device budget.
 
     Returns the report dict (also logged) — keys in bytes unless noted.
+    ``target_device`` names the pod's chip for the comm roofline (e.g.
+    "v5p") when preflighting from a non-TPU login host; defaults to the
+    local device on TPU, v5p otherwise.
     """
     from ..checkpoint import abstract_train_state
 
@@ -93,5 +199,21 @@ def run_preflight(trainer, *, global_batch: int, seq_length: int) -> dict:
         f"(+ transient grads {grad_b * gib:.2f} GiB)"
         + (f"; device limit {report['device_bytes_limit'] * gib:.2f} GiB"
            if "device_bytes_limit" in report else ""))
+
+    if target_device is None and jax.default_backend() != "tpu":
+        target_device = "v5p"  # the 405B recipe's stated target pod
+    comm = comm_roofline(trainer, global_batch=global_batch,
+                         seq_length=seq_length, device_kind=target_device)
+    report["comm"] = comm
+    mib = 1 / 2**20
+    rows = "; ".join(f"{k} {v * mib:.0f} MiB" for k, v in
+                     comm["per_collective_bytes_per_chip"].items() if v)
+    LOGGER.info(
+        f"comm roofline ({target_device or 'local device'}): "
+        f"{rows or 'no cross-chip collectives'} | "
+        f"t_comm {comm['t_comm_s'] * 1e3:.1f} ms vs t_compute "
+        f"{comm['t_compute_s'] * 1e3:.1f} ms -> MFU ceiling "
+        f"{comm['mfu_ceiling_overlapped']:.1%} overlapped / "
+        f"{comm['mfu_ceiling_serial']:.1%} serial")
     del lowered
     return report

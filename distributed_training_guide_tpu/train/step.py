@@ -120,6 +120,20 @@ class Trainer:
     def __post_init__(self):
         if self.plan is None:
             self.plan = make_plan("single", make_mesh(devices=jax.devices()[:1]))
+        # seq-dependent rope types (dynamic NTK, longrope) trace their
+        # frequencies from max(positions)+1; under context parallelism each
+        # sequence shard sees only its slice, so shards would compute
+        # DIFFERENT frequencies — reject loudly instead of silently diverging
+        if self.plan.mesh.shape.get("cp", 1) > 1:
+            from ..ops.rope import SEQ_DEPENDENT_ROPE_TYPES, rope_type_of
+
+            rt = rope_type_of(getattr(self.bundle.config, "rope_scaling", None))
+            if rt in SEQ_DEPENDENT_ROPE_TYPES:
+                raise ValueError(
+                    f"rope_scaling type {rt!r} computes frequencies from the "
+                    f"runtime sequence length and cannot run under context "
+                    f"parallelism (sequence shards would disagree); use a "
+                    f"static rope type (linear/yarn/llama3) or cp=1")
         if self.offload_opt_state or self.offload_params:
             kinds = {m.kind for m in jax.local_devices()[0].addressable_memories()}
             if "pinned_host" not in kinds:
@@ -240,6 +254,7 @@ class Trainer:
         under_pp = self.plan.mesh.shape["pp"] > 1
         plan_head_axis = ("tp" if not under_pp
                           and self.plan.rules.get("heads") == "tp" else None)
+        window = getattr(cfg, "sliding_window", None)
         if self.plan.mesh.shape["cp"] > 1 and not callable(attn_impl):
             if self.context_impl == "ulysses":
                 # all-to-all CP: heads shard over cp (x tp) during
@@ -259,7 +274,7 @@ class Trainer:
                         "--context-impl ring")
                 attn_impl = make_ulysses_attention(
                     self.plan.mesh, data_axes=self.plan.data_axes,
-                    head_axis=plan_head_axis,
+                    head_axis=plan_head_axis, window=window,
                     impl="flash" if under_pp else attn_impl)
             elif self.context_impl == "ring":
                 # cp carries the ring's ppermutes; batch/head axes are
@@ -268,6 +283,13 @@ class Trainer:
                 # tp-shards them
                 from ..ops.ring_attention import make_ring_attention
 
+                if window is not None:
+                    raise ValueError(
+                        "sliding_window + ring context parallelism is not "
+                        "implemented (the zigzag hop schedule would need "
+                        "band-aware skipping); use --context-impl ulysses "
+                        "(the window passes through its full-sequence "
+                        "layout) or cp=1")
                 attn_impl = make_ring_attention(
                     self.plan.mesh, data_axes=self.plan.data_axes,
                     head_axis=plan_head_axis, hop_loop=self.cp_hop_loop)
@@ -291,7 +313,7 @@ class Trainer:
 
             wrapped = make_sharded_flash_attention(
                 self.plan.mesh, batch_axes=self.plan.data_axes,
-                head_axis=plan_head_axis,
+                head_axis=plan_head_axis, window=window,
                 forced=attn_impl == "flash")
             if wrapped is not None:
                 attn_impl = wrapped

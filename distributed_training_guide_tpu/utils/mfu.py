@@ -43,7 +43,16 @@ _PEAK_FLOPS = [
 ]
 
 
-def device_peak_flops(device: "jax.Device | None" = None) -> float:
+def device_peak_flops(device: "jax.Device | None" = None,
+                      device_kind: str | None = None) -> float:
+    """``device_kind`` names a TARGET chip (e.g. "v5p") without probing a
+    local device — the preflight roofline prices pod plans from CPU hosts."""
+    if device_kind is not None:
+        kind = device_kind.lower()
+        for key, flops in _PEAK_FLOPS:
+            if key in kind:
+                return flops
+        return 459e12  # v5p, the 405B recipe's stated target
     device = device or jax.local_devices()[0]
     kind = getattr(device, "device_kind", "").lower()
     for key, flops in _PEAK_FLOPS:
@@ -58,3 +67,34 @@ def compute_mfu(tokens_per_s: float, flops_per_token: float, n_chips: int = 1,
                 peak_flops_per_chip: float | None = None) -> float:
     peak = peak_flops_per_chip or device_peak_flops()
     return (tokens_per_s * flops_per_token) / (peak * n_chips)
+
+
+# Aggregate ICI bandwidth per chip (bytes/s, all links, one direction) by
+# device kind substring — public spec-sheet numbers (v5p: 4800 Gbit/s ICI
+# per chip; v5e: 1600; v4: 2400; v6e: 3584). The preflight roofline
+# (train/preflight.py) divides ring-collective bytes by this, the standard
+# scaling-book first-order model; real meshes split it over links/axes, so
+# treat results as a best-case bound, not a simulator.
+_ICI_BYTES_PER_S = [
+    ("v6e", 3584e9 / 8),
+    ("v6", 3584e9 / 8),
+    ("v5p", 4800e9 / 8),
+    ("v5e", 1600e9 / 8),
+    ("v5 lite", 1600e9 / 8),
+    ("v5litepod", 1600e9 / 8),
+    ("v4", 2400e9 / 8),
+    ("v3", 1400e9 / 8),
+]
+
+
+def device_ici_bandwidth(device: "jax.Device | None" = None,
+                         device_kind: str | None = None) -> float:
+    """Bytes/s of ICI egress per chip; ``device_kind`` overrides probing so
+    a CPU login host can run the roofline for a target pod (preflight)."""
+    kind = (device_kind if device_kind is not None
+            else getattr(device or jax.local_devices()[0], "device_kind", "")
+            ).lower()
+    for key, bw in _ICI_BYTES_PER_S:
+        if key in kind:
+            return bw
+    return 4800e9 / 8  # default to the v5p target the 405B recipe names

@@ -14,6 +14,7 @@ import subprocess
 import sys
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 import pytest
 
@@ -270,3 +271,66 @@ def test_405b_preflight_at_pod_shape():
     # the pod-shape program structure executed for real (debug family,
     # same mesh + plan + remat): finite loss out of one optimizer step
     assert np.isfinite(report["pod_exec_loss"])
+
+    # comm roofline (VERDICT-r4 item 7): the quantitative basis for the
+    # >=40%-MFU-on-v5p north star this single-chip environment can produce.
+    # At fsdp=32 x tp=8, batch 32, seq 4096 the ring-collective bytes sit
+    # well under the compute time — comm-overlapped ceiling ~100%, serial
+    # (zero overlap, worst case) still above the 40% target
+    comm = report["comm"]
+    t = comm["per_collective_bytes_per_chip"]
+    assert t["fsdp_allgather_weights"] > 0
+    assert t["fsdp_reducescatter_grads"] > 0
+    assert t["tp_allreduce_activations"] > 0
+    assert t["dp_allreduce_grads"] == 0          # no dp axis in this plan
+    assert comm["mfu_ceiling_overlapped"] >= 0.95
+    assert comm["mfu_ceiling_serial"] >= 0.40
+
+
+def test_comm_model_kinds_match_compiled_hlo(eight_devices):
+    """The analytical comm model's collective KINDS must appear in the real
+    optimized HLO for the same plan (small scale, 2x2x2 mesh): nonzero
+    fsdp rows <-> all-gather + reduce-scatter ops, nonzero tp rows <->
+    all-reduce ops. Guards the model against drifting from what GSPMD
+    actually emits."""
+    from distributed_training_guide_tpu.checkpoint import abstract_train_state
+    from distributed_training_guide_tpu.models import get_model
+    from distributed_training_guide_tpu.parallel import make_mesh, make_plan
+    from distributed_training_guide_tpu.train import Trainer, adamw_cosine
+    from distributed_training_guide_tpu.train.preflight import comm_roofline
+
+    bundle = get_model("llama-debug", dtype=jnp.float32, num_heads=4,
+                       num_kv_heads=2)
+    plan = make_plan("tp_fsdp", make_mesh(dp=2, tp=2, fsdp=2))
+    trainer = Trainer(bundle=bundle, optimizer=adamw_cosine(1e-3), plan=plan,
+                      donate=False)
+    comm = comm_roofline(trainer, global_batch=8, seq_length=64,
+                         device_kind="v5p")
+    t = comm["per_collective_bytes_per_chip"]
+    assert all(t[k] > 0 for k in ("fsdp_allgather_weights",
+                                  "fsdp_reducescatter_grads",
+                                  "tp_allreduce_activations",
+                                  "dp_allreduce_grads"))
+
+    state = abstract_train_state(trainer)
+    batch = {k: jax.ShapeDtypeStruct((8, 64), np.int32, sharding=sh)
+             for k, sh in trainer.batch_shardings().items()}
+    hlo = trainer.step_fn.lower(state, batch).compile().as_text()
+    assert "all-gather" in hlo, "fsdp weight all-gather missing from HLO"
+    assert "all-reduce" in hlo, "tp/dp all-reduce missing from HLO"
+
+    # grad-reduction guard on an fsdp-ONLY plan (no tp axis -> no megatron
+    # all-reduces to mask the check): the fsdp grad reduction must appear,
+    # as reduce-scatter or as XLA's all-reduce+slice spelling
+    plan_f = make_plan("fsdp", make_mesh(fsdp=8))
+    t_f = Trainer(bundle=bundle, optimizer=adamw_cosine(1e-3), plan=plan_f,
+                  donate=False)
+    comm_f = comm_roofline(t_f, global_batch=8, seq_length=64,
+                           device_kind="v5p")
+    assert comm_f["per_collective_bytes_per_chip"]["tp_allreduce_activations"] == 0
+    state_f = abstract_train_state(t_f)
+    batch_f = {k: jax.ShapeDtypeStruct((8, 64), np.int32, sharding=sh)
+               for k, sh in t_f.batch_shardings().items()}
+    hlo_f = t_f.step_fn.lower(state_f, batch_f).compile().as_text()
+    assert ("reduce-scatter" in hlo_f) or ("all-reduce" in hlo_f), (
+        "fsdp grad reduction missing from HLO in every spelling")

@@ -6,6 +6,8 @@ build a tiny ``LlamaForCausalLM`` / ``GPT2LMHeadModel`` with torch (CPU),
 ``convert_hf_checkpoint``, load via the sharded loader, and require our
 pure-JAX forward to match torch's logits.
 """
+import json
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -328,14 +330,42 @@ def test_auto_hf_config_ingestion(tmp_path, caplog):
         theirs = model(torch.tensor(ids)).logits.float().numpy()
     np.testing.assert_allclose(ours, theirs, rtol=2e-4, atol=2e-4)
 
-    # unimplemented attention extras warn LOUDLY (not silently diverge):
-    # sliding_window narrower than the context, and rope_scaling
+    # sliding_window is SUPPORTED: a live window lands on the config (the
+    # flash kernel's banded path; numerics pinned in tests/test_swa.py) …
     mist = tmp_path / "mist_swa"
     mist.mkdir()
     transformers.MistralConfig(
         vocab_size=64, hidden_size=32, intermediate_size=64,
         num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
         sliding_window=4096, max_position_embeddings=32768).save_pretrained(mist)
+    _, mcfg = config_from_hf(mist)
+    assert mcfg.sliding_window == 4096
+    # …but Qwen2's is gated behind use_sliding_window (default False: the
+    # key is present-but-inert on every Qwen2 config)
+    qwen_swa = tmp_path / "qwen_swa"
+    qwen_swa.mkdir()
+    transformers.Qwen2Config(
+        vocab_size=64, hidden_size=32, intermediate_size=64,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        sliding_window=4096, use_sliding_window=False,
+        max_position_embeddings=32768).save_pretrained(qwen_swa)
+    _, qcfg = config_from_hf(qwen_swa)
+    assert qcfg.sliding_window is None
+    # ...and a LIVE Qwen2 window with max_window_layers < num_layers mixes
+    # full- and sliding-window layers — unimplementable with one global
+    # window, must fail loudly at ingestion (not silently band every layer)
+    qwen_mixed = tmp_path / "qwen_mixed"
+    qwen_mixed.mkdir()
+    transformers.Qwen2Config(
+        vocab_size=64, hidden_size=32, intermediate_size=64,
+        num_hidden_layers=4, num_attention_heads=4, num_key_value_heads=2,
+        sliding_window=4096, use_sliding_window=True, max_window_layers=2,
+        max_position_embeddings=32768).save_pretrained(qwen_mixed)
+    with pytest.raises(ValueError, match="max_window_layers"):
+        config_from_hf(qwen_mixed)
+
+    # rope_scaling is SUPPORTED: ingestion freezes the dict onto the config
+    # (full numerics parity is pinned in tests/test_rope_scaling.py)
     rope = tmp_path / "llama_rope"
     rope.mkdir()
     transformers.LlamaConfig(
@@ -352,14 +382,21 @@ def test_auto_hf_config_ingestion(tmp_path, caplog):
         num_hidden_layers=2, num_attention_heads=4,
         rope_scaling={"rope_type": "linear", "factor": 2.0}).save_pretrained(
             neox_rope)
-    with caplog.at_level("WARNING",
-                         logger="distributed_training_guide_tpu.models.auto"):
-        config_from_hf(mist)
-        config_from_hf(rope)
-        config_from_hf(neox_rope)
-    assert "sliding_window=4096" in caplog.text
-    assert "rope_scaling" in caplog.text
-    assert "GPTNeoXForCausalLM: rope_scaling" in caplog.text
+    _, rcfg = config_from_hf(rope)
+    assert dict(rcfg.rope_scaling)["rope_type"] == "llama3"
+    assert rcfg.max_position_embeddings == 131072
+    _, ncfg = config_from_hf(neox_rope)
+    assert dict(ncfg.rope_scaling)["factor"] == 2.0
+    # ...but an rope type we do NOT implement still fails loudly at ingestion
+    bad_rope = tmp_path / "bad_rope"
+    bad_rope.mkdir()
+    (bad_rope / "config.json").write_text(json.dumps({
+        "architectures": ["LlamaForCausalLM"], "vocab_size": 64,
+        "hidden_size": 32, "intermediate_size": 64, "num_hidden_layers": 2,
+        "num_attention_heads": 4,
+        "rope_scaling": {"rope_type": "su", "factor": 2.0}}))
+    with pytest.raises(ValueError, match="unsupported rope_scaling"):
+        config_from_hf(bad_rope)
 
     # loud failure on an unsupported architecture
     bad = tmp_path / "bad"

@@ -113,12 +113,42 @@ def test_kv_cache_gpt2_matches_recompute():
     assert fast == slow
 
 
-def test_kv_cache_unsupported_family_refuses():
+def test_kv_cache_moe_matches_recompute():
+    """The MoE cache path: routed FFN per decoded token (drop-free expert
+    dispatch in prefill/decode) through the shared cache contract. The
+    recompute side uses capacity_factor = num_experts so IT is drop-free
+    too — with zero drops on both sides, per-token routing is independent
+    of the other buffer rows and cached greedy must equal recompute."""
+    bundle = get_model("moe-debug", dtype=jnp.float32, capacity_factor=4.0)
+    params = bundle.init(bundle.config, jax.random.key(6))
+    prompt = [12, 3, 44]
+    slow = make_sampler(bundle)(params, prompt, 6)
+    fast = make_sampler(bundle, kv_cache=True)(params, prompt, 6)
+    assert fast == slow
+
+    # prefill logits == plain forward last position (router included)
+    from distributed_training_guide_tpu.models import moe
+
+    cache = moe.init_cache(bundle.config, 1, len(prompt) + 2)
+    ids = jnp.asarray(prompt, jnp.int32)[None, :]
+    logit, cache = moe.prefill(bundle.config, params, ids, cache)
+    full = bundle.apply(bundle.config, params, ids)
+    np.testing.assert_allclose(np.asarray(logit), np.asarray(full[:, -1]),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_sampler_library_length_guard():
+    """make_sampler used as a LIBRARY must refuse prompt+steps past the
+    position table (both modes) — the CLI-only check left silent jit
+    clamping (ADVICE r4)."""
     import pytest
 
-    bundle = get_model("moe-debug", dtype=jnp.float32)
-    with pytest.raises(ValueError, match="no KV-cached decode"):
-        make_sampler(bundle, kv_cache=True)
+    bundle = get_model("gpt2-debug", dtype=jnp.float32)
+    params = bundle.init(bundle.config, jax.random.key(0))
+    max_pos = bundle.config.max_position_embeddings
+    for kv in (False, True):
+        with pytest.raises(ValueError, match="max_position_embeddings"):
+            make_sampler(bundle, kv_cache=kv)(params, [1, 2], max_pos)
 
 
 def test_cli_hermetic_path(capsys):
