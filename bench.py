@@ -175,7 +175,7 @@ def _save_last_good(final: dict) -> dict | None:
                    ("model", "seq", "global_batch", "step_ms", "remat",
                     "remat_policy", "optimizer", "param_dtype",
                     "loss_chunks", "fence_every", "offload_opt_state",
-                    "n_chips", "device",
+                    "sliding_window", "n_chips", "device",
                     "steps_timed", "tokens_per_s_per_chip")
                    if k in detail},
     }
@@ -242,6 +242,8 @@ def run_rung(rung: dict) -> None:
         overrides["param_dtype"] = getattr(jnp, rung["param_dtype"])
     if rung.get("max_position"):  # raise the RoPE table past the preset's
         overrides["max_position_embeddings"] = rung["max_position"]
+    if rung.get("sliding_window"):  # banded flash kernel (SWA) rungs
+        overrides["sliding_window"] = rung["sliding_window"]
     bundle = get_model(rung["model"], **overrides)
     cfg = bundle.config
     seq = min(rung["seq"], cfg.max_position_embeddings)
@@ -296,6 +298,8 @@ def run_rung(rung: dict) -> None:
                    if rung.get("fence_every", 1) > 1 else {}),
                 **({"offload_opt_state": True}
                    if rung.get("offload_opt_state") else {}),
+                **({"sliding_window": rung["sliding_window"]}
+                   if rung.get("sliding_window") else {}),
                 "loss": round(loss, 4),
                 "steps_timed": steps_timed,
             },
@@ -597,6 +601,19 @@ SWEEP_QUEUE = [
     dict(name="fence4_seq32k_adafactor_b1_lc8", model="llama-650m", batch=1,
          seq=32768, max_position=32768, remat=True, remat_policy="attn",
          optimizer="adafactor", fence_every=4, loss_chunks=8),
+    # --- sliding-window rungs (round 5: the banded flash kernel skips kv
+    # tiles below the band, O(S*window) attention). A/B against the measured
+    # full-causal rows at the same shape: fence4_seq8k_adafactor_b4 (55.9%)
+    # and fence4_seq16k_adafactor_b2 (queued above). MFU here still counts
+    # full dense-causal attention FLOPs (the conventional accounting), so
+    # compare step_ms, not the MFU column, for the kernel-speedup claim.
+    dict(name="fence4_seq8k_swa2k_adafactor_b4", model="llama-650m", batch=4,
+         seq=8192, max_position=8192, sliding_window=2048, remat=True,
+         remat_policy="attn", optimizer="adafactor", fence_every=4),
+    dict(name="fence4_seq16k_swa2k_adafactor_b2", model="llama-650m",
+         batch=2, seq=16384, max_position=16384, sliding_window=2048,
+         remat=True, remat_policy="attn", optimizer="adafactor",
+         fence_every=4),
 ]
 
 

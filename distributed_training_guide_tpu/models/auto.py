@@ -1,12 +1,12 @@
 """AutoModel-style config ingestion: HF ``config.json`` -> a native bundle.
 
 The reference trains *any* HF causal LM via ``AutoModelForCausalLM``
-(``01-single-gpu/train_llm.py:57``). The native families here cover eight
+(``01-single-gpu/train_llm.py:57``). The native families here cover nine
 HF architectures; this module removes the remaining friction — needing a
 registry preset for every size variant. ``-m hf:<dir>`` (or
 ``get_model("hf:<dir>")``) reads the checkpoint's own ``config.json``,
 recognizes the architecture, and builds the exact family config — so any
-Llama/Mistral/Qwen2/Gemma/Phi-3/GPT-2/Mixtral/GPT-NeoX(Pythia)
+Llama/Mistral/Qwen2/Qwen3/Gemma/Phi-3/GPT-2/Mixtral/GPT-NeoX(Pythia)
 checkpoint trains (and converts, ``models/hf_convert.py``) without touching the
 registry:
 
@@ -29,7 +29,7 @@ def _sliding_window_kw(cfg: dict, arch: str) -> dict:
     window = cfg.get("sliding_window")
     if not window:
         return {}
-    if arch == "Qwen2ForCausalLM":
+    if arch in ("Qwen2ForCausalLM", "Qwen3ForCausalLM"):
         if not cfg.get("use_sliding_window"):
             return {}
         # HF additionally keeps the FIRST max_window_layers layers on full
@@ -108,6 +108,8 @@ def _build_llama(cfg: dict, arch: str):
         kw["attn_bias"] = cfg.get("attention_bias", True)
     else:
         kw["attn_bias"] = cfg.get("attention_bias", False)
+    if arch == "Qwen3ForCausalLM":  # per-head q/k RMSNorm, always on
+        kw["qk_norm"] = True
     act = cfg.get("hidden_act", "silu")
     if arch == "GemmaForCausalLM":
         kw.update(norm_plus_one=True, scale_embed=True,
@@ -184,6 +186,7 @@ _ARCH_BUILDERS = {
     "LlamaForCausalLM": ("llama", _build_llama),
     "MistralForCausalLM": ("llama", _build_llama),
     "Qwen2ForCausalLM": ("llama", _build_llama),
+    "Qwen3ForCausalLM": ("llama", _build_llama),
     "GemmaForCausalLM": ("llama", _build_llama),
     "GPT2LMHeadModel": ("gpt2", _build_gpt2),
     "MixtralForCausalLM": ("moe", _build_mixtral),
@@ -209,7 +212,8 @@ def config_from_hf(config_path: str | Path):
     # exports) — a present-but-unsupported arch (e.g. a classification
     # head) must hit the loud failure, not get remapped to causal LM
     by_type = {"llama": "LlamaForCausalLM", "mistral": "MistralForCausalLM",
-               "qwen2": "Qwen2ForCausalLM", "gemma": "GemmaForCausalLM",
+               "qwen2": "Qwen2ForCausalLM", "qwen3": "Qwen3ForCausalLM",
+               "gemma": "GemmaForCausalLM",
                "gpt2": "GPT2LMHeadModel", "mixtral": "MixtralForCausalLM",
                "gpt_neox": "GPTNeoXForCausalLM", "phi3": "Phi3ForCausalLM"}
     if not archs and cfg.get("model_type") in by_type:

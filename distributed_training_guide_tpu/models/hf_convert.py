@@ -67,6 +67,9 @@ def _map_llama(name: str):
             "self_attn.q_proj.bias": ("layers.attn.bq", False),
             "self_attn.k_proj.bias": ("layers.attn.bk", False),
             "self_attn.v_proj.bias": ("layers.attn.bv", False),
+            # Qwen3-style per-head q/k RMSNorm scales ([head_dim] vectors)
+            "self_attn.q_norm.weight": ("layers.attn.q_norm", False),
+            "self_attn.k_norm.weight": ("layers.attn.k_norm", False),
         }
         if rest in table:
             leaf, t = table[rest]

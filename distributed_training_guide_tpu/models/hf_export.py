@@ -68,6 +68,8 @@ def _emit_llama(config, leaves: dict) -> dict:
         "layers.attn.bq": ("self_attn.q_proj.bias", False),
         "layers.attn.bk": ("self_attn.k_proj.bias", False),
         "layers.attn.bv": ("self_attn.v_proj.bias", False),
+        "layers.attn.q_norm": ("self_attn.q_norm.weight", False),
+        "layers.attn.k_norm": ("self_attn.k_norm.weight", False),
     }
     for leaf, (hf, transpose) in per_layer.items():
         if leaf not in leaves:
@@ -247,7 +249,13 @@ def _hf_config(bundle) -> dict:
             out["sliding_window"] = c.sliding_window
         return out
     # llama family: the config knobs decide which architecture this is
-    if getattr(c, "norm_plus_one", False):
+    if getattr(c, "qk_norm", False):
+        base.update(architectures=["Qwen3ForCausalLM"], model_type="qwen3",
+                    head_dim=c.head_size, attention_bias=False)
+        if getattr(c, "sliding_window", None):  # Qwen3 gates SWA like Qwen2
+            base.update(sliding_window=c.sliding_window,
+                        use_sliding_window=True)
+    elif getattr(c, "norm_plus_one", False):
         base.update(architectures=["GemmaForCausalLM"], model_type="gemma",
                     head_dim=c.head_size,
                     hidden_act="gelu_pytorch_tanh",

@@ -55,6 +55,7 @@ class LlamaConfig:
     # attends keys with 0 <= i - j < window; None = full causal
     sliding_window: Optional[int] = None
     attn_bias: bool = False         # QKV projection biases (Qwen2-style)
+    qk_norm: bool = False           # per-head RMSNorm on q/k pre-rope (Qwen3)
     act_fn: str = "silu"            # MLP gate activation: silu | gelu_tanh (Gemma)
     norm_plus_one: bool = False     # RMSNorm scales by (1 + w) (Gemma)
     scale_embed: bool = False       # multiply embeddings by sqrt(hidden) (Gemma)
@@ -72,6 +73,8 @@ class LlamaConfig:
         per_layer = e * hq + 2 * e * hkv + hq * e + 3 * e * f + 2 * e
         if self.attn_bias:
             per_layer += hq + 2 * hkv
+        if self.qk_norm:
+            per_layer += 2 * self.head_size
         head = 0 if self.tie_word_embeddings else e * v
         return v * e + self.num_layers * per_layer + e + head
 
@@ -97,6 +100,9 @@ def init(config: LlamaConfig, rng: jax.Array) -> dict:
         attn.update(bq=jnp.zeros((l, hq), config.param_dtype),
                     bk=jnp.zeros((l, hkv), config.param_dtype),
                     bv=jnp.zeros((l, hkv), config.param_dtype))
+    if config.qk_norm:    # Qwen3 per-head q/k RMSNorm scales (ones, HF init)
+        attn.update(q_norm=jnp.ones((l, d), config.param_dtype),
+                    k_norm=jnp.ones((l, d), config.param_dtype))
     params = {
         "embed": {"embedding": dense(next(keys), (v, e))},
         "layers": {
@@ -131,6 +137,9 @@ def param_logical_axes(config: LlamaConfig) -> dict:
     if config.attn_bias:  # biases shard with the head dim they add onto
         attn_axes.update(bq=("layers", "heads"), bk=("layers", "kv"),
                          bv=("layers", "kv"))
+    if config.qk_norm:    # one [head_dim] scale shared by every head: never
+        attn_axes.update(q_norm=("layers", "head_dim_vector"),  # sharded
+                         k_norm=("layers", "head_dim_vector"))
     axes = {
         "embed": {"embedding": ("vocab", "embed")},
         "layers": {
@@ -204,6 +213,11 @@ def attention_sublayer(config, x: jnp.ndarray, attn_params: dict, norm_scale,
     q = q.reshape(b, s, -1, d)
     k = k.reshape(b, s, -1, d)
     v = v.reshape(b, s, -1, d)
+    if "q_norm" in attn_params:  # Qwen3: per-head RMSNorm pre-rope; the
+        # [head_dim] scale is head-independent, so it is replicated under
+        # manual tp (elementwise per head — no collective needed)
+        q = _rmsnorm(q, attn_params["q_norm"], config.rms_norm_eps)
+        k = _rmsnorm(k, attn_params["k_norm"], config.rms_norm_eps)
     rs = getattr(config, "rope_scaling", None)
     q = apply_rope(q, positions, config.rope_theta, rs,
                    config.max_position_embeddings)
@@ -510,4 +524,16 @@ PRESETS = {
                               num_layers=28, num_heads=28, num_kv_heads=4,
                               rope_theta=1e6, rms_norm_eps=1e-6, attn_bias=True,
                               max_position_embeddings=32768),
+    # Qwen3 dense = llama + per-head q/k RMSNorm (qk_norm) and NO qkv biases;
+    # explicit head_dim 128 regardless of hidden/heads (public model cards)
+    "qwen3-0.6b": LlamaConfig(vocab_size=151936, hidden_size=1024, intermediate_size=3072,
+                              num_layers=28, num_heads=16, num_kv_heads=8,
+                              head_dim=128, qk_norm=True, rope_theta=1e6,
+                              rms_norm_eps=1e-6, tie_word_embeddings=True,
+                              max_position_embeddings=40960),
+    "qwen3-8b": LlamaConfig(vocab_size=151936, hidden_size=4096, intermediate_size=12288,
+                            num_layers=36, num_heads=32, num_kv_heads=8,
+                            head_dim=128, qk_norm=True, rope_theta=1e6,
+                            rms_norm_eps=1e-6,
+                            max_position_embeddings=40960),
 }

@@ -71,6 +71,29 @@ def test_export_qwen_bias_roundtrip(tmp_path):
     _roundtrip(tmp_path, model, bundle, 128)
 
 
+def test_export_qwen3_qk_norm_roundtrip(tmp_path):
+    """The llama emitter's q_norm/k_norm leaves + the qk_norm -> Qwen3 arch
+    selection (randomized norm scales so identity can't mask a drop)."""
+    hf_cfg = transformers.Qwen3Config(
+        vocab_size=128, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        head_dim=32, max_position_embeddings=256, rope_theta=10000.0,
+        rms_norm_eps=1e-6, tie_word_embeddings=False)
+    torch.manual_seed(0)
+    model = transformers.Qwen3ForCausalLM(hf_cfg).eval()
+    with torch.no_grad():
+        for layer in model.model.layers:
+            layer.self_attn.q_norm.weight.normal_(1.0, 0.3)
+            layer.self_attn.k_norm.weight.normal_(1.0, 0.3)
+    bundle = get_model("qwen3-0.6b", vocab_size=128, hidden_size=64,
+                       intermediate_size=128, num_layers=2, num_heads=4,
+                       num_kv_heads=2, head_dim=32,
+                       max_position_embeddings=256, rope_theta=10000.0,
+                       rms_norm_eps=1e-6, tie_word_embeddings=False,
+                       dtype=jnp.float32)
+    _roundtrip(tmp_path, model, bundle, 128)
+
+
 def test_export_tied_llama_roundtrip(tmp_path):
     """tie_word_embeddings=True: the emitter must OMIT lm_head (HF re-ties
     from the embedding) and the reloaded logits still match."""

@@ -154,6 +154,43 @@ def test_qwen2_parity(tmp_path):
     np.testing.assert_allclose(ours, theirs, rtol=2e-4, atol=2e-4)
 
 
+def test_qwen3_parity(tmp_path):
+    """Qwen3 dense = Llama + per-head q/k RMSNorm before rope (qk_norm) and
+    an explicit head_dim decoupled from hidden/heads. Randomizes the norm
+    scales (HF inits them to ones — identity would not exercise the path)
+    and pins logits end to end through hf: ingestion."""
+    hf_cfg = transformers.Qwen3Config(
+        vocab_size=128, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        head_dim=32, max_position_embeddings=256, rope_theta=10000.0,
+        rms_norm_eps=1e-5, tie_word_embeddings=False)
+    torch.manual_seed(0)
+    model = transformers.Qwen3ForCausalLM(hf_cfg).eval()
+    with torch.no_grad():
+        for layer in model.model.layers:
+            layer.self_attn.q_norm.weight.normal_(1.0, 0.3)
+            layer.self_attn.k_norm.weight.normal_(1.0, 0.3)
+    model.save_pretrained(tmp_path / "hf", safe_serialization=True)
+
+    bundle = get_model(f"hf:{tmp_path / 'hf'}", dtype=jnp.float32)
+    assert bundle.config.qk_norm and not bundle.config.attn_bias
+    assert bundle.config.head_dim == 32
+    convert_hf_checkpoint(tmp_path / "hf", tmp_path / "conv", bundle=bundle)
+    plan = make_plan("single", make_mesh(devices=jax.devices()[:1]))
+    params = load_pretrained(bundle, _replicated_shardings(bundle, plan),
+                             tmp_path / "conv")
+    assert np.abs(np.asarray(params["layers"]["attn"]["q_norm"]) - 1).max() > 0
+
+    ids = np.random.RandomState(0).randint(0, 128, (2, 24))
+    ours = np.asarray(bundle.apply(bundle.config, params, jnp.asarray(ids)))
+    with torch.no_grad():
+        theirs = model(torch.tensor(ids)).logits.float().numpy()
+    np.testing.assert_allclose(ours, theirs, rtol=2e-4, atol=2e-4)
+
+    # pretrained -> one optimizer step through the qk_norm leaves
+    assert np.isfinite(_one_train_step(bundle, plan, params, ids))
+
+
 def test_gemma_parity(tmp_path):
     """Gemma = llama + three real architecture knobs: GeGLU (tanh-gelu
     gate), (1+w) RMSNorm scaling, sqrt(hidden)-scaled embeddings — plus MQA
@@ -276,6 +313,11 @@ def test_auto_hf_config_ingestion(tmp_path, caplog):
                                   num_attention_heads=4, num_key_value_heads=1,
                                   head_dim=16), "llama",
          lambda c: c.norm_plus_one and c.scale_embed and c.head_dim == 16),
+        (transformers.Qwen3Config(vocab_size=64, hidden_size=32,
+                                  intermediate_size=64, num_hidden_layers=2,
+                                  num_attention_heads=4, num_key_value_heads=2,
+                                  head_dim=16), "llama",
+         lambda c: c.qk_norm and not c.attn_bias and c.head_dim == 16),
         (transformers.GPT2Config(vocab_size=64, n_embd=32, n_layer=2,
                                  n_head=4), "gpt2",
          lambda c: c.num_layers == 2),

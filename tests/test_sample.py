@@ -113,6 +113,22 @@ def test_kv_cache_gpt2_matches_recompute():
     assert fast == slow
 
 
+def test_kv_cache_qwen3_qk_norm_matches_recompute():
+    """Qwen3's per-head q/k RMSNorm rides attention_sublayer, so the cache
+    path (k written post-norm+rope, like HF's cache) must reproduce the
+    recompute sampler's greedy tokens."""
+    bundle = get_model("qwen3-0.6b", vocab_size=256, hidden_size=64,
+                       intermediate_size=128, num_layers=2, num_heads=4,
+                       num_kv_heads=2, head_dim=16,
+                       max_position_embeddings=128, dtype=jnp.float32)
+    assert bundle.config.qk_norm
+    params = bundle.init(bundle.config, jax.random.key(7))
+    prompt = [4, 31]
+    slow = make_sampler(bundle)(params, prompt, 5)
+    fast = make_sampler(bundle, kv_cache=True)(params, prompt, 5)
+    assert fast == slow
+
+
 def test_kv_cache_moe_matches_recompute():
     """The MoE cache path: routed FFN per decoded token (drop-free expert
     dispatch in prefill/decode) through the shared cache contract. The
