@@ -116,6 +116,13 @@ def get_parser() -> argparse.ArgumentParser:
     parser.add_argument("--wandb-per-host", action="store_true",
                         help="grouped per-host runs instead of one process-0 "
                              "run (wandb-configurations pattern 2)")
+    parser.add_argument("--sliding-window", default=None, type=int,
+                        metavar="W",
+                        help="sliding-window attention: each token attends "
+                             "the previous W tokens only (banded flash "
+                             "kernel, O(S*W) attention). Overrides the "
+                             "model config; hf: checkpoints with "
+                             "sliding_window set enable this automatically")
     parser.add_argument("--param-dtype", default="float32",
                         choices=["float32", "bfloat16"],
                         help="parameter STORAGE dtype (compute is bf16 "
@@ -193,6 +200,8 @@ def run_training(args, plan_factory: Callable, *, extra_log: Optional[dict] = No
         import jax.numpy as jnp
         overrides["param_dtype"] = {"bfloat16": jnp.bfloat16,
                                     "float32": jnp.float32}[args.param_dtype]
+    if getattr(args, "sliding_window", None):
+        overrides["sliding_window"] = args.sliding_window
     bundle = get_model(args.model_name, **overrides)
     cfg = bundle.config
     LOGGER.info(f"Training {bundle.num_params():,} model parameters "

@@ -37,6 +37,18 @@ def test_run_training_ddp(tmp_path, eight_devices):
     assert out["last_info"]["tokens_per_s"] > 0
 
 
+def test_run_training_sliding_window_flag(tmp_path, eight_devices):
+    """--sliding-window W overrides the model config and trains through the
+    banded attention; loss differs from the full-causal run (the band binds)."""
+    full = run_training(make_args(tmp_path / "a"),
+                        lambda: make_plan("ddp", make_mesh()))
+    swa = run_training(make_args(tmp_path / "b", sliding_window=16),
+                       lambda: make_plan("ddp", make_mesh()))
+    assert np.isfinite(swa["last_info"]["running_loss"])
+    assert (abs(swa["last_info"]["running_loss"]
+                - full["last_info"]["running_loss"]) > 1e-6)
+
+
 def test_run_training_profile_trace(tmp_path, eight_devices):
     """--profile-dir captures a steady-state jax.profiler window (steps
     10-15, the C22 diagnostics surface) — never exercised by the other
