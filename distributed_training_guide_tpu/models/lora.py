@@ -1,0 +1,210 @@
+"""LoRA adapters for the llama family — parameter-efficient fine-tuning.
+
+Beyond the reference (it trains full parameters only): freeze the base
+checkpoint and train low-rank deltas ``W_eff = W + (alpha/r) * A @ B`` on
+chosen projection matrices. TPU-first formulation:
+
+- the base family keeps layers STACKED on a leading axis and scanned
+  (``models/llama.py``), so each adapter is one pair of stacked tensors
+  ``A [L, in, r]`` / ``B [L, r, out]`` — the merge is a single einsum per
+  target, inside the same scan-compiled block;
+- the merge happens at APPLY time (``W + scale * A@B`` materialized per
+  step): on TPU the delta einsum is tiny (r << in/out) and XLA fuses the
+  add into the consumer matmul's operand stream. Serving-style "merge once,
+  keep two weight copies" is ``merge_lora`` (export path);
+- adapters get their own leaves under ``params["lora"]`` with logical axes
+  derived from the base leaf's axes (A inherits the IN axis, B the OUT
+  axis, the rank dim is never sharded) — so fsdp/tp plans shard adapters
+  consistently with their base matrices and the optimizer-state rules
+  apply unchanged;
+- freezing is an optax mask (``lora_mask`` / ``mask_optimizer``), not a
+  separate code path: the Trainer still differentiates the whole tree, and
+  the masked transform zeroes base updates while keeping moments only for
+  the adapter leaves (MaskedNode elsewhere — ZeRO sharding rules still
+  structurally match).
+
+Usage (any chapter CLI): ``--lora-rank 8 [--lora-alpha 16]
+[--lora-targets wq,wv]`` — composes with ``--pretrained`` for the standard
+finetune-a-checkpoint flow, and with every sharding plan.
+"""
+from __future__ import annotations
+
+from functools import partial
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+
+from .registry import ModelBundle
+
+# target short-name -> key path into the llama-family params tree. All are
+# stacked [L, in, out] matmuls (biases/norms are not LoRA targets).
+TARGET_PATHS = {
+    "wq": ("layers", "attn", "wq"),
+    "wk": ("layers", "attn", "wk"),
+    "wv": ("layers", "attn", "wv"),
+    "wo": ("layers", "attn", "wo"),
+    "gate": ("layers", "mlp", "gate"),
+    "up": ("layers", "mlp", "up"),
+    "down": ("layers", "mlp", "down"),
+}
+
+DEFAULT_TARGETS = ("wq", "wv")   # the classic LoRA-paper pair
+
+
+def _get(tree, path):
+    for p in path:
+        tree = tree[p]
+    return tree
+
+
+def _set(tree, path, value):
+    """Return a copy of ``tree`` with ``path`` replaced by ``value``
+    (shallow-copies only the spine — other leaves stay shared)."""
+    if not path:
+        return value
+    out = dict(tree)
+    out[path[0]] = _set(tree[path[0]], path[1:], value)
+    return out
+
+
+def lora_bundle(base: ModelBundle, *, rank: int = 8, alpha: float = 16.0,
+                targets: Sequence[str] = DEFAULT_TARGETS) -> ModelBundle:
+    """Wrap ``base`` so params = {"base": <frozen>, "lora": {t: {"a","b"}}}.
+
+    B starts at zero, so step-0 logits are EXACTLY the base model's (pinned
+    by test). Only the llama family is supported — its targets cover six of
+    the nine HF architectures (llama/mistral/qwen2/qwen3/gemma/phi-3)."""
+    if base.family != "llama":
+        raise ValueError(
+            f"LoRA targets are defined for the llama family only (got "
+            f"{base.family!r}); gpt2/neox fuse QKV and moe stacks experts — "
+            f"extend TARGET_PATHS if you need them")
+    if rank < 1:
+        raise ValueError(f"lora rank must be >= 1, got {rank}")
+    unknown = [t for t in targets if t not in TARGET_PATHS]
+    if unknown:
+        raise ValueError(f"unknown lora targets {unknown}; "
+                         f"choose from {sorted(TARGET_PATHS)}")
+    targets = tuple(targets)
+    scale = alpha / rank
+    config = base.config
+
+    def init_adapters(cfg, rng):
+        """Adapter leaves only (shapes from an abstract base init — the
+        pretrained-load path must not materialize a random base model)."""
+        shapes = jax.eval_shape(lambda: base.init(cfg, jax.random.key(0)))
+        keys = iter(jax.random.split(rng, len(targets)))
+        lora = {}
+        for t in targets:
+            l, fan_in, fan_out = _get(shapes, TARGET_PATHS[t]).shape
+            lora[t] = {
+                # A ~ N(0, 0.02) like every other dense init here; B = 0 so
+                # the wrapped model starts exactly at the base function
+                "a": (0.02 * jax.random.normal(
+                    next(keys), (l, fan_in, rank), jnp.float32)
+                ).astype(cfg.param_dtype),
+                "b": jnp.zeros((l, rank, fan_out), cfg.param_dtype),
+            }
+        return lora
+
+    def init(cfg, rng):
+        return {"base": base.init(cfg, rng),
+                "lora": init_adapters(cfg, jax.random.fold_in(rng, 0x10FA))}
+
+    def merge(cfg, params):
+        merged = params["base"]
+        for t in targets:
+            pair = params["lora"][t]
+            w = _get(merged, TARGET_PATHS[t])
+            delta = jnp.einsum("lir,lro->lio", pair["a"].astype(w.dtype),
+                               pair["b"].astype(w.dtype))
+            merged = _set(merged, TARGET_PATHS[t],
+                          w + jnp.asarray(scale, w.dtype) * delta)
+        return merged
+
+    def apply(cfg, params, *args, **kwargs):
+        return base.apply(cfg, merge(cfg, params), *args, **kwargs)
+
+    def param_logical_axes(cfg):
+        base_axes = base.param_logical_axes(cfg)
+        lora_axes = {}
+        for t in targets:
+            layers_ax, in_ax, out_ax = _get(base_axes, TARGET_PATHS[t])
+            # the rank dim is tiny and never sharded; A/B inherit the base
+            # leaf's in/out axes so tp/fsdp plans place them with their matrix
+            lora_axes[t] = {"a": (layers_ax, in_ax, None),
+                            "b": (layers_ax, None, out_ax)}
+        return {"base": base_axes, "lora": lora_axes}
+
+    apply_with_aux = None
+    if base.apply_with_aux is not None:     # unreachable today (llama-only)
+        def apply_with_aux(cfg, params, *args, **kwargs):  # pragma: no cover
+            return base.apply_with_aux(cfg, merge(cfg, params), *args, **kwargs)
+
+    bundle = ModelBundle(
+        name=f"{base.name}+lora(r={rank},alpha={alpha:g},{','.join(targets)})",
+        config=config, init=init, apply=apply,
+        param_logical_axes=param_logical_axes, family=base.family,
+        apply_with_aux=apply_with_aux)
+    # non-dataclass attributes for tooling (merge_lora, the CLI loader)
+    object.__setattr__(bundle, "lora_base", base)
+    object.__setattr__(bundle, "lora_merge", merge)
+    object.__setattr__(bundle, "lora_init_adapters", init_adapters)
+    object.__setattr__(bundle, "lora_targets", targets)
+    object.__setattr__(bundle, "lora_rank", rank)
+    return bundle
+
+
+def load_pretrained_lora(bundle: ModelBundle, param_shardings, out_dir,
+                         seed: int = 0, param_dtype=None) -> dict:
+    """Pretrained BASE weights (converted checkpoint, sharded streaming
+    load) + fresh adapters placed on their plan shardings — the standard
+    finetune-a-checkpoint entry."""
+    from .hf_convert import load_pretrained
+
+    base = getattr(bundle, "lora_base", None)
+    if base is None:
+        raise ValueError("load_pretrained_lora needs a lora_bundle")
+    base_params = load_pretrained(base, param_shardings["base"], out_dir,
+                                  param_dtype)
+    init_ad = jax.jit(partial(bundle.lora_init_adapters, bundle.config),
+                      out_shardings=param_shardings["lora"])
+    return {"base": base_params, "lora": init_ad(jax.random.key(seed))}
+
+
+def num_trainable_params(bundle: ModelBundle) -> int:
+    shapes = jax.eval_shape(
+        lambda: bundle.lora_init_adapters(bundle.config, jax.random.key(0)))
+    return sum(int(jnp.prod(jnp.asarray(s.shape)))
+               for s in jax.tree.leaves(shapes))
+
+
+def merge_lora(bundle: ModelBundle, params: dict) -> dict:
+    """Fold the trained deltas into base-layout params (for ``hf_export``,
+    sampling via the base bundle, or publishing a plain checkpoint)."""
+    merge = getattr(bundle, "lora_merge", None)
+    if merge is None:
+        raise ValueError("merge_lora needs a bundle built by lora_bundle")
+    return merge(bundle.config, params)
+
+
+def lora_labels(params: dict) -> dict:
+    """"trainable" for adapter leaves, "frozen" for the base — the
+    optax.multi_transform label tree matching the params."""
+    return {
+        "base": jax.tree.map(lambda _: "frozen", params["base"]),
+        "lora": jax.tree.map(lambda _: "trainable", params["lora"]),
+    }
+
+
+def mask_optimizer(inner):
+    """Wrap any optax transform so it updates ONLY the adapters and ZEROES
+    the base updates. NOT ``optax.masked``: masked passes the RAW gradient
+    through for masked-out leaves (they would train unregularized — the
+    opposite of frozen). The callable label form works with abstract shapes
+    (eval_shape in the Trainer's sharding derivation)."""
+    import optax
+
+    return optax.multi_transform(
+        {"trainable": inner, "frozen": optax.set_to_zero()}, lora_labels)

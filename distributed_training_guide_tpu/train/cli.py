@@ -116,6 +116,16 @@ def get_parser() -> argparse.ArgumentParser:
     parser.add_argument("--wandb-per-host", action="store_true",
                         help="grouped per-host runs instead of one process-0 "
                              "run (wandb-configurations pattern 2)")
+    parser.add_argument("--lora-rank", default=0, type=int, metavar="R",
+                        help="train LoRA adapters of rank R on a FROZEN "
+                             "base model instead of full parameters "
+                             "(llama family; composes with --pretrained "
+                             "and every sharding plan). 0 = off")
+    parser.add_argument("--lora-alpha", default=16.0, type=float,
+                        help="LoRA scale numerator (delta = alpha/R * A@B)")
+    parser.add_argument("--lora-targets", default="wq,wv",
+                        help="comma list of adapted projections "
+                             "(wq,wk,wv,wo,gate,up,down)")
     parser.add_argument("--sliding-window", default=None, type=int,
                         metavar="W",
                         help="sliding-window attention: each token attends "
@@ -204,13 +214,27 @@ def run_training(args, plan_factory: Callable, *, extra_log: Optional[dict] = No
         overrides["sliding_window"] = args.sliding_window
     bundle = get_model(args.model_name, **overrides)
     cfg = bundle.config
+    optimizer = OPTIMIZERS[args.optimizer](args.lr)
+    lora_rank = getattr(args, "lora_rank", 0)
+    if lora_rank:
+        from ..models.lora import lora_bundle, mask_optimizer, num_trainable_params
+
+        bundle = lora_bundle(bundle, rank=lora_rank,
+                             alpha=getattr(args, "lora_alpha", 16.0),
+                             targets=tuple(
+                                 getattr(args, "lora_targets",
+                                         "wq,wv").split(",")))
+        optimizer = mask_optimizer(optimizer)
+        LOGGER.info(f"LoRA: rank {lora_rank}, "
+                    f"{num_trainable_params(bundle):,} trainable adapter "
+                    f"params over a frozen {bundle.num_params():,}-param base")
     LOGGER.info(f"Training {bundle.num_params():,} model parameters "
                 f"on mesh {dict(plan.mesh.shape)} strategy={plan.strategy}")
 
     seq_length = min(args.seq_length, cfg.max_position_embeddings)
     trainer = Trainer(
         bundle=bundle,
-        optimizer=OPTIMIZERS[args.optimizer](args.lr),
+        optimizer=optimizer,
         plan=plan,
         grad_accum=args.grad_accum,
         remat=args.checkpoint_activations,
@@ -264,10 +288,17 @@ def run_training(args, plan_factory: Callable, *, extra_log: Optional[dict] = No
         state, host_state = io.restore(abstract_train_state(trainer))
         LOGGER.info(f"Resumed=True | {host_state}")
     elif pretrained_dir:
-        from ..models.hf_convert import load_pretrained
-
         LOGGER.info(f"Loading pretrained weights from {pretrained_dir}")
-        params = load_pretrained(bundle, trainer.param_shardings, pretrained_dir)
+        if lora_rank:
+            from ..models.lora import load_pretrained_lora
+
+            params = load_pretrained_lora(bundle, trainer.param_shardings,
+                                          pretrained_dir, seed=args.seed)
+        else:
+            from ..models.hf_convert import load_pretrained
+
+            params = load_pretrained(bundle, trainer.param_shardings,
+                                     pretrained_dir)
         state = trainer.init_state_from_params(params, args.seed)
         if is_experiment:
             LOGGER.info(f"Resumed=False | {host_state}")
