@@ -1,12 +1,12 @@
 """AutoModel-style config ingestion: HF ``config.json`` -> a native bundle.
 
 The reference trains *any* HF causal LM via ``AutoModelForCausalLM``
-(``01-single-gpu/train_llm.py:57``). The native families here cover nine
+(``01-single-gpu/train_llm.py:57``). The native families here cover ten
 HF architectures; this module removes the remaining friction — needing a
 registry preset for every size variant. ``-m hf:<dir>`` (or
 ``get_model("hf:<dir>")``) reads the checkpoint's own ``config.json``,
 recognizes the architecture, and builds the exact family config — so any
-Llama/Mistral/Qwen2/Qwen3/Gemma/Phi-3/GPT-2/Mixtral/GPT-NeoX(Pythia)
+Llama/Mistral/Qwen2/Qwen3/Gemma/Phi-3/OLMo-2/GPT-2/Mixtral/GPT-NeoX(Pythia)
 checkpoint trains (and converts, ``models/hf_convert.py``) without touching the
 registry:
 
@@ -110,6 +110,10 @@ def _build_llama(cfg: dict, arch: str):
         kw["attn_bias"] = cfg.get("attention_bias", False)
     if arch == "Qwen3ForCausalLM":  # per-head q/k RMSNorm, always on
         kw["qk_norm"] = True
+    if arch == "Olmo2ForCausalLM":
+        # OLMo-2: post-norm block wiring (norms on sublayer OUTPUTS) and
+        # FULL-WIDTH q/k RMSNorm applied before the head reshape
+        kw.update(post_norm=True, qk_norm="flat")
     act = cfg.get("hidden_act", "silu")
     if arch == "GemmaForCausalLM":
         kw.update(norm_plus_one=True, scale_embed=True,
@@ -187,6 +191,7 @@ _ARCH_BUILDERS = {
     "MistralForCausalLM": ("llama", _build_llama),
     "Qwen2ForCausalLM": ("llama", _build_llama),
     "Qwen3ForCausalLM": ("llama", _build_llama),
+    "Olmo2ForCausalLM": ("llama", _build_llama),
     "GemmaForCausalLM": ("llama", _build_llama),
     "GPT2LMHeadModel": ("gpt2", _build_gpt2),
     "MixtralForCausalLM": ("moe", _build_mixtral),
@@ -213,7 +218,7 @@ def config_from_hf(config_path: str | Path):
     # head) must hit the loud failure, not get remapped to causal LM
     by_type = {"llama": "LlamaForCausalLM", "mistral": "MistralForCausalLM",
                "qwen2": "Qwen2ForCausalLM", "qwen3": "Qwen3ForCausalLM",
-               "gemma": "GemmaForCausalLM",
+               "gemma": "GemmaForCausalLM", "olmo2": "Olmo2ForCausalLM",
                "gpt2": "GPT2LMHeadModel", "mixtral": "MixtralForCausalLM",
                "gpt_neox": "GPTNeoXForCausalLM", "phi3": "Phi3ForCausalLM"}
     if not archs and cfg.get("model_type") in by_type:

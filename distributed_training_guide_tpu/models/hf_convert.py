@@ -212,6 +212,8 @@ def _make_map_llama(config):
     hq, hkv = config.num_heads * d, config.num_kv_heads * d
     f = config.intermediate_size
 
+    post_norm = getattr(config, "post_norm", False)
+
     def mapper(name: str):
         m = re.match(r"model\.layers\.(\d+)\.(.+)", name)
         if m:
@@ -223,6 +225,14 @@ def _make_map_llama(config):
             if rest == "mlp.gate_up_proj.weight":
                 return [("layers.mlp.gate", idx, lambda w: w[:f].T),
                         ("layers.mlp.up", idx, lambda w: w[f:].T)]
+            if post_norm:
+                # OLMo-2 reuses llama's post_attention_layernorm NAME but
+                # applies it to the attention OUTPUT; plus a new
+                # post_feedforward_layernorm on the MLP output
+                if rest == "post_attention_layernorm.weight":
+                    return "layers.attn_out_norm", idx, False
+                if rest == "post_feedforward_layernorm.weight":
+                    return "layers.mlp_out_norm", idx, False
         return _map_llama(name)
 
     return mapper

@@ -70,6 +70,10 @@ def _emit_llama(config, leaves: dict) -> dict:
         "layers.attn.bv": ("self_attn.v_proj.bias", False),
         "layers.attn.q_norm": ("self_attn.q_norm.weight", False),
         "layers.attn.k_norm": ("self_attn.k_norm.weight", False),
+        # OLMo-2 post-norm wiring (note: HF reuses the
+        # post_attention_layernorm NAME for the attn-OUTPUT norm)
+        "layers.attn_out_norm": ("post_attention_layernorm.weight", False),
+        "layers.mlp_out_norm": ("post_feedforward_layernorm.weight", False),
     }
     for leaf, (hf, transpose) in per_layer.items():
         if leaf not in leaves:
@@ -249,7 +253,10 @@ def _hf_config(bundle) -> dict:
             out["sliding_window"] = c.sliding_window
         return out
     # llama family: the config knobs decide which architecture this is
-    if getattr(c, "qk_norm", False):
+    if getattr(c, "post_norm", False):
+        base.update(architectures=["Olmo2ForCausalLM"], model_type="olmo2",
+                    attention_bias=False)
+    elif getattr(c, "qk_norm", False):
         base.update(architectures=["Qwen3ForCausalLM"], model_type="qwen3",
                     head_dim=c.head_size, attention_bias=False)
         if getattr(c, "sliding_window", None):  # Qwen3 gates SWA like Qwen2

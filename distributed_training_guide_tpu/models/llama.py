@@ -55,7 +55,13 @@ class LlamaConfig:
     # attends keys with 0 <= i - j < window; None = full causal
     sliding_window: Optional[int] = None
     attn_bias: bool = False         # QKV projection biases (Qwen2-style)
-    qk_norm: bool = False           # per-head RMSNorm on q/k pre-rope (Qwen3)
+    # RMSNorm on q/k pre-rope: False | True (per-head [head_dim], Qwen3) |
+    # "flat" (full-width [heads*head_dim], applied before the head reshape,
+    # OLMo-2)
+    qk_norm: Any = False
+    # OLMo-2 block wiring: NO pre-norms; RMSNorm applied to each sublayer's
+    # OUTPUT before the residual add (x = x + norm(attn(x)))
+    post_norm: bool = False
     act_fn: str = "silu"            # MLP gate activation: silu | gelu_tanh (Gemma)
     norm_plus_one: bool = False     # RMSNorm scales by (1 + w) (Gemma)
     scale_embed: bool = False       # multiply embeddings by sqrt(hidden) (Gemma)
@@ -73,7 +79,9 @@ class LlamaConfig:
         per_layer = e * hq + 2 * e * hkv + hq * e + 3 * e * f + 2 * e
         if self.attn_bias:
             per_layer += hq + 2 * hkv
-        if self.qk_norm:
+        if self.qk_norm == "flat":
+            per_layer += hq + hkv
+        elif self.qk_norm:
             per_layer += 2 * self.head_size
         head = 0 if self.tie_word_embeddings else e * v
         return v * e + self.num_layers * per_layer + e + head
@@ -100,21 +108,29 @@ def init(config: LlamaConfig, rng: jax.Array) -> dict:
         attn.update(bq=jnp.zeros((l, hq), config.param_dtype),
                     bk=jnp.zeros((l, hkv), config.param_dtype),
                     bv=jnp.zeros((l, hkv), config.param_dtype))
-    if config.qk_norm:    # Qwen3 per-head q/k RMSNorm scales (ones, HF init)
+    if config.qk_norm == "flat":  # OLMo-2 full-width q/k RMSNorm scales
+        attn.update(q_norm=jnp.ones((l, hq), config.param_dtype),
+                    k_norm=jnp.ones((l, hkv), config.param_dtype))
+    elif config.qk_norm:  # Qwen3 per-head q/k RMSNorm scales (ones, HF init)
         attn.update(q_norm=jnp.ones((l, d), config.param_dtype),
                     k_norm=jnp.ones((l, d), config.param_dtype))
+    layers = {
+        "attn": attn,
+        "mlp": {
+            "gate": dense(next(keys), (l, e, f)),
+            "up": dense(next(keys), (l, e, f)),
+            "down": dense(next(keys), (l, f, e)),
+        },
+    }
+    if config.post_norm:   # OLMo-2: norms sit on the sublayer OUTPUTS
+        layers.update(attn_out_norm=jnp.ones((l, e), config.param_dtype),
+                      mlp_out_norm=jnp.ones((l, e), config.param_dtype))
+    else:
+        layers.update(input_norm=jnp.ones((l, e), config.param_dtype),
+                      post_attn_norm=jnp.ones((l, e), config.param_dtype))
     params = {
         "embed": {"embedding": dense(next(keys), (v, e))},
-        "layers": {
-            "attn": attn,
-            "mlp": {
-                "gate": dense(next(keys), (l, e, f)),
-                "up": dense(next(keys), (l, e, f)),
-                "down": dense(next(keys), (l, f, e)),
-            },
-            "input_norm": jnp.ones((l, e), config.param_dtype),
-            "post_attn_norm": jnp.ones((l, e), config.param_dtype),
-        },
+        "layers": layers,
         "final_norm": jnp.ones((e,), config.param_dtype),
     }
     if not config.tie_word_embeddings:
@@ -137,21 +153,29 @@ def param_logical_axes(config: LlamaConfig) -> dict:
     if config.attn_bias:  # biases shard with the head dim they add onto
         attn_axes.update(bq=("layers", "heads"), bk=("layers", "kv"),
                          bv=("layers", "kv"))
-    if config.qk_norm:    # one [head_dim] scale shared by every head: never
+    if config.qk_norm == "flat":  # full-width scales shard with their heads
+        attn_axes.update(q_norm=("layers", "heads_vector"),
+                         k_norm=("layers", "kv_vector"))
+    elif config.qk_norm:  # one [head_dim] scale shared by every head: never
         attn_axes.update(q_norm=("layers", "head_dim_vector"),  # sharded
                          k_norm=("layers", "head_dim_vector"))
+    layer_axes = {
+        "attn": attn_axes,
+        "mlp": {
+            "gate": ("layers", "embed", "mlp"),
+            "up": ("layers", "embed", "mlp"),
+            "down": ("layers", "mlp", "embed"),
+        },
+    }
+    if config.post_norm:
+        layer_axes.update(attn_out_norm=("layers", "embed_vector"),
+                          mlp_out_norm=("layers", "embed_vector"))
+    else:
+        layer_axes.update(input_norm=("layers", "embed_vector"),
+                          post_attn_norm=("layers", "embed_vector"))
     axes = {
         "embed": {"embedding": ("vocab", "embed")},
-        "layers": {
-            "attn": attn_axes,
-            "mlp": {
-                "gate": ("layers", "embed", "mlp"),
-                "up": ("layers", "embed", "mlp"),
-                "down": ("layers", "mlp", "embed"),
-            },
-            "input_norm": ("layers", "embed_vector"),
-            "post_attn_norm": ("layers", "embed_vector"),
-        },
+        "layers": layer_axes,
         "final_norm": ("embed_vector",),
     }
     if not config.tie_word_embeddings:
@@ -203,19 +227,28 @@ def attention_sublayer(config, x: jnp.ndarray, attn_params: dict, norm_scale,
     b, s, e = x.shape
     d = config.head_size
     cdt = config.dtype
-    h = _rmsnorm(x, norm_scale, config.rms_norm_eps,
-                 getattr(config, "norm_plus_one", False))
+    if norm_scale is None:  # post-norm wiring (OLMo-2): raw residual in;
+        h = x               # the caller norms the OUTPUT instead
+    else:
+        h = _rmsnorm(x, norm_scale, config.rms_norm_eps,
+                     getattr(config, "norm_plus_one", False))
     q, k, v = (h @ attn_params[w].astype(cdt) for w in ("wq", "wk", "wv"))
     if "bq" in attn_params:  # Qwen2-style QKV biases; shard-local under
         q = q + attn_params["bq"].astype(cdt)  # manual tp (bias carries the
         k = k + attn_params["bk"].astype(cdt)  # same heads/kv logical axis
         v = v + attn_params["bv"].astype(cdt)  # as its matmul output)
+    qk_mode = getattr(config, "qk_norm", False)
+    if qk_mode == "flat":  # OLMo-2: full-width RMSNorm BEFORE the head
+        # reshape; the [hq]/[hkv] scales carry heads/kv logical axes, so
+        # under manual tp each member's shard matches its local width
+        q = _rmsnorm(q, attn_params["q_norm"], config.rms_norm_eps)
+        k = _rmsnorm(k, attn_params["k_norm"], config.rms_norm_eps)
     q = q.reshape(b, s, -1, d)
     k = k.reshape(b, s, -1, d)
     v = v.reshape(b, s, -1, d)
-    if "q_norm" in attn_params:  # Qwen3: per-head RMSNorm pre-rope; the
-        # [head_dim] scale is head-independent, so it is replicated under
-        # manual tp (elementwise per head — no collective needed)
+    if qk_mode is True:  # Qwen3: per-head RMSNorm pre-rope; the [head_dim]
+        # scale is head-independent, so it is replicated under manual tp
+        # (elementwise per head — no collective needed)
         q = _rmsnorm(q, attn_params["q_norm"], config.rms_norm_eps)
         k = _rmsnorm(k, attn_params["k_norm"], config.rms_norm_eps)
     rs = getattr(config, "rope_scaling", None)
@@ -252,10 +285,16 @@ def attention_sublayer(config, x: jnp.ndarray, attn_params: dict, norm_scale,
 
 def mlp_sublayer(config, x: jnp.ndarray, layer: dict,
                  tp_axis: Optional[str] = None) -> jnp.ndarray:
-    """post-attn norm -> gated MLP (residual added by caller)."""
+    """post-attn norm -> gated MLP (residual added by caller). Under
+    post-norm wiring (no ``post_attn_norm`` leaf) the raw stream feeds the
+    MLP and the caller norms the output."""
     cdt = config.dtype
-    h = _rmsnorm(x, layer["post_attn_norm"], config.rms_norm_eps,
-                 getattr(config, "norm_plus_one", False))
+    scale = layer.get("post_attn_norm")
+    if scale is None:
+        h = x
+    else:
+        h = _rmsnorm(x, scale, config.rms_norm_eps,
+                     getattr(config, "norm_plus_one", False))
     gate = h @ layer["mlp"]["gate"].astype(cdt)
     up = h @ layer["mlp"]["up"].astype(cdt)
     act_fn = ACT_FNS[getattr(config, "act_fn", "silu")]
@@ -277,6 +316,16 @@ def _block(config: LlamaConfig, x: jnp.ndarray, layer: dict,
         if activation_sharding is not None:
             return jax.lax.with_sharding_constraint(y, activation_sharding)
         return y
+
+    if getattr(config, "post_norm", False):   # OLMo-2 wiring
+        attn = attention_sublayer(config, x, layer["attn"], None,
+                                  positions, attn_impl, standard_layout,
+                                  tp_axis)
+        x = constrain(x + _rmsnorm(attn, layer["attn_out_norm"],
+                                   config.rms_norm_eps))
+        mlp = mlp_sublayer(config, x, layer, tp_axis)
+        return constrain(x + _rmsnorm(mlp, layer["mlp_out_norm"],
+                                      config.rms_norm_eps))
 
     attn = attention_sublayer(config, x, layer["attn"], layer["input_norm"],
                               positions, attn_impl, standard_layout, tp_axis)
@@ -380,6 +429,19 @@ def apply(
 # Training paths are unaffected (separate entry points).
 # ---------------------------------------------------------------------------
 
+def _decode_residuals(config, x, layer, attn):
+    """Shared residual wiring for the prefill/decode bodies (pre- and
+    post-norm variants); returns (new_x, None)."""
+    if getattr(config, "post_norm", False):
+        x = x + _rmsnorm(attn, layer["attn_out_norm"], config.rms_norm_eps)
+        x = x + _rmsnorm(mlp_sublayer(config, x, layer),
+                         layer["mlp_out_norm"], config.rms_norm_eps)
+    else:
+        x = x + attn
+        x = x + mlp_sublayer(config, x, layer)
+    return x, None
+
+
 def init_cache(config: LlamaConfig, batch: int, max_len: int) -> dict:
     """Zeroed per-layer KV cache, [L, B, max_len, kv_heads, head_dim]."""
     shape = (config.num_layers, batch, max_len, config.num_kv_heads,
@@ -399,10 +461,10 @@ def prefill(config: LlamaConfig, params: dict, input_ids: jnp.ndarray,
     def body(x, inputs):
         layer, ck, cv = inputs
         attn, (k, v) = attention_sublayer(
-            config, x, layer["attn"], layer["input_norm"], positions,
+            config, x, layer["attn"],
+            None if config.post_norm else layer["input_norm"], positions,
             "xla", return_kv=True)
-        x = x + attn
-        x = x + mlp_sublayer(config, x, layer)
+        x, _ = _decode_residuals(config, x, layer, attn)
         nk = jax.lax.dynamic_update_slice(ck, k.astype(ck.dtype), (0, 0, 0, 0))
         nv = jax.lax.dynamic_update_slice(cv, v.astype(cv.dtype), (0, 0, 0, 0))
         return x, (nk, nv)
@@ -428,10 +490,10 @@ def decode_step(config: LlamaConfig, params: dict, token_ids: jnp.ndarray,
     def body(x, inputs):
         layer, ck, cv = inputs
         attn, (nk, nv) = attention_sublayer(
-            config, x, layer["attn"], layer["input_norm"], positions,
+            config, x, layer["attn"],
+            None if config.post_norm else layer["input_norm"], positions,
             "xla", kv_cache=(ck, cv, pos), return_kv=True)
-        x = x + attn
-        x = x + mlp_sublayer(config, x, layer)
+        x, _ = _decode_residuals(config, x, layer, attn)
         return x, (nk, nv)
 
     x, (ks, vs) = jax.lax.scan(body, x, (params["layers"],
@@ -536,4 +598,11 @@ PRESETS = {
                             head_dim=128, qk_norm=True, rope_theta=1e6,
                             rms_norm_eps=1e-6,
                             max_position_embeddings=40960),
+    # OLMo-2 = llama + post-norm block wiring (norms on sublayer outputs)
+    # + full-width q/k RMSNorm; MHA (kv == heads), public 1124-7B card
+    "olmo2-7b": LlamaConfig(vocab_size=100352, hidden_size=4096, intermediate_size=11008,
+                            num_layers=32, num_heads=32, num_kv_heads=32,
+                            post_norm=True, qk_norm="flat",
+                            rope_theta=500000.0, rms_norm_eps=1e-6,
+                            max_position_embeddings=4096),
 }

@@ -58,6 +58,7 @@ STRATEGIES: dict[str, dict[str, Any]] = {
         "mlp": "tp",
         "vocab": "tp",
         "heads_vector": "tp",
+        "kv_vector": "tp",
         "mlp_vector": "tp",
     },
     # chapter 07: 2-D = FSDP x TP on orthogonal axes
@@ -67,6 +68,7 @@ STRATEGIES: dict[str, dict[str, Any]] = {
         "mlp": "tp",
         "vocab": "tp",
         "heads_vector": "tp",
+        "kv_vector": "tp",
         "mlp_vector": "tp",
         "embed": "fsdp",
     },
@@ -75,11 +77,13 @@ STRATEGIES: dict[str, dict[str, Any]] = {
     "pp": {"layers": "pp"},
     "pp_fsdp": {"layers": "pp", "embed": "fsdp", "vocab": "fsdp"},
     "pp_tp": {"layers": "pp", "heads": "tp", "kv": "tp", "mlp": "tp",
-              "vocab": "tp", "heads_vector": "tp", "mlp_vector": "tp"},
+              "vocab": "tp", "heads_vector": "tp", "kv_vector": "tp",
+              "mlp_vector": "tp"},
     # pp x tp x fsdp: tp is manual inside the pipeline shard_map (megatron
     # shards + vocab-parallel embed/head), fsdp stays auto on the embed dim
     "pp_tp_fsdp": {"layers": "pp", "heads": "tp", "kv": "tp", "mlp": "tp",
-                   "vocab": "tp", "heads_vector": "tp", "mlp_vector": "tp",
+                   "vocab": "tp", "heads_vector": "tp", "kv_vector": "tp",
+                   "mlp_vector": "tp",
                    "embed": "fsdp"},
     # chapter 10 (beyond the reference): MoE expert parallelism — the expert
     # dim of stacked expert weights lives on ep; GSPMD derives the token

@@ -94,6 +94,28 @@ def test_export_qwen3_qk_norm_roundtrip(tmp_path):
     _roundtrip(tmp_path, model, bundle, 128)
 
 
+def test_export_olmo2_post_norm_roundtrip(tmp_path):
+    """The post-norm leaves (attn_out_norm/mlp_out_norm, flat q/k norms) +
+    the post_norm -> Olmo2 arch selection through AutoModel reload."""
+    hf_cfg = transformers.Olmo2Config(
+        vocab_size=128, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=256, rope_theta=10000.0, rms_norm_eps=1e-6,
+        tie_word_embeddings=False)
+    torch.manual_seed(0)
+    model = transformers.Olmo2ForCausalLM(hf_cfg).eval()
+    with torch.no_grad():
+        for layer in model.model.layers:
+            layer.post_attention_layernorm.weight.normal_(1.0, 0.3)
+            layer.post_feedforward_layernorm.weight.normal_(1.0, 0.3)
+    bundle = get_model("olmo2-7b", vocab_size=128, hidden_size=64,
+                       intermediate_size=128, num_layers=2, num_heads=4,
+                       num_kv_heads=2, max_position_embeddings=256,
+                       rope_theta=10000.0, rms_norm_eps=1e-6,
+                       dtype=jnp.float32)
+    _roundtrip(tmp_path, model, bundle, 128)
+
+
 def test_export_tied_llama_roundtrip(tmp_path):
     """tie_word_embeddings=True: the emitter must OMIT lm_head (HF re-ties
     from the embedding) and the reloaded logits still match."""

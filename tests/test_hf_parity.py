@@ -191,6 +191,45 @@ def test_qwen3_parity(tmp_path):
     assert np.isfinite(_one_train_step(bundle, plan, params, ids))
 
 
+def test_olmo2_parity(tmp_path):
+    """OLMo-2 = llama math with two real wiring changes: POST-norm blocks
+    (x + norm(attn(x)), x + norm(mlp(x)) — no pre-norms) and FULL-WIDTH q/k
+    RMSNorm applied before the head reshape. Randomizes the norm scales and
+    pins logits end to end through hf: ingestion."""
+    hf_cfg = transformers.Olmo2Config(
+        vocab_size=128, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=256, rope_theta=10000.0, rms_norm_eps=1e-5,
+        tie_word_embeddings=False)
+    torch.manual_seed(0)
+    model = transformers.Olmo2ForCausalLM(hf_cfg).eval()
+    with torch.no_grad():
+        for layer in model.model.layers:
+            layer.self_attn.q_norm.weight.normal_(1.0, 0.3)
+            layer.self_attn.k_norm.weight.normal_(1.0, 0.3)
+            layer.post_attention_layernorm.weight.normal_(1.0, 0.3)
+            layer.post_feedforward_layernorm.weight.normal_(1.0, 0.3)
+    model.save_pretrained(tmp_path / "hf", safe_serialization=True)
+
+    bundle = get_model(f"hf:{tmp_path / 'hf'}", dtype=jnp.float32)
+    assert bundle.config.post_norm and bundle.config.qk_norm == "flat"
+    convert_hf_checkpoint(tmp_path / "hf", tmp_path / "conv", bundle=bundle)
+    plan = make_plan("single", make_mesh(devices=jax.devices()[:1]))
+    params = load_pretrained(bundle, _replicated_shardings(bundle, plan),
+                             tmp_path / "conv")
+    assert "attn_out_norm" in params["layers"]
+    assert params["layers"]["attn"]["q_norm"].shape[-1] == 64  # full width
+
+    ids = np.random.RandomState(0).randint(0, 128, (2, 24))
+    ours = np.asarray(bundle.apply(bundle.config, params, jnp.asarray(ids)))
+    with torch.no_grad():
+        theirs = model(torch.tensor(ids)).logits.float().numpy()
+    np.testing.assert_allclose(ours, theirs, rtol=2e-4, atol=2e-4)
+
+    # pretrained -> one optimizer step through the post-norm wiring
+    assert np.isfinite(_one_train_step(bundle, plan, params, ids))
+
+
 def test_gemma_parity(tmp_path):
     """Gemma = llama + three real architecture knobs: GeGLU (tanh-gelu
     gate), (1+w) RMSNorm scaling, sqrt(hidden)-scaled embeddings — plus MQA
@@ -318,6 +357,10 @@ def test_auto_hf_config_ingestion(tmp_path, caplog):
                                   num_attention_heads=4, num_key_value_heads=2,
                                   head_dim=16), "llama",
          lambda c: c.qk_norm and not c.attn_bias and c.head_dim == 16),
+        (transformers.Olmo2Config(vocab_size=64, hidden_size=32,
+                                  intermediate_size=64, num_hidden_layers=2,
+                                  num_attention_heads=4, num_key_value_heads=2),
+         "llama", lambda c: c.post_norm and c.qk_norm == "flat"),
         (transformers.GPT2Config(vocab_size=64, n_embd=32, n_layer=2,
                                  n_head=4), "gpt2",
          lambda c: c.num_layers == 2),

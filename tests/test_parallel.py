@@ -161,6 +161,38 @@ def test_qwen_bias_tp_matches_single_device(eight_devices):
     np.testing.assert_allclose(got, golden, rtol=1e-4)
 
 
+def test_olmo2_post_norm_tp_matches_single_device(eight_devices):
+    """OLMo-2 wiring under tensor parallelism: the FULL-WIDTH q/k norm
+    scales carry heads/kv logical axes (the kv_vector rule), so tp shards
+    them column-wise with their projections, and the post-norm residuals
+    ride the tp psum outputs — trajectory must match single-device."""
+    bundle = get_model("olmo2-7b", vocab_size=512, hidden_size=64,
+                       intermediate_size=128, num_layers=2, num_heads=4,
+                       num_kv_heads=2, max_position_embeddings=256,
+                       dtype=jnp.float32)
+    assert bundle.config.post_norm and bundle.config.qk_norm == "flat"
+
+    def run(strategy, mesh):
+        t = Trainer(bundle=bundle, optimizer=adamw_cosine(1e-3),
+                    plan=make_plan(strategy, mesh), donate=False)
+        state = t.init_state(0)
+        if strategy == "tp":   # flat norms shard over their heads/kv dim
+            kn = state.params["layers"]["attn"]["k_norm"]
+            assert "tp" in jax.tree.leaves(tuple(kn.sharding.spec)), kn.sharding
+        ids = np.random.RandomState(0).randint(0, 512, (GLOBAL_BATCH, SEQ))
+        batch = {k: jax.device_put(jnp.asarray(ids), t.batch_shardings()[k])
+                 for k in ("input_ids", "labels")}
+        losses = []
+        for _ in range(2):
+            state, m = t.step_fn(state, batch)
+            losses.append(float(m["loss"]))
+        return losses
+
+    golden = run("single", make_mesh(devices=jax.devices()[:1]))
+    got = run("tp", make_mesh(tp=2))
+    np.testing.assert_allclose(got, golden, rtol=1e-4)
+
+
 def test_params_actually_sharded(eight_devices):
     trainer = make_trainer("fsdp", fsdp=8)
     state = trainer.init_state(0)

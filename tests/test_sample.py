@@ -129,6 +129,21 @@ def test_kv_cache_qwen3_qk_norm_matches_recompute():
     assert fast == slow
 
 
+def test_kv_cache_olmo2_post_norm_matches_recompute():
+    """OLMo-2's post-norm wiring through the cache path: the decode body's
+    residuals norm the sublayer OUTPUTS; cached greedy must equal recompute."""
+    bundle = get_model("olmo2-7b", vocab_size=256, hidden_size=64,
+                       intermediate_size=128, num_layers=2, num_heads=4,
+                       num_kv_heads=2, max_position_embeddings=128,
+                       dtype=jnp.float32)
+    assert bundle.config.post_norm and bundle.config.qk_norm == "flat"
+    params = bundle.init(bundle.config, jax.random.key(8))
+    prompt = [6, 17, 2]
+    slow = make_sampler(bundle)(params, prompt, 5)
+    fast = make_sampler(bundle, kv_cache=True)(params, prompt, 5)
+    assert fast == slow
+
+
 def test_kv_cache_moe_matches_recompute():
     """The MoE cache path: routed FFN per decoded token (drop-free expert
     dispatch in prefill/decode) through the shared cache contract. The
