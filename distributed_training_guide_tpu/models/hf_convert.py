@@ -140,6 +140,21 @@ def _map_mixtral(name: str):
         if rest == "gate.weight":
             return "layers.moe.router", idx, True
         return None
+    # Qwen3-MoE spells the same block `mlp.` with llama-style expert names
+    # (gate_proj/up_proj/down_proj) and `mlp.gate` as the router
+    m = re.match(r"model\.layers\.(\d+)\.mlp\.(.+)", name)
+    if m:
+        idx, rest = int(m.group(1)), m.group(2)
+        e = re.match(r"experts\.(\d+)\.(gate_proj|up_proj|down_proj)\.weight",
+                     rest)
+        if e:
+            leaf = {"gate_proj": "layers.moe.gate",
+                    "up_proj": "layers.moe.up",
+                    "down_proj": "layers.moe.down"}[e.group(2)]
+            return leaf, (idx, int(e.group(1))), True
+        if rest == "gate.weight":
+            return "layers.moe.router", idx, True
+        return None
     return _map_llama(name)
 
 

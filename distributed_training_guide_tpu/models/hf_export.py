@@ -165,22 +165,30 @@ def _emit_moe(config, leaves: dict) -> dict:
         "layers.attn.wv": "self_attn.v_proj.weight",
         "layers.attn.wo": "self_attn.o_proj.weight",
     }
+    # qk_norm selects the Qwen3-MoE spelling (mlp.experts.N.gate_proj...);
+    # plain configs keep Mixtral's (block_sparse_moe.experts.N.w1...)
+    qwen3 = bool(getattr(config, "qk_norm", False))
+    expert_names = ({"gate": "gate_proj", "up": "up_proj", "down": "down_proj"}
+                    if qwen3 else {"gate": "w1", "up": "w3", "down": "w2"})
     for i in range(config.num_layers):
         for leaf, hf in attn.items():
             out[f"model.layers.{i}.{hf}"] = leaves[leaf][i].T
+        if qwen3:
+            out[f"model.layers.{i}.self_attn.q_norm.weight"] = \
+                leaves["layers.attn.q_norm"][i]
+            out[f"model.layers.{i}.self_attn.k_norm.weight"] = \
+                leaves["layers.attn.k_norm"][i]
         out[f"model.layers.{i}.input_layernorm.weight"] = \
             leaves["layers.input_norm"][i]
         out[f"model.layers.{i}.post_attention_layernorm.weight"] = \
             leaves["layers.post_attn_norm"][i]
-        moe_prefix = f"model.layers.{i}.block_sparse_moe"
+        moe_prefix = (f"model.layers.{i}.mlp" if qwen3
+                      else f"model.layers.{i}.block_sparse_moe")
         out[f"{moe_prefix}.gate.weight"] = leaves["layers.moe.router"][i].T
         for x in range(config.num_experts):
-            out[f"{moe_prefix}.experts.{x}.w1.weight"] = \
-                leaves["layers.moe.gate"][i, x].T
-            out[f"{moe_prefix}.experts.{x}.w3.weight"] = \
-                leaves["layers.moe.up"][i, x].T
-            out[f"{moe_prefix}.experts.{x}.w2.weight"] = \
-                leaves["layers.moe.down"][i, x].T
+            for ours, theirs in expert_names.items():
+                out[f"{moe_prefix}.experts.{x}.{theirs}.weight"] = \
+                    leaves[f"layers.moe.{ours}"][i, x].T
     return out
 
 
@@ -244,11 +252,22 @@ def _hf_config(bundle) -> dict:
             "tie_word_embeddings": c.tie_word_embeddings,
             **_rope_scaling_out(c)}
     if bundle.family == "moe":
-        out = {**base, "architectures": ["MixtralForCausalLM"],
-               "model_type": "mixtral",
-               "num_local_experts": c.num_experts,
-               "num_experts_per_tok": c.experts_per_token,
-               "router_aux_loss_coef": c.router_aux_coef}
+        if getattr(c, "qk_norm", False):
+            out = {**base, "architectures": ["Qwen3MoeForCausalLM"],
+                   "model_type": "qwen3_moe",
+                   "num_experts": c.num_experts,
+                   "num_experts_per_tok": c.experts_per_token,
+                   "moe_intermediate_size": c.intermediate_size,
+                   "norm_topk_prob": c.norm_topk_prob,
+                   "router_aux_loss_coef": c.router_aux_coef,
+                   "head_dim": c.head_size,
+                   "decoder_sparse_step": 1, "mlp_only_layers": []}
+        else:
+            out = {**base, "architectures": ["MixtralForCausalLM"],
+                   "model_type": "mixtral",
+                   "num_local_experts": c.num_experts,
+                   "num_experts_per_tok": c.experts_per_token,
+                   "router_aux_loss_coef": c.router_aux_coef}
         if getattr(c, "sliding_window", None):
             out["sliding_window"] = c.sliding_window
         return out

@@ -58,6 +58,12 @@ class MoELlamaConfig:
     experts_per_token: int = 2
     capacity_factor: float = 1.25
     router_aux_coef: float = 0.01
+    # renormalize the chosen top-k weights (Mixtral: always; Qwen3-MoE:
+    # the norm_topk_prob config flag)
+    norm_topk_prob: bool = True
+    # RMSNorm on q/k pre-rope (Qwen3-MoE: True = per-head [head_dim]);
+    # shares llama.attention_sublayer's contract
+    qk_norm: Any = False
     head_dim: Optional[int] = None
     max_position_embeddings: int = 4096
     rope_theta: float = 10000.0
@@ -77,6 +83,10 @@ class MoELlamaConfig:
         d = self.head_size
         hq, hkv = self.num_heads * d, self.num_kv_heads * d
         attn = e * hq + 2 * e * hkv + hq * e
+        if self.qk_norm == "flat":
+            attn += hq + hkv
+        elif self.qk_norm:
+            attn += 2 * d
         moe = e * self.num_experts + self.num_experts * 3 * e * f
         per_layer = attn + moe + 2 * e
         head = 0 if self.tie_word_embeddings else e * v
@@ -89,6 +99,10 @@ class MoELlamaConfig:
         d = self.head_size
         hq, hkv = self.num_heads * d, self.num_kv_heads * d
         attn = e * hq + 2 * e * hkv + hq * e
+        if self.qk_norm == "flat":
+            attn += hq + hkv
+        elif self.qk_norm:
+            attn += 2 * d
         moe = e * self.num_experts + self.experts_per_token * 3 * e * f
         per_layer = attn + moe + 2 * e
         head = 0 if self.tie_word_embeddings else e * v
@@ -106,15 +120,22 @@ def init(config: MoELlamaConfig, rng: jax.Array) -> dict:
     def dense(key, shape):
         return (0.02 * jax.random.normal(key, shape, jnp.float32)).astype(config.param_dtype)
 
+    attn = {
+        "wq": dense(next(keys), (l, e, hq)),
+        "wk": dense(next(keys), (l, e, hkv)),
+        "wv": dense(next(keys), (l, e, hkv)),
+        "wo": dense(next(keys), (l, hq, e)),
+    }
+    if config.qk_norm == "flat":
+        attn.update(q_norm=jnp.ones((l, hq), config.param_dtype),
+                    k_norm=jnp.ones((l, hkv), config.param_dtype))
+    elif config.qk_norm:   # Qwen3-MoE per-head q/k RMSNorm scales
+        attn.update(q_norm=jnp.ones((l, d), config.param_dtype),
+                    k_norm=jnp.ones((l, d), config.param_dtype))
     params = {
         "embed": {"embedding": dense(next(keys), (v, e))},
         "layers": {
-            "attn": {
-                "wq": dense(next(keys), (l, e, hq)),
-                "wk": dense(next(keys), (l, e, hkv)),
-                "wv": dense(next(keys), (l, e, hkv)),
-                "wo": dense(next(keys), (l, hq, e)),
-            },
+            "attn": attn,
             "moe": {
                 "router": dense(next(keys), (l, e, ex)),
                 "gate": dense(next(keys), (l, ex, e, f)),
@@ -132,15 +153,22 @@ def init(config: MoELlamaConfig, rng: jax.Array) -> dict:
 
 
 def param_logical_axes(config: MoELlamaConfig) -> dict:
+    attn_axes = {
+        "wq": ("layers", "embed", "heads"),
+        "wk": ("layers", "embed", "kv"),
+        "wv": ("layers", "embed", "kv"),
+        "wo": ("layers", "heads", "embed"),
+    }
+    if config.qk_norm == "flat":
+        attn_axes.update(q_norm=("layers", "heads_vector"),
+                         k_norm=("layers", "kv_vector"))
+    elif config.qk_norm:
+        attn_axes.update(q_norm=("layers", "head_dim_vector"),
+                         k_norm=("layers", "head_dim_vector"))
     axes = {
         "embed": {"embedding": ("vocab", "embed")},
         "layers": {
-            "attn": {
-                "wq": ("layers", "embed", "heads"),
-                "wk": ("layers", "embed", "kv"),
-                "wv": ("layers", "embed", "kv"),
-                "wo": ("layers", "heads", "embed"),
-            },
+            "attn": attn_axes,
             "moe": {
                 "router": ("layers", "embed", "experts_vector"),
                 "gate": ("layers", "experts", "embed", "mlp"),
@@ -196,8 +224,10 @@ def _moe_ffn(config: MoELlamaConfig, x: jnp.ndarray, moe: dict,
     probs = jax.nn.softmax(router_logits, axis=-1)
 
     topk_probs, topk_idx = jax.lax.top_k(probs, k)               # [T, k]
-    # renormalize the chosen weights (Mixtral convention)
-    topk_probs = topk_probs / jnp.sum(topk_probs, axis=-1, keepdims=True)
+    if getattr(config, "norm_topk_prob", True):
+        # renormalize the chosen weights (Mixtral: always; Qwen3-MoE: the
+        # norm_topk_prob flag — off, the raw softmax mass is the weight)
+        topk_probs = topk_probs / jnp.sum(topk_probs, axis=-1, keepdims=True)
 
     # flatten (token, choice) pairs choice-rank-major -> greedy priority
     expert_flat = topk_idx.T.reshape(k * t)                      # [kT]
@@ -425,4 +455,13 @@ PRESETS = {
                                    num_heads=32, num_kv_heads=8, num_experts=8,
                                    experts_per_token=2, rope_theta=1e6,
                                    max_position_embeddings=32768),
+    # Qwen3-MoE 30B-A3B-shaped (public card): Qwen3 attention (qk_norm,
+    # head_dim 128) + 128 experts top-8 at per-expert width 768
+    "qwen3-30b-a3b": MoELlamaConfig(vocab_size=151936, hidden_size=2048,
+                                    intermediate_size=768, num_layers=48,
+                                    num_heads=32, num_kv_heads=4, head_dim=128,
+                                    num_experts=128, experts_per_token=8,
+                                    qk_norm=True, norm_topk_prob=True,
+                                    rope_theta=1e6, rms_norm_eps=1e-6,
+                                    max_position_embeddings=40960),
 }

@@ -185,6 +185,32 @@ def test_export_mixtral_roundtrip(tmp_path):
     _roundtrip(tmp_path, model, bundle, 512)
 
 
+def test_export_qwen3_moe_roundtrip(tmp_path):
+    """The Qwen3-MoE emitter spelling (mlp.experts.N.gate_proj + mlp.gate
+    router + q/k norm rows) + the qk_norm -> Qwen3Moe arch selection."""
+    hf_cfg = transformers.Qwen3MoeConfig(
+        vocab_size=128, hidden_size=64, intermediate_size=128,
+        moe_intermediate_size=96, num_hidden_layers=2,
+        num_attention_heads=4, num_key_value_heads=2, head_dim=32,
+        num_experts=4, num_experts_per_tok=2, norm_topk_prob=False,
+        max_position_embeddings=256, rope_theta=10000.0, rms_norm_eps=1e-6,
+        tie_word_embeddings=False)
+    torch.manual_seed(0)
+    model = transformers.Qwen3MoeForCausalLM(hf_cfg).eval()
+    with torch.no_grad():
+        for layer in model.model.layers:
+            layer.self_attn.q_norm.weight.normal_(1.0, 0.3)
+            layer.self_attn.k_norm.weight.normal_(1.0, 0.3)
+    bundle = get_model("qwen3-30b-a3b", vocab_size=128, hidden_size=64,
+                       intermediate_size=96, num_layers=2, num_heads=4,
+                       num_kv_heads=2, head_dim=32, num_experts=4,
+                       experts_per_token=2, norm_topk_prob=False,
+                       max_position_embeddings=256, rope_theta=10000.0,
+                       rms_norm_eps=1e-6, tie_word_embeddings=False,
+                       capacity_factor=4.0, dtype=jnp.float32)
+    _roundtrip(tmp_path, model, bundle, 128)
+
+
 def test_export_cli_from_orbax_checkpoint(tmp_path, eight_devices):
     """The publish workflow end to end: train a few steps through the real
     chapter loop (Orbax checkpoint), run the hf_export CLI against the

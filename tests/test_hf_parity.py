@@ -501,6 +501,60 @@ def test_auto_hf_config_ingestion(tmp_path, caplog):
         config_from_hf(bad2)
 
 
+def test_qwen3_moe_parity(tmp_path):
+    """Qwen3-MoE = Qwen3 attention (per-head qk_norm) + the Mixtral-style
+    routed FFN with TWO spelling changes (mlp.experts.N.gate_proj names,
+    mlp.gate router) and the norm_topk_prob flag OFF by default (raw softmax
+    mass as combine weights). capacity_factor = E makes drops impossible so
+    the dense HF dispatch is reproducible exactly."""
+    hf_cfg = transformers.Qwen3MoeConfig(
+        vocab_size=128, hidden_size=64, intermediate_size=128,
+        moe_intermediate_size=96, num_hidden_layers=2,
+        num_attention_heads=4, num_key_value_heads=2, head_dim=32,
+        num_experts=4, num_experts_per_tok=2, norm_topk_prob=False,
+        decoder_sparse_step=1, mlp_only_layers=[],
+        max_position_embeddings=256, rope_theta=10000.0, rms_norm_eps=1e-5,
+        tie_word_embeddings=False)
+    torch.manual_seed(0)
+    model = transformers.Qwen3MoeForCausalLM(hf_cfg).eval()
+    with torch.no_grad():
+        for layer in model.model.layers:
+            layer.self_attn.q_norm.weight.normal_(1.0, 0.3)
+            layer.self_attn.k_norm.weight.normal_(1.0, 0.3)
+    model.save_pretrained(tmp_path / "hf", safe_serialization=True)
+
+    bundle = get_model(f"hf:{tmp_path / 'hf'}", dtype=jnp.float32,
+                       capacity_factor=4.0)
+    assert bundle.family == "moe" and bundle.config.qk_norm
+    assert bundle.config.intermediate_size == 96   # moe_intermediate_size
+    assert not bundle.config.norm_topk_prob
+    convert_hf_checkpoint(tmp_path / "hf", tmp_path / "conv", bundle=bundle)
+    plan = make_plan("single", make_mesh(devices=jax.devices()[:1]))
+    params = load_pretrained(bundle, _replicated_shardings(bundle, plan),
+                             tmp_path / "conv")
+
+    ids = np.random.RandomState(0).randint(0, 128, (2, 24))
+    ours = np.asarray(bundle.apply(bundle.config, params, jnp.asarray(ids),
+                                   attn_impl="xla"))
+    with torch.no_grad():
+        theirs = model(torch.tensor(ids)).logits.float().numpy()
+    np.testing.assert_allclose(ours, theirs, rtol=2e-4, atol=2e-4)
+
+    # dense-MoE interleaving must fail loudly, not silently misroute
+    from distributed_training_guide_tpu.models.auto import config_from_hf
+
+    mixed = tmp_path / "mixed"
+    mixed.mkdir()
+    transformers.Qwen3MoeConfig(
+        vocab_size=64, hidden_size=32, intermediate_size=64,
+        moe_intermediate_size=48, num_hidden_layers=4,
+        num_attention_heads=4, num_key_value_heads=2,
+        num_experts=4, num_experts_per_tok=2,
+        mlp_only_layers=[0, 1]).save_pretrained(mixed)
+    with pytest.raises(ValueError, match="mlp_only_layers"):
+        config_from_hf(mixed)
+
+
 def test_mixtral_parity(tmp_path):
     """The MoE family against HF MixtralForCausalLM: same softmax-all ->
     top-k -> renormalize routing, so with capacity_factor = E (zero
