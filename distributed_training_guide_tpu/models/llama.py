@@ -114,6 +114,10 @@ def init(config: LlamaConfig, rng: jax.Array) -> dict:
     elif config.qk_norm:  # Qwen3 per-head q/k RMSNorm scales (ones, HF init)
         attn.update(q_norm=jnp.ones((l, d), config.param_dtype),
                     k_norm=jnp.ones((l, d), config.param_dtype))
+    # key-consumption ORDER is part of the determinism contract (same seed
+    # -> same params across versions): embed draws before the MLP leaves,
+    # exactly as in every prior release
+    embed = dense(next(keys), (v, e))
     layers = {
         "attn": attn,
         "mlp": {
@@ -129,7 +133,7 @@ def init(config: LlamaConfig, rng: jax.Array) -> dict:
         layers.update(input_norm=jnp.ones((l, e), config.param_dtype),
                       post_attn_norm=jnp.ones((l, e), config.param_dtype))
     params = {
-        "embed": {"embedding": dense(next(keys), (v, e))},
+        "embed": {"embedding": embed},
         "layers": layers,
         "final_norm": jnp.ones((e,), config.param_dtype),
     }

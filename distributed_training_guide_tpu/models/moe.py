@@ -120,6 +120,9 @@ def init(config: MoELlamaConfig, rng: jax.Array) -> dict:
     def dense(key, shape):
         return (0.02 * jax.random.normal(key, shape, jnp.float32)).astype(config.param_dtype)
 
+    # key-consumption ORDER is part of the determinism contract (same seed
+    # -> same params across versions): embed draws first, as it always has
+    embed = dense(next(keys), (v, e))
     attn = {
         "wq": dense(next(keys), (l, e, hq)),
         "wk": dense(next(keys), (l, e, hkv)),
@@ -133,7 +136,7 @@ def init(config: MoELlamaConfig, rng: jax.Array) -> dict:
         attn.update(q_norm=jnp.ones((l, d), config.param_dtype),
                     k_norm=jnp.ones((l, d), config.param_dtype))
     params = {
-        "embed": {"embedding": dense(next(keys), (v, e))},
+        "embed": {"embedding": embed},
         "layers": {
             "attn": attn,
             "moe": {
