@@ -205,6 +205,22 @@ def _rmsnorm(x: jnp.ndarray, scale: jnp.ndarray, eps: float,
     return (x * scale).astype(dtype)
 
 
+def _flat_rmsnorm(x: jnp.ndarray, scale: jnp.ndarray, eps: float,
+                  tp_axis: Optional[str]) -> jnp.ndarray:
+    """RMSNorm over the FULL flattened heads width (OLMo-2 q/k norm).
+    Outside manual regions this is plain ``_rmsnorm`` (GSPMD inserts any
+    needed collective itself); inside a manual-tp shard_map the local shard
+    is ``[.., width/tp]``, so the sum-of-squares is psum'd across members
+    and divided by the GLOBAL width before the local scale applies."""
+    if tp_axis is None:
+        return _rmsnorm(x, scale, eps)
+    xf = x.astype(jnp.float32)
+    ss = _psum(jnp.sum(xf * xf, axis=-1, keepdims=True), tp_axis)
+    width = x.shape[-1] * jax.lax.psum(1, tp_axis)
+    normed = xf * jax.lax.rsqrt(ss / width + eps)
+    return (normed * scale.astype(jnp.float32)).astype(x.dtype)
+
+
 def attention_sublayer(config, x: jnp.ndarray, attn_params: dict, norm_scale,
                        positions: jnp.ndarray, attn_impl,
                        standard_layout: bool = True,
@@ -243,10 +259,15 @@ def attention_sublayer(config, x: jnp.ndarray, attn_params: dict, norm_scale,
         v = v + attn_params["bv"].astype(cdt)  # as its matmul output)
     qk_mode = getattr(config, "qk_norm", False)
     if qk_mode == "flat":  # OLMo-2: full-width RMSNorm BEFORE the head
-        # reshape; the [hq]/[hkv] scales carry heads/kv logical axes, so
-        # under manual tp each member's shard matches its local width
-        q = _rmsnorm(q, attn_params["q_norm"], config.rms_norm_eps)
-        k = _rmsnorm(k, attn_params["k_norm"], config.rms_norm_eps)
+        # reshape; the [hq]/[hkv] scales carry heads/kv logical axes so each
+        # member's SCALE shard matches its local width — but the RMS itself
+        # is a reduction over the full width, so under manual tp the
+        # sum-of-squares must cross the shard boundary (shard-local mean
+        # would be silently wrong numerics)
+        q = _flat_rmsnorm(q, attn_params["q_norm"], config.rms_norm_eps,
+                          tp_axis)
+        k = _flat_rmsnorm(k, attn_params["k_norm"], config.rms_norm_eps,
+                          tp_axis)
     q = q.reshape(b, s, -1, d)
     k = k.reshape(b, s, -1, d)
     v = v.reshape(b, s, -1, d)

@@ -73,8 +73,9 @@ def lora_bundle(base: ModelBundle, *, rank: int = 8, alpha: float = 16.0,
     """Wrap ``base`` so params = {"base": <frozen>, "lora": {t: {"a","b"}}}.
 
     B starts at zero, so step-0 logits are EXACTLY the base model's (pinned
-    by test). Only the llama family is supported — its targets cover six of
-    the nine HF architectures (llama/mistral/qwen2/qwen3/gemma/phi-3)."""
+    by test). Only the llama family is supported — its targets cover seven
+    of the eleven HF architectures (llama/mistral/qwen2/qwen3/gemma/phi-3/
+    olmo-2)."""
     if base.family != "llama":
         raise ValueError(
             f"LoRA targets are defined for the llama family only (got "

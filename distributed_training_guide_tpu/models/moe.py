@@ -61,9 +61,10 @@ class MoELlamaConfig:
     # renormalize the chosen top-k weights (Mixtral: always; Qwen3-MoE:
     # the norm_topk_prob config flag)
     norm_topk_prob: bool = True
-    # RMSNorm on q/k pre-rope (Qwen3-MoE: True = per-head [head_dim]);
-    # shares llama.attention_sublayer's contract
-    qk_norm: Any = False
+    # per-head RMSNorm on q/k pre-rope (Qwen3-MoE); shares
+    # llama.attention_sublayer's contract. Only the per-head (True) form
+    # exists in MoE checkpoints — no flat variant here
+    qk_norm: bool = False
     head_dim: Optional[int] = None
     max_position_embeddings: int = 4096
     rope_theta: float = 10000.0
@@ -83,9 +84,7 @@ class MoELlamaConfig:
         d = self.head_size
         hq, hkv = self.num_heads * d, self.num_kv_heads * d
         attn = e * hq + 2 * e * hkv + hq * e
-        if self.qk_norm == "flat":
-            attn += hq + hkv
-        elif self.qk_norm:
+        if self.qk_norm:
             attn += 2 * d
         moe = e * self.num_experts + self.num_experts * 3 * e * f
         per_layer = attn + moe + 2 * e
@@ -99,9 +98,7 @@ class MoELlamaConfig:
         d = self.head_size
         hq, hkv = self.num_heads * d, self.num_kv_heads * d
         attn = e * hq + 2 * e * hkv + hq * e
-        if self.qk_norm == "flat":
-            attn += hq + hkv
-        elif self.qk_norm:
+        if self.qk_norm:
             attn += 2 * d
         moe = e * self.num_experts + self.experts_per_token * 3 * e * f
         per_layer = attn + moe + 2 * e
@@ -129,10 +126,7 @@ def init(config: MoELlamaConfig, rng: jax.Array) -> dict:
         "wv": dense(next(keys), (l, e, hkv)),
         "wo": dense(next(keys), (l, hq, e)),
     }
-    if config.qk_norm == "flat":
-        attn.update(q_norm=jnp.ones((l, hq), config.param_dtype),
-                    k_norm=jnp.ones((l, hkv), config.param_dtype))
-    elif config.qk_norm:   # Qwen3-MoE per-head q/k RMSNorm scales
+    if config.qk_norm:     # Qwen3-MoE per-head q/k RMSNorm scales
         attn.update(q_norm=jnp.ones((l, d), config.param_dtype),
                     k_norm=jnp.ones((l, d), config.param_dtype))
     params = {
@@ -162,10 +156,7 @@ def param_logical_axes(config: MoELlamaConfig) -> dict:
         "wv": ("layers", "embed", "kv"),
         "wo": ("layers", "heads", "embed"),
     }
-    if config.qk_norm == "flat":
-        attn_axes.update(q_norm=("layers", "heads_vector"),
-                         k_norm=("layers", "kv_vector"))
-    elif config.qk_norm:
+    if config.qk_norm:
         attn_axes.update(q_norm=("layers", "head_dim_vector"),
                          k_norm=("layers", "head_dim_vector"))
     axes = {

@@ -262,6 +262,70 @@ def test_pp_neox_family(eight_devices):
         np.testing.assert_allclose(losses, glosses, rtol=2e-4, err_msg=strategy)
 
 
+def test_flat_rmsnorm_manual_tp_matches_full_width(eight_devices):
+    """The OLMo-2 full-width q/k RMSNorm under MANUAL tp: the statistic is
+    a reduction over the sharded heads dim, so the psum'd sum-of-squares
+    must reproduce the unsharded norm EXACTLY. x is deliberately
+    anisotropic across the shard boundary (first half scaled 3x) so a
+    shard-local mean cannot masquerade as the global one."""
+    from jax.sharding import PartitionSpec as P
+    from distributed_training_guide_tpu.models.llama import (_flat_rmsnorm,
+                                                             _rmsnorm)
+    from distributed_training_guide_tpu.parallel import make_mesh
+
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.randn(2, 4, 64), jnp.float32)
+    x = x.at[..., :32].multiply(3.0)          # local stats != global stats
+    scale = jnp.asarray(1.0 + 0.1 * rng.randn(64), jnp.float32)
+    mesh = make_mesh(tp=2, devices=jax.devices()[:2])
+
+    manual = jax.jit(jax.shard_map(
+        lambda xs, ss: _flat_rmsnorm(xs, ss, 1e-5, "tp"),
+        mesh=mesh, in_specs=(P(None, None, "tp"), P("tp")),
+        out_specs=P(None, None, "tp")))(x, scale)
+    ref = _rmsnorm(x, scale, 1e-5)
+    np.testing.assert_allclose(np.asarray(manual), np.asarray(ref),
+                               rtol=1e-6, atol=1e-6)
+    # and the shard-local statistic really WOULD diverge (test has teeth)
+    local = jax.jit(jax.shard_map(
+        lambda xs, ss: _rmsnorm(xs, ss, 1e-5),
+        mesh=mesh, in_specs=(P(None, None, "tp"), P("tp")),
+        out_specs=P(None, None, "tp")))(x, scale)
+    assert np.abs(np.asarray(local) - np.asarray(ref)).max() > 0.1
+
+
+def test_pp_olmo2_family(eight_devices):
+    """OLMo-2 under the 1F1B schedule, incl. pp x tp MANUAL megatron
+    shards: the full-width q/k RMSNorm is a reduction over the heads dim,
+    which tp shards — the psum'd sum-of-squares (_flat_rmsnorm) must make
+    the manual-tp trajectory match single-device exactly (a shard-local
+    mean would silently diverge here)."""
+    bundle = get_model("olmo2-7b", vocab_size=512, hidden_size=64,
+                       intermediate_size=128, num_layers=2, num_heads=4,
+                       num_kv_heads=2, max_position_embeddings=256,
+                       dtype=jnp.float32)
+    assert bundle.config.post_norm and bundle.config.qk_norm == "flat"
+    golden_t = Trainer(bundle=bundle, optimizer=adamw_cosine(1e-3),
+                       plan=make_plan("single",
+                                      make_mesh(devices=jax.devices()[:1])),
+                       donate=False)
+    gstate = golden_t.init_state(0)
+    ids = np.random.RandomState(0).randint(0, 512, (GB, SEQ))
+    gbatch = {k: jax.device_put(jnp.asarray(ids), golden_t.batch_shardings()[k])
+              for k in ("input_ids", "labels")}
+    glosses = [float(golden_t.step_fn(gstate, gbatch)[1]["loss"])]
+
+    for strategy, mesh_kw in (("pp", {"pp": 2}), ("pp_tp", {"pp": 2, "tp": 2})):
+        t = Trainer(bundle=bundle, optimizer=adamw_cosine(1e-3),
+                    plan=make_plan(strategy, make_mesh(**mesh_kw)), donate=False,
+                    pp_microbatches=2)
+        state = t.init_state(0)
+        batch = {k: jax.device_put(jnp.asarray(ids), t.batch_shardings()[k])
+                 for k in ("input_ids", "labels")}
+        losses = [float(t.step_fn(state, batch)[1]["loss"])]
+        np.testing.assert_allclose(losses, glosses, rtol=2e-4, err_msg=strategy)
+
+
 def test_pp_moe_family(eight_devices):
     """MoE under the 1F1B schedule: router aux loss flows through the
     per-tick vjp (cotangent on the stage's aux output) and the trajectory
