@@ -326,6 +326,36 @@ def test_pp_olmo2_family(eight_devices):
         np.testing.assert_allclose(losses, glosses, rtol=2e-4, err_msg=strategy)
 
 
+def test_pp_qwen3_family(eight_devices):
+    """Qwen3 under the 1F1B schedule incl. manual megatron tp: the per-head
+    [head_dim] q/k norm scales are REPLICATED across tp members (the norm
+    reduces over the unsharded head_dim), so the manual path needs no
+    collective — trajectory must still match single-device."""
+    bundle = get_model("qwen3-0.6b", vocab_size=512, hidden_size=64,
+                       intermediate_size=128, num_layers=2, num_heads=4,
+                       num_kv_heads=2, head_dim=16,
+                       max_position_embeddings=256, dtype=jnp.float32)
+    assert bundle.config.qk_norm is True
+    golden_t = Trainer(bundle=bundle, optimizer=adamw_cosine(1e-3),
+                       plan=make_plan("single",
+                                      make_mesh(devices=jax.devices()[:1])),
+                       donate=False)
+    gstate = golden_t.init_state(0)
+    ids = np.random.RandomState(0).randint(0, 512, (GB, SEQ))
+    gbatch = {k: jax.device_put(jnp.asarray(ids), golden_t.batch_shardings()[k])
+              for k in ("input_ids", "labels")}
+    glosses = [float(golden_t.step_fn(gstate, gbatch)[1]["loss"])]
+
+    t = Trainer(bundle=bundle, optimizer=adamw_cosine(1e-3),
+                plan=make_plan("pp_tp", make_mesh(pp=2, tp=2)), donate=False,
+                pp_microbatches=2)
+    state = t.init_state(0)
+    batch = {k: jax.device_put(jnp.asarray(ids), t.batch_shardings()[k])
+             for k in ("input_ids", "labels")}
+    losses = [float(t.step_fn(state, batch)[1]["loss"])]
+    np.testing.assert_allclose(losses, glosses, rtol=2e-4)
+
+
 def test_pp_moe_family(eight_devices):
     """MoE under the 1F1B schedule: router aux loss flows through the
     per-tick vjp (cotangent on the stage's aux output) and the trajectory
