@@ -1,13 +1,13 @@
 """AutoModel-style config ingestion: HF ``config.json`` -> a native bundle.
 
 The reference trains *any* HF causal LM via ``AutoModelForCausalLM``
-(``01-single-gpu/train_llm.py:57``). The native families here cover eleven
+(``01-single-gpu/train_llm.py:57``). The native families here cover twelve
 HF architectures; this module removes the remaining friction — needing a
 registry preset for every size variant. ``-m hf:<dir>`` (or
 ``get_model("hf:<dir>")``) reads the checkpoint's own ``config.json``,
 recognizes the architecture, and builds the exact family config — so any
-Llama/Mistral/Qwen2/Qwen3/Gemma/Phi-3/OLMo-2/GPT-2/Mixtral/Qwen3-MoE/
-GPT-NeoX(Pythia) checkpoint trains (and converts, ``models/hf_convert.py``) without touching the
+Llama/Mistral/Qwen2/Qwen3/Gemma/Gemma-2/Phi-3/OLMo-2/GPT-2/Mixtral/
+Qwen3-MoE/GPT-NeoX(Pythia) checkpoint trains (and converts, ``models/hf_convert.py``) without touching the
 registry:
 
     python convert_llama.py <hf-dir> <conv> hf:<hf-dir>
@@ -119,6 +119,30 @@ def _build_llama(cfg: dict, arch: str):
         kw.update(norm_plus_one=True, scale_embed=True,
                   tie_word_embeddings=True)
         act = "gelu_pytorch_tanh"   # HF applies tanh-gelu whatever the key says
+    if arch == "Gemma2ForCausalLM":
+        # Gemma-2 = Gemma + sandwich norms (both sides of each sublayer),
+        # tanh softcapping of attention scores and final logits, a score
+        # scale from query_pre_attn_scalar, and an ALTERNATING per-layer
+        # sliding-window pattern — the global sliding_window key is replaced
+        # by layer_windows (0 = full attention on that layer)
+        kw.pop("sliding_window", None)
+        kw.update(norm_plus_one=True, scale_embed=True, sandwich_norm=True,
+                  tie_word_embeddings=True,
+                  attn_logit_softcap=cfg.get("attn_logit_softcapping"),
+                  final_logit_softcap=cfg.get("final_logit_softcapping"),
+                  query_pre_attn_scalar=cfg.get("query_pre_attn_scalar"))
+        act = "gelu_pytorch_tanh"
+        w = cfg.get("sliding_window")
+        if w and w < cfg.get("max_position_embeddings", 8192):
+            lt = cfg.get("layer_types")
+            if lt:
+                pattern = tuple(w if t == "sliding_attention" else 0
+                                for t in lt)
+            else:  # pre-layer_types configs: sliding on even layers
+                pattern = tuple(w if i % 2 == 0 else 0
+                                for i in range(cfg["num_hidden_layers"]))
+            if any(pattern):
+                kw["layer_windows"] = pattern
     if act not in _HF_ACTS:
         raise ValueError(f"{arch}: unsupported hidden_act {act!r} "
                          f"(supported: {sorted(_HF_ACTS)})")
@@ -222,6 +246,7 @@ _ARCH_BUILDERS = {
     "Qwen3ForCausalLM": ("llama", _build_llama),
     "Olmo2ForCausalLM": ("llama", _build_llama),
     "GemmaForCausalLM": ("llama", _build_llama),
+    "Gemma2ForCausalLM": ("llama", _build_llama),
     "GPT2LMHeadModel": ("gpt2", _build_gpt2),
     "MixtralForCausalLM": ("moe", _build_mixtral),
     "Qwen3MoeForCausalLM": ("moe", _build_qwen3_moe),
@@ -248,7 +273,8 @@ def config_from_hf(config_path: str | Path):
     # head) must hit the loud failure, not get remapped to causal LM
     by_type = {"llama": "LlamaForCausalLM", "mistral": "MistralForCausalLM",
                "qwen2": "Qwen2ForCausalLM", "qwen3": "Qwen3ForCausalLM",
-               "gemma": "GemmaForCausalLM", "olmo2": "Olmo2ForCausalLM",
+               "gemma": "GemmaForCausalLM", "gemma2": "Gemma2ForCausalLM",
+               "olmo2": "Olmo2ForCausalLM",
                "gpt2": "GPT2LMHeadModel", "mixtral": "MixtralForCausalLM",
                "qwen3_moe": "Qwen3MoeForCausalLM",
                "gpt_neox": "GPTNeoXForCausalLM", "phi3": "Phi3ForCausalLM"}

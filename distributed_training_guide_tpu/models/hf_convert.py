@@ -228,6 +228,7 @@ def _make_map_llama(config):
     f = config.intermediate_size
 
     post_norm = getattr(config, "post_norm", False)
+    sandwich = getattr(config, "sandwich_norm", False)
 
     def mapper(name: str):
         m = re.match(r"model\.layers\.(\d+)\.(.+)", name)
@@ -240,14 +241,18 @@ def _make_map_llama(config):
             if rest == "mlp.gate_up_proj.weight":
                 return [("layers.mlp.gate", idx, lambda w: w[:f].T),
                         ("layers.mlp.up", idx, lambda w: w[f:].T)]
-            if post_norm:
-                # OLMo-2 reuses llama's post_attention_layernorm NAME but
-                # applies it to the attention OUTPUT; plus a new
+            if post_norm or sandwich:
+                # OLMo-2/Gemma-2 reuse llama's post_attention_layernorm
+                # NAME but apply it to the attention OUTPUT; plus a
                 # post_feedforward_layernorm on the MLP output
                 if rest == "post_attention_layernorm.weight":
                     return "layers.attn_out_norm", idx, False
                 if rest == "post_feedforward_layernorm.weight":
                     return "layers.mlp_out_norm", idx, False
+            if sandwich and rest == "pre_feedforward_layernorm.weight":
+                # Gemma-2's pre-FFN norm fills llama's post_attn_norm slot
+                # (the leaf mlp_sublayer pre-norms with)
+                return "layers.post_attn_norm", idx, False
         return _map_llama(name)
 
     return mapper

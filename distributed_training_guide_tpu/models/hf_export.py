@@ -75,6 +75,10 @@ def _emit_llama(config, leaves: dict) -> dict:
         "layers.attn_out_norm": ("post_attention_layernorm.weight", False),
         "layers.mlp_out_norm": ("post_feedforward_layernorm.weight", False),
     }
+    if getattr(config, "sandwich_norm", False):
+        # Gemma-2: the post_attn_norm leaf is the PRE-FFN norm
+        per_layer["layers.post_attn_norm"] = ("pre_feedforward_layernorm.weight",
+                                              False)
     for leaf, (hf, transpose) in per_layer.items():
         if leaf not in leaves:
             continue   # e.g. biases on a no-attn_bias config
@@ -272,7 +276,19 @@ def _hf_config(bundle) -> dict:
             out["sliding_window"] = c.sliding_window
         return out
     # llama family: the config knobs decide which architecture this is
-    if getattr(c, "post_norm", False):
+    if getattr(c, "sandwich_norm", False):
+        base.update(architectures=["Gemma2ForCausalLM"], model_type="gemma2",
+                    head_dim=c.head_size,
+                    hidden_act="gelu_pytorch_tanh",
+                    hidden_activation="gelu_pytorch_tanh",
+                    query_pre_attn_scalar=c.query_pre_attn_scalar,
+                    attn_logit_softcapping=c.attn_logit_softcap,
+                    final_logit_softcapping=c.final_logit_softcap)
+        if getattr(c, "layer_windows", None):
+            base["sliding_window"] = max(c.layer_windows)
+            base["layer_types"] = ["sliding_attention" if w else
+                                   "full_attention" for w in c.layer_windows]
+    elif getattr(c, "post_norm", False):
         base.update(architectures=["Olmo2ForCausalLM"], model_type="olmo2",
                     attention_bias=False)
     elif getattr(c, "qk_norm", False):

@@ -62,6 +62,17 @@ class LlamaConfig:
     # OLMo-2 block wiring: NO pre-norms; RMSNorm applied to each sublayer's
     # OUTPUT before the residual add (x = x + norm(attn(x)))
     post_norm: bool = False
+    # Gemma-2 block wiring: norms on BOTH sides of each sublayer
+    # (x = x + norm(attn(norm(x))); x = x + norm(mlp(norm(x))))
+    sandwich_norm: bool = False
+    # Gemma-2 attention extras: tanh capping of attention scores / final
+    # logits, score scale override (query_pre_attn_scalar ** -0.5), and the
+    # per-layer window pattern (an L-tuple, 0 = full attention that layer —
+    # Gemma-2 alternates sliding/full). All run on the xla attention path.
+    attn_logit_softcap: Optional[float] = None
+    final_logit_softcap: Optional[float] = None
+    query_pre_attn_scalar: Optional[float] = None
+    layer_windows: Optional[tuple] = None
     act_fn: str = "silu"            # MLP gate activation: silu | gelu_tanh (Gemma)
     norm_plus_one: bool = False     # RMSNorm scales by (1 + w) (Gemma)
     scale_embed: bool = False       # multiply embeddings by sqrt(hidden) (Gemma)
@@ -129,6 +140,11 @@ def init(config: LlamaConfig, rng: jax.Array) -> dict:
     if config.post_norm:   # OLMo-2: norms sit on the sublayer OUTPUTS
         layers.update(attn_out_norm=jnp.ones((l, e), config.param_dtype),
                       mlp_out_norm=jnp.ones((l, e), config.param_dtype))
+    elif config.sandwich_norm:   # Gemma-2: norms on BOTH sides
+        layers.update(input_norm=jnp.ones((l, e), config.param_dtype),
+                      attn_out_norm=jnp.ones((l, e), config.param_dtype),
+                      post_attn_norm=jnp.ones((l, e), config.param_dtype),
+                      mlp_out_norm=jnp.ones((l, e), config.param_dtype))
     else:
         layers.update(input_norm=jnp.ones((l, e), config.param_dtype),
                       post_attn_norm=jnp.ones((l, e), config.param_dtype))
@@ -173,6 +189,11 @@ def param_logical_axes(config: LlamaConfig) -> dict:
     }
     if config.post_norm:
         layer_axes.update(attn_out_norm=("layers", "embed_vector"),
+                          mlp_out_norm=("layers", "embed_vector"))
+    elif config.sandwich_norm:
+        layer_axes.update(input_norm=("layers", "embed_vector"),
+                          attn_out_norm=("layers", "embed_vector"),
+                          post_attn_norm=("layers", "embed_vector"),
                           mlp_out_norm=("layers", "embed_vector"))
     else:
         layer_axes.update(input_norm=("layers", "embed_vector"),
@@ -225,7 +246,8 @@ def attention_sublayer(config, x: jnp.ndarray, attn_params: dict, norm_scale,
                        positions: jnp.ndarray, attn_impl,
                        standard_layout: bool = True,
                        tp_axis: Optional[str] = None,
-                       kv_cache=None, return_kv: bool = False):
+                       kv_cache=None, return_kv: bool = False,
+                       window_override=None):
     """norm -> rope'd GQA attention -> output proj (residual added by caller).
 
     Shared by the dense Llama block and the MoE family (config is duck-typed:
@@ -282,6 +304,13 @@ def attention_sublayer(config, x: jnp.ndarray, attn_params: dict, norm_scale,
     k = apply_rope(k, positions, config.rope_theta, rs,
                    config.max_position_embeddings)
     window = getattr(config, "sliding_window", None)
+    if window_override is not None:  # per-layer pattern (Gemma-2): a traced
+        window = window_override     # scalar, already 0 -> "no band" resolved
+    # Gemma-2 attention extras (None everywhere else): score-scale override
+    # and tanh logit capping — both force the xla path via auto dispatch
+    qpas = getattr(config, "query_pre_attn_scalar", None)
+    attn_scale = (qpas ** -0.5) if qpas else None
+    softcap = getattr(config, "attn_logit_softcap", None)
     if kv_cache is not None:
         ck, cv, pos = kv_cache
         k = jax.lax.dynamic_update_slice(ck, k.astype(ck.dtype), (0, pos, 0, 0))
@@ -290,7 +319,8 @@ def attention_sublayer(config, x: jnp.ndarray, attn_params: dict, norm_scale,
                                   (b, ck.shape[1]))
         attn = multihead_attention(q, k, v, causal=True, positions=positions,
                                    kv_positions=kv_pos, impl="xla",
-                                   standard_layout=False, window=window)
+                                   standard_layout=False, window=window,
+                                   scale=attn_scale, logit_softcap=softcap)
     elif callable(attn_impl):  # e.g. ring attention under context parallelism
         # Trainer-built wrappers carry the window themselves (the sharded
         # flash factory) or reject it (ring/ulysses CP, Trainer validation)
@@ -299,7 +329,8 @@ def attention_sublayer(config, x: jnp.ndarray, attn_params: dict, norm_scale,
         attn = multihead_attention(q, k, v, causal=True, positions=positions,
                                    kv_positions=positions, impl=attn_impl,
                                    standard_layout=standard_layout,
-                                   window=window)
+                                   window=window, scale=attn_scale,
+                                   logit_softcap=softcap)
     out = attn.reshape(b, s, -1) @ attn_params["wo"].astype(cdt)
     if tp_axis is not None:
         out = _psum(out, tp_axis)
@@ -336,24 +367,40 @@ def _block(config: LlamaConfig, x: jnp.ndarray, layer: dict,
            positions: jnp.ndarray, attn_impl: str,
            activation_sharding: Optional[Any] = None,
            standard_layout: bool = True,
-           tp_axis: Optional[str] = None) -> jnp.ndarray:
+           tp_axis: Optional[str] = None,
+           window_override=None) -> jnp.ndarray:
     def constrain(y):
         if activation_sharding is not None:
             return jax.lax.with_sharding_constraint(y, activation_sharding)
         return y
 
+    plus_one = getattr(config, "norm_plus_one", False)
     if getattr(config, "post_norm", False):   # OLMo-2 wiring
         attn = attention_sublayer(config, x, layer["attn"], None,
                                   positions, attn_impl, standard_layout,
-                                  tp_axis)
+                                  tp_axis, window_override=window_override)
         x = constrain(x + _rmsnorm(attn, layer["attn_out_norm"],
-                                   config.rms_norm_eps))
+                                   config.rms_norm_eps, plus_one))
         mlp = mlp_sublayer(config, x, layer, tp_axis)
         return constrain(x + _rmsnorm(mlp, layer["mlp_out_norm"],
-                                      config.rms_norm_eps))
+                                      config.rms_norm_eps, plus_one))
+
+    if getattr(config, "sandwich_norm", False):   # Gemma-2 wiring: norms on
+        # both sides of each sublayer; mlp_sublayer's pre-norm reads the
+        # post_attn_norm leaf (HF pre_feedforward_layernorm)
+        attn = attention_sublayer(config, x, layer["attn"],
+                                  layer["input_norm"], positions, attn_impl,
+                                  standard_layout, tp_axis,
+                                  window_override=window_override)
+        x = constrain(x + _rmsnorm(attn, layer["attn_out_norm"],
+                                   config.rms_norm_eps, plus_one))
+        mlp = mlp_sublayer(config, x, layer, tp_axis)
+        return constrain(x + _rmsnorm(mlp, layer["mlp_out_norm"],
+                                      config.rms_norm_eps, plus_one))
 
     attn = attention_sublayer(config, x, layer["attn"], layer["input_norm"],
-                              positions, attn_impl, standard_layout, tp_axis)
+                              positions, attn_impl, standard_layout, tp_axis,
+                              window_override=window_override)
     x = constrain(x + attn)
     return constrain(x + mlp_sublayer(config, x, layer, tp_axis))
 
@@ -397,8 +444,13 @@ def final_hidden(config: LlamaConfig, params: dict, x: jnp.ndarray) -> jnp.ndarr
 
 def lm_head_logits(config: LlamaConfig, params: dict, x: jnp.ndarray) -> jnp.ndarray:
     """Final norm + output projection (pipeline last-stage exit)."""
-    return jnp.dot(final_hidden(config, params, x), output_weights(config, params),
-                   preferred_element_type=jnp.float32)
+    logits = jnp.dot(final_hidden(config, params, x),
+                     output_weights(config, params),
+                     preferred_element_type=jnp.float32)
+    cap = getattr(config, "final_logit_softcap", None)
+    if cap:   # Gemma-2 final logit capping
+        logits = jnp.tanh(logits / cap) * cap
+    return logits
 
 
 def apply(
@@ -433,14 +485,30 @@ def apply(
                     activation_sharding=activation_sharding,
                     standard_layout=standard_layout)
 
-    def scan_body(carry, layer_params):
-        return block(carry, layer_params), None
+    layer_windows = getattr(config, "layer_windows", None)
+    if layer_windows:
+        # per-layer sliding-window pattern (Gemma-2 alternates sliding /
+        # full): the window rides the scan as a traced per-layer scalar;
+        # 0 (= full attention) maps to a band wider than any sequence
+        wins = jnp.asarray([w if w else 2 ** 30 for w in layer_windows],
+                           jnp.int32)
+
+        def scan_body(carry, xs):
+            layer_params, w = xs
+            return block(carry, layer_params, window_override=w), None
+
+        scan_xs = (params["layers"], wins)
+    else:
+        def scan_body(carry, layer_params):
+            return block(carry, layer_params), None
+
+        scan_xs = params["layers"]
 
     if remat:
         policy = remat_policy or jax.checkpoint_policies.nothing_saveable
         scan_body = jax.checkpoint(scan_body, policy=policy, prevent_cse=False)
 
-    x, _ = jax.lax.scan(scan_body, x, params["layers"])
+    x, _ = jax.lax.scan(scan_body, x, scan_xs)
 
     if return_hidden:
         return final_hidden(config, params, x)
@@ -455,16 +523,27 @@ def apply(
 # ---------------------------------------------------------------------------
 
 def _decode_residuals(config, x, layer, attn):
-    """Shared residual wiring for the prefill/decode bodies (pre- and
-    post-norm variants); returns (new_x, None)."""
-    if getattr(config, "post_norm", False):
-        x = x + _rmsnorm(attn, layer["attn_out_norm"], config.rms_norm_eps)
+    """Shared residual wiring for the prefill/decode bodies (pre-, post-,
+    and sandwich-norm variants); returns (new_x, None)."""
+    plus_one = getattr(config, "norm_plus_one", False)
+    if getattr(config, "post_norm", False) or getattr(config, "sandwich_norm",
+                                                      False):
+        x = x + _rmsnorm(attn, layer["attn_out_norm"], config.rms_norm_eps,
+                         plus_one)
         x = x + _rmsnorm(mlp_sublayer(config, x, layer),
-                         layer["mlp_out_norm"], config.rms_norm_eps)
+                         layer["mlp_out_norm"], config.rms_norm_eps, plus_one)
     else:
         x = x + attn
         x = x + mlp_sublayer(config, x, layer)
     return x, None
+
+
+def _decode_layer_windows(config):
+    """Per-layer window column for the decode scans (None when uniform)."""
+    lw = getattr(config, "layer_windows", None)
+    if not lw:
+        return None
+    return jnp.asarray([w if w else 2 ** 30 for w in lw], jnp.int32)
 
 
 def init_cache(config: LlamaConfig, batch: int, max_len: int) -> dict:
@@ -483,19 +562,25 @@ def prefill(config: LlamaConfig, params: dict, input_ids: jnp.ndarray,
     positions = jnp.broadcast_to(jnp.arange(p)[None, :], (b, p))
     x = embed_tokens(config, params, input_ids, positions)
 
+    wins = _decode_layer_windows(config)
+
     def body(x, inputs):
-        layer, ck, cv = inputs
+        layer, ck, cv, w = inputs
         attn, (k, v) = attention_sublayer(
             config, x, layer["attn"],
             None if config.post_norm else layer["input_norm"], positions,
-            "xla", return_kv=True)
+            "xla", return_kv=True, window_override=w)
         x, _ = _decode_residuals(config, x, layer, attn)
         nk = jax.lax.dynamic_update_slice(ck, k.astype(ck.dtype), (0, 0, 0, 0))
         nv = jax.lax.dynamic_update_slice(cv, v.astype(cv.dtype), (0, 0, 0, 0))
         return x, (nk, nv)
 
-    x, (ks, vs) = jax.lax.scan(body, x, (params["layers"],
-                                         cache["k"], cache["v"]))
+    if wins is None:
+        body_fn = lambda x, inp: body(x, (*inp, None))
+        xs = (params["layers"], cache["k"], cache["v"])
+    else:
+        body_fn, xs = body, (params["layers"], cache["k"], cache["v"], wins)
+    x, (ks, vs) = jax.lax.scan(body_fn, x, xs)
     # slice BEFORE the head: projecting all P positions to [B, P, V] fp32
     # only to keep one row would cost P x the lm_head matmul and a
     # prompt-length-scaled logits buffer (norm + projection are per-position)
@@ -512,17 +597,23 @@ def decode_step(config: LlamaConfig, params: dict, token_ids: jnp.ndarray,
     positions = jnp.broadcast_to(jnp.asarray(pos)[None, None], (b, 1))
     x = embed_tokens(config, params, token_ids, positions)
 
+    wins = _decode_layer_windows(config)
+
     def body(x, inputs):
-        layer, ck, cv = inputs
+        layer, ck, cv, w = inputs
         attn, (nk, nv) = attention_sublayer(
             config, x, layer["attn"],
             None if config.post_norm else layer["input_norm"], positions,
-            "xla", kv_cache=(ck, cv, pos), return_kv=True)
+            "xla", kv_cache=(ck, cv, pos), return_kv=True, window_override=w)
         x, _ = _decode_residuals(config, x, layer, attn)
         return x, (nk, nv)
 
-    x, (ks, vs) = jax.lax.scan(body, x, (params["layers"],
-                                         cache["k"], cache["v"]))
+    if wins is None:
+        body_fn = lambda x, inp: body(x, (*inp, None))
+        xs = (params["layers"], cache["k"], cache["v"])
+    else:
+        body_fn, xs = body, (params["layers"], cache["k"], cache["v"], wins)
+    x, (ks, vs) = jax.lax.scan(body_fn, x, xs)
     return lm_head_logits(config, params, x)[:, -1], {"k": ks, "v": vs}
 
 
@@ -601,6 +692,27 @@ PRESETS = {
                             act_fn="gelu_tanh", norm_plus_one=True, scale_embed=True,
                             rms_norm_eps=1e-6, tie_word_embeddings=True,
                             max_position_embeddings=8192),
+    # Gemma-2 = Gemma + sandwich norms, tanh softcaps (attention 50, final
+    # 30), query_pre_attn_scalar score scale, and the alternating
+    # sliding/full window pattern (sliding on even layers, window 4096)
+    "gemma2-2b": LlamaConfig(vocab_size=256000, hidden_size=2304, intermediate_size=9216,
+                             num_layers=26, num_heads=8, num_kv_heads=4, head_dim=256,
+                             act_fn="gelu_tanh", norm_plus_one=True, scale_embed=True,
+                             sandwich_norm=True, rms_norm_eps=1e-6,
+                             tie_word_embeddings=True, attn_logit_softcap=50.0,
+                             final_logit_softcap=30.0, query_pre_attn_scalar=256.0,
+                             layer_windows=tuple(4096 if i % 2 == 0 else 0
+                                                 for i in range(26)),
+                             max_position_embeddings=8192),
+    "gemma2-9b": LlamaConfig(vocab_size=256000, hidden_size=3584, intermediate_size=14336,
+                             num_layers=42, num_heads=16, num_kv_heads=8, head_dim=256,
+                             act_fn="gelu_tanh", norm_plus_one=True, scale_embed=True,
+                             sandwich_norm=True, rms_norm_eps=1e-6,
+                             tie_word_embeddings=True, attn_logit_softcap=50.0,
+                             final_logit_softcap=30.0, query_pre_attn_scalar=256.0,
+                             layer_windows=tuple(4096 if i % 2 == 0 else 0
+                                                 for i in range(42)),
+                             max_position_embeddings=8192),
     # Qwen2.5 dense = llama + QKV biases (attn_bias); small cards tie embeddings
     "qwen2.5-0.5b": LlamaConfig(vocab_size=151936, hidden_size=896, intermediate_size=4864,
                                 num_layers=24, num_heads=14, num_kv_heads=2,

@@ -25,17 +25,23 @@ def _xla_attention(
     causal: bool,
     positions: Optional[jnp.ndarray],
     kv_positions: Optional[jnp.ndarray],
-    window: Optional[int] = None,
+    window=None,
+    scale: Optional[float] = None,
+    logit_softcap: Optional[float] = None,
 ) -> jnp.ndarray:
     b, sq, hq, d = q.shape
     _, sk, hkv, _ = k.shape
     groups = hq // hkv
-    scale = 1.0 / jnp.sqrt(jnp.asarray(d, dtype=jnp.float32))
+    if scale is None:
+        scale = 1.0 / jnp.sqrt(jnp.asarray(d, dtype=jnp.float32))
 
     qg = q.reshape(b, sq, hkv, groups, d)
     # scores in fp32: softmax in bf16 is numerically unacceptable at long seq
     scores = jnp.einsum("bqhgd,bkhd->bhgqk", qg, k, preferred_element_type=jnp.float32)
     scores = scores * scale
+
+    if logit_softcap is not None:  # Gemma-2: tanh cap BEFORE the mask
+        scores = jnp.tanh(scores / logit_softcap) * logit_softcap
 
     if causal:
         if positions is None:
@@ -70,7 +76,9 @@ def multihead_attention(
     kv_positions: Optional[jnp.ndarray] = None,
     impl: str = "auto",
     standard_layout: bool = True,
-    window: Optional[int] = None,
+    window=None,
+    scale: Optional[float] = None,
+    logit_softcap: Optional[float] = None,
 ) -> jnp.ndarray:
     """Scaled-dot-product attention with GQA.
 
@@ -78,16 +86,29 @@ def multihead_attention(
     (flash on TPU when causal, tile-aligned, and the caller confirms the
     standard contiguous position layout via ``standard_layout`` — sequence-
     sharded/CP callers pass False and get the mask-aware xla path).
-    ``window``: sliding-window attention (both paths; the flash kernel skips
-    out-of-band kv tiles for an O(S*window) cost).
+    ``window``: sliding-window attention. Static ints run on both paths
+    (the flash kernel skips out-of-band kv tiles for an O(S*window) cost);
+    a TRACED window (per-layer patterns, Gemma-2) runs on the xla path.
+    ``scale``: score scale override (Gemma-2's query_pre_attn_scalar**-0.5;
+    default head_dim**-0.5). ``logit_softcap``: Gemma-2 tanh capping —
+    xla path only (auto falls back; forced flash fails loudly).
     """
+    static_window = window is None or isinstance(window, int)
     if impl == "auto":
         on_tpu = jax.default_backend() == "tpu"
         aligned = (q.shape[1] % 128 == 0 and k.shape[1] % 128 == 0
                    and q.shape[-1] % 64 == 0)
-        impl = "flash" if (on_tpu and aligned and causal and standard_layout) else "xla"
+        impl = ("flash" if (on_tpu and aligned and causal and standard_layout
+                            and logit_softcap is None and scale is None
+                            and static_window) else "xla")
     if impl == "flash":
+        if logit_softcap is not None or scale is not None or not static_window:
+            raise ValueError(
+                "impl='flash' does not implement logit softcapping, scale "
+                "overrides, or traced (per-layer) windows — use impl='xla' "
+                "(auto falls back by itself)")
         from .flash_attention import flash_attention
 
         return flash_attention(q, k, v, causal=causal, window=window)
-    return _xla_attention(q, k, v, causal, positions, kv_positions, window)
+    return _xla_attention(q, k, v, causal, positions, kv_positions, window,
+                          scale, logit_softcap)

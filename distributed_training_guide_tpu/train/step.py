@@ -134,6 +134,13 @@ class Trainer:
                     f"runtime sequence length and cannot run under context "
                     f"parallelism (sequence shards would disagree); use a "
                     f"static rope type (linear/yarn/llama3) or cp=1")
+        if getattr(self.bundle.config, "layer_windows", None) and (
+                self.plan.mesh.shape.get("cp", 1) > 1
+                or self.plan.mesh.shape.get("pp", 1) > 1):
+            raise ValueError(
+                "per-layer sliding-window patterns (Gemma-2 layer_windows) "
+                "are not implemented under context or pipeline parallelism; "
+                "use dp/fsdp/tp plans")
         if self.offload_opt_state or self.offload_params:
             kinds = {m.kind for m in jax.local_devices()[0].addressable_memories()}
             if "pinned_host" not in kinds:
@@ -297,6 +304,18 @@ class Trainer:
                 raise ValueError(f"unknown context_impl "
                                  f"{self.context_impl!r}; use 'ring' or "
                                  f"'ulysses'")
+        elif (not callable(attn_impl)
+              and (getattr(cfg, "attn_logit_softcap", None) is not None
+                   or getattr(cfg, "query_pre_attn_scalar", None)
+                   or getattr(cfg, "layer_windows", None))):
+            # Gemma-2 attention extras run on the xla path only — wrapping
+            # the sharded flash kernel here would silently drop the softcap
+            if attn_impl == "flash":
+                raise ValueError(
+                    "attn_impl='flash' does not implement logit softcapping "
+                    "/ score-scale overrides / per-layer windows (Gemma-2); "
+                    "drop --attn-impl (auto resolves to the xla path)")
+            attn_impl = "xla"
         elif (not callable(attn_impl)
               and (attn_impl == "flash"
                    or (attn_impl == "auto"

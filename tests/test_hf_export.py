@@ -94,6 +94,33 @@ def test_export_qwen3_qk_norm_roundtrip(tmp_path):
     _roundtrip(tmp_path, model, bundle, 128)
 
 
+def test_export_gemma2_sandwich_roundtrip(tmp_path):
+    """The Gemma-2 emitter: four norms per layer (post_attn_norm re-mapped
+    to pre_feedforward_layernorm), softcaps/scale/layer_types in the
+    config, arch selected from sandwich_norm — through AutoModel reload."""
+    hf_cfg = transformers.Gemma2Config(
+        vocab_size=128, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        head_dim=32, max_position_embeddings=256, rope_theta=10000.0,
+        rms_norm_eps=1e-6, query_pre_attn_scalar=24.0,
+        attn_logit_softcapping=50.0, final_logit_softcapping=30.0,
+        sliding_window=16, attn_implementation="eager",
+        hidden_activation="gelu_pytorch_tanh", tie_word_embeddings=True)
+    torch.manual_seed(0)
+    model = transformers.Gemma2ForCausalLM(hf_cfg).eval()
+    with torch.no_grad():
+        for layer in model.model.layers:
+            layer.pre_feedforward_layernorm.weight.normal_(0.0, 0.3)
+            layer.post_feedforward_layernorm.weight.normal_(0.0, 0.3)
+    bundle = get_model("gemma2-2b", vocab_size=128, hidden_size=64,
+                       intermediate_size=128, num_layers=2, num_heads=4,
+                       num_kv_heads=2, head_dim=32,
+                       layer_windows=(16, 0), query_pre_attn_scalar=24.0,
+                       max_position_embeddings=256, rope_theta=10000.0,
+                       dtype=jnp.float32)
+    _roundtrip(tmp_path, model, bundle, 128)
+
+
 def test_export_olmo2_post_norm_roundtrip(tmp_path):
     """The post-norm leaves (attn_out_norm/mlp_out_norm, flat q/k norms) +
     the post_norm -> Olmo2 arch selection through AutoModel reload."""

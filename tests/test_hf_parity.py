@@ -265,6 +265,52 @@ def test_gemma_parity(tmp_path):
     assert np.isfinite(_one_train_step(bundle, plan, params, ids))
 
 
+def test_gemma2_parity(tmp_path):
+    """Gemma-2 = Gemma + four REAL mechanism changes, all pinned here at
+    once: sandwich norms (both sides of each sublayer), tanh softcapping of
+    attention scores and final logits, the query_pre_attn_scalar score
+    scale, and the ALTERNATING per-layer sliding/full window pattern. seq
+    48 > window 16 means the even (sliding) layers genuinely band while the
+    odd (full) layers don't — a uniform-window implementation cannot pass."""
+    hf_cfg = transformers.Gemma2Config(
+        vocab_size=128, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        head_dim=32, max_position_embeddings=256, rope_theta=10000.0,
+        rms_norm_eps=1e-6, query_pre_attn_scalar=24.0,
+        attn_logit_softcapping=50.0, final_logit_softcapping=30.0,
+        sliding_window=16, attn_implementation="eager",
+        hidden_activation="gelu_pytorch_tanh", tie_word_embeddings=True)
+    torch.manual_seed(0)
+    model = transformers.Gemma2ForCausalLM(hf_cfg).eval()
+    with torch.no_grad():
+        for layer in model.model.layers:
+            layer.post_attention_layernorm.weight.normal_(0.0, 0.3)
+            layer.pre_feedforward_layernorm.weight.normal_(0.0, 0.3)
+            layer.post_feedforward_layernorm.weight.normal_(0.0, 0.3)
+    model.save_pretrained(tmp_path / "hf", safe_serialization=True)
+
+    bundle = get_model(f"hf:{tmp_path / 'hf'}", dtype=jnp.float32)
+    c = bundle.config
+    assert c.sandwich_norm and c.attn_logit_softcap == 50.0
+    assert c.final_logit_softcap == 30.0 and c.query_pre_attn_scalar == 24.0
+    assert c.layer_windows == (16, 0) and c.sliding_window is None
+    convert_hf_checkpoint(tmp_path / "hf", tmp_path / "conv", bundle=bundle)
+    plan = make_plan("single", make_mesh(devices=jax.devices()[:1]))
+    params = load_pretrained(bundle, _replicated_shardings(bundle, plan),
+                             tmp_path / "conv")
+    assert "attn_out_norm" in params["layers"]
+    assert "post_attn_norm" in params["layers"]   # the pre-FFN norm slot
+
+    ids = np.random.RandomState(0).randint(0, 128, (2, 48))
+    ours = np.asarray(bundle.apply(bundle.config, params, jnp.asarray(ids)))
+    with torch.no_grad():
+        theirs = model(torch.tensor(ids)).logits.float().numpy()
+    np.testing.assert_allclose(ours, theirs, rtol=2e-4, atol=2e-4)
+
+    # pretrained -> one optimizer step through the sandwich wiring
+    assert np.isfinite(_one_train_step(bundle, plan, params, ids))
+
+
 @pytest.mark.parametrize("parallel_residual", [True, False])
 def test_neox_parity(tmp_path, parallel_residual):
     """GPT-NeoX/Pythia: the parallel-residual block (x + attn(ln1 x) +
@@ -361,6 +407,13 @@ def test_auto_hf_config_ingestion(tmp_path, caplog):
                                   intermediate_size=64, num_hidden_layers=2,
                                   num_attention_heads=4, num_key_value_heads=2),
          "llama", lambda c: c.post_norm and c.qk_norm == "flat"),
+        (transformers.Gemma2Config(vocab_size=64, hidden_size=32,
+                                   intermediate_size=64, num_hidden_layers=4,
+                                   num_attention_heads=4, num_key_value_heads=2,
+                                   head_dim=16, sliding_window=8,
+                                   max_position_embeddings=256),
+         "llama", lambda c: (c.sandwich_norm and c.attn_logit_softcap
+                             and c.layer_windows == (8, 0, 8, 0))),
         (transformers.GPT2Config(vocab_size=64, n_embd=32, n_layer=2,
                                  n_head=4), "gpt2",
          lambda c: c.num_layers == 2),

@@ -144,6 +144,23 @@ def test_kv_cache_olmo2_post_norm_matches_recompute():
     assert fast == slow
 
 
+def test_kv_cache_gemma2_matches_recompute():
+    """Gemma-2's cache path: sandwich norms, softcaps, score scale, and the
+    per-layer window column threaded through the decode scans — cached
+    greedy must equal the recompute sampler past the sliding window."""
+    bundle = get_model("gemma2-2b", vocab_size=256, hidden_size=64,
+                       intermediate_size=128, num_layers=2, num_heads=4,
+                       num_kv_heads=2, head_dim=16,
+                       layer_windows=(8, 0), query_pre_attn_scalar=24.0,
+                       max_position_embeddings=128, dtype=jnp.float32)
+    assert bundle.config.sandwich_norm and bundle.config.layer_windows
+    params = bundle.init(bundle.config, jax.random.key(9))
+    prompt = list(range(2, 14))            # prompt longer than the window
+    slow = make_sampler(bundle)(params, prompt, 6)
+    fast = make_sampler(bundle, kv_cache=True)(params, prompt, 6)
+    assert fast == slow
+
+
 def test_kv_cache_moe_matches_recompute():
     """The MoE cache path: routed FFN per decoded token (drop-free expert
     dispatch in prefill/decode) through the shared cache contract. The
