@@ -193,6 +193,35 @@ def test_olmo2_post_norm_tp_matches_single_device(eight_devices):
     np.testing.assert_allclose(got, golden, rtol=1e-4)
 
 
+def test_gemma2_sandwich_tp_matches_single_device(eight_devices):
+    """Gemma-2 under tensor parallelism: sandwich norms ride the psum'd
+    sublayer outputs, the traced per-layer window mask and softcap run on
+    the xla path under GSPMD sharding — trajectory must match single-device."""
+    bundle = get_model("gemma2-2b", vocab_size=512, hidden_size=64,
+                       intermediate_size=128, num_layers=2, num_heads=4,
+                       num_kv_heads=2, head_dim=16, layer_windows=(8, 0),
+                       query_pre_attn_scalar=24.0,
+                       max_position_embeddings=256, dtype=jnp.float32)
+    assert bundle.config.sandwich_norm and bundle.config.attn_logit_softcap
+
+    def run(strategy, mesh):
+        t = Trainer(bundle=bundle, optimizer=adamw_cosine(1e-3),
+                    plan=make_plan(strategy, mesh), donate=False)
+        state = t.init_state(0)
+        ids = np.random.RandomState(0).randint(0, 512, (GLOBAL_BATCH, SEQ))
+        batch = {k: jax.device_put(jnp.asarray(ids), t.batch_shardings()[k])
+                 for k in ("input_ids", "labels")}
+        losses = []
+        for _ in range(2):
+            state, m = t.step_fn(state, batch)
+            losses.append(float(m["loss"]))
+        return losses
+
+    golden = run("single", make_mesh(devices=jax.devices()[:1]))
+    got = run("tp", make_mesh(tp=2))
+    np.testing.assert_allclose(got, golden, rtol=1e-4)
+
+
 def test_params_actually_sharded(eight_devices):
     trainer = make_trainer("fsdp", fsdp=8)
     state = trainer.init_state(0)
