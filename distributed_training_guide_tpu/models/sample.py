@@ -5,9 +5,9 @@ Neither the reference nor this guide is an inference framework; this is
 the smallest honest sampler. Default mode re-runs the FULL forward over a
 fixed-size buffer per token (any family, one compile); ``--kv-cache``
 switches to prefill + single-token decode steps over a functional KV
-cache carried through the layer scan (llama, gpt2, neox, and moe — the
-routed FFN runs drop-free per decoded token; same tokens, pinned per
-family by test). Either way: a qualitative check for checkpoints, not a
+cache carried through the layer scan (the llama family incl. qwen3/
+olmo2/gemma2 wirings, gpt2, neox, and moe — the routed FFN runs
+drop-free per decoded token; same tokens, pinned per family by test). Either way: a qualitative check for checkpoints, not a
 serving path.
 
     # hermetic (no tokenizer): raw token ids in, ids out
@@ -33,7 +33,7 @@ def make_sampler(bundle, temperature: float = 0.0, kv_cache: bool = False):
       fixed buffer and the token at ``pos`` is written — O(steps x
       forward(prompt+steps));
     - ``kv_cache=True`` (families exporting ``init_cache``/``prefill``/
-      ``decode_step`` — llama, gpt2, neox, moe): one prefill over the
+      ``decode_step`` — the llama family, gpt2, neox, moe): one prefill over the
       prompt, then one single-token program per step attending over the
       cache — O(forward(prompt) + steps x token).
 
