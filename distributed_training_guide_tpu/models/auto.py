@@ -25,27 +25,25 @@ def _sliding_window_kw(cfg: dict, arch: str) -> dict:
     """``sliding_window`` from an HF config dict. Qwen2-style configs gate
     it behind ``use_sliding_window`` (default False — the key is present on
     every Qwen2 config but usually inert); everywhere else a non-null value
-    is live. Values >= max_position are dropped (the band never binds)."""
+    is live. Values >= max_position are dropped (the band never binds).
+
+    Qwen2/Qwen3 additionally keep the FIRST ``max_window_layers`` layers on
+    FULL attention (sliding only afterwards): that mixed pattern maps onto
+    ``layer_windows`` — the per-layer window column Gemma-2's alternating
+    scheme rides — instead of the uniform ``sliding_window``."""
     window = cfg.get("sliding_window")
     if not window:
+        return {}
+    if window >= cfg.get("max_position_embeddings", 4096):
         return {}
     if arch in ("Qwen2ForCausalLM", "Qwen3ForCausalLM"):
         if not cfg.get("use_sliding_window"):
             return {}
-        # HF additionally keeps the FIRST max_window_layers layers on full
-        # attention (layer_types = full*mwl + sliding*rest); the native
-        # config has ONE global window — a mixed-layer checkpoint must fail
-        # loudly here, not silently band every layer
-        mwl = cfg.get("max_window_layers", cfg["num_hidden_layers"])
-        if mwl and mwl < cfg["num_hidden_layers"]:
-            raise ValueError(
-                f"{arch}: max_window_layers={mwl} < num_hidden_layers="
-                f"{cfg['num_hidden_layers']} mixes full- and sliding-window "
-                f"layers, which this family does not implement (one global "
-                f"sliding_window); retrain/eval with seq <= window or use "
-                f"a uniform-window checkpoint")
-    if window >= cfg.get("max_position_embeddings", 4096):
-        return {}
+        n = cfg["num_hidden_layers"]
+        mwl = cfg.get("max_window_layers", n)
+        if mwl and mwl < n:
+            return {"layer_windows": tuple(
+                0 if i < mwl else int(window) for i in range(n))}
     return {"sliding_window": int(window)}
 
 
@@ -172,9 +170,20 @@ def _build_mixtral(cfg: dict, arch: str):
         **_llama_kwargs(cfg),
         **_sliding_window_kw(cfg, arch),
     )
+    _reject_moe_layer_windows(kw, arch)
     if "router_aux_loss_coef" in cfg:   # HF Mixtral ships 0.02, not our 0.01
         kw["router_aux_coef"] = cfg["router_aux_loss_coef"]
     return MoELlamaConfig(**kw)
+
+
+def _reject_moe_layer_windows(kw: dict, arch: str) -> None:
+    if kw.pop("layer_windows", None) is not None:
+        # the moe family's layer scan doesn't thread the per-layer window
+        # column (dense llama does) — refuse rather than band every layer
+        raise ValueError(
+            f"{arch}: mixed full/sliding layer patterns (max_window_layers) "
+            f"are not implemented for the MoE family; use a uniform-window "
+            f"or windowless checkpoint")
 
 
 def _build_qwen3_moe(cfg: dict, arch: str):
@@ -195,6 +204,7 @@ def _build_qwen3_moe(cfg: dict, arch: str):
         **_llama_kwargs(cfg),
         **_sliding_window_kw(cfg, arch),
     )
+    _reject_moe_layer_windows(kw, arch)
     # the per-expert FFN width is moe_intermediate_size (plain
     # intermediate_size is the dense-MLP width of the mlp_only_layers we
     # just rejected)

@@ -204,6 +204,21 @@ _EMITTERS = {"llama": _emit_llama, "gpt2": _emit_gpt2, "neox": _emit_neox,
 # config.json emitters (inverse of models/auto.py's builders)
 # ---------------------------------------------------------------------------
 
+def _qwen_window_out(c) -> dict:
+    """Qwen2/3 sliding-window keys for export. A uniform window maps to
+    use_sliding_window; a full-then-sliding ``layer_windows`` pattern
+    (ingested from max_window_layers) maps back to that key — dropping
+    either would reload as full attention: silent divergence."""
+    lw = getattr(c, "layer_windows", None)
+    if lw:
+        return {"sliding_window": max(lw), "use_sliding_window": True,
+                "max_window_layers": next(
+                    (i for i, w in enumerate(lw) if w), len(lw))}
+    if getattr(c, "sliding_window", None):
+        return {"sliding_window": c.sliding_window, "use_sliding_window": True}
+    return {}
+
+
 def _rope_scaling_out(c) -> dict:
     """Round-trip the frozen rope_scaling tuple back to HF's dict form —
     dropping it would reload as plain RoPE: silently divergent long-context
@@ -294,9 +309,7 @@ def _hf_config(bundle) -> dict:
     elif getattr(c, "qk_norm", False):
         base.update(architectures=["Qwen3ForCausalLM"], model_type="qwen3",
                     head_dim=c.head_size, attention_bias=False)
-        if getattr(c, "sliding_window", None):  # Qwen3 gates SWA like Qwen2
-            base.update(sliding_window=c.sliding_window,
-                        use_sliding_window=True)
+        base.update(_qwen_window_out(c))
     elif getattr(c, "norm_plus_one", False):
         base.update(architectures=["GemmaForCausalLM"], model_type="gemma",
                     head_dim=c.head_size,
@@ -306,9 +319,7 @@ def _hf_config(bundle) -> dict:
         base.update(architectures=["Qwen2ForCausalLM"], model_type="qwen2")
         if c.head_dim:  # same silent-divergence risk as the llama branch:
             base["head_dim"] = c.head_dim  # default is hidden/heads on reload
-        if getattr(c, "sliding_window", None):  # Qwen2 gates SWA on the flag
-            base.update(sliding_window=c.sliding_window,
-                        use_sliding_window=True)
+        base.update(_qwen_window_out(c))
     elif getattr(c, "sliding_window", None):
         # plain-llama math + a live window == Mistral (HF LlamaConfig has no
         # sliding_window; exporting it as llama would silently drop the band)

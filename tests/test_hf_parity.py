@@ -265,6 +265,35 @@ def test_gemma_parity(tmp_path):
     assert np.isfinite(_one_train_step(bundle, plan, params, ids))
 
 
+def test_qwen2_max_window_layers_parity(tmp_path):
+    """Qwen2 with use_sliding_window=True and max_window_layers < L: the
+    FIRST layer runs full attention, the second bands at window 16. seq 48
+    > window means the two layers genuinely differ — pins the layer_windows
+    ingestion path for the qwen flavor (Gemma-2 pins the alternating one)."""
+    hf_cfg = transformers.Qwen2Config(
+        vocab_size=128, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=256, rope_theta=10000.0, rms_norm_eps=1e-5,
+        sliding_window=16, use_sliding_window=True, max_window_layers=1,
+        attn_implementation="eager", tie_word_embeddings=False)
+    torch.manual_seed(0)
+    model = transformers.Qwen2ForCausalLM(hf_cfg).eval()
+    model.save_pretrained(tmp_path / "hf", safe_serialization=True)
+
+    bundle = get_model(f"hf:{tmp_path / 'hf'}", dtype=jnp.float32)
+    assert bundle.config.layer_windows == (0, 16)
+    convert_hf_checkpoint(tmp_path / "hf", tmp_path / "conv", bundle=bundle)
+    plan = make_plan("single", make_mesh(devices=jax.devices()[:1]))
+    params = load_pretrained(bundle, _replicated_shardings(bundle, plan),
+                             tmp_path / "conv")
+
+    ids = np.random.RandomState(0).randint(0, 128, (2, 48))
+    ours = np.asarray(bundle.apply(bundle.config, params, jnp.asarray(ids)))
+    with torch.no_grad():
+        theirs = model(torch.tensor(ids)).logits.float().numpy()
+    np.testing.assert_allclose(ours, theirs, rtol=2e-4, atol=2e-4)
+
+
 def test_gemma2_parity(tmp_path):
     """Gemma-2 = Gemma + four REAL mechanism changes, all pinned here at
     once: sandwich norms (both sides of each sublayer), tanh softcapping of
@@ -489,9 +518,9 @@ def test_auto_hf_config_ingestion(tmp_path, caplog):
         max_position_embeddings=32768).save_pretrained(qwen_swa)
     _, qcfg = config_from_hf(qwen_swa)
     assert qcfg.sliding_window is None
-    # ...and a LIVE Qwen2 window with max_window_layers < num_layers mixes
-    # full- and sliding-window layers — unimplementable with one global
-    # window, must fail loudly at ingestion (not silently band every layer)
+    # ...and a LIVE Qwen2 window with max_window_layers < num_layers (the
+    # first mwl layers stay FULL attention) maps onto the per-layer
+    # layer_windows column (numerics pinned in test_qwen2_max_window_layers_parity)
     qwen_mixed = tmp_path / "qwen_mixed"
     qwen_mixed.mkdir()
     transformers.Qwen2Config(
@@ -499,8 +528,9 @@ def test_auto_hf_config_ingestion(tmp_path, caplog):
         num_hidden_layers=4, num_attention_heads=4, num_key_value_heads=2,
         sliding_window=4096, use_sliding_window=True, max_window_layers=2,
         max_position_embeddings=32768).save_pretrained(qwen_mixed)
-    with pytest.raises(ValueError, match="max_window_layers"):
-        config_from_hf(qwen_mixed)
+    _, qmcfg = config_from_hf(qwen_mixed)
+    assert qmcfg.layer_windows == (0, 0, 4096, 4096)
+    assert qmcfg.sliding_window is None
 
     # rope_scaling is SUPPORTED: ingestion freezes the dict onto the config
     # (full numerics parity is pinned in tests/test_rope_scaling.py)
