@@ -1,13 +1,13 @@
 """AutoModel-style config ingestion: HF ``config.json`` -> a native bundle.
 
 The reference trains *any* HF causal LM via ``AutoModelForCausalLM``
-(``01-single-gpu/train_llm.py:57``). The native families here cover twelve
+(``01-single-gpu/train_llm.py:57``). The native families here cover thirteen
 HF architectures; this module removes the remaining friction — needing a
 registry preset for every size variant. ``-m hf:<dir>`` (or
 ``get_model("hf:<dir>")``) reads the checkpoint's own ``config.json``,
 recognizes the architecture, and builds the exact family config — so any
 Llama/Mistral/Qwen2/Qwen3/Gemma/Gemma-2/Phi-3/OLMo-2/GPT-2/Mixtral/
-Qwen3-MoE/GPT-NeoX(Pythia) checkpoint trains (and converts, ``models/hf_convert.py``) without touching the
+Qwen2-MoE/Qwen3-MoE/GPT-NeoX(Pythia) checkpoint trains (and converts, ``models/hf_convert.py``) without touching the
 registry:
 
     python convert_llama.py <hf-dir> <conv> hf:<hf-dir>
@@ -186,6 +186,31 @@ def _reject_moe_layer_windows(kw: dict, arch: str) -> None:
             f"or windowless checkpoint")
 
 
+def _build_qwen2_moe(cfg: dict, arch: str):
+    from .moe import MoELlamaConfig
+
+    if cfg.get("mlp_only_layers") or cfg.get("decoder_sparse_step", 1) != 1:
+        raise ValueError(
+            f"{arch}: mlp_only_layers={cfg.get('mlp_only_layers')} / "
+            f"decoder_sparse_step={cfg.get('decoder_sparse_step')} mixes "
+            f"dense and MoE layers, which this family does not implement "
+            f"(uniform MoE blocks only)")
+    kw = dict(
+        num_experts=cfg["num_experts"],
+        experts_per_token=cfg["num_experts_per_tok"],
+        **_llama_kwargs(cfg),
+        **_sliding_window_kw(cfg, arch),
+    )
+    _reject_moe_layer_windows(kw, arch)
+    kw["intermediate_size"] = cfg["moe_intermediate_size"]
+    kw["shared_expert_intermediate"] = cfg["shared_expert_intermediate_size"]
+    kw["attn_bias"] = True                    # Qwen2 attention (QKV biases)
+    kw["norm_topk_prob"] = cfg.get("norm_topk_prob", False)
+    if "router_aux_loss_coef" in cfg:
+        kw["router_aux_coef"] = cfg["router_aux_loss_coef"]
+    return MoELlamaConfig(**kw)
+
+
 def _build_qwen3_moe(cfg: dict, arch: str):
     from .moe import MoELlamaConfig
 
@@ -259,6 +284,7 @@ _ARCH_BUILDERS = {
     "Gemma2ForCausalLM": ("llama", _build_llama),
     "GPT2LMHeadModel": ("gpt2", _build_gpt2),
     "MixtralForCausalLM": ("moe", _build_mixtral),
+    "Qwen2MoeForCausalLM": ("moe", _build_qwen2_moe),
     "Qwen3MoeForCausalLM": ("moe", _build_qwen3_moe),
     "GPTNeoXForCausalLM": ("neox", _build_neox),
     # Phi-3 is llama-math with fused checkpoint tensors (qkv_proj,
@@ -286,6 +312,7 @@ def config_from_hf(config_path: str | Path):
                "gemma": "GemmaForCausalLM", "gemma2": "Gemma2ForCausalLM",
                "olmo2": "Olmo2ForCausalLM",
                "gpt2": "GPT2LMHeadModel", "mixtral": "MixtralForCausalLM",
+               "qwen2_moe": "Qwen2MoeForCausalLM",
                "qwen3_moe": "Qwen3MoeForCausalLM",
                "gpt_neox": "GPTNeoXForCausalLM", "phi3": "Phi3ForCausalLM"}
     if not archs and cfg.get("model_type") in by_type:

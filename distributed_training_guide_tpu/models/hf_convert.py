@@ -140,8 +140,9 @@ def _map_mixtral(name: str):
         if rest == "gate.weight":
             return "layers.moe.router", idx, True
         return None
-    # Qwen3-MoE spells the same block `mlp.` with llama-style expert names
-    # (gate_proj/up_proj/down_proj) and `mlp.gate` as the router
+    # Qwen2/3-MoE spell the same block `mlp.` with llama-style expert names
+    # (gate_proj/up_proj/down_proj) and `mlp.gate` as the router; Qwen2-MoE
+    # adds the shared expert + its scalar gate
     m = re.match(r"model\.layers\.(\d+)\.mlp\.(.+)", name)
     if m:
         idx, rest = int(m.group(1)), m.group(2)
@@ -154,6 +155,13 @@ def _map_mixtral(name: str):
             return leaf, (idx, int(e.group(1))), True
         if rest == "gate.weight":
             return "layers.moe.router", idx, True
+        shared = {"shared_expert.gate_proj.weight": "layers.moe.shared_gate_proj",
+                  "shared_expert.up_proj.weight": "layers.moe.shared_up",
+                  "shared_expert.down_proj.weight": "layers.moe.shared_down"}
+        if rest in shared:
+            return shared[rest], idx, True
+        if rest == "shared_expert_gate.weight":   # [1, E] Linear -> [E]
+            return "layers.moe.shared_gate", idx, lambda w: w[0]
         return None
     return _map_llama(name)
 

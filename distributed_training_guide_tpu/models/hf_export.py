@@ -169,15 +169,21 @@ def _emit_moe(config, leaves: dict) -> dict:
         "layers.attn.wv": "self_attn.v_proj.weight",
         "layers.attn.wo": "self_attn.o_proj.weight",
     }
-    # qk_norm selects the Qwen3-MoE spelling (mlp.experts.N.gate_proj...);
-    # plain configs keep Mixtral's (block_sparse_moe.experts.N.w1...)
-    qwen3 = bool(getattr(config, "qk_norm", False))
+    # qk_norm (Qwen3-MoE) or a shared expert (Qwen2-MoE) selects the qwen
+    # spelling (mlp.experts.N.gate_proj...); plain configs keep Mixtral's
+    # (block_sparse_moe.experts.N.w1...)
+    qwen = bool(getattr(config, "qk_norm", False)
+                or getattr(config, "shared_expert_intermediate", None))
     expert_names = ({"gate": "gate_proj", "up": "up_proj", "down": "down_proj"}
-                    if qwen3 else {"gate": "w1", "up": "w3", "down": "w2"})
+                    if qwen else {"gate": "w1", "up": "w3", "down": "w2"})
     for i in range(config.num_layers):
         for leaf, hf in attn.items():
             out[f"model.layers.{i}.{hf}"] = leaves[leaf][i].T
-        if qwen3:
+        if "layers.attn.bq" in leaves:   # Qwen2-MoE QKV biases
+            for b, hf in (("bq", "q_proj"), ("bk", "k_proj"), ("bv", "v_proj")):
+                out[f"model.layers.{i}.self_attn.{hf}.bias"] = \
+                    leaves[f"layers.attn.{b}"][i]
+        if getattr(config, "qk_norm", False):
             out[f"model.layers.{i}.self_attn.q_norm.weight"] = \
                 leaves["layers.attn.q_norm"][i]
             out[f"model.layers.{i}.self_attn.k_norm.weight"] = \
@@ -186,13 +192,21 @@ def _emit_moe(config, leaves: dict) -> dict:
             leaves["layers.input_norm"][i]
         out[f"model.layers.{i}.post_attention_layernorm.weight"] = \
             leaves["layers.post_attn_norm"][i]
-        moe_prefix = (f"model.layers.{i}.mlp" if qwen3
+        moe_prefix = (f"model.layers.{i}.mlp" if qwen
                       else f"model.layers.{i}.block_sparse_moe")
         out[f"{moe_prefix}.gate.weight"] = leaves["layers.moe.router"][i].T
         for x in range(config.num_experts):
             for ours, theirs in expert_names.items():
                 out[f"{moe_prefix}.experts.{x}.{theirs}.weight"] = \
                     leaves[f"layers.moe.{ours}"][i, x].T
+        if "layers.moe.shared_gate" in leaves:   # Qwen2-MoE shared expert
+            for ours, theirs in (("shared_gate_proj", "gate_proj"),
+                                 ("shared_up", "up_proj"),
+                                 ("shared_down", "down_proj")):
+                out[f"{moe_prefix}.shared_expert.{theirs}.weight"] = \
+                    leaves[f"layers.moe.{ours}"][i].T
+            out[f"{moe_prefix}.shared_expert_gate.weight"] = \
+                leaves["layers.moe.shared_gate"][i][None, :]
     return out
 
 
@@ -271,7 +285,18 @@ def _hf_config(bundle) -> dict:
             "tie_word_embeddings": c.tie_word_embeddings,
             **_rope_scaling_out(c)}
     if bundle.family == "moe":
-        if getattr(c, "qk_norm", False):
+        if getattr(c, "shared_expert_intermediate", None):
+            out = {**base, "architectures": ["Qwen2MoeForCausalLM"],
+                   "model_type": "qwen2_moe",
+                   "num_experts": c.num_experts,
+                   "num_experts_per_tok": c.experts_per_token,
+                   "moe_intermediate_size": c.intermediate_size,
+                   "shared_expert_intermediate_size":
+                       c.shared_expert_intermediate,
+                   "norm_topk_prob": c.norm_topk_prob,
+                   "router_aux_loss_coef": c.router_aux_coef,
+                   "decoder_sparse_step": 1, "mlp_only_layers": []}
+        elif getattr(c, "qk_norm", False):
             out = {**base, "architectures": ["Qwen3MoeForCausalLM"],
                    "model_type": "qwen3_moe",
                    "num_experts": c.num_experts,

@@ -65,6 +65,12 @@ class MoELlamaConfig:
     # llama.attention_sublayer's contract. Only the per-head (True) form
     # exists in MoE checkpoints — no flat variant here
     qk_norm: bool = False
+    # QKV projection biases (Qwen2-MoE attention is Qwen2-style)
+    attn_bias: bool = False
+    # Qwen2-MoE shared expert: a dense gated MLP of this width runs on
+    # EVERY token, its output scaled by sigmoid(x @ shared_gate) and added
+    # to the routed combine. None = no shared expert (Mixtral/Qwen3-MoE)
+    shared_expert_intermediate: Optional[int] = None
     head_dim: Optional[int] = None
     max_position_embeddings: int = 4096
     rope_theta: float = 10000.0
@@ -86,7 +92,11 @@ class MoELlamaConfig:
         attn = e * hq + 2 * e * hkv + hq * e
         if self.qk_norm:
             attn += 2 * d
+        if self.attn_bias:
+            attn += hq + 2 * hkv
         moe = e * self.num_experts + self.num_experts * 3 * e * f
+        if self.shared_expert_intermediate:
+            moe += 3 * e * self.shared_expert_intermediate + e
         per_layer = attn + moe + 2 * e
         head = 0 if self.tie_word_embeddings else e * v
         return v * e + self.num_layers * per_layer + e + head
@@ -100,7 +110,11 @@ class MoELlamaConfig:
         attn = e * hq + 2 * e * hkv + hq * e
         if self.qk_norm:
             attn += 2 * d
+        if self.attn_bias:
+            attn += hq + 2 * hkv
         moe = e * self.num_experts + self.experts_per_token * 3 * e * f
+        if self.shared_expert_intermediate:   # always active
+            moe += 3 * e * self.shared_expert_intermediate + e
         per_layer = attn + moe + 2 * e
         head = 0 if self.tie_word_embeddings else e * v
         return v * e + self.num_layers * per_layer + e + head
@@ -129,16 +143,29 @@ def init(config: MoELlamaConfig, rng: jax.Array) -> dict:
     if config.qk_norm:     # Qwen3-MoE per-head q/k RMSNorm scales
         attn.update(q_norm=jnp.ones((l, d), config.param_dtype),
                     k_norm=jnp.ones((l, d), config.param_dtype))
+    if config.attn_bias:   # Qwen2-MoE QKV biases (zeros, like HF init)
+        attn.update(bq=jnp.zeros((l, hq), config.param_dtype),
+                    bk=jnp.zeros((l, hkv), config.param_dtype),
+                    bv=jnp.zeros((l, hkv), config.param_dtype))
+    moe_leaves = {
+        "router": dense(next(keys), (l, e, ex)),
+        "gate": dense(next(keys), (l, ex, e, f)),
+        "up": dense(next(keys), (l, ex, e, f)),
+        "down": dense(next(keys), (l, ex, f, e)),
+    }
+    if config.shared_expert_intermediate:   # Qwen2-MoE shared expert
+        fs = config.shared_expert_intermediate
+        moe_leaves.update(
+            shared_gate_proj=dense(next(keys), (l, e, fs)),
+            shared_up=dense(next(keys), (l, e, fs)),
+            shared_down=dense(next(keys), (l, fs, e)),
+            shared_gate=dense(next(keys), (l, e)),
+        )
     params = {
         "embed": {"embedding": embed},
         "layers": {
             "attn": attn,
-            "moe": {
-                "router": dense(next(keys), (l, e, ex)),
-                "gate": dense(next(keys), (l, ex, e, f)),
-                "up": dense(next(keys), (l, ex, e, f)),
-                "down": dense(next(keys), (l, ex, f, e)),
-            },
+            "moe": moe_leaves,
             "input_norm": jnp.ones((l, e), config.param_dtype),
             "post_attn_norm": jnp.ones((l, e), config.param_dtype),
         },
@@ -159,16 +186,28 @@ def param_logical_axes(config: MoELlamaConfig) -> dict:
     if config.qk_norm:
         attn_axes.update(q_norm=("layers", "head_dim_vector"),
                          k_norm=("layers", "head_dim_vector"))
+    if config.attn_bias:
+        attn_axes.update(bq=("layers", "heads"), bk=("layers", "kv"),
+                         bv=("layers", "kv"))
+    moe_axes = {
+        "router": ("layers", "embed", "experts_vector"),
+        "gate": ("layers", "experts", "embed", "mlp"),
+        "up": ("layers", "experts", "embed", "mlp"),
+        "down": ("layers", "experts", "mlp", "embed"),
+    }
+    if config.shared_expert_intermediate:
+        # the shared expert is a plain dense MLP: megatron mlp-dim shards
+        # under tp, no expert dim (replicated over ep); the scalar gate
+        # vector is never sharded
+        moe_axes.update(shared_gate_proj=("layers", "embed", "mlp"),
+                        shared_up=("layers", "embed", "mlp"),
+                        shared_down=("layers", "mlp", "embed"),
+                        shared_gate=("layers", "embed_vector"))
     axes = {
         "embed": {"embedding": ("vocab", "embed")},
         "layers": {
             "attn": attn_axes,
-            "moe": {
-                "router": ("layers", "embed", "experts_vector"),
-                "gate": ("layers", "experts", "embed", "mlp"),
-                "up": ("layers", "experts", "embed", "mlp"),
-                "down": ("layers", "experts", "mlp", "embed"),
-            },
+            "moe": moe_axes,
             "input_norm": ("layers", "embed_vector"),
             "post_attn_norm": ("layers", "embed_vector"),
         },
@@ -271,6 +310,19 @@ def _moe_ffn(config: MoELlamaConfig, x: jnp.ndarray, moe: dict,
     # choice-rank-major layout — a reshape and a dense sum
     y = jnp.sum((y_choice * weight_flat[:, None].astype(cdt))
                 .reshape(k, t, d), axis=0)
+    if "shared_gate" in moe:   # Qwen2-MoE shared expert: dense gated MLP on
+        # every token, output scaled by a sigmoid scalar gate and ADDED to
+        # the routed combine. Under manual tp its mlp-dim-sharded down-proj
+        # is a partial sum like the routed one — the single psum below
+        # covers both (addition commutes with psum)
+        xs = xt.astype(cdt)
+        hs = jax.nn.silu(xs @ moe["shared_gate_proj"].astype(cdt))
+        hs = hs * (xs @ moe["shared_up"].astype(cdt))
+        shared_out = hs @ moe["shared_down"].astype(cdt)
+        sgate = jax.nn.sigmoid(
+            (xt.astype(jnp.float32) @ moe["shared_gate"].astype(jnp.float32)
+             )[:, None])
+        y = y + sgate.astype(cdt) * shared_out
     if tp_axis is not None:
         y = _psum(y, tp_axis)
 
@@ -449,6 +501,16 @@ PRESETS = {
                                    num_heads=32, num_kv_heads=8, num_experts=8,
                                    experts_per_token=2, rope_theta=1e6,
                                    max_position_embeddings=32768),
+    # Qwen1.5-MoE-A2.7B-shaped (public card): Qwen2 attention (QKV biases)
+    # + 60 experts top-4 at width 1408 + the 5632-wide shared expert
+    "qwen1.5-moe-a2.7b": MoELlamaConfig(vocab_size=151936, hidden_size=2048,
+                                        intermediate_size=1408, num_layers=24,
+                                        num_heads=16, num_kv_heads=16,
+                                        num_experts=60, experts_per_token=4,
+                                        attn_bias=True, norm_topk_prob=False,
+                                        shared_expert_intermediate=5632,
+                                        rope_theta=1e6, rms_norm_eps=1e-6,
+                                        max_position_embeddings=8192),
     # Qwen3-MoE 30B-A3B-shaped (public card): Qwen3 attention (qk_norm,
     # head_dim 128) + 128 experts top-8 at per-expert width 768
     "qwen3-30b-a3b": MoELlamaConfig(vocab_size=151936, hidden_size=2048,

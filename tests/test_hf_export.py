@@ -238,6 +238,32 @@ def test_export_qwen3_moe_roundtrip(tmp_path):
     _roundtrip(tmp_path, model, bundle, 128)
 
 
+def test_export_qwen2_moe_shared_expert_roundtrip(tmp_path):
+    """The Qwen2-MoE emitter: shared-expert leaves + the [1,E] scalar gate
+    + QKV bias rows, arch selected from shared_expert_intermediate."""
+    hf_cfg = transformers.Qwen2MoeConfig(
+        vocab_size=128, hidden_size=64, intermediate_size=128,
+        moe_intermediate_size=96, shared_expert_intermediate_size=112,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        num_experts=4, num_experts_per_tok=2, norm_topk_prob=False,
+        max_position_embeddings=256, rope_theta=10000.0, rms_norm_eps=1e-6,
+        tie_word_embeddings=False)
+    torch.manual_seed(0)
+    model = transformers.Qwen2MoeForCausalLM(hf_cfg).eval()
+    with torch.no_grad():
+        for layer in model.model.layers:
+            layer.self_attn.q_proj.bias.normal_(0.0, 0.5)
+            layer.mlp.shared_expert_gate.weight.normal_(0.0, 0.5)
+    bundle = get_model("qwen1.5-moe-a2.7b", vocab_size=128, hidden_size=64,
+                       intermediate_size=96, shared_expert_intermediate=112,
+                       num_layers=2, num_heads=4, num_kv_heads=2,
+                       num_experts=4, experts_per_token=2,
+                       max_position_embeddings=256, rope_theta=10000.0,
+                       rms_norm_eps=1e-6, capacity_factor=4.0,
+                       dtype=jnp.float32)
+    _roundtrip(tmp_path, model, bundle, 128)
+
+
 def test_export_cli_from_orbax_checkpoint(tmp_path, eight_devices):
     """The publish workflow end to end: train a few steps through the real
     chapter loop (Orbax checkpoint), run the hf_export CLI against the

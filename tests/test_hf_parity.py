@@ -638,6 +638,48 @@ def test_qwen3_moe_parity(tmp_path):
         config_from_hf(mixed)
 
 
+def test_qwen2_moe_parity(tmp_path):
+    """Qwen2-MoE = Qwen2 attention (QKV biases) + routed FFN + the SHARED
+    expert: a dense gated MLP on every token whose output is scaled by
+    sigmoid(x @ shared_expert_gate) and added to the routed combine.
+    capacity_factor = E for exactness vs HF's dense dispatch."""
+    hf_cfg = transformers.Qwen2MoeConfig(
+        vocab_size=128, hidden_size=64, intermediate_size=128,
+        moe_intermediate_size=96, shared_expert_intermediate_size=112,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        num_experts=4, num_experts_per_tok=2, norm_topk_prob=False,
+        decoder_sparse_step=1, mlp_only_layers=[],
+        max_position_embeddings=256, rope_theta=10000.0, rms_norm_eps=1e-5,
+        tie_word_embeddings=False)
+    torch.manual_seed(0)
+    model = transformers.Qwen2MoeForCausalLM(hf_cfg).eval()
+    with torch.no_grad():   # exercise the bias + scalar-gate paths for real
+        for layer in model.model.layers:
+            for proj in (layer.self_attn.q_proj, layer.self_attn.k_proj,
+                         layer.self_attn.v_proj):
+                proj.bias.normal_(0.0, 0.5)
+            layer.mlp.shared_expert_gate.weight.normal_(0.0, 0.5)
+    model.save_pretrained(tmp_path / "hf", safe_serialization=True)
+
+    bundle = get_model(f"hf:{tmp_path / 'hf'}", dtype=jnp.float32,
+                       capacity_factor=4.0)
+    c = bundle.config
+    assert c.attn_bias and c.shared_expert_intermediate == 112
+    assert c.intermediate_size == 96 and not c.norm_topk_prob
+    convert_hf_checkpoint(tmp_path / "hf", tmp_path / "conv", bundle=bundle)
+    plan = make_plan("single", make_mesh(devices=jax.devices()[:1]))
+    params = load_pretrained(bundle, _replicated_shardings(bundle, plan),
+                             tmp_path / "conv")
+    assert np.abs(np.asarray(params["layers"]["moe"]["shared_gate"])).max() > 0
+
+    ids = np.random.RandomState(0).randint(0, 128, (2, 24))
+    ours = np.asarray(bundle.apply(bundle.config, params, jnp.asarray(ids),
+                                   attn_impl="xla"))
+    with torch.no_grad():
+        theirs = model(torch.tensor(ids)).logits.float().numpy()
+    np.testing.assert_allclose(ours, theirs, rtol=2e-4, atol=2e-4)
+
+
 def test_mixtral_parity(tmp_path):
     """The MoE family against HF MixtralForCausalLM: same softmax-all ->
     top-k -> renormalize routing, so with capacity_factor = E (zero
