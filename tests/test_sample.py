@@ -185,6 +185,24 @@ def test_kv_cache_moe_matches_recompute():
                                rtol=1e-5, atol=1e-5)
 
 
+def test_kv_cache_qwen2_moe_shared_expert_matches_recompute():
+    """The shared expert (+ QKV biases) through the MoE cache path: the
+    sigmoid-gated dense branch runs per decoded token alongside the
+    drop-free routed dispatch — cached greedy must equal recompute."""
+    bundle = get_model("qwen1.5-moe-a2.7b", vocab_size=256, hidden_size=64,
+                       intermediate_size=48, shared_expert_intermediate=80,
+                       num_layers=2, num_heads=4, num_kv_heads=2,
+                       num_experts=4, experts_per_token=2,
+                       max_position_embeddings=128, capacity_factor=4.0,
+                       dtype=jnp.float32)
+    assert bundle.config.shared_expert_intermediate and bundle.config.attn_bias
+    params = bundle.init(bundle.config, jax.random.key(11))
+    prompt = [9, 40, 3]
+    slow = make_sampler(bundle)(params, prompt, 6)
+    fast = make_sampler(bundle, kv_cache=True)(params, prompt, 6)
+    assert fast == slow
+
+
 def test_sampler_library_length_guard():
     """make_sampler used as a LIBRARY must refuse prompt+steps past the
     position table (both modes) — the CLI-only check left silent jit
