@@ -36,7 +36,11 @@ def _sliding_window_kw(cfg: dict, arch: str) -> dict:
         return {}
     if window >= cfg.get("max_position_embeddings", 4096):
         return {}
-    if arch in ("Qwen2ForCausalLM", "Qwen3ForCausalLM"):
+    if arch in ("Qwen2ForCausalLM", "Qwen3ForCausalLM",
+                "Qwen2MoeForCausalLM", "Qwen3MoeForCausalLM"):
+        # the MoE flavors gate identically — HF Qwen2MoeConfig ships
+        # sliding_window=4096 with use_sliding_window=False by default, and
+        # treating that inert key as live would band every layer silently
         if not cfg.get("use_sliding_window"):
             return {}
         n = cfg["num_hidden_layers"]

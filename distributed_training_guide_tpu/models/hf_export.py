@@ -219,15 +219,25 @@ _EMITTERS = {"llama": _emit_llama, "gpt2": _emit_gpt2, "neox": _emit_neox,
 # ---------------------------------------------------------------------------
 
 def _qwen_window_out(c) -> dict:
-    """Qwen2/3 sliding-window keys for export. A uniform window maps to
-    use_sliding_window; a full-then-sliding ``layer_windows`` pattern
-    (ingested from max_window_layers) maps back to that key — dropping
-    either would reload as full attention: silent divergence."""
+    """Qwen2/3 (dense and MoE) sliding-window keys for export. A uniform
+    window maps to use_sliding_window; a full-then-sliding ``layer_windows``
+    pattern (ingested from max_window_layers) maps back to that key —
+    dropping either would reload as full attention: silent divergence."""
     lw = getattr(c, "layer_windows", None)
     if lw:
-        return {"sliding_window": max(lw), "use_sliding_window": True,
-                "max_window_layers": next(
-                    (i for i, w in enumerate(lw) if w), len(lw))}
+        mwl = next((i for i, w in enumerate(lw) if w), len(lw))
+        w = max(lw)
+        if lw != tuple(0 if i < mwl else w for i in range(len(lw))):
+            # anything but leading-zeros-then-constant (e.g. a Gemma-style
+            # alternating pattern forced onto a qwen config) is not
+            # expressible as max_window_layers — refuse rather than export
+            # a config that reloads with different attention
+            raise ValueError(
+                f"layer_windows {lw} is not a full-then-sliding "
+                f"(max_window_layers) pattern and cannot be exported as a "
+                f"Qwen config")
+        return {"sliding_window": w, "use_sliding_window": True,
+                "max_window_layers": mwl}
     if getattr(c, "sliding_window", None):
         return {"sliding_window": c.sliding_window, "use_sliding_window": True}
     return {}
@@ -286,33 +296,36 @@ def _hf_config(bundle) -> dict:
             **_rope_scaling_out(c)}
     if bundle.family == "moe":
         if getattr(c, "shared_expert_intermediate", None):
-            out = {**base, "architectures": ["Qwen2MoeForCausalLM"],
-                   "model_type": "qwen2_moe",
-                   "num_experts": c.num_experts,
-                   "num_experts_per_tok": c.experts_per_token,
-                   "moe_intermediate_size": c.intermediate_size,
-                   "shared_expert_intermediate_size":
-                       c.shared_expert_intermediate,
-                   "norm_topk_prob": c.norm_topk_prob,
-                   "router_aux_loss_coef": c.router_aux_coef,
-                   "decoder_sparse_step": 1, "mlp_only_layers": []}
-        elif getattr(c, "qk_norm", False):
-            out = {**base, "architectures": ["Qwen3MoeForCausalLM"],
-                   "model_type": "qwen3_moe",
-                   "num_experts": c.num_experts,
-                   "num_experts_per_tok": c.experts_per_token,
-                   "moe_intermediate_size": c.intermediate_size,
-                   "norm_topk_prob": c.norm_topk_prob,
-                   "router_aux_loss_coef": c.router_aux_coef,
-                   "head_dim": c.head_size,
-                   "decoder_sparse_step": 1, "mlp_only_layers": []}
-        else:
-            out = {**base, "architectures": ["MixtralForCausalLM"],
-                   "model_type": "mixtral",
-                   "num_local_experts": c.num_experts,
-                   "num_experts_per_tok": c.experts_per_token,
-                   "router_aux_loss_coef": c.router_aux_coef}
-        if getattr(c, "sliding_window", None):
+            # Qwen gates SWA on use_sliding_window (_qwen_window_out); a
+            # bare sliding_window key would reload as FULL attention
+            return {**base, "architectures": ["Qwen2MoeForCausalLM"],
+                    "model_type": "qwen2_moe",
+                    "num_experts": c.num_experts,
+                    "num_experts_per_tok": c.experts_per_token,
+                    "moe_intermediate_size": c.intermediate_size,
+                    "shared_expert_intermediate_size":
+                        c.shared_expert_intermediate,
+                    "norm_topk_prob": c.norm_topk_prob,
+                    "router_aux_loss_coef": c.router_aux_coef,
+                    "decoder_sparse_step": 1, "mlp_only_layers": [],
+                    **_qwen_window_out(c)}
+        if getattr(c, "qk_norm", False):
+            return {**base, "architectures": ["Qwen3MoeForCausalLM"],
+                    "model_type": "qwen3_moe",
+                    "num_experts": c.num_experts,
+                    "num_experts_per_tok": c.experts_per_token,
+                    "moe_intermediate_size": c.intermediate_size,
+                    "norm_topk_prob": c.norm_topk_prob,
+                    "router_aux_loss_coef": c.router_aux_coef,
+                    "head_dim": c.head_size,
+                    "decoder_sparse_step": 1, "mlp_only_layers": [],
+                    **_qwen_window_out(c)}
+        out = {**base, "architectures": ["MixtralForCausalLM"],
+               "model_type": "mixtral",
+               "num_local_experts": c.num_experts,
+               "num_experts_per_tok": c.experts_per_token,
+               "router_aux_loss_coef": c.router_aux_coef}
+        if getattr(c, "sliding_window", None):  # Mixtral's key is always live
             out["sliding_window"] = c.sliding_window
         return out
     # llama family: the config knobs decide which architecture this is

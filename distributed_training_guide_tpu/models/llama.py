@@ -485,13 +485,11 @@ def apply(
                     activation_sharding=activation_sharding,
                     standard_layout=standard_layout)
 
-    layer_windows = getattr(config, "layer_windows", None)
-    if layer_windows:
+    wins = _layer_window_column(config)
+    if wins is not None:
         # per-layer sliding-window pattern (Gemma-2 alternates sliding /
         # full): the window rides the scan as a traced per-layer scalar;
         # 0 (= full attention) maps to a band wider than any sequence
-        wins = jnp.asarray([w if w else 2 ** 30 for w in layer_windows],
-                           jnp.int32)
 
         def scan_body(carry, xs):
             layer_params, w = xs
@@ -538,8 +536,10 @@ def _decode_residuals(config, x, layer, attn):
     return x, None
 
 
-def _decode_layer_windows(config):
-    """Per-layer window column for the decode scans (None when uniform)."""
+def _layer_window_column(config):
+    """Per-layer window column for the layer scans — training AND decode
+    share this one translation (None when uniform; 0 -> a band wider than
+    any supported sequence)."""
     lw = getattr(config, "layer_windows", None)
     if not lw:
         return None
@@ -562,7 +562,7 @@ def prefill(config: LlamaConfig, params: dict, input_ids: jnp.ndarray,
     positions = jnp.broadcast_to(jnp.arange(p)[None, :], (b, p))
     x = embed_tokens(config, params, input_ids, positions)
 
-    wins = _decode_layer_windows(config)
+    wins = _layer_window_column(config)
 
     def body(x, inputs):
         layer, ck, cv, w = inputs
@@ -597,7 +597,7 @@ def decode_step(config: LlamaConfig, params: dict, token_ids: jnp.ndarray,
     positions = jnp.broadcast_to(jnp.asarray(pos)[None, None], (b, 1))
     x = embed_tokens(config, params, token_ids, positions)
 
-    wins = _decode_layer_windows(config)
+    wins = _layer_window_column(config)
 
     def body(x, inputs):
         layer, ck, cv, w = inputs

@@ -141,6 +141,16 @@ class Trainer:
                 "per-layer sliding-window patterns (Gemma-2 layer_windows) "
                 "are not implemented under context or pipeline parallelism; "
                 "use dp/fsdp/tp plans")
+        if self.plan.mesh.shape.get("cp", 1) > 1 and (
+                getattr(self.bundle.config, "attn_logit_softcap", None)
+                is not None
+                or getattr(self.bundle.config, "query_pre_attn_scalar", None)):
+            # the ring/ulysses wrappers don't thread the softcap/scale —
+            # running them would SILENTLY drop Gemma-2's attention math
+            raise ValueError(
+                "attention logit softcapping / query_pre_attn_scalar "
+                "(Gemma-2) are not implemented under context parallelism; "
+                "use dp/fsdp/tp plans")
         if self.offload_opt_state or self.offload_params:
             kinds = {m.kind for m in jax.local_devices()[0].addressable_memories()}
             if "pinned_host" not in kinds:

@@ -679,6 +679,36 @@ def test_qwen2_moe_parity(tmp_path):
         theirs = model(torch.tensor(ids)).logits.float().numpy()
     np.testing.assert_allclose(ours, theirs, rtol=2e-4, atol=2e-4)
 
+    # HF Qwen2MoeConfig DEFAULTS ship sliding_window=4096 with
+    # use_sliding_window=False — the inert key must NOT band any layer
+    # (review-r5 finding: the arch gate must cover the MoE flavors too)
+    from distributed_training_guide_tpu.models.auto import config_from_hf
+
+    inert = tmp_path / "inert"
+    inert.mkdir()
+    transformers.Qwen2MoeConfig(
+        vocab_size=64, hidden_size=32, intermediate_size=64,
+        moe_intermediate_size=48, shared_expert_intermediate_size=56,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        num_experts=4, num_experts_per_tok=2, sliding_window=4096,
+        use_sliding_window=False,
+        max_position_embeddings=32768).save_pretrained(inert)
+    _, icfg = config_from_hf(inert)
+    assert icfg.sliding_window is None
+    # ...and a LIVE mixed pattern on a MoE arch is rejected loudly (the
+    # moe scan has no per-layer window column)
+    mixed = tmp_path / "mixed_moe"
+    mixed.mkdir()
+    transformers.Qwen2MoeConfig(
+        vocab_size=64, hidden_size=32, intermediate_size=64,
+        moe_intermediate_size=48, shared_expert_intermediate_size=56,
+        num_hidden_layers=4, num_attention_heads=4, num_key_value_heads=2,
+        num_experts=4, num_experts_per_tok=2, sliding_window=4096,
+        use_sliding_window=True, max_window_layers=2,
+        max_position_embeddings=32768).save_pretrained(mixed)
+    with pytest.raises(ValueError, match="max_window_layers"):
+        config_from_hf(mixed)
+
 
 def test_mixtral_parity(tmp_path):
     """The MoE family against HF MixtralForCausalLM: same softmax-all ->

@@ -150,6 +150,20 @@ def test_ring_cp_rejects_swa():
         trainer.step_fn  # attention impl resolves lazily with the step fn
 
 
+def test_cp_rejects_gemma2_attention_extras():
+    """Softcap / query_pre_attn_scalar under cp would be SILENTLY dropped
+    by the ring/ulysses wrappers — the Trainer must reject them loudly
+    (review-r5 finding), even without layer_windows set."""
+    from distributed_training_guide_tpu.models import get_model
+    from distributed_training_guide_tpu.parallel import make_mesh, make_plan
+    from distributed_training_guide_tpu.train import Trainer, adamw_cosine
+
+    bundle = get_model("llama-debug", attn_logit_softcap=50.0)
+    plan = make_plan("ddp", make_mesh(cp=2, devices=jax.devices()[:2]))
+    with pytest.raises(ValueError, match="softcapping"):
+        Trainer(bundle=bundle, optimizer=adamw_cosine(1e-4), plan=plan)
+
+
 def test_swa_train_step_and_ulysses_compose():
     """A real optimizer step with the window active (single device), and the
     Ulysses CP path accepting the window (full-seq layout during attention)."""
