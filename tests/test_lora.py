@@ -179,6 +179,46 @@ def test_lora_checkpoint_resume_bit_exact(tmp_path, eight_devices):
                                   golden["last_info"]["running_loss"])
 
 
+@pytest.mark.parametrize("preset,over", [
+    ("qwen3-0.6b", dict(vocab_size=256, hidden_size=64, intermediate_size=128,
+                        num_layers=2, num_heads=4, num_kv_heads=2, head_dim=16,
+                        max_position_embeddings=128)),
+    ("olmo2-7b", dict(vocab_size=256, hidden_size=64, intermediate_size=128,
+                      num_layers=2, num_heads=4, num_kv_heads=2,
+                      max_position_embeddings=128)),
+    ("gemma2-2b", dict(vocab_size=256, hidden_size=64, intermediate_size=128,
+                       num_layers=2, num_heads=4, num_kv_heads=2, head_dim=16,
+                       layer_windows=(8, 0), query_pre_attn_scalar=16.0,
+                       max_position_embeddings=128)),
+])
+def test_lora_composes_with_family_wirings(preset, over):
+    """LoRA over the non-vanilla llama wirings people actually finetune:
+    Qwen3 (qk-norm), OLMo-2 (post-norm), Gemma-2 (sandwich + softcaps +
+    per-layer windows). Step-0 exactness, frozen base, adapters move."""
+    base = get_model(preset, dtype=jnp.float32, **over)
+    wrapped = lora_bundle(base, rank=4)
+    params = wrapped.init(wrapped.config, jax.random.key(0))
+    ids = _ids(vocab=256)
+    np.testing.assert_array_equal(
+        np.asarray(wrapped.apply(wrapped.config, params, ids)),
+        np.asarray(base.apply(base.config, params["base"], ids)))
+
+    trainer = Trainer(bundle=wrapped,
+                      optimizer=mask_optimizer(adamw_cosine(1e-2)),
+                      plan=make_plan("single",
+                                     make_mesh(devices=jax.devices()[:1])),
+                      donate=False)
+    state = trainer.init_state(0)
+    before = jax.tree.map(np.asarray, state.params)
+    batch = {k: ids for k in ("input_ids", "labels")}
+    state2, m = trainer.step_fn(state, batch)
+    assert np.isfinite(float(m["loss"]))
+    for b, a in zip(jax.tree.leaves(before["base"]),
+                    jax.tree.leaves(jax.tree.map(np.asarray,
+                                                 state2.params["base"]))):
+        np.testing.assert_array_equal(b, a)
+
+
 def test_lora_rejects_non_llama_and_bad_targets():
     with pytest.raises(ValueError, match="llama family"):
         lora_bundle(get_model("gpt2-debug"), rank=4)
