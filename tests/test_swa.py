@@ -150,6 +150,40 @@ def test_ring_cp_rejects_swa():
         trainer.step_fn  # attention impl resolves lazily with the step fn
 
 
+def test_swa_remat_policy_keeps_banded_kernel_residuals():
+    """The banded kernel under jax.checkpoint with the attn policy: the
+    flash_out/flash_lse tags must still save (window is a nondiff static),
+    so gradients match the un-remat'd ones AND backward avoids the full
+    forward recompute (same pallas-call-count mechanism pin as the causal
+    remat test — a tag drift would silently degrade to full recompute)."""
+    from distributed_training_guide_tpu.train.step import REMAT_POLICIES
+
+    rng = np.random.RandomState(3)
+    q = jnp.asarray(rng.randn(1, 64, 4, 32), jnp.float32)
+    k = jnp.asarray(rng.randn(1, 64, 2, 32), jnp.float32)
+    v = jnp.asarray(rng.randn(1, 64, 2, 32), jnp.float32)
+
+    def f(q, k, v):
+        o = flash_attention(q, k, v, causal=True, window=24,
+                            block_q=32, block_k=32, interpret=True)
+        return jnp.sum(o * o)
+
+    ref = jax.grad(f, argnums=(0, 1, 2))(q, k, v)
+    got = jax.grad(jax.checkpoint(f, policy=REMAT_POLICIES["attn"]),
+                   argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(ref, got):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-5, atol=1e-5)
+
+    def n_pallas(policy):
+        jaxpr = jax.make_jaxpr(
+            jax.grad(jax.checkpoint(f, policy=REMAT_POLICIES[policy])))(q, k, v)
+        return str(jaxpr).count("pallas_call")
+
+    assert n_pallas("attn") < n_pallas("all"), \
+        (n_pallas("attn"), n_pallas("all"))
+
+
 def test_cp_rejects_gemma2_attention_extras():
     """Softcap / query_pre_attn_scalar under cp would be SILENTLY dropped
     by the ring/ulysses wrappers — the Trainer must reject them loudly
