@@ -44,3 +44,9 @@ if _os.environ.get("JAX_PLATFORMS"):
     except Exception:
         pass  # backend already initialized; too late to switch
 
+# jax-version drift shims (jax.shard_map / get_abstract_mesh on jax 0.4.x) —
+# see compat.py; no-op on jax >= 0.5
+from . import compat as _compat
+
+_compat.install()
+

@@ -1,4 +1,4 @@
-"""Sharded checkpoint + resume via Orbax/TensorStore.
+"""Sharded checkpoint + resume via Orbax/TensorStore, hardened.
 
 Covers all three reference checkpoint formats (C15, SURVEY.md section 2) with
 one mechanism:
@@ -14,16 +14,48 @@ for pretrained weights, ``05:118-139``). Resume trigger stays the reference's
 ``state.json`` contract (``01:94``): resumable iff ``<exp_dir>/state.json``
 exists. RNG state persists inside the TrainState (determinism recipe,
 ``related-topics/determinism/README.md:46-68``).
+
+Fault-tolerance layer on top of that contract:
+
+- every published checkpoint gets an integrity manifest (sizes + CRC32 +
+  the host loop state, ``manifest.py``), written before state.json swings;
+- ``keep_n`` checkpoints are retained (state.json carries the chain,
+  newest first) instead of delete-all-but-latest;
+- restore verifies the manifest and falls back through the retention chain
+  past corrupt/missing checkpoints, logging what it skipped;
+- transient filesystem errors during save are retried with bounded
+  exponential backoff (single-process sync saves; with ``async_save`` the
+  retry covers the blocking snapshot/enqueue phase only, and multi-host
+  saves propagate instead of retrying — recovery there belongs to the
+  supervisor restart layer);
+- unreferenced ``checkpoint-*`` orphans (a crash between the Orbax commit
+  and the state.json swing) are swept by the WRITER at its first ``save()``
+  and at every publish. Restore-only consumers (hf_export, engine loads)
+  never delete anything: a sweep on open could collect a live writer's
+  committed-but-unpublished checkpoint.
 """
 from __future__ import annotations
 
 import json
+import logging
+import re
+import shutil
+import time
 from pathlib import Path
 from typing import Any, Optional
 
 import jax
 
 from ..utils.procguards import is_process0, sync_processes
+from . import manifest as manifest_mod
+
+LOGGER = logging.getLogger(__name__)
+
+_CKPT_RE = re.compile(r"^checkpoint-\d+$")
+
+
+class CheckpointCorruptionError(RuntimeError):
+    """Every checkpoint in the retention chain failed verification/restore."""
 
 
 class CheckpointIO:
@@ -34,17 +66,23 @@ class CheckpointIO:
     so crash-safety is preserved (an unfinalized save is invisible to
     resume; the previous checkpoint stays referenced)."""
 
-    def __init__(self, exp_dir: str | Path, *, async_save: bool = False):
+    def __init__(self, exp_dir: str | Path, *, async_save: bool = False,
+                 keep_n: int = 2, save_retries: int = 2,
+                 retry_backoff_s: float = 0.5):
         self.exp_dir = Path(exp_dir)
         import orbax.checkpoint as ocp
 
         self._ocp = ocp
         self.async_save = async_save
+        self.keep_n = max(1, int(keep_n))
+        self.save_retries = max(0, int(save_retries))
+        self.retry_backoff_s = retry_backoff_s
         if async_save:
             self._checkpointer = ocp.AsyncCheckpointer(ocp.StandardCheckpointHandler())
         else:
             self._checkpointer = ocp.StandardCheckpointer()
-        self._pending: Optional[tuple[Path, dict, Optional[Path]]] = None
+        self._pending: Optional[tuple[Path, dict, list[str]]] = None
+        self._swept = False
 
     # ---- paths -------------------------------------------------------------
     @property
@@ -54,36 +92,99 @@ class CheckpointIO:
     def _ckpt_dir(self, step: int) -> Path:
         return (self.exp_dir / f"checkpoint-{step}").absolute()
 
-    def _current_ckpt_dir(self) -> Optional[Path]:
+    def _read_state_json(self) -> Optional[dict]:
         if not self.state_json.exists():
             return None
         try:
             with open(self.state_json) as fp:
-                name = json.load(fp).get("checkpoint")
+                return json.load(fp)
         except (json.JSONDecodeError, OSError):
             return None
-        if not name:
-            return None
-        path = (self.exp_dir / name).absolute()
-        return path if path.exists() else None
+
+    def _retained_names(self) -> list[str]:
+        """Retention chain (newest first) from state.json; legacy files
+        (pre-retention) carry only ``checkpoint``, a one-entry chain."""
+        payload = self._read_state_json()
+        if not payload:
+            return []
+        names = payload.get("retained")
+        if not isinstance(names, list) or not names:
+            names = [payload.get("checkpoint")]
+        return [n for n in names if n]
+
+    def _retention_chain(self) -> list[Path]:
+        return [p for n in self._retained_names()
+                if (p := (self.exp_dir / n).absolute()).exists()]
 
     def can_resume(self) -> bool:
-        return self._current_ckpt_dir() is not None
+        return bool(self._retention_chain())
+
+    # ---- orphan sweep ------------------------------------------------------
+    def _sweep_orphans(self) -> None:
+        """Collect ``checkpoint-*`` dirs (and stray manifests) that no
+        state.json references — the leak left by a crash between the Orbax
+        dir commit and ``_finalize``. Called only from the WRITE path
+        (first ``save()``): calling save() asserts exclusive ownership of
+        the exp_dir, so anything unreferenced is a dead prior incarnation's
+        leftovers. Restore-only consumers never sweep — a reader opening a
+        live writer's exp_dir must not collect its committed-but-unpublished
+        checkpoint."""
+        if not self.exp_dir.is_dir() or not is_process0():
+            return
+        referenced = set(self._retained_names())
+        for entry in self.exp_dir.iterdir():
+            if entry.is_dir() and _CKPT_RE.match(entry.name):
+                name = entry.name
+            elif entry.is_file() and entry.name.endswith(".manifest.json"):
+                name = entry.name[:-len(".manifest.json")]
+                if (self.exp_dir / name).exists():
+                    continue  # its dir decides; swept together below
+            else:
+                continue
+            if name in referenced:
+                continue
+            LOGGER.warning("sweeping orphaned checkpoint artifact %s "
+                           "(unreferenced by state.json)", entry.name)
+            self._remove_checkpoint(name)
+
+    def _remove_checkpoint(self, name: str) -> None:
+        shutil.rmtree(self.exp_dir / name, ignore_errors=True)
+        try:
+            manifest_mod.manifest_path(self.exp_dir, name).unlink()
+        except OSError:
+            pass
 
     # ---- save --------------------------------------------------------------
-    def _finalize(self, path: Path, host_state: dict, old: Optional[Path]) -> None:
+    def _finalize(self, path: Path, host_state: dict,
+                  retained_before: list[str]) -> None:
         """Wait for the write, then atomically publish + prune."""
         self._checkpointer.wait_until_finished()
         sync_processes("ckpt_saved")
         if is_process0():
+            step = int(host_state.get("global_step", 0))
+            # manifest before state.json: a crash in between leaves an
+            # unreferenced dir+manifest pair (swept later), never a
+            # referenced checkpoint without integrity data
+            manifest_mod.write_manifest(path, step, host_state)
+            retained = [path.name] + [n for n in retained_before
+                                      if n != path.name]
+            keep = retained[:self.keep_n]
             tmp = self.state_json.with_suffix(".json.tmp")
             with open(tmp, "w") as fp:
-                json.dump({**host_state, "checkpoint": path.name}, fp)
+                json.dump({**host_state, "checkpoint": path.name,
+                           "retained": keep}, fp)
             tmp.replace(self.state_json)  # atomic on POSIX
-            if old is not None and old != path:
-                import shutil
+            # prune EVERYTHING outside the new chain, not just the names we
+            # know we dropped — also collects orphans the startup sweep
+            # spared for being too young (the writer is exclusive here)
+            keep_set = set(keep)
+            for entry in self.exp_dir.iterdir():
+                if (entry.is_dir() and _CKPT_RE.match(entry.name)
+                        and entry.name not in keep_set):
+                    self._remove_checkpoint(entry.name)
+            from ..utils import faults
 
-                shutil.rmtree(old, ignore_errors=True)
+            faults.maybe_corrupt_checkpoint(path, step)
         sync_processes("ckpt_state_json")
 
     def flush(self) -> None:
@@ -98,36 +199,182 @@ class CheckpointIO:
         if close_fn:  # release the AsyncCheckpointer thread pool / barriers
             close_fn()
 
+    def _write_with_retry(self, path: Path, train_state: Any) -> None:
+        """Bounded-backoff retry around the Orbax write for transient
+        filesystem errors (partial output from a failed attempt is removed
+        so the retry starts clean). Covers the full write for sync saves;
+        for ``async_save`` only the blocking snapshot/enqueue phase — a
+        background-write failure raises at the next finalize, un-retried
+        (the state snapshot is gone by then), with the previous checkpoint
+        still the referenced one. SINGLE-PROCESS only: with multiple hosts
+        the error propagates instead — one host retrying would rmtree the
+        shared tmp dir peers are still writing into and re-enter Orbax's
+        commit barrier alone; recovery there belongs to the restart layer."""
+        delay = self.retry_backoff_s
+        for attempt in range(self.save_retries + 1):
+            try:
+                self._checkpointer.save(path, train_state, force=True)
+                return
+            except OSError as exc:
+                if attempt >= self.save_retries or jax.process_count() > 1:
+                    raise
+                LOGGER.warning(
+                    "checkpoint save attempt %d/%d failed (%s); retrying "
+                    "in %.2fs", attempt + 1, self.save_retries + 1, exc,
+                    delay)
+                shutil.rmtree(path, ignore_errors=True)
+                for tmp in self.exp_dir.glob(f"{path.name}.orbax-checkpoint-tmp-*"):
+                    shutil.rmtree(tmp, ignore_errors=True)
+                time.sleep(delay)
+                delay *= 2
+
     def save(self, train_state: Any, host_state: dict) -> None:
         """Crash-safe save: each step writes a fresh ``checkpoint-<step>`` dir
         (all hosts write their own shards in parallel; Orbax finalizes the dir
-        atomically), then process 0 atomically swings state.json to it, then
-        older checkpoints are pruned. A crash at any point leaves the previous
-        checkpoint referenced by a valid state.json."""
+        atomically), then process 0 writes the integrity manifest, atomically
+        swings state.json to the new retention chain, and prunes beyond
+        ``keep_n``. A crash at any point leaves the previous chain referenced
+        by a valid state.json."""
         self.flush()
         self.exp_dir.mkdir(parents=True, exist_ok=True)
+        if not self._swept:
+            self._sweep_orphans()
+            self._swept = True
+        from ..utils import faults
+
+        faults.maybe_save_latency()
         step = int(host_state.get("global_step", 0))
         path = self._ckpt_dir(step)
-        old = self._current_ckpt_dir()
-        self._checkpointer.save(path, train_state, force=True)
+        retained_before = self._retained_names()
+        self._write_with_retry(path, train_state)
         if self.async_save:
-            self._pending = (path, dict(host_state), old)
+            self._pending = (path, dict(host_state), retained_before)
         else:
-            self._finalize(path, host_state, old)
+            self._finalize(path, host_state, retained_before)
 
     # ---- restore -----------------------------------------------------------
+    def _rebase_restored(self, tree: Any) -> Any:
+        """Copy restored leaves onto fresh XLA-allocated buffers.
+
+        Donating a TensorStore-backed restored buffer into a jitted step
+        whose executable came from the persistent compilation cache corrupts
+        the allocator heap on the CPU backend (glibc "double free /
+        smallbin corrupted" aborts — found by this repo's chaos drills, jax
+        0.4.37). The copy costs one pass over the state at resume time and
+        makes every restored leaf an ordinary XLA buffer. Leaves living in
+        non-default memory (pinned_host offload) keep their storage: a plain
+        copy would not preserve the memory kind, and the offload step path
+        device-puts them before any donation anyway."""
+        try:
+            default_kind = jax.local_devices()[0].default_memory().kind
+        except Exception:  # backends without memory-kind support
+            default_kind = None
+
+        def copy_leaf(x):
+            kind = getattr(getattr(x, "sharding", None), "memory_kind", None)
+            if (default_kind is not None and kind is not None
+                    and kind != default_kind):
+                return x
+            return x.copy()
+
+        return jax.tree.map(copy_leaf, tree)
+
+    def _host_state_for(self, path: Path, manifest: Optional[dict]) -> dict:
+        if manifest is not None and isinstance(manifest.get("host_state"), dict):
+            return dict(manifest["host_state"])
+        # legacy checkpoint (pre-manifest): state.json's counters describe
+        # the NEWEST checkpoint; warn when we restored an older one
+        host_state = dict(self._read_state_json() or {})
+        host_state.pop("checkpoint", None)
+        host_state.pop("retained", None)
+        if path.name != (self._retained_names() or [path.name])[0]:
+            LOGGER.warning(
+                "restored %s without a manifest; host counters from "
+                "state.json may describe a newer checkpoint", path.name)
+        return host_state
+
+    def _verified_candidate(self, chain: list[Path],
+                            failures: list[str]) -> int:
+        """Index of the newest chain entry whose manifest verifies (legacy
+        no-manifest entries are trusted with a warning), or -1."""
+        for i, path in enumerate(chain):
+            manifest = manifest_mod.load_manifest(self.exp_dir, path.name)
+            if manifest is None:
+                LOGGER.warning("checkpoint %s has no manifest (legacy "
+                               "save?); restoring unverified", path.name)
+                return i
+            problems = manifest_mod.verify_manifest(path, manifest)
+            if not problems:
+                return i
+            LOGGER.warning("skipping checkpoint %s: failed integrity check "
+                           "(%s)", path.name, "; ".join(problems[:3]))
+            failures.append(f"{path.name}: {problems[0]}")
+        return -1
+
     def restore(self, abstract_state: Any) -> tuple[Any, dict]:
         """abstract_state: pytree of jax.ShapeDtypeStruct *with shardings* —
-        each host reads exactly its shards from TensorStore."""
+        each host reads exactly its shards from TensorStore.
+
+        Walks the retention chain newest-first; a checkpoint whose manifest
+        fails verification (single-process: or whose TensorStore read
+        raises) is skipped with a warning and the next-older one is tried.
+        Multi-host, the fallback decision must be one decision: process 0
+        verifies the manifests and broadcasts the chosen candidate, so hosts
+        can never restore different checkpoints (per-host verdicts could
+        diverge on a flaky shared FS — half the pod resuming step N and half
+        step N-1 hangs collectives or silently forks the run). A TensorStore
+        read error then fails the whole gang loudly instead of falling back
+        on one host only; the supervisor's restart retries the same agreed
+        candidate. Raises ``CheckpointCorruptionError`` when candidates
+        existed but none survived, ``FileNotFoundError`` when there was
+        nothing to resume."""
         self.flush()
-        path = self._current_ckpt_dir()
-        if path is None:
+        chain = self._retention_chain()
+        if not chain:
             raise FileNotFoundError(f"no resumable checkpoint in {self.exp_dir}")
-        train_state = self._checkpointer.restore(path, abstract_state)
-        with open(self.state_json) as fp:
-            host_state = json.load(fp)
-        host_state.pop("checkpoint", None)
-        return train_state, host_state
+        failures: list[str] = []
+        if jax.process_count() > 1:
+            import numpy as np
+            from jax.experimental import multihost_utils
+
+            idx = (self._verified_candidate(chain, failures)
+                   if is_process0() else 0)
+            idx = int(multihost_utils.broadcast_one_to_all(
+                np.int32(idx), is_source=is_process0()))
+            if idx < 0:
+                raise CheckpointCorruptionError(
+                    f"no checkpoint in {self.exp_dir} survived verification: "
+                    + "; ".join(failures))
+            path = chain[idx]
+            if idx > 0:
+                LOGGER.warning("process 0 chose fallback checkpoint %s",
+                               path.name)
+            train_state = self._checkpointer.restore(path, abstract_state)
+            manifest = manifest_mod.load_manifest(self.exp_dir, path.name)
+            return (self._rebase_restored(train_state),
+                    self._host_state_for(path, manifest))
+        start = 0
+        while True:
+            idx = self._verified_candidate(chain[start:], failures)
+            if idx < 0:
+                raise CheckpointCorruptionError(
+                    f"no checkpoint in {self.exp_dir} survived verification: "
+                    + "; ".join(failures))
+            path = chain[start + idx]
+            manifest = manifest_mod.load_manifest(self.exp_dir, path.name)
+            try:
+                train_state = self._checkpointer.restore(path, abstract_state)
+            except Exception as exc:  # noqa: BLE001 — any reader error falls back
+                LOGGER.warning("skipping checkpoint %s: restore failed (%s)",
+                               path.name, exc)
+                failures.append(f"{path.name}: {exc}")
+                start += idx + 1
+                continue
+            if failures:
+                LOGGER.warning("fell back to checkpoint %s after skipping: %s",
+                               path.name, "; ".join(failures))
+            return (self._rebase_restored(train_state),
+                    self._host_state_for(path, manifest))
 
 
 def abstract_train_state(trainer):

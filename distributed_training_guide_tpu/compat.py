@@ -1,0 +1,66 @@
+"""Compatibility shims for older jax versions (robustness to env drift).
+
+The sharded-attention wrappers and the pipeline are written against the
+jax >= 0.5 public API: ``jax.shard_map(..., axis_names=..., check_vma=...)``
+and ``jax.sharding.get_abstract_mesh()``. Containers pinned to jax 0.4.x
+(observed live: 0.4.37) lack both, and without this module every cp/pp/flash
+code path dies with ``AttributeError`` at trace time — an environment detail
+taking down otherwise-correct code, which is exactly the failure class this
+framework hardens against elsewhere.
+
+Installed from the package ``__init__`` (idempotent, no-op on jax >= 0.5):
+
+- ``jax.shard_map`` maps onto ``jax.experimental.shard_map.shard_map``,
+  translating ``axis_names={manual}`` to the old complement spelling
+  ``auto=mesh.axis_names - manual`` and ``check_vma`` to ``check_rep``.
+- ``jax.sharding.get_abstract_mesh`` returns an empty-mesh stub, so
+  ``_in_manual_context()``-style probes report "not inside a manual region".
+  That is the truth at top level (the common path: cp/flash wrappers under
+  plain jit); *nested* manual regions (attention wrappers inside the
+  pipeline's pp-manual body) have no 0.4.x equivalent and will fail in
+  shard_map's own mesh checks rather than here.
+"""
+from __future__ import annotations
+
+import functools
+
+
+class _EmptyAbstractMesh:
+    """Stand-in for jax.sharding.AbstractMesh outside any manual region."""
+
+    axis_names: tuple = ()
+    axis_types: tuple = ()
+    shape = {}
+
+    def __repr__(self):  # pragma: no cover - debugging aid
+        return "_EmptyAbstractMesh()"
+
+
+_EMPTY_MESH = _EmptyAbstractMesh()
+
+
+def install() -> None:
+    """Idempotently install the shims onto the jax namespace."""
+    import jax
+
+    if not hasattr(jax, "shard_map"):
+        from jax.experimental.shard_map import shard_map as _legacy_shard_map
+
+        def shard_map(f=None, *, mesh, in_specs=None, out_specs=None,
+                      axis_names=None, check_vma=True, **kw):
+            if f is None:  # used as functools.partial target, then called
+                return functools.partial(
+                    shard_map, mesh=mesh, in_specs=in_specs,
+                    out_specs=out_specs, axis_names=axis_names,
+                    check_vma=check_vma, **kw)
+            auto = frozenset()
+            if axis_names is not None:
+                auto = frozenset(mesh.axis_names) - frozenset(axis_names)
+            return _legacy_shard_map(f, mesh, in_specs=in_specs,
+                                     out_specs=out_specs,
+                                     check_rep=check_vma, auto=auto, **kw)
+
+        jax.shard_map = shard_map
+
+    if not hasattr(jax.sharding, "get_abstract_mesh"):
+        jax.sharding.get_abstract_mesh = lambda: _EMPTY_MESH

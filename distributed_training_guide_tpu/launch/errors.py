@@ -36,7 +36,11 @@ def write_error_file(exc: BaseException, path: str | None = None) -> None:
     payload = {
         "message": {
             "error": repr(exc),
-            "traceback": traceback.format_exc(),
+            # format the EXCEPTION, not the ambient except-state:
+            # traceback.format_exc() yields "NoneType: None" for callers
+            # outside an active except block (e.g. the guard-abort path,
+            # which constructs the exception before raising it)
+            "traceback": "".join(traceback.format_exception(exc)),
             "process_index": proc,
             "timestamp": int(time.time()),
             "hostname": os.uname().nodename,
@@ -64,3 +68,40 @@ def record(fn):
             raise
 
     return wrapper
+
+
+# ---- failure classification (supervisor restart policy) ---------------------
+# Poison pills: failures that are a deterministic function of (config, data,
+# code) — restarting reproduces them, so the supervisor should stop instead of
+# burning its restart budget (and the pod's queue slot). Two deliberate
+# restrictions keep false poisons from breaking elasticity:
+# - matched against the error *repr* only: tracebacks mention files like
+#   jax/_src/sharding_impls.py for unrelated errors;
+# - only patterns SPECIFIC to deterministic failures. Generic markers like
+#   "INVALID_ARGUMENT" also prefix collateral errors on surviving ranks when
+#   a peer dies mid-collective (e.g. "INVALID_ARGUMENT: Multiprocess
+#   computations aren't implemented..." from a torn-down gang) — classifying
+#   those as poison would refuse exactly the restart elasticity exists for.
+POISON_PATTERNS: tuple[tuple[str, tuple[str, ...]], ...] = (
+    ("oom", ("RESOURCE_EXHAUSTED", "out of memory", "MemoryError",
+             "hbm usage")),
+    ("shape/sharding", ("not divisible", "divisible by", "NamedSharding",
+                        "incompatible shapes", "shape mismatch")),
+    ("non-finite", ("NonFiniteLossError",)),
+)
+
+
+def classify_error(payload: dict) -> str | None:
+    """Reason string when the error file describes a poison pill, else None
+    (= unknown/transient: restart is worth trying). Tolerates foreign error
+    files where "message" is a plain string rather than our dict shape —
+    the supervisor runs arbitrary worker commands."""
+    msg = payload.get("message", payload) if isinstance(payload, dict) else {}
+    if not isinstance(msg, dict):
+        msg = {"error": str(msg)}
+    text = str(msg.get("error", ""))
+    lowered = text.lower()
+    for reason, patterns in POISON_PATTERNS:
+        if any(p.lower() in lowered for p in patterns):
+            return reason
+    return None

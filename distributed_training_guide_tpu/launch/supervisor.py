@@ -1,4 +1,4 @@
-"""Elastic supervisor: restart-on-failure for training workers.
+"""Elastic supervisor: restart-on-failure for training workers, hardened.
 
 Parity with torchrun's elasticity (reference ``related-topics/elastic-training/
 README.md:5-16``): ``--max-restarts N`` restarts the worker when it fails, and
@@ -12,11 +12,27 @@ and the checkpoint reshards into it on restore — see
 ``<log_dir>/attempt_<n>/`` (torchrun's ``--redirects 3 --log-dir``,
 ``02-distributed-data-parallel/README.md:99-100``).
 
-On a TPU pod every host runs this supervisor; when any host's worker dies the
-others' collectives stall, so each supervisor also kills its worker when the
-coordinator declares a restart (here: worker exit or ``--heartbeat-timeout``
-with no log progress — the power-draw-drop hang heuristic of
-``diagnosing-errors/README.md:7-19`` in process form).
+Restart policy (the part torchrun leaves to the operator):
+
+- **Exponential backoff** between restarts (``--restart-backoff``, doubled
+  per attempt up to ``--backoff-cap``): a crash loop against a sick
+  filesystem or a recovering TPU runtime must not hammer it at full rate.
+- **Poison-pill detection**: after a failure the supervisor reads the
+  worker's error file(s) (``ERROR_FILE``, plus the per-rank ``.rankN``
+  variants a gang writes) and classifies them (``launch/errors.py``). OOMs,
+  shape/sharding errors, and guard-abort NaNs are deterministic functions of
+  the config — restarting reproduces them, so the supervisor stops
+  immediately instead of burning every attempt (``--restart-on-poison``
+  opts back into blind restarts).
+
+Hang detection: each worker gets ``HEARTBEAT_FILE`` pointed into its attempt
+dir; the training loop writes step+timestamp there every iteration
+(``utils/heartbeat.py``), and that file going stale for
+``--heartbeat-timeout`` seconds means the *loop* stopped — the collective
+stall of ``diagnosing-errors/README.md:7-19`` — so the worker is SIGKILLed
+and the normal restart policy applies. Workers that never write a heartbeat
+(foreign commands, crash before step 1) fall back to the original log-size
+heuristic.
 
 Usage:
     python -m distributed_training_guide_tpu.launch.supervisor \
@@ -25,6 +41,7 @@ Usage:
 from __future__ import annotations
 
 import argparse
+import json
 import os
 import signal
 import subprocess
@@ -32,15 +49,41 @@ import sys
 import time
 from pathlib import Path
 
+from .errors import classify_error
+
+
+def _poison_reason(error_file: Path) -> str | None:
+    """First poison classification across the attempt's error files (the
+    direct ERROR_FILE plus any per-rank suffixed files a gang produced)."""
+    candidates = [error_file] + sorted(
+        error_file.parent.glob(error_file.name + ".rank*"))
+    for path in candidates:
+        if not path.is_file():
+            continue
+        try:
+            with open(path) as fp:
+                payload = json.load(fp)
+        except (OSError, json.JSONDecodeError):
+            continue
+        reason = classify_error(payload)
+        if reason:
+            msg = payload.get("message", payload) if isinstance(payload, dict) else {}
+            err = msg.get("error", "?") if isinstance(msg, dict) else str(msg)
+            return f"{reason}: {err} ({path.name})"
+    return None
+
 
 def run_supervised(cmd: list[str], max_restarts: int, log_dir: Path,
-                   heartbeat_timeout: float | None = None) -> int:
+                   heartbeat_timeout: float | None = None, *,
+                   restart_backoff: float = 1.0, backoff_cap: float = 60.0,
+                   stop_on_poison: bool = True) -> int:
     attempt = 0
     while True:
         attempt_dir = log_dir / f"attempt_{attempt}"
         attempt_dir.mkdir(parents=True, exist_ok=True)
         env = dict(os.environ)
         env.setdefault("ERROR_FILE", str(attempt_dir / "error.json"))
+        env["HEARTBEAT_FILE"] = str(attempt_dir / "heartbeat.json")
         stdout = open(attempt_dir / "stdout.log", "ab")
         stderr = open(attempt_dir / "stderr.log", "ab")
         print(f"[supervisor] attempt {attempt}: {' '.join(cmd)} -> {attempt_dir}",
@@ -65,30 +108,57 @@ def run_supervised(cmd: list[str], max_restarts: int, log_dir: Path,
             return 0
         print(f"[supervisor] attempt {attempt} failed rc={rc} "
               f"(error file: {env['ERROR_FILE']})", flush=True)
+        if stop_on_poison:
+            reason = _poison_reason(Path(env["ERROR_FILE"]))
+            if reason:
+                print(f"[supervisor] non-retryable failure ({reason}); "
+                      f"not restarting — fix the config/data and relaunch",
+                      flush=True)
+                return rc
         if attempt >= max_restarts:
             print(f"[supervisor] max restarts ({max_restarts}) exhausted", flush=True)
             return rc
+        delay = min(backoff_cap, restart_backoff * (2 ** attempt))
+        if delay > 0:
+            print(f"[supervisor] backing off {delay:.1f}s before attempt "
+                  f"{attempt + 1}", flush=True)
+            time.sleep(delay)
         attempt += 1
+
+
+def _progress_stamp(attempt_dir: Path, logs: list[Path]) -> tuple:
+    """Liveness observable for hang detection: the worker-written heartbeat
+    file once it exists (the positive 'loop is advancing' signal), log sizes
+    until then (legacy heuristic — quiet-but-healthy phases can false-
+    positive, which is exactly why the heartbeat file exists)."""
+    hb = attempt_dir / "heartbeat.json"
+    try:
+        st = hb.stat()
+        return ("heartbeat", st.st_mtime_ns, st.st_size)
+    except OSError:
+        return ("logs", sum(p.stat().st_size for p in logs if p.exists()))
 
 
 def _wait_with_heartbeat(proc: subprocess.Popen, attempt_dir: Path,
                          timeout: float) -> int:
-    """Kill the worker if its logs stop growing for `timeout` seconds (hang
-    detection — the collective-stall case where the process never exits)."""
+    """Kill the worker if its liveness signal stops for `timeout` seconds
+    (hang detection — the collective-stall case where the process never
+    exits)."""
     logs = [attempt_dir / "stdout.log", attempt_dir / "stderr.log"]
-    last_size = -1
+    last_stamp = None
     last_change = time.time()
     while True:
         rc = proc.poll()
         if rc is not None:
             return rc
-        size = sum(p.stat().st_size for p in logs if p.exists())
+        stamp = _progress_stamp(attempt_dir, logs)
         now = time.time()
-        if size != last_size:
-            last_size, last_change = size, now
+        if stamp != last_stamp:
+            last_stamp, last_change = stamp, now
         elif now - last_change > timeout:
-            print(f"[supervisor] no log progress for {timeout}s -> SIGKILL (hang)",
-                  flush=True)
+            kind = last_stamp[0] if last_stamp else "logs"
+            print(f"[supervisor] no {kind} progress for {timeout}s -> "
+                  f"SIGKILL (hang)", flush=True)
             proc.kill()
             return proc.wait() or -9
         time.sleep(min(5.0, timeout / 4))
@@ -99,7 +169,17 @@ def main():
     parser.add_argument("--max-restarts", type=int, default=3)
     parser.add_argument("--log-dir", default="./supervisor-logs")
     parser.add_argument("--heartbeat-timeout", type=float, default=None,
-                        help="seconds of log silence before declaring a hang")
+                        help="seconds without heartbeat-file (or, before the "
+                             "first beat, log) progress before declaring a "
+                             "hang and killing the worker")
+    parser.add_argument("--restart-backoff", type=float, default=1.0,
+                        help="seconds before the first restart; doubles per "
+                             "attempt up to --backoff-cap. 0 disables")
+    parser.add_argument("--backoff-cap", type=float, default=60.0)
+    parser.add_argument("--restart-on-poison", action="store_true",
+                        help="restart even when the error file classifies as "
+                             "a deterministic poison pill (OOM, shape/"
+                             "sharding, guard abort) — default is to stop")
     parser.add_argument("cmd", nargs=argparse.REMAINDER,
                         help="-- followed by the worker command")
     args = parser.parse_args()
@@ -107,7 +187,10 @@ def main():
     if not cmd:
         parser.error("no worker command given (use: supervisor [opts] -- cmd ...)")
     sys.exit(run_supervised(cmd, args.max_restarts, Path(args.log_dir),
-                            args.heartbeat_timeout))
+                            args.heartbeat_timeout,
+                            restart_backoff=args.restart_backoff,
+                            backoff_cap=args.backoff_cap,
+                            stop_on_poison=not args.restart_on_poison))
 
 
 if __name__ == "__main__":

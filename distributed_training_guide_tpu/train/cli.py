@@ -86,6 +86,20 @@ def get_parser() -> argparse.ArgumentParser:
                              "(auto at cp >= 8), unrolled = O(cp); per hop "
                              "the two are op-for-op identical")
     parser.add_argument("--max-steps", default=None, type=int)
+    parser.add_argument("--guard-policy", default="off",
+                        choices=["off", "skip", "abort"],
+                        help="non-finite loss/grad-norm policy (train/"
+                             "guards.py): skip = drop the poisoned update "
+                             "(params/opt state revert in-step), abort past "
+                             "--guard-max-skips consecutive; abort = fail "
+                             "fast, writing the step + metrics to the "
+                             "torchelastic-style error file. off (default) "
+                             "= reference behavior (NaNs propagate)")
+    parser.add_argument("--guard-max-skips", default=5, type=_positive_int,
+                        metavar="N",
+                        help="with --guard-policy skip: abort after N "
+                             "consecutive non-finite steps (a divergent run "
+                             "must not spin forever)")
     parser.add_argument("--pretrained", default=None, metavar="DIR",
                         help="directory produced by convert_llama.py / "
                              "convert_hf_checkpoint: start from these weights "
@@ -104,6 +118,12 @@ def get_parser() -> argparse.ArgumentParser:
     parser.add_argument("--async-checkpoint", action="store_true",
                         help="overlap checkpoint writes with training (Orbax "
                              "async; state.json publishes when the write commits)")
+    parser.add_argument("--keep-checkpoints", default=2, type=_positive_int,
+                        metavar="N",
+                        help="retain the N newest checkpoints (manifest-"
+                             "verified on restore; a corrupt latest falls "
+                             "back to the next-oldest). 1 = the old "
+                             "delete-all-but-latest behavior")
     parser.add_argument("--loss-chunks", type=int, default=0,
                         help=">0: compute the loss in sequence chunks, never "
                              "materializing full [B,S,V] logits (big-vocab "
@@ -243,10 +263,15 @@ def run_training(args, plan_factory: Callable, *, extra_log: Optional[dict] = No
         attn_impl=args.attn_impl,
         context_impl=getattr(args, "context_impl", "ring"),
         cp_hop_loop=getattr(args, "cp_hop_loop", "auto"),
+        guard_policy=getattr(args, "guard_policy", "off"),
         offload_opt_state=offload_opt_state,
         offload_params=offload_params,
         pp_microbatches=pp_microbatches,
     )
+    from .guards import GuardMonitor
+
+    guard = GuardMonitor(getattr(args, "guard_policy", "off"),
+                         getattr(args, "guard_max_skips", 5))
 
     global_batch = args.batch_size * plan.data_parallel_size * args.grad_accum
 
@@ -280,7 +305,8 @@ def run_training(args, plan_factory: Callable, *, extra_log: Optional[dict] = No
     is_experiment = args.experiment_name is not None
     if is_experiment:
         exp_dir = exp_dir / args.experiment_name
-    io = (CheckpointIO(exp_dir, async_save=args.async_checkpoint)
+    io = (CheckpointIO(exp_dir, async_save=args.async_checkpoint,
+                       keep_n=getattr(args, "keep_checkpoints", 2))
           if is_experiment else None)
 
     host_state = host_state_dict()
@@ -336,14 +362,26 @@ def run_training(args, plan_factory: Callable, *, extra_log: Optional[dict] = No
         except ImportError:
             pass
 
+    from ..utils.faults import maybe_crash
+    from ..utils.heartbeat import HeartbeatWriter
+
+    heartbeat = HeartbeatWriter()  # no-op unless $HEARTBEAT_FILE is set
+
     profile_started = profile_done = False
     profile_start_step = 0
     done = False
-    pending_losses = []  # device scalars banked between host-read fences
+    pending_losses = []  # (step, loss, notfinite) banked between fences
 
     def drain_losses():
-        for l in pending_losses:
-            host_state["running_loss"] += float(l)  # host read = hard fence
+        for step_no, l, flag in pending_losses:
+            # host read = hard fence. The guard monitor sees every step's
+            # flag (abort may thus surface a fence group late — the error
+            # file still names the offending step); skipped steps stay out
+            # of running_loss so one NaN doesn't poison every later window
+            if flag is not None and guard.observe(
+                    float(flag), step_no, {"loss": float(l)}):
+                continue
+            host_state["running_loss"] += float(l)
         pending_losses.clear()
     try:
         for epoch in range(host_state["epoch"], args.num_epochs):
@@ -369,7 +407,9 @@ def run_training(args, plan_factory: Callable, *, extra_log: Optional[dict] = No
                     # work of the whole group is charged to time/step —
                     # draining after the timer closed would let untimed
                     # compute inflate tokens_per_s/MFU.
-                    pending_losses.append(metrics["loss"])
+                    pending_losses.append(
+                        (host_state["global_step"] + 1, metrics["loss"],
+                         metrics.get("notfinite") if guard.enabled else None))
                     if (len(pending_losses) >= args.fence_every
                             or (host_state["global_step"] + 1)
                             % args.log_freq == 0):
@@ -377,6 +417,7 @@ def run_training(args, plan_factory: Callable, *, extra_log: Optional[dict] = No
 
                 host_state["global_step"] += 1
                 host_state["epoch_step"] += 1
+                heartbeat.beat(host_state["global_step"])
                 if progress:
                     progress.update(1)
 
@@ -410,6 +451,8 @@ def run_training(args, plan_factory: Callable, *, extra_log: Optional[dict] = No
                         "mfu": compute_mfu(tokens_per_s, flops_per_token, n_chips),
                         "time/total": ms_per_step,
                         **{f"time/{k}": t.avg_elapsed_ms() for k, t in timers.items()},
+                        **({"guard_skipped": guard.total_skipped}
+                           if guard.enabled else {}),
                         **(extra_log or {}),
                     }
                     LOGGER.info(info)
@@ -431,6 +474,11 @@ def run_training(args, plan_factory: Callable, *, extra_log: Optional[dict] = No
                     drain_losses()
                     LOGGER.info("Saving checkpoint.")
                     io.save(state, host_state)
+
+                # after the checkpoint block: an injected crash at step N
+                # leaves the step-N checkpoint (if any) published, matching
+                # the "died right after saving" drill the docs describe
+                maybe_crash(host_state["global_step"])
 
                 if args.max_steps and host_state["global_step"] >= args.max_steps:
                     done = True

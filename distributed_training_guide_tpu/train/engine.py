@@ -204,6 +204,16 @@ class TrainingEngine:
         else:
             remat, remat_policy = bool(ac), "all"
 
+        # {"policy": "off"|"skip"|"abort", "max_consecutive_skips": N} —
+        # train/guards.py; detection compiles into the step, enforcement
+        # happens on the metrics train_batch already host-reads
+        from .guards import GuardMonitor
+
+        sg = config.get("step_guards", {})
+        guard_policy = sg.get("policy", "off")
+        self._guard = GuardMonitor(guard_policy,
+                                   sg.get("max_consecutive_skips", 5))
+
         self.trainer = Trainer(
             bundle=bundle,
             optimizer=optimizer,
@@ -214,6 +224,7 @@ class TrainingEngine:
             attn_impl=config.get("attn_impl", "auto"),
             context_impl=config.get("context_impl", "ring"),
             cp_hop_loop=config.get("cp_hop_loop", "auto"),
+            guard_policy=guard_policy,
             loss_chunks=config.get("loss_chunks", 0),
             pp_microbatches=config.get("pp_microbatches"),
             # both spellings: our top-level key, and DeepSpeed's nested
@@ -232,7 +243,7 @@ class TrainingEngine:
                         "offload_param", False))),
         )
         self.state = self.trainer.init_state(config.get("seed", 0))
-        self._io = None
+        self._ios: dict[str, Any] = {}  # save_dir/tag -> CheckpointIO
 
     # ---- deepspeed-surface methods ----------------------------------------
     @property
@@ -247,23 +258,46 @@ class TrainingEngine:
     def train_batch(self, batch: dict) -> dict:
         """fwd + bwd + optimizer step (= model_engine.backward + step)."""
         self.state, metrics = self.trainer.step_fn(self.state, batch)
-        return {k: float(v) for k, v in metrics.items()}
+        out = {k: float(v) for k, v in metrics.items()}
+        if self._guard.enabled:
+            skipped = self._guard.observe(
+                out.get("notfinite", 0.0),
+                step=int(jax.device_get(self.state.step)), metrics=out)
+            out["guard_skipped"] = float(skipped)
+        return out
+
+    def _io_for(self, save_dir: str | Path, tag: Optional[str]):
+        """One CheckpointIO per destination, reused across calls and closed
+        by ``close()`` — retention state and any in-flight async save live on
+        the IO object, so a throwaway per call would leak its Orbax
+        resources and re-run the orphan sweep on every save."""
+        from ..checkpoint import CheckpointIO
+
+        key = str(Path(save_dir) / (tag or ""))
+        io = self._ios.get(key)
+        if io is None:
+            io = self._ios[key] = CheckpointIO(key)
+        return io
 
     def save_checkpoint(self, save_dir: str | Path, tag: Optional[str] = None) -> None:
-        from ..checkpoint import CheckpointIO
         from .state import host_state_dict
 
-        io = CheckpointIO(Path(save_dir) / (tag or ""))
         host = host_state_dict()
         host["global_step"] = int(jax.device_get(self.state.step))
-        io.save(self.state, host)
+        self._io_for(save_dir, tag).save(self.state, host)
 
     def load_checkpoint(self, save_dir: str | Path, tag: Optional[str] = None) -> dict:
-        from ..checkpoint import CheckpointIO, abstract_train_state
+        from ..checkpoint import abstract_train_state
 
-        io = CheckpointIO(Path(save_dir) / (tag or ""))
+        io = self._io_for(save_dir, tag)
         self.state, host = io.restore(abstract_train_state(self.trainer))
         return host
+
+    def close(self) -> None:
+        """Flush + release every CheckpointIO this engine opened."""
+        for io in self._ios.values():
+            io.close()
+        self._ios.clear()
 
 
 def initialize(config: dict | str | Path) -> TrainingEngine:

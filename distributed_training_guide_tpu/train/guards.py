@@ -1,0 +1,123 @@
+"""Step-level non-finite guards: detect NaN/Inf loss or grad-norm in-step.
+
+The reference's answer to a NaN loss is forensic: rerun under
+``JAX_DEBUG_NANS=1`` / anomaly mode after the run already died
+(``diagnosing-errors/README.md``). A production run wants a *policy* instead:
+
+- ``skip``: drop the poisoned update — keep the previous params/opt state,
+  let the step counter advance, count consecutive skips and abort past a
+  threshold (one bad batch shouldn't kill a pod-day; a divergent run
+  shouldn't spin forever either). The loss-scale-skip pattern of AMP
+  training, applied to bf16 land where the cause is data/LR, not scale.
+- ``abort``: fail fast with a machine-readable error file naming the step
+  and metrics — the supervisor classifies it as a poison pill and stops the
+  restart loop (a NaN at step N is deterministic under resume: restarting
+  into the same batch reproduces it).
+
+Split across the jit boundary: ``apply_step_guard`` runs INSIDE the compiled
+step (detection + the skip-select are a few scalar ops and a predicated
+tree-select — no extra host sync, works under async dispatch), while
+``GuardMonitor`` runs host-side on the metrics the loop already reads,
+honoring ``--fence-every`` banking (an abort may therefore surface up to one
+fence group after the offending step; the error file still names the step).
+"""
+from __future__ import annotations
+
+import dataclasses
+import logging
+
+LOGGER = logging.getLogger(__name__)
+
+GUARD_POLICIES = ("off", "skip", "abort")
+
+
+class NonFiniteLossError(RuntimeError):
+    """Raised (host-side) when the guard policy says training must stop."""
+
+    def __init__(self, step: int, metrics: dict, reason: str):
+        self.step = int(step)
+        self.metrics = dict(metrics)
+        super().__init__(
+            f"non-finite training step {step}: {metrics} ({reason})")
+
+
+def validate_guard_policy(policy: str) -> str:
+    if policy not in GUARD_POLICIES:
+        raise ValueError(f"unknown guard policy {policy!r}; "
+                         f"choose from {GUARD_POLICIES}")
+    return policy
+
+
+def apply_step_guard(policy: str, prev_state, new_state, metrics):
+    """In-jit guard: adds a ``notfinite`` 0/1 metric; under ``skip`` the
+    params/opt-state revert to ``prev_state`` when the step was poisoned
+    (the step counter and rng still advance — skips consume schedule and
+    data like the reference's AMP scaler skips consume steps).
+
+    Traced inside the compiled train step: ``prev_state`` is the step's
+    (donated) input, so the select costs no extra memory — XLA aliases
+    whichever side wins into the output buffers.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    ok = jnp.isfinite(metrics["loss"]) & jnp.isfinite(metrics["grad_norm"])
+    metrics = {**metrics, "notfinite": (~ok).astype(jnp.float32)}
+    if policy == "skip":
+        def sel(new, old):
+            return jnp.where(ok, new, old)
+
+        new_state = dataclasses.replace(
+            new_state,
+            params=jax.tree.map(sel, new_state.params, prev_state.params),
+            opt_state=jax.tree.map(sel, new_state.opt_state,
+                                   prev_state.opt_state))
+    return new_state, metrics
+
+
+class GuardMonitor:
+    """Host-side policy enforcement over the per-step ``notfinite`` flags.
+
+    ``observe`` returns True when the step was skipped (callers keep skipped
+    losses out of ``running_loss`` — averaging NaN in would poison every
+    logged window after the skip). Raises ``NonFiniteLossError`` — after
+    writing the torchelastic-style error file — when the policy is ``abort``
+    or the consecutive-skip budget is exhausted.
+    """
+
+    def __init__(self, policy: str, max_consecutive_skips: int = 5):
+        self.policy = validate_guard_policy(policy)
+        self.max_consecutive_skips = max_consecutive_skips
+        self.consecutive_skips = 0
+        self.total_skipped = 0
+
+    @property
+    def enabled(self) -> bool:
+        return self.policy != "off"
+
+    def _abort(self, step: int, metrics: dict, reason: str) -> None:
+        from ..launch.errors import write_error_file
+
+        exc = NonFiniteLossError(step, metrics, reason)
+        write_error_file(exc)
+        raise exc
+
+    def observe(self, notfinite: float, step: int,
+                metrics: dict | None = None) -> bool:
+        if not self.enabled or not notfinite:
+            self.consecutive_skips = 0
+            return False
+        metrics = metrics or {}
+        if self.policy == "abort":
+            self._abort(step, metrics, "guard policy 'abort'")
+        self.consecutive_skips += 1
+        self.total_skipped += 1
+        LOGGER.warning(
+            "non-finite step %d skipped (%d consecutive, %d total)",
+            step, self.consecutive_skips, self.total_skipped)
+        if self.consecutive_skips > self.max_consecutive_skips:
+            self._abort(step, metrics,
+                        f"{self.consecutive_skips} consecutive skips "
+                        f"exceed --guard-max-skips="
+                        f"{self.max_consecutive_skips}")
+        return True

@@ -29,6 +29,7 @@ from ..models.registry import ModelBundle
 from ..ops.cross_entropy import causal_lm_loss
 from ..parallel.mesh import make_mesh
 from ..parallel.plans import ShardingPlan, make_plan, spec_for_leaf
+from .guards import apply_step_guard, validate_guard_policy
 from .state import TrainState
 
 
@@ -113,11 +114,13 @@ class Trainer:
     cp_hop_loop: str = "auto"  # ring hop loop: "auto"/"scan"/"unrolled"
     loss_fn: Callable = causal_lm_loss
     donate: bool = True
+    guard_policy: str = "off"  # "off" | "skip" | "abort" (train/guards.py)
     offload_opt_state: bool = False
     offload_params: bool = False  # params live in host memory between steps
     pp_microbatches: Optional[int] = None  # pipeline microbatches (default 2*pp)
 
     def __post_init__(self):
+        validate_guard_policy(self.guard_policy)
         if self.plan is None:
             self.plan = make_plan("single", make_mesh(devices=jax.devices()[:1]))
         # seq-dependent rope types (dynamic NTK, longrope) trace their
@@ -430,6 +433,12 @@ class Trainer:
         if grad_fn is None:
             grad_fn = jax.value_and_grad(loss_on_microbatch, has_aux=True)
 
+        # deterministic NaN fault (utils/faults.py), resolved at build time so
+        # the injected branch compiles into the step only when the drill is on
+        from ..utils.faults import active_faults
+
+        nan_fault_step = active_faults().nan_loss_step
+
         def train_step(state: TrainState, batch: dict):
             params = state.params
             opt_state = state.opt_state
@@ -462,6 +471,9 @@ class Trainer:
             else:
                 (loss, extras), grads = grad_fn(params, batch)
 
+            if nan_fault_step is not None:
+                loss = jnp.where(state.step == nan_fault_step, jnp.nan, loss)
+
             updates, new_opt = self.optimizer.update(grads, opt_state, params)
             new_params = optax.apply_updates(params, updates)
             metrics = {
@@ -471,10 +483,18 @@ class Trainer:
             }
             new_state = TrainState(step=state.step + 1, params=new_params,
                                    opt_state=new_opt, rng=state.rng)
+            if self.guard_policy != "off":
+                # flags non-finite loss/grad-norm; under "skip" the params/
+                # opt-state revert to the (donated) inputs via a predicated
+                # select — all inside this compiled program, no host sync
+                new_state, metrics = apply_step_guard(
+                    self.guard_policy, state, new_state, metrics)
             return new_state, metrics
 
         metric_sharding = {"loss": self.plan.replicated(),
                            "grad_norm": self.plan.replicated(),
+                           **({"notfinite": self.plan.replicated()}
+                              if self.guard_policy != "off" else {}),
                            **{k: self.plan.replicated() for k in extra_keys}}
         offloading = self.offload_params or self.offload_opt_state
         jitted = jax.jit(

@@ -3,6 +3,8 @@ from .memory import get_mem_stats
 from .logging import init_logging, log_dict
 from .procguards import process0_first, process_ordered, is_process0, sync_processes
 from .mfu import transformer_flops_per_token, device_peak_flops, compute_mfu
+from .faults import FaultSpec, active_faults
+from .heartbeat import HeartbeatWriter, heartbeat_path, read_heartbeat
 
 __all__ = [
     "LocalTimer",
@@ -16,4 +18,9 @@ __all__ = [
     "transformer_flops_per_token",
     "device_peak_flops",
     "compute_mfu",
+    "FaultSpec",
+    "active_faults",
+    "HeartbeatWriter",
+    "heartbeat_path",
+    "read_heartbeat",
 ]

@@ -1,0 +1,131 @@
+"""Deterministic fault injection for failure drills.
+
+The reference teaches failure *diagnosis* (``diagnosing-errors/README.md``)
+but gives no way to rehearse a failure on purpose; every restart/resume path
+in this repo would otherwise only be exercised by real crashes. These env-var
+driven faults make failures reproducible — the chaos tests
+(``tests/test_chaos.py``) and operators running fire drills on a real pod use
+the same switches:
+
+- ``DTG_FAULT_CRASH_STEP=N`` [+ ``DTG_FAULT_CRASH_MODE=kill|exc``]: die at
+  the end of global step N — SIGKILL (default; no cleanup, the supervisor's
+  worst case) or a raised exception (exercises the ``@record`` error file).
+- ``DTG_FAULT_NAN_LOSS_STEP=N``: overwrite the loss with NaN inside the
+  jitted step when ``state.step == N`` (drives ``train/guards.py`` policies).
+- ``DTG_FAULT_CORRUPT_CKPT_STEP=N``: after the step-N checkpoint publishes,
+  flip bytes in its largest shard file — the manifest then catches it and
+  restore falls back through the retention chain.
+- ``DTG_FAULT_SAVE_LATENCY_S=X``: sleep X seconds inside every checkpoint
+  save (slow-NFS simulation; exercises async-save overlap and heartbeats).
+
+All faults are deterministic functions of (env, step): a drill that kills a
+run at step N kills every rerun at step N too, so kill -> restart -> resume
+trajectories can be compared bit-for-bit against an uninterrupted run.
+"""
+from __future__ import annotations
+
+import dataclasses
+import logging
+import os
+import signal
+from pathlib import Path
+from typing import Optional
+
+LOGGER = logging.getLogger(__name__)
+
+ENV_CRASH_STEP = "DTG_FAULT_CRASH_STEP"
+ENV_CRASH_MODE = "DTG_FAULT_CRASH_MODE"
+ENV_NAN_LOSS_STEP = "DTG_FAULT_NAN_LOSS_STEP"
+ENV_CORRUPT_CKPT_STEP = "DTG_FAULT_CORRUPT_CKPT_STEP"
+ENV_SAVE_LATENCY_S = "DTG_FAULT_SAVE_LATENCY_S"
+
+_CORRUPT_BYTES = 256
+
+
+def _env_int(name: str) -> Optional[int]:
+    raw = os.environ.get(name)
+    if raw is None or raw == "":
+        return None
+    try:
+        return int(raw)
+    except ValueError:
+        LOGGER.warning("ignoring non-integer %s=%r", name, raw)
+        return None
+
+
+@dataclasses.dataclass(frozen=True)
+class FaultSpec:
+    crash_step: Optional[int] = None
+    crash_mode: str = "kill"          # "kill" (SIGKILL) or "exc" (raise)
+    nan_loss_step: Optional[int] = None
+    corrupt_ckpt_step: Optional[int] = None
+    save_latency_s: float = 0.0
+
+
+def active_faults() -> FaultSpec:
+    """Parse the fault env vars (re-read on every call: cheap, and lets tests
+    monkeypatch the environment without import-order games)."""
+    try:
+        latency = float(os.environ.get(ENV_SAVE_LATENCY_S, 0) or 0)
+    except ValueError:
+        latency = 0.0
+    return FaultSpec(
+        crash_step=_env_int(ENV_CRASH_STEP),
+        crash_mode=os.environ.get(ENV_CRASH_MODE, "kill"),
+        nan_loss_step=_env_int(ENV_NAN_LOSS_STEP),
+        corrupt_ckpt_step=_env_int(ENV_CORRUPT_CKPT_STEP),
+        save_latency_s=latency,
+    )
+
+
+def maybe_crash(global_step: int) -> None:
+    """Host-side crash fault, called at the end of each loop iteration (after
+    any checkpoint for this step has published, so 'crash at step N' leaves
+    the step-N checkpoint on disk when N is a checkpoint step)."""
+    spec = active_faults()
+    if spec.crash_step is None or global_step != spec.crash_step:
+        return
+    if spec.crash_mode == "exc":
+        raise RuntimeError(
+            f"injected fault: crash at global step {global_step} "
+            f"({ENV_CRASH_STEP}={spec.crash_step})")
+    LOGGER.warning("injected fault: SIGKILL at global step %d", global_step)
+    os.kill(os.getpid(), signal.SIGKILL)
+
+
+def maybe_save_latency() -> None:
+    spec = active_faults()
+    if spec.save_latency_s > 0:
+        import time
+
+        LOGGER.warning("injected fault: %.3fs checkpoint save latency",
+                       spec.save_latency_s)
+        time.sleep(spec.save_latency_s)
+
+
+def corrupt_checkpoint_dir(ckpt_dir: Path) -> Optional[str]:
+    """Flip the leading bytes of the largest file under ``ckpt_dir`` (the
+    biggest TensorStore chunk — the array data, not tiny metadata). Returns
+    the corrupted file's relative path, or None if the dir has no files."""
+    ckpt_dir = Path(ckpt_dir)
+    files = [p for p in ckpt_dir.rglob("*") if p.is_file()]
+    if not files:
+        return None
+    victim = max(files, key=lambda p: p.stat().st_size)
+    with open(victim, "r+b") as fp:
+        chunk = fp.read(_CORRUPT_BYTES)
+        fp.seek(0)
+        fp.write(bytes(b ^ 0xFF for b in chunk))
+    return str(victim.relative_to(ckpt_dir))
+
+
+def maybe_corrupt_checkpoint(ckpt_dir: Path, step: int) -> None:
+    """Checkpoint-corruption fault, applied AFTER the manifest + state.json
+    published: the manifest holds the good checksums, the dir holds bad bytes
+    — exactly what a post-publish partial write looks like to a restart."""
+    spec = active_faults()
+    if spec.corrupt_ckpt_step is None or step != spec.corrupt_ckpt_step:
+        return
+    victim = corrupt_checkpoint_dir(ckpt_dir)
+    LOGGER.warning("injected fault: corrupted %s in checkpoint %s",
+                   victim, Path(ckpt_dir).name)

@@ -36,6 +36,10 @@ except Exception:
 
 import pytest  # noqa: E402
 
+# Marker hygiene is enforced by `--strict-markers` in pyproject.toml: every
+# marker must be registered under [tool.pytest.ini_options] markers, and an
+# unknown one fails collection loudly instead of silently deselecting wrong.
+
 
 @pytest.fixture(scope="session")
 def eight_devices():

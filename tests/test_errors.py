@@ -1,0 +1,121 @@
+"""Unit tests for launch/errors.py: the @record error-file contract, the
+outside-except traceback fix, env fallbacks, and poison-pill classification
+(ISSUE 1 satellites). No jax compile, fast."""
+import json
+
+import pytest
+
+from distributed_training_guide_tpu.launch.errors import (
+    classify_error, error_file_path, record, write_error_file)
+
+
+def read_payload(path):
+    payload = json.loads(path.read_text())
+    assert set(payload) == {"message"}
+    msg = payload["message"]
+    for key in ("error", "traceback", "process_index", "timestamp",
+                "hostname", "pid"):
+        assert key in msg, key
+    return msg
+
+
+def test_record_writes_well_formed_error_file(tmp_path, monkeypatch):
+    err = tmp_path / "logs" / "error.json"   # parent dir must be created too
+    monkeypatch.setenv("ERROR_FILE", str(err))
+
+    @record
+    def boom():
+        raise ValueError("kaboom from the worker")
+
+    with pytest.raises(ValueError):
+        boom()
+    msg = read_payload(err)
+    assert "kaboom from the worker" in msg["error"]
+    # a REAL traceback naming the raise site, not torchelastic's un-captured
+    # "NoneType: None"
+    assert "boom" in msg["traceback"] and "ValueError" in msg["traceback"]
+
+
+def test_write_error_file_outside_except_block(tmp_path, monkeypatch):
+    """Direct calls with a constructed (never-raised) exception — the guard
+    abort path — must still record the exception, not 'NoneType: None'
+    (traceback.format_exc reads the *ambient* exception state, which is
+    empty outside an except block)."""
+    err = tmp_path / "error.json"
+    monkeypatch.setenv("ERROR_FILE", str(err))
+    write_error_file(RuntimeError("constructed, never raised"))
+    msg = read_payload(err)
+    assert "constructed, never raised" in msg["error"]
+    assert "NoneType: None" not in msg["traceback"]
+    assert "RuntimeError" in msg["traceback"]
+
+
+def test_torchelastic_env_fallback(tmp_path, monkeypatch):
+    monkeypatch.delenv("ERROR_FILE", raising=False)
+    monkeypatch.setenv("TORCHELASTIC_ERROR_FILE", str(tmp_path / "te.json"))
+    assert error_file_path() == str(tmp_path / "te.json")
+    write_error_file(KeyError("ported launch command"))
+    assert "ported launch command" in read_payload(tmp_path / "te.json")["error"]
+
+
+def test_write_error_file_noop_without_env(monkeypatch):
+    monkeypatch.delenv("ERROR_FILE", raising=False)
+    monkeypatch.delenv("TORCHELASTIC_ERROR_FILE", raising=False)
+    write_error_file(RuntimeError("nowhere to go"))   # must not raise
+
+
+# ---- classification ---------------------------------------------------------
+
+def payload_for(error_repr, traceback=""):
+    return {"message": {"error": error_repr, "traceback": traceback}}
+
+
+@pytest.mark.parametrize("error,reason", [
+    ("XlaRuntimeError('RESOURCE_EXHAUSTED: Out of memory allocating "
+     "123456 bytes')", "oom"),
+    ("ValueError('8 devices not divisible by tensor x pipeline = 3')",
+     "shape/sharding"),
+    ("NonFiniteLossError('non-finite training step 7: ...')", "non-finite"),
+])
+def test_classify_poison(error, reason):
+    assert classify_error(payload_for(error)) == reason
+
+
+def test_classify_transient_is_none():
+    assert classify_error(payload_for(
+        "RuntimeError('injected failure after step-3 checkpoint (test)')")) is None
+    assert classify_error(payload_for(
+        "ConnectionError('coordinator unreachable')")) is None
+    assert classify_error({}) is None
+
+
+def test_classify_tolerates_foreign_error_file_shapes():
+    """The supervisor runs arbitrary commands; a worker may write
+    {"message": "<string>"} instead of our nested dict — classification must
+    still work (and not crash the supervisor mid-failure-handling)."""
+    assert classify_error({"message": "RESOURCE_EXHAUSTED: oom"}) == "oom"
+    assert classify_error({"message": "it broke"}) is None
+    assert classify_error("not even a dict") is None
+
+
+def test_classify_collateral_gang_teardown_is_not_poison():
+    """When one rank of a fail-fast gang dies, SURVIVORS write collateral
+    errors (collective torn down mid-flight) that carry generic Xla markers
+    like INVALID_ARGUMENT. Those must classify as transient — stopping the
+    restart loop on a victim's error would break exactly the elasticity the
+    supervisor exists for (observed live: jax 0.4.37 CPU gangs)."""
+    assert classify_error(payload_for(
+        'XlaRuntimeError("INVALID_ARGUMENT: Multiprocess computations '
+        "aren't implemented on the CPU backend.\")")) is None
+    assert classify_error(payload_for(
+        "XlaRuntimeError('INVALID_ARGUMENT: Sharding contains unknown "
+        "device')")) is None
+
+
+def test_classify_ignores_traceback_text():
+    """Poison patterns must match the error repr only: every jax traceback
+    walks files named *sharding*.py, and matching there would turn any
+    transient failure into a no-restart verdict."""
+    p = payload_for("TimeoutError('barrier timed out')",
+                    traceback="File jax/_src/sharding_impls.py line 1 ...")
+    assert classify_error(p) is None

@@ -1,0 +1,145 @@
+"""Step-level non-finite guards (train/guards.py) + the deterministic NaN
+fault: in-jit detection/skip-select semantics, host-side policy enforcement,
+and the engine's step_guards config surface (ISSUE 1 tentpole part 2)."""
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_training_guide_tpu.models import get_model
+from distributed_training_guide_tpu.parallel import make_mesh, make_plan
+from distributed_training_guide_tpu.train import Trainer, adamw_cosine
+from distributed_training_guide_tpu.train.guards import (
+    GuardMonitor, NonFiniteLossError)
+from distributed_training_guide_tpu.utils.faults import ENV_NAN_LOSS_STEP
+
+
+def make_trainer(**kw):
+    bundle = get_model("llama-debug", dtype=jnp.float32)
+    return Trainer(bundle=bundle, optimizer=adamw_cosine(1e-3),
+                   plan=make_plan("ddp", make_mesh()), **kw)
+
+
+def batch_for(t, seed=0):
+    ids = jnp.asarray(np.random.RandomState(seed).randint(0, 512, (8, 16)))
+    return {k: jax.device_put(ids, t.batch_shardings()[k])
+            for k in ("input_ids", "labels")}
+
+
+def leaves(state):
+    return [np.asarray(x) for x in jax.tree.leaves(jax.device_get(state.params))]
+
+
+def test_skip_policy_reverts_poisoned_update(eight_devices, monkeypatch):
+    """NaN injected at state.step==1 (the second call): the skip policy must
+    keep params/opt-state bit-identical to the pre-step values, advance the
+    step counter, and recover on the next (finite) step."""
+    monkeypatch.setenv(ENV_NAN_LOSS_STEP, "1")
+    t = make_trainer(guard_policy="skip", donate=False)
+    batch = batch_for(t)
+    s1, m1 = t.step_fn(t.init_state(0), batch)
+    assert float(m1["notfinite"]) == 0.0
+
+    before = leaves(s1)
+    s2, m2 = t.step_fn(s1, batch)
+    assert float(m2["notfinite"]) == 1.0
+    assert not np.isfinite(float(m2["loss"]))         # honest metric
+    for a, b in zip(before, leaves(s2)):
+        np.testing.assert_array_equal(a, b)           # update dropped
+    assert int(s2.step) == 2                          # schedule still advances
+
+    s3, m3 = t.step_fn(s2, batch)
+    assert float(m3["notfinite"]) == 0.0
+    assert np.isfinite(float(m3["loss"]))
+    assert any(not np.array_equal(a, b)
+               for a, b in zip(before, leaves(s3)))   # training resumed
+
+
+def test_guard_off_keeps_metric_surface(eight_devices):
+    t = make_trainer(donate=False)
+    _, m = t.step_fn(t.init_state(0), batch_for(t))
+    assert "notfinite" not in m                       # zero-cost when off
+
+
+def test_monitor_abort_writes_error_file(tmp_path, monkeypatch):
+    err = tmp_path / "error.json"
+    monkeypatch.setenv("ERROR_FILE", str(err))
+    mon = GuardMonitor("abort")
+    with pytest.raises(NonFiniteLossError, match="step 7"):
+        mon.observe(1.0, step=7, metrics={"loss": float("nan")})
+    msg = json.loads(err.read_text())["message"]
+    assert "NonFiniteLossError" in msg["error"]
+    assert "step 7" in msg["error"]
+    assert "NoneType: None" not in msg["traceback"]   # satellite fix
+
+
+def test_monitor_skip_threshold(tmp_path, monkeypatch):
+    monkeypatch.setenv("ERROR_FILE", str(tmp_path / "e.json"))
+    mon = GuardMonitor("skip", max_consecutive_skips=2)
+    assert mon.observe(1.0, step=1) is True
+    assert mon.observe(1.0, step=2) is True
+    assert mon.observe(0.0, step=3) is False          # finite resets the run
+    assert mon.observe(1.0, step=4) is True
+    assert mon.observe(1.0, step=5) is True
+    with pytest.raises(NonFiniteLossError, match="consecutive"):
+        mon.observe(1.0, step=6)
+    assert mon.total_skipped == 5
+    assert (tmp_path / "e.json").exists()
+
+
+def test_monitor_off_is_inert():
+    mon = GuardMonitor("off")
+    assert mon.observe(1.0, step=1) is False
+    assert not mon.enabled
+
+
+def test_trainer_rejects_unknown_policy():
+    with pytest.raises(ValueError, match="guard policy"):
+        make_trainer(guard_policy="panic")
+
+
+def test_engine_step_guards_config(eight_devices, monkeypatch):
+    """The DeepSpeed-surface spelling: step_guards in the engine config wires
+    the in-jit guard and the host monitor; a NaN step reports skipped=1 and
+    leaves the next step trainable."""
+    monkeypatch.setenv(ENV_NAN_LOSS_STEP, "1")
+    from distributed_training_guide_tpu.train.engine import initialize
+
+    engine = initialize({
+        "model": "llama-debug",
+        "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
+        "step_guards": {"policy": "skip", "max_consecutive_skips": 3},
+    })
+    ids = np.random.RandomState(0).randint(0, 512, (engine.global_batch_size, 32))
+    batch_sh = engine.trainer.batch_shardings()
+    batch = {k: jax.device_put(ids, batch_sh[k]) for k in ("input_ids", "labels")}
+    m1 = engine.train_batch(batch)
+    assert m1["notfinite"] == 0.0 and m1["guard_skipped"] == 0.0
+    m2 = engine.train_batch(batch)                    # state.step==1: poisoned
+    assert m2["notfinite"] == 1.0 and m2["guard_skipped"] == 1.0
+    m3 = engine.train_batch(batch)
+    assert m3["notfinite"] == 0.0 and np.isfinite(m3["loss"])
+    engine.close()
+
+
+def test_engine_caches_checkpoint_io(tmp_path, eight_devices):
+    """save/load_checkpoint reuse ONE CheckpointIO per destination (retention
+    and async state live on the IO object; a throwaway per call would leak
+    its Orbax resources and re-run the orphan sweep every save), and close()
+    releases them."""
+    from distributed_training_guide_tpu.train.engine import initialize
+
+    engine = initialize({"model": "llama-debug",
+                         "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}}})
+    engine.save_checkpoint(tmp_path / "eng")
+    io_first = engine._ios[str(tmp_path / "eng")]
+    engine.save_checkpoint(tmp_path / "eng")
+    engine.load_checkpoint(tmp_path / "eng")
+    assert engine._ios[str(tmp_path / "eng")] is io_first   # reused
+    assert len(engine._ios) == 1
+    engine.save_checkpoint(tmp_path / "other")
+    assert len(engine._ios) == 2
+    engine.close()
+    assert engine._ios == {}

@@ -102,7 +102,8 @@ def test_supervisor_restarts_then_succeeds(tmp_path):
         "    sys.exit(0)\n"
         "s.write_text('x')\n"
         "sys.exit(3)\n")]
-    rc = run_supervised(cmd, max_restarts=2, log_dir=tmp_path / "logs")
+    rc = run_supervised(cmd, max_restarts=2, log_dir=tmp_path / "logs",
+                        restart_backoff=0.05)
     assert rc == 0
     out0 = (tmp_path / "logs" / "attempt_0" / "stdout.log").read_text()
     out1 = (tmp_path / "logs" / "attempt_1" / "stdout.log").read_text()
@@ -111,9 +112,141 @@ def test_supervisor_restarts_then_succeeds(tmp_path):
 
 def test_supervisor_exhausts_restarts(tmp_path):
     rc = run_supervised([PY, "-c", "import sys; sys.exit(5)"],
-                        max_restarts=1, log_dir=tmp_path / "logs")
+                        max_restarts=1, log_dir=tmp_path / "logs",
+                        restart_backoff=0.05)
     assert rc == 5
     assert (tmp_path / "logs" / "attempt_1").is_dir()   # restarted once
+
+
+def test_supervisor_exponential_backoff(tmp_path):
+    """Two restarts with base 0.4s must sleep ~0.4 + ~0.8s between attempts
+    — the crash loop is rate-limited (and the schedule doubles, not flat)."""
+    t0 = time.time()
+    rc = run_supervised([PY, "-c", "import sys; sys.exit(9)"],
+                        max_restarts=2, log_dir=tmp_path / "logs",
+                        restart_backoff=0.4)
+    elapsed = time.time() - t0
+    assert rc == 9
+    assert (tmp_path / "logs" / "attempt_2").is_dir()
+    assert elapsed >= 1.2                  # 0.4 + 0.8 backoff floors
+    assert elapsed < 60
+
+
+def test_supervisor_backoff_cap(tmp_path):
+    """backoff_cap bounds the schedule: base 10 with cap 0.1 must not sleep
+    anywhere near 10s."""
+    t0 = time.time()
+    run_supervised([PY, "-c", "import sys; sys.exit(9)"],
+                   max_restarts=1, log_dir=tmp_path / "logs",
+                   restart_backoff=10.0, backoff_cap=0.1)
+    assert time.time() - t0 < 8
+
+
+def _error_writing_worker(error: str) -> list:
+    """A worker that writes its own torchelastic-style error file (what
+    @record does) and exits nonzero."""
+    return [PY, "-c", (
+        "import json, os, sys\n"
+        "path = os.environ['ERROR_FILE']\n"
+        "os.makedirs(os.path.dirname(path) or '.', exist_ok=True)\n"
+        "with open(path, 'w') as fp:\n"
+        f"    json.dump({{'message': {{'error': {error!r},\n"
+        "               'traceback': '...'}}, fp)\n"
+        "sys.exit(1)\n")]
+
+
+def test_supervisor_stops_on_poison_pill(tmp_path):
+    """An OOM error file is a deterministic failure: the supervisor must
+    stop after attempt 0 instead of burning its restart budget."""
+    cmd = _error_writing_worker(
+        "XlaRuntimeError('RESOURCE_EXHAUSTED: Out of memory allocating 1TB')")
+    rc = run_supervised(cmd, max_restarts=3, log_dir=tmp_path / "logs",
+                        restart_backoff=0.05)
+    assert rc == 1
+    assert (tmp_path / "logs" / "attempt_0").is_dir()
+    assert not (tmp_path / "logs" / "attempt_1").exists()   # no restart
+
+
+def test_supervisor_poison_in_rank_file_and_override(tmp_path):
+    """Gangs write per-rank error files (error.json.rankN) — classification
+    must read those too; --restart-on-poison opts back into restarting."""
+    worker = [PY, "-c", (
+        "import json, os, sys\n"
+        "path = os.environ['ERROR_FILE'] + '.rank1'\n"
+        "os.makedirs(os.path.dirname(path) or '.', exist_ok=True)\n"
+        "with open(path, 'w') as fp:\n"
+        "    json.dump({'message': {'error': \"ValueError('8 devices not "
+        "divisible by tensor x pipeline = 3')\"}}, fp)\n"
+        "sys.exit(1)\n")]
+    rc = run_supervised(worker, max_restarts=2, log_dir=tmp_path / "a",
+                        restart_backoff=0.05)
+    assert rc == 1
+    assert not (tmp_path / "a" / "attempt_1").exists()
+
+    rc = run_supervised(worker, max_restarts=1, log_dir=tmp_path / "b",
+                        restart_backoff=0.05, stop_on_poison=False)
+    assert (tmp_path / "b" / "attempt_1").is_dir()          # blind restart
+
+
+def test_supervisor_poison_in_foreign_error_file_shape(tmp_path):
+    """A worker that writes {"message": "<plain string>"} (not our nested
+    dict) must still classify — and the supervisor must report it without
+    crashing on the foreign shape."""
+    worker = [PY, "-c", (
+        "import json, os, sys\n"
+        "with open(os.environ['ERROR_FILE'], 'w') as fp:\n"
+        "    json.dump({'message': 'RESOURCE_EXHAUSTED: out of memory'}, fp)\n"
+        "sys.exit(1)\n")]
+    rc = run_supervised(worker, max_restarts=2, log_dir=tmp_path / "logs",
+                        restart_backoff=0.05)
+    assert rc == 1
+    assert not (tmp_path / "logs" / "attempt_1").exists()   # stopped cleanly
+
+
+def test_supervisor_transient_error_file_still_restarts(tmp_path):
+    """A non-poison error file (transient infra failure) must not disable
+    elasticity."""
+    cmd = _error_writing_worker("ConnectionError('coordinator unreachable')")
+    rc = run_supervised(cmd, max_restarts=1, log_dir=tmp_path / "logs",
+                        restart_backoff=0.05)
+    assert rc == 1
+    assert (tmp_path / "logs" / "attempt_1").is_dir()       # restarted
+
+
+def test_supervisor_heartbeat_file_preferred_over_log_silence(tmp_path):
+    """A worker that logs NOTHING but beats its HEARTBEAT_FILE must survive
+    a heartbeat_timeout shorter than its runtime — under the old log-size
+    heuristic this healthy-but-quiet worker was killed as hung."""
+    cmd = [PY, "-c", (
+        "import json, os, time\n"
+        "path = os.environ['HEARTBEAT_FILE']\n"
+        "for step in range(8):\n"
+        "    with open(path + '.tmp', 'w') as fp:\n"
+        "        json.dump({'step': step, 'time': time.time()}, fp)\n"
+        "    os.replace(path + '.tmp', path)\n"
+        "    time.sleep(0.4)\n")]
+    rc = run_supervised(cmd, max_restarts=0, log_dir=tmp_path / "logs",
+                        heartbeat_timeout=1.5)
+    assert rc == 0                       # ~3.2s silent runtime, not killed
+
+
+def test_supervisor_stale_heartbeat_kills_worker(tmp_path):
+    """The inverse: a worker that beats once and then wedges (while still
+    CHATTING on stdout — the chatty-death-spiral case the log heuristic
+    misses) is killed when the heartbeat goes stale."""
+    cmd = [PY, "-c", (
+        "import json, os, time\n"
+        "path = os.environ['HEARTBEAT_FILE']\n"
+        "with open(path, 'w') as fp:\n"
+        "    json.dump({'step': 1, 'time': time.time()}, fp)\n"
+        "while True:\n"
+        "    print('still chatting', flush=True)\n"
+        "    time.sleep(0.2)\n")]
+    t0 = time.time()
+    rc = run_supervised(cmd, max_restarts=0, log_dir=tmp_path / "logs",
+                        heartbeat_timeout=1.5)
+    assert rc != 0
+    assert time.time() - t0 < 60
 
 
 def test_supervisor_heartbeat_kills_hung_worker(tmp_path):

@@ -1,0 +1,216 @@
+"""Checkpoint integrity: manifest write/verify, keep-n retention, corruption
+fallback, orphan sweep, and transient-save retry (ISSUE 1 tentpole part 1).
+
+Uses a tiny hand-built pytree (not a full Trainer) wherever possible so the
+mechanics are pinned without paying a model compile; the end-to-end drills on
+real TrainStates live in test_chaos.py.
+"""
+import json
+import time
+from pathlib import Path
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_training_guide_tpu.checkpoint import (
+    CheckpointCorruptionError, CheckpointIO, load_manifest, manifest_path,
+    verify_manifest, write_manifest)
+from distributed_training_guide_tpu.utils.faults import corrupt_checkpoint_dir
+
+
+def small_state(scale=1.0):
+    return {"w": jnp.arange(16, dtype=jnp.float32) * scale,
+            "b": jnp.ones((4,), jnp.float32) * scale}
+
+
+def abstract_small_state():
+    sharding = jax.sharding.SingleDeviceSharding(jax.devices()[0])
+    return {"w": jax.ShapeDtypeStruct((16,), jnp.float32, sharding=sharding),
+            "b": jax.ShapeDtypeStruct((4,), jnp.float32, sharding=sharding)}
+
+
+def save_step(io, step, scale=None):
+    host = {"epoch": 0, "global_step": step, "epoch_step": step,
+            "running_loss": 0.0}
+    io.save(small_state(scale if scale is not None else float(step)), host)
+
+
+# ---- manifest primitives ----------------------------------------------------
+
+def test_manifest_roundtrip_and_verify(tmp_path):
+    d = tmp_path / "checkpoint-1"
+    (d / "sub").mkdir(parents=True)
+    (d / "a.bin").write_bytes(b"hello world" * 100)
+    (d / "sub" / "b.bin").write_bytes(b"\x00" * 1000)
+    write_manifest(d, 1, {"global_step": 1})
+    man = load_manifest(tmp_path, "checkpoint-1")
+    assert man["step"] == 1
+    assert man["host_state"] == {"global_step": 1}
+    assert {f["path"] for f in man["files"]} == {"a.bin", "sub/b.bin"}
+    assert verify_manifest(d, man) == []
+
+    # bit flip -> checksum mismatch (size unchanged, the nasty case)
+    raw = bytearray((d / "a.bin").read_bytes())
+    raw[0] ^= 0xFF
+    (d / "a.bin").write_bytes(bytes(raw))
+    problems = verify_manifest(d, man)
+    assert problems and "checksum mismatch: a.bin" in problems[0]
+
+    # truncation -> size mismatch reported without checksumming
+    (d / "sub" / "b.bin").write_bytes(b"\x00" * 999)
+    assert any("size mismatch: sub/b.bin" in p for p in verify_manifest(d, man))
+
+    # deletion -> missing file
+    (d / "a.bin").unlink()
+    assert any("missing file: a.bin" in p for p in verify_manifest(d, man))
+
+
+def test_load_manifest_absent_or_garbage(tmp_path):
+    assert load_manifest(tmp_path, "checkpoint-9") is None
+    manifest_path(tmp_path, "checkpoint-9").write_text("{not json")
+    assert load_manifest(tmp_path, "checkpoint-9") is None
+
+
+# ---- retention + fallback ---------------------------------------------------
+
+def test_keep_n_retention_chain(tmp_path):
+    io = CheckpointIO(tmp_path, keep_n=2)
+    for step in (1, 2, 3):
+        save_step(io, step)
+    io.close()
+    dirs = sorted(p.name for p in tmp_path.iterdir()
+                  if p.is_dir() and p.name.startswith("checkpoint-"))
+    assert dirs == ["checkpoint-2", "checkpoint-3"]   # 1 pruned, 2 retained
+    state = json.loads((tmp_path / "state.json").read_text())
+    assert state["checkpoint"] == "checkpoint-3"
+    assert state["retained"] == ["checkpoint-3", "checkpoint-2"]
+    # manifests track the dirs: pruned one is gone too
+    assert load_manifest(tmp_path, "checkpoint-3") is not None
+    assert load_manifest(tmp_path, "checkpoint-2") is not None
+    assert load_manifest(tmp_path, "checkpoint-1") is None
+
+
+def test_restore_falls_back_past_corrupt_latest(tmp_path, caplog):
+    io = CheckpointIO(tmp_path, keep_n=2)
+    save_step(io, 1)
+    save_step(io, 2)
+    io.close()
+    corrupt_checkpoint_dir(tmp_path / "checkpoint-2")
+
+    io2 = CheckpointIO(tmp_path)
+    restored, host = io2.restore(abstract_small_state())
+    assert host["global_step"] == 1                   # fell back to step 1
+    np.testing.assert_array_equal(np.asarray(restored["w"]),
+                                  np.arange(16, dtype=np.float32) * 1.0)
+    assert any("skipping checkpoint checkpoint-2" in r.message
+               for r in caplog.records)
+
+
+def test_restore_raises_when_whole_chain_corrupt(tmp_path):
+    io = CheckpointIO(tmp_path, keep_n=2)
+    save_step(io, 1)
+    save_step(io, 2)
+    io.close()
+    corrupt_checkpoint_dir(tmp_path / "checkpoint-1")
+    corrupt_checkpoint_dir(tmp_path / "checkpoint-2")
+    with pytest.raises(CheckpointCorruptionError, match="checkpoint-2"):
+        CheckpointIO(tmp_path).restore(abstract_small_state())
+
+
+def test_restore_legacy_state_json_without_manifest(tmp_path):
+    """Pre-retention layouts (state.json with only `checkpoint`, no manifest
+    file) must keep restoring — upgrades can't strand old experiments."""
+    io = CheckpointIO(tmp_path, keep_n=1)
+    save_step(io, 4)
+    io.close()
+    manifest_path(tmp_path, "checkpoint-4").unlink()
+    state = json.loads((tmp_path / "state.json").read_text())
+    del state["retained"]
+    (tmp_path / "state.json").write_text(json.dumps(state))
+
+    io2 = CheckpointIO(tmp_path)
+    assert io2.can_resume()
+    restored, host = io2.restore(abstract_small_state())
+    assert host["global_step"] == 4
+    assert "checkpoint" not in host and "retained" not in host
+
+
+# ---- orphan sweep -----------------------------------------------------------
+
+def test_orphan_sweep_on_first_save(tmp_path):
+    """A dir committed by Orbax but never referenced by state.json (crash
+    between save and finalize) is collected when the next WRITER starts
+    saving; referenced dirs and non-checkpoint entries are untouched."""
+    io = CheckpointIO(tmp_path, keep_n=2)
+    save_step(io, 1)
+    io.close()
+    orphan = tmp_path / "checkpoint-99"
+    orphan.mkdir()
+    (orphan / "shard").write_bytes(b"x" * 64)
+    write_manifest(orphan, 99, {"global_step": 99})
+    stray_manifest = manifest_path(tmp_path, "checkpoint-77")
+    stray_manifest.write_text("{}")
+    keepme = tmp_path / "not-a-checkpoint"
+    keepme.mkdir()
+
+    io2 = CheckpointIO(tmp_path, keep_n=2)
+    assert orphan.exists()                  # opening an IO deletes NOTHING
+    save_step(io2, 2)
+    io2.close()
+    assert not orphan.exists()
+    assert not manifest_path(tmp_path, "checkpoint-99").exists()
+    assert not stray_manifest.exists()
+    assert (tmp_path / "checkpoint-1").exists()       # retained: kept
+    assert (tmp_path / "checkpoint-2").exists()
+    assert keepme.exists()
+
+
+def test_restore_only_consumer_never_deletes(tmp_path):
+    """A read-only CheckpointIO (export / engine load / crash inspection)
+    must not collect unreferenced dirs: to a reader, an in-flight async
+    save from a live writer is indistinguishable from an orphan."""
+    io = CheckpointIO(tmp_path, keep_n=2)
+    save_step(io, 1)
+    io.close()
+    inflight = tmp_path / "checkpoint-50"   # committed, not yet published
+    inflight.mkdir()
+    (inflight / "shard").write_bytes(b"y")
+    reader = CheckpointIO(tmp_path)
+    _, host = reader.restore(abstract_small_state())
+    assert host["global_step"] == 1
+    assert inflight.exists()                # untouched by init + restore
+
+
+# ---- save retry -------------------------------------------------------------
+
+def test_save_retries_transient_fs_errors(tmp_path, monkeypatch):
+    io = CheckpointIO(tmp_path, save_retries=2, retry_backoff_s=0.01)
+    real_save = io._checkpointer.save
+    calls = {"n": 0}
+
+    def flaky_save(path, state, **kw):
+        calls["n"] += 1
+        if calls["n"] <= 2:
+            raise OSError("simulated EIO: lost NFS lease")
+        return real_save(path, state, **kw)
+
+    monkeypatch.setattr(io._checkpointer, "save", flaky_save)
+    save_step(io, 1)
+    io.close()
+    assert calls["n"] == 3                            # 2 failures + success
+    restored, host = CheckpointIO(tmp_path).restore(abstract_small_state())
+    assert host["global_step"] == 1
+
+
+def test_save_retry_budget_exhausted_raises(tmp_path, monkeypatch):
+    io = CheckpointIO(tmp_path, save_retries=1, retry_backoff_s=0.01)
+
+    def always_fail(path, state, **kw):
+        raise OSError("disk on fire")
+
+    monkeypatch.setattr(io._checkpointer, "save", always_fail)
+    with pytest.raises(OSError, match="disk on fire"):
+        save_step(io, 1)
+    assert not (tmp_path / "state.json").exists()     # nothing published

@@ -30,7 +30,19 @@ import sys
 import tempfile
 from pathlib import Path
 
+import jax
 import pytest
+
+# jax < 0.5 hard-fails any sharded computation spanning processes on CPU
+# ("INVALID_ARGUMENT: Multiprocess computations aren't implemented on the
+# CPU backend" out of the first jitted program) — the gang-TRAINING tests
+# cannot pass there and each burns a full gang spawn before failing, starving
+# the rest of the tier-1 time budget. Barrier/loader scenarios (no sharded
+# compute) still run. Drop this gate when the environment's jax moves >= 0.5.
+_JAX_VERSION = tuple(int(p) for p in jax.__version__.split(".")[:2])
+requires_mp_compute = pytest.mark.skipif(
+    _JAX_VERSION < (0, 5),
+    reason="jax<0.5 CPU backend cannot run multiprocess computations")
 
 REPO = Path(__file__).parent.parent
 CH02 = REPO / "02-distributed-data-parallel" / "train_llm.py"
@@ -117,6 +129,7 @@ def single_process_losses(script, flags: list, save_dir) -> dict:
     return losses_by_step(sp.stdout + sp.stderr)
 
 
+@requires_mp_compute
 def test_gang_ddp_matches_single_process(tmp_path, warm_cache):
     """2 procs x 4 devices and 1 proc x 8 devices build the same dp=8 mesh
     over the same global batch: the logged loss trajectory must agree. This
@@ -143,6 +156,7 @@ def test_gang_ddp_matches_single_process(tmp_path, warm_cache):
         assert abs(loss - sp_losses[step]) < 1e-4, (step, loss, sp_losses[step])
 
 
+@requires_mp_compute
 def test_gang_fence_every_matches_per_step(tmp_path, warm_cache):
     """--fence-every across a REAL process boundary: each process banks its
     own device-loss reads and drains at the (log-freq) boundary; the logged
@@ -165,6 +179,7 @@ def test_gang_fence_every_matches_per_step(tmp_path, warm_cache):
         assert abs(loss - sp_losses[step]) < 1e-4, (step, loss, sp_losses)
 
 
+@requires_mp_compute
 def test_gang_fsdp_trains_with_cross_process_shards(tmp_path, warm_cache):
     """fsdp shards every parameter over all 8 devices, i.e. ACROSS the two
     processes: init, step collectives, and the loader all have to handle
@@ -181,6 +196,7 @@ def test_gang_fsdp_trains_with_cross_process_shards(tmp_path, warm_cache):
     assert "strategy=fsdp" in rank0
 
 
+@requires_mp_compute
 def test_gang_tp_spans_process_boundary(tmp_path, warm_cache):
     """tp=8 on a 2-process x 4-device gang: every tensor-parallel group
     crosses the process boundary, so the per-layer megatron all-reduces run
@@ -198,6 +214,7 @@ def test_gang_tp_spans_process_boundary(tmp_path, warm_cache):
     assert "'tp': 8" in rank0
 
 
+@requires_mp_compute
 def test_gang_ring_cp_spans_process_boundary(tmp_path, warm_cache):
     """cp=8 on a 2-process x 4-device gang: the zigzag ring's ppermute hops
     cross the process boundary every cycle — the long-context regime a
@@ -214,6 +231,7 @@ def test_gang_ring_cp_spans_process_boundary(tmp_path, warm_cache):
     assert "'cp': 8" in rank0
 
 
+@requires_mp_compute
 def test_gang_pipeline_stage_per_process(tmp_path, warm_cache):
     """pp=2 on a 2-process x 4-device gang with the pp axis outermost:
     each pipeline stage lives on one process, so every 1F1B activation /
@@ -232,6 +250,7 @@ def test_gang_pipeline_stage_per_process(tmp_path, warm_cache):
     assert "'pp': 2" in rank0
 
 
+@requires_mp_compute
 def test_gang_moe_ep_spans_process_boundary(tmp_path, warm_cache):
     """ep=8 on a 2-process x 4-device gang: the MoE token all-to-all
     dispatches across the process boundary (each process hosts half the
@@ -253,6 +272,7 @@ def test_gang_moe_ep_spans_process_boundary(tmp_path, warm_cache):
     assert "'ep': 8" in rank0
 
 
+@requires_mp_compute
 def test_gang_checkpoint_resume_bitexact(tmp_path, warm_cache):
     """Multihost Orbax save (every process writes its shards, process 0
     swings state.json behind a barrier) + restore in a FRESH gang, compared
@@ -313,6 +333,7 @@ def test_gang_loader_materializes_only_local_shards(tmp_path, warm_cache):
         assert r["rows_fetched"] == r["n_batches"] * r["global_batch"] // 2
 
 
+@requires_mp_compute
 def test_supervisor_restarts_gang_and_resumes(tmp_path, warm_cache):
     """The torchrun-elasticity loop end to end: rank 1 crashes after the
     step-3 checkpoint; fail-fast takes the gang down; the supervisor
