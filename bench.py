@@ -173,7 +173,7 @@ def _save_last_good(final: dict) -> dict | None:
         "git_commit": _git_head(),
         "config": {k: detail[k] for k in
                    ("model", "seq", "global_batch", "step_ms", "remat",
-                    "remat_policy", "optimizer", "param_dtype",
+                    "remat_policy", "optimizer", "param_dtype", "precision",
                     "loss_chunks", "fence_every", "offload_opt_state",
                     "sliding_window", "n_chips", "device",
                     "steps_timed", "tokens_per_s_per_chip")
@@ -261,7 +261,8 @@ def run_rung(rung: dict) -> None:
                       remat=remat, remat_policy=rung.get("remat_policy", "all"),
                       attn_impl=rung.get("attn_impl", "auto"),
                       loss_chunks=rung.get("loss_chunks", 0),
-                      offload_opt_state=rung.get("offload_opt_state", False))
+                      offload_opt_state=rung.get("offload_opt_state", False),
+                      precision=rung.get("precision", "fp32"))
     state = trainer.init_state(0)
 
     global_batch = batch * plan.data_parallel_size
@@ -292,6 +293,8 @@ def run_rung(rung: dict) -> None:
                 "optimizer": rung.get("optimizer", "adamw"),
                 **({"param_dtype": rung["param_dtype"]}
                    if rung.get("param_dtype") else {}),
+                **({"precision": rung["precision"]}
+                   if rung.get("precision") else {}),
                 **({"loss_chunks": rung["loss_chunks"]}
                    if rung.get("loss_chunks") else {}),
                 **({"fence_every": rung["fence_every"]}
@@ -494,6 +497,26 @@ SWEEP_QUEUE = [
     # the standard MoE accounting.
     dict(name="moe1b_adafactor_b8", model="moe-1b-8e", batch=8, seq=2048,
          remat=True, remat_policy="attn", optimizer="adafactor"),
+    # --- precision-policy rungs (train/precision.py; unmeasured, so they sit
+    # ahead of the fence entries per the fence4 ordering note below).
+    # bf16-master = 8 B/param total state (fp32-computed update, bf16
+    # storage) — vs param_dtype=bfloat16's bf16-computed update at the same
+    # memory, this is the same batch budget with better numerics; adam8bit
+    # frees ~3.7 GB of 650M fp32 Adam moments, paying int8 (de)quantize
+    # compute inside the fused step — the measurement decides whether the
+    # bigger batch wins it back.
+    dict(name="bf16master_b16", model="llama-650m", batch=16, seq=2048,
+         remat=True, remat_policy="attn", precision="bf16-master"),
+    dict(name="bf16master_b24", model="llama-650m", batch=24, seq=2048,
+         remat=True, remat_policy="attn", precision="bf16-master"),
+    dict(name="adam8bit_b16", model="llama-650m", batch=16, seq=2048,
+         remat=True, remat_policy="attn", precision="adam8bit"),
+    dict(name="bf16master_adam8bit_b24", model="llama-650m", batch=24,
+         seq=2048, remat=True, remat_policy="attn",
+         precision="bf16-master+adam8bit"),
+    dict(name="bf16master_adam8bit_attnmlp_b16", model="llama-650m",
+         batch=16, seq=2048, remat=True, remat_policy="attn_mlp",
+         precision="bf16-master+adam8bit"),
     # LAST on purpose: fence_every=4 dispatches 4 steps ahead, the exact
     # pattern this pool's documented failure mode punishes — its first
     # attempt (2026-07-31 03:50) stalled and the pool went down with it.

@@ -1,11 +1,12 @@
 from .orbax_io import (CheckpointCorruptionError, CheckpointIO,
-                       abstract_train_state)
+                       abstract_train_state, restore_train_state)
 from .manifest import load_manifest, manifest_path, verify_manifest, write_manifest
 
 __all__ = [
     "CheckpointIO",
     "CheckpointCorruptionError",
     "abstract_train_state",
+    "restore_train_state",
     "write_manifest",
     "load_manifest",
     "verify_manifest",

@@ -298,6 +298,11 @@ class CheckpointIO:
         """Index of the newest chain entry whose manifest verifies (legacy
         no-manifest entries are trusted with a warning), or -1."""
         for i, path in enumerate(chain):
+            if not path.exists():
+                LOGGER.warning("skipping checkpoint %s: referenced by "
+                               "state.json but missing on disk", path.name)
+                failures.append(f"{path.name}: missing")
+                continue
             manifest = manifest_mod.load_manifest(self.exp_dir, path.name)
             if manifest is None:
                 LOGGER.warning("checkpoint %s has no manifest (legacy "
@@ -329,11 +334,17 @@ class CheckpointIO:
         existed but none survived, ``FileNotFoundError`` when there was
         nothing to resume."""
         self.flush()
-        chain = self._retention_chain()
-        if not chain:
+        names = self._retained_names()
+        if not names:
             raise FileNotFoundError(f"no resumable checkpoint in {self.exp_dir}")
         failures: list[str] = []
         if jax.process_count() > 1:
+            # the broadcast index must mean the same checkpoint on every
+            # host, so the index space is the state.json name list itself —
+            # NOT each host's existence-filtered view of the shared FS
+            # (hosts seeing different subsets would resolve the same index
+            # to different checkpoints: a silent fork of the run)
+            chain = [(self.exp_dir / n).absolute() for n in names]
             import numpy as np
             from jax.experimental import multihost_utils
 
@@ -353,6 +364,9 @@ class CheckpointIO:
             manifest = manifest_mod.load_manifest(self.exp_dir, path.name)
             return (self._rebase_restored(train_state),
                     self._host_state_for(path, manifest))
+        chain = self._retention_chain()
+        if not chain:
+            raise FileNotFoundError(f"no resumable checkpoint in {self.exp_dir}")
         start = 0
         while True:
             idx = self._verified_candidate(chain[start:], failures)
@@ -377,20 +391,97 @@ class CheckpointIO:
                     self._host_state_for(path, manifest))
 
 
-def abstract_train_state(trainer):
-    """Sharded abstract TrainState (restore target) for a Trainer."""
+def abstract_train_state(trainer, *, fp32_reference: bool = False):
+    """Sharded abstract TrainState (restore target) for a Trainer.
+
+    ``fp32_reference=True`` builds the PRE-precision-policy layout (fp32
+    params, the unwrapped optimizer's fp32 moments) — the restore target for
+    checkpoints written before a run adopted a storage policy."""
     import jax.numpy as jnp
 
+    from ..train.precision import cast_floats
     from ..train.state import TrainState
 
     def shape_fn(seed):
         init_rng, train_rng = jax.random.split(jax.random.key(seed))
         params = trainer.bundle.init(trainer.bundle.config, init_rng)
-        opt_state = trainer.optimizer.init(params)
+        if fp32_reference:
+            params = cast_floats(params, jnp.float32)
+            opt_state = trainer.base_optimizer.init(params)
+        else:
+            params = trainer.precision.cast_params(params)
+            opt_state = trainer.optimizer.init(params)
         return TrainState(step=jnp.zeros((), jnp.int32), params=params,
                           opt_state=opt_state, rng=jax.random.key_data(train_rng))
 
     state_shapes = jax.eval_shape(shape_fn, jnp.zeros((), jnp.uint32))
+    shardings = (trainer.fp32_state_shardings if fp32_reference
+                 else trainer.state_shardings)
     return jax.tree.map(
         lambda sd, sh: jax.ShapeDtypeStruct(sd.shape, sd.dtype, sharding=sh),
-        state_shapes, trainer.state_shardings)
+        state_shapes, shardings)
+
+
+def _recorded_precision_policy(io: CheckpointIO) -> Optional[str]:
+    """Precision-policy stamp of the newest retained checkpoint's manifest
+    host_state, or None (legacy/pre-stamp saves)."""
+    for path in io._retention_chain()[:1]:
+        manifest = manifest_mod.load_manifest(io.exp_dir, path.name)
+        if manifest and isinstance(manifest.get("host_state"), dict):
+            return manifest["host_state"].get("precision_policy")
+    return None
+
+
+def restore_train_state(io: CheckpointIO, trainer) -> tuple[Any, dict]:
+    """Policy-aware restore: the one entry point train loops should use.
+
+    Restores into the trainer's precision-policy storage layout. An fp32
+    (pre-policy) checkpoint restored into a policy run is re-encoded —
+    params cast and optimizer moments (re)quantized into policy storage —
+    with a logged warning, since requantized moments are not bit-identical
+    to ones carried through a quantized checkpoint. Every OTHER layout
+    mismatch is a loud failure, not a fallback: the save path stamps the
+    policy name into the manifest host_state, so restoring a quantized
+    checkpoint into a run that dropped (or changed) its --precision-policy
+    raises naming both policies instead of silently resuming an older
+    checkpoint from the retention chain and masking the config regression.
+    Unstamped (pre-stamp) checkpoints keep the try-then-fall-back behavior."""
+    policy = trainer.precision
+    recorded = _recorded_precision_policy(io)
+    if recorded and recorded != policy.name:
+        if recorded == "fp32" and not policy.is_noop:
+            # known-fp32 checkpoint into a policy run: skip the doomed
+            # policy-layout attempt and go straight to the re-encode path
+            state32, host = io.restore(
+                abstract_train_state(trainer, fp32_reference=True))
+            LOGGER.warning(
+                "checkpoint in %s holds fp32 (pre-policy) state; re-encoding "
+                "into precision policy '%s' — quantized moments are "
+                "re-quantized, so they will not be bit-identical to a native "
+                "policy checkpoint", io.exp_dir, policy.name)
+            return trainer.encode_fp32_state(state32), host
+        raise ValueError(
+            f"checkpoint in {io.exp_dir} was written under precision policy "
+            f"{recorded!r} but this run is configured for {policy.name!r}; "
+            f"restore with the matching --precision-policy / "
+            f"optimizer.params.precision (fp32 checkpoints re-encode into "
+            f"policy runs automatically; other conversions are not "
+            f"performed silently)")
+    try:
+        return io.restore(abstract_train_state(trainer))
+    except FileNotFoundError:
+        raise
+    except Exception as exc:  # noqa: BLE001 — unstamped layout mismatch
+        if policy.is_noop:
+            raise
+        try:
+            state32, host = io.restore(
+                abstract_train_state(trainer, fp32_reference=True))
+        except Exception:
+            raise exc  # the original (policy-layout) failure is the story
+        LOGGER.warning(
+            "checkpoint in %s holds fp32 (pre-policy) state; re-encoding "
+            "into precision policy '%s' — quantized moments are "
+            "re-quantized, so they will not be bit-identical to a native "
+            "policy checkpoint", io.exp_dir, policy.name)
+        return trainer.encode_fp32_state(state32), host

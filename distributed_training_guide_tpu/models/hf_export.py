@@ -426,13 +426,18 @@ def main(argv=None) -> None:
     parser.add_argument("--optimizer", default="adamw",
                         choices=["adamw", "adafactor", "lion"],
                         help="optimizer the run used")
+    parser.add_argument("--precision-policy", default="fp32",
+                        help="precision policy the run trained with (must "
+                             "match, like --optimizer: the checkpoint holds "
+                             "the policy's storage layout — e.g. int8 "
+                             "quantized moments for adam8bit)")
     parser.add_argument("--dtype", default="float32",
                         choices=["float32", "bfloat16", "float16"])
     args = parser.parse_args(argv)
 
     import jax
 
-    from ..checkpoint import CheckpointIO, abstract_train_state
+    from ..checkpoint import CheckpointIO, restore_train_state
     from ..parallel import make_mesh, make_plan
     from ..train import Trainer
     from ..train.optimizer import OPTIMIZERS
@@ -450,9 +455,10 @@ def main(argv=None) -> None:
             else make_plan("single", make_mesh(devices=jax.devices()[:1])))
     trainer = Trainer(bundle=bundle,
                       optimizer=OPTIMIZERS[args.optimizer](1e-4),
-                      plan=plan, donate=False)
+                      plan=plan, donate=False,
+                      precision=args.precision_policy)
     io = CheckpointIO(args.exp_dir)
-    state, host_state = io.restore(abstract_train_state(trainer))
+    state, host_state = restore_train_state(io, trainer)
     out = export_hf_checkpoint(bundle, state.params, args.out_dir,
                                dtype=args.dtype)
     print(f"exported step-{host_state.get('global_step', '?')} params of "

@@ -1,6 +1,10 @@
 from .state import TrainState
 from .optimizer import adafactor_cosine, adamw_cosine, lion_cosine
+from .precision import (POLICIES, PrecisionPolicy, Quantized,
+                        dequantize_blockwise, quantize_blockwise,
+                        resolve_policy)
 from .step import Trainer
 
 __all__ = ["TrainState", "adafactor_cosine", "adamw_cosine", "lion_cosine",
-           "Trainer"]
+           "Trainer", "PrecisionPolicy", "POLICIES", "Quantized",
+           "quantize_blockwise", "dequantize_blockwise", "resolve_policy"]

@@ -153,6 +153,19 @@ def get_parser() -> argparse.ArgumentParser:
                              "kernel, O(S*W) attention). Overrides the "
                              "model config; hf: checkpoints with "
                              "sliding_window set enable this automatically")
+    parser.add_argument("--precision-policy", default="fp32",
+                        metavar="POLICY",
+                        help="storage-precision policy (train/precision.py): "
+                             "fp32 (default, the reference's mixed-precision "
+                             "layout, bit-identical to before the flag "
+                             "existed); bf16-master = bf16 param/moment/"
+                             "accum storage with the optimizer update "
+                             "computed in fp32 (8 B/param instead of 16); "
+                             "adam8bit = int8 block-quantized Adam moments "
+                             "with per-block fp32 scales (Dettmers et al.; "
+                             "opt state ~3.9x smaller); policies compose "
+                             "with '+', e.g. bf16-master+adam8bit. "
+                             "--preflight prices the chosen policy")
     parser.add_argument("--param-dtype", default="float32",
                         choices=["float32", "bfloat16"],
                         help="parameter STORAGE dtype (compute is bf16 "
@@ -210,7 +223,7 @@ def run_training(args, plan_factory: Callable, *, extra_log: Optional[dict] = No
     # failing later would strand an unfinished wandb run and leak the loader
     if getattr(args, "fence_every", 1) < 1:
         raise SystemExit(f"--fence-every must be >= 1, got {args.fence_every}")
-    from ..checkpoint import CheckpointIO, abstract_train_state
+    from ..checkpoint import CheckpointIO, restore_train_state
     from ..data import ShardedBatchLoader, get_tokenizer, load_and_preprocess_data
     from ..models import get_model
     from ..train import Trainer
@@ -267,6 +280,7 @@ def run_training(args, plan_factory: Callable, *, extra_log: Optional[dict] = No
         offload_opt_state=offload_opt_state,
         offload_params=offload_params,
         pp_microbatches=pp_microbatches,
+        precision=getattr(args, "precision_policy", "fp32"),
     )
     from .guards import GuardMonitor
 
@@ -311,7 +325,9 @@ def run_training(args, plan_factory: Callable, *, extra_log: Optional[dict] = No
 
     host_state = host_state_dict()
     if io is not None and io.can_resume():
-        state, host_state = io.restore(abstract_train_state(trainer))
+        # policy-aware: an fp32 checkpoint restored into a precision-policy
+        # run is re-encoded (re-quantized) with a logged warning
+        state, host_state = restore_train_state(io, trainer)
         LOGGER.info(f"Resumed=True | {host_state}")
     elif pretrained_dir:
         LOGGER.info(f"Loading pretrained weights from {pretrained_dir}")
@@ -334,6 +350,10 @@ def run_training(args, plan_factory: Callable, *, extra_log: Optional[dict] = No
             LOGGER.info(f"Resumed=False | {host_state}")
     if is_experiment:
         exp_dir.mkdir(parents=True, exist_ok=True)
+    # stamped into every manifest's host_state: restore_train_state uses it
+    # to fail loudly when a run drops/changes its --precision-policy instead
+    # of silently falling back through the retention chain
+    host_state["precision_policy"] = trainer.precision.name
 
     from ..utils.tracking import make_tracker
 

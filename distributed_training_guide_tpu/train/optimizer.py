@@ -15,6 +15,7 @@ from __future__ import annotations
 import math
 from typing import Optional
 
+import jax.numpy as jnp
 import optax
 
 
@@ -30,8 +31,6 @@ def make_schedule(lr: float, t_max: int = 1000, eta_min_ratio: float = 0.01,
     eta_min = lr * eta_min_ratio
 
     def schedule(step):
-        import jax.numpy as jnp
-
         warm = jnp.minimum(step / max(warmup_steps, 1), 1.0) if warmup_steps else 1.0
         t = jnp.clip(step - warmup_steps, 0, t_max)
         if decay == "cosine":

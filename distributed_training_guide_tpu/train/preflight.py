@@ -171,12 +171,20 @@ def run_preflight(trainer, *, global_batch: int, seq_length: int,
     opt_b = _per_device_bytes(
         state.opt_state,
         jax.tree.map(lambda s: s.sharding, state.opt_state))
-    # grads are transient but resident at the optimizer boundary, fp32,
-    # sharded like the params
-    grad_b = _per_device_bytes(
-        jax.tree.map(lambda sd: jax.ShapeDtypeStruct(sd.shape, np.float32),
-                     state.params),
-        trainer.param_shardings)
+    # grads are transient but resident at the optimizer boundary, sharded
+    # like the params; their dtype is the policy's accum-buffer dtype when
+    # accumulating, else the param storage dtype (what value_and_grad yields)
+    def grad_bytes(param_shapes, dtype):
+        return _per_device_bytes(
+            jax.tree.map(
+                lambda sd: jax.ShapeDtypeStruct(
+                    sd.shape, dtype if dtype is not None else sd.dtype),
+                param_shapes),
+            trainer.param_shardings)
+
+    policy = trainer.precision
+    grad_b = grad_bytes(state.params,
+                        policy.accum_dtype if trainer.grad_accum > 1 else None)
     report = {
         "per_device_param_bytes": params_b,
         "per_device_opt_state_bytes": opt_b,
@@ -185,6 +193,25 @@ def run_preflight(trainer, *, global_batch: int, seq_length: int,
         "n_devices": trainer.plan.mesh.devices.size,
         "mesh": dict(trainer.plan.mesh.shape),
         "lowered": True,
+    }
+    # price the precision policy against the fp32 baseline (the 16 B/param
+    # math of 05/README.md): same plan, unwrapped optimizer, fp32 leaves —
+    # so "how much HBM did the policy buy" is a reported number, not a claim
+    fp32_sh = trainer.fp32_state_shardings
+    fp32_opt_shapes = jax.eval_shape(trainer.base_optimizer.init,
+                                     trainer.fp32_param_shapes)
+    params32_b = _per_device_bytes(trainer.fp32_param_shapes,
+                                   trainer.param_shardings)
+    opt32_b = _per_device_bytes(fp32_opt_shapes, fp32_sh.opt_state)
+    grad32_b = grad_bytes(trainer.fp32_param_shapes, np.float32)
+    total_b, total32_b = params_b + opt_b + grad_b, params32_b + opt32_b + grad32_b
+    report["precision"] = {
+        "policy": policy.name,
+        "per_device_opt_state_bytes_fp32": opt32_b,
+        "per_device_total_bytes_fp32": total32_b,
+        "opt_state_reduction": round(opt32_b / opt_b, 2) if opt_b else 1.0,
+        "total_state_reduction": (round(total32_b / total_b, 2)
+                                  if total_b else 1.0),
     }
     try:
         stats = jax.devices()[0].memory_stats() or {}
@@ -199,6 +226,12 @@ def run_preflight(trainer, *, global_batch: int, seq_length: int,
         f"(+ transient grads {grad_b * gib:.2f} GiB)"
         + (f"; device limit {report['device_bytes_limit'] * gib:.2f} GiB"
            if "device_bytes_limit" in report else ""))
+    LOGGER.info(
+        f"precision policy '{policy.name}': optimizer state "
+        f"{report['precision']['opt_state_reduction']:.2f}x smaller than "
+        f"fp32, total state (params+opt+grads) "
+        f"{report['precision']['total_state_reduction']:.2f}x smaller "
+        f"({total32_b * gib:.2f} -> {total_b * gib:.2f} GiB per device)")
 
     if target_device is None and jax.default_backend() != "tpu":
         target_device = "v5p"  # the 405B recipe's stated target pod

@@ -30,6 +30,7 @@ from ..ops.cross_entropy import causal_lm_loss
 from ..parallel.mesh import make_mesh
 from ..parallel.plans import ShardingPlan, make_plan, spec_for_leaf
 from .guards import apply_step_guard, validate_guard_policy
+from .precision import resolve_policy
 from .state import TrainState
 
 
@@ -82,10 +83,30 @@ def _opt_state_shardings(plan: ShardingPlan, opt_shape_tree, axes_tree, param_sh
 
     def leaf_sharding(path, leaf):
         ks = _keystr(path)
+        # block-quantized moments (train/precision.py Quantized containers)
+        # flatten into a ``.q``/``.scale`` pair under the moment's own path:
+        # the int8 payload keeps the param's shape and shards identically;
+        # the per-block scales take the same spec, with the block axis
+        # replicated whenever the (possibly ragged) block tiling would not
+        # align with the payload's shards
+        field = None
+        if ks and ks[-1] in (".q", ".scale"):
+            field, ks = ks[-1], ks[:-1]
         if leaf.ndim == 0:
             return NamedSharding(plan.mesh, P())
         for ppath, ax, shape in by_path:
-            if len(ks) >= len(ppath) and ks[-len(ppath):] == ppath and tuple(leaf.shape) == tuple(shape):
+            if len(ks) < len(ppath) or ks[-len(ppath):] != ppath:
+                continue
+            if field == ".scale":
+                if (leaf.ndim != len(shape)
+                        or tuple(leaf.shape[:-1]) != tuple(shape[:-1])):
+                    continue
+                spec = spec_for_leaf(plan.mesh, ax, leaf.shape, rules)
+                bs = -(-shape[-1] // leaf.shape[-1])
+                if bs * leaf.shape[-1] != shape[-1] and len(spec) == leaf.ndim:
+                    spec = P(*spec[:-1])  # ragged tiling: replicate block axis
+                return NamedSharding(plan.mesh, spec)
+            if tuple(leaf.shape) == tuple(shape):
                 return NamedSharding(plan.mesh, spec_for_leaf(plan.mesh, ax, leaf.shape, rules))
         return NamedSharding(plan.mesh, P())
 
@@ -118,9 +139,19 @@ class Trainer:
     offload_opt_state: bool = False
     offload_params: bool = False  # params live in host memory between steps
     pp_microbatches: Optional[int] = None  # pipeline microbatches (default 2*pp)
+    # storage-precision policy (train/precision.py): name, '+'-composition,
+    # or a PrecisionPolicy. The optimizer handed in stays the single entry
+    # point — the policy wraps it here, so fp32 runs are bit-identical
+    precision: Any = "fp32"
 
     def __post_init__(self):
         validate_guard_policy(self.guard_policy)
+        self.precision = resolve_policy(self.precision)
+        # keep the unwrapped optimizer reachable: preflight prices the fp32
+        # baseline with it, and checkpoint restore uses its (fp32) state
+        # layout as the fallback target for pre-policy checkpoints
+        self.base_optimizer = self.optimizer
+        self.optimizer = self.precision.wrap(self.optimizer)
         if self.plan is None:
             self.plan = make_plan("single", make_mesh(devices=jax.devices()[:1]))
         # seq-dependent rope types (dynamic NTK, longrope) trace their
@@ -164,7 +195,19 @@ class Trainer:
     # ---- shapes & shardings ------------------------------------------------
     @cached_property
     def param_shapes(self):
-        return jax.eval_shape(lambda: self.bundle.init(self.bundle.config, jax.random.key(0)))
+        return jax.eval_shape(lambda: self.precision.cast_params(
+            self.bundle.init(self.bundle.config, jax.random.key(0))))
+
+    @cached_property
+    def fp32_param_shapes(self):
+        """Param shapes with every float leaf fp32 — the pre-policy storage
+        layout, used as the baseline for preflight's byte accounting and as
+        the restore target for checkpoints written by fp32 runs."""
+        from .precision import cast_floats
+
+        return jax.eval_shape(lambda: cast_floats(
+            self.bundle.init(self.bundle.config, jax.random.key(0)),
+            jnp.float32))
 
     @cached_property
     def logical_axes(self):
@@ -201,6 +244,36 @@ class Trainer:
             rng=NamedSharding(self.plan.mesh, P()),
         )
 
+    @cached_property
+    def fp32_state_shardings(self) -> TrainState:
+        """Shardings for the PRE-policy (fp32, unwrapped-optimizer) state
+        layout — the restore target when a checkpoint written by an fp32 run
+        is loaded into a policy run, and preflight's byte baseline."""
+        opt_shapes = jax.eval_shape(self.base_optimizer.init,
+                                    self.fp32_param_shapes)
+        return TrainState(
+            step=NamedSharding(self.plan.mesh, P()),
+            params=self.param_shardings,
+            opt_state=_opt_state_shardings(self.plan, opt_shapes,
+                                           self.logical_axes,
+                                           self.fp32_param_shapes),
+            rng=NamedSharding(self.plan.mesh, P()),
+        )
+
+    def encode_fp32_state(self, state: TrainState) -> TrainState:
+        """Re-encode an fp32-layout TrainState into this trainer's precision
+        policy (cast params, quantize/downcast the optimizer moments) — the
+        checkpoint-restore fallback path for pre-policy checkpoints."""
+        pol = self.precision
+
+        def encode(s):
+            return TrainState(step=s.step, params=pol.cast_params(s.params),
+                              opt_state=pol.store_opt_state(s.opt_state),
+                              rng=s.rng)
+
+        jitted = jax.jit(encode, out_shardings=self._device_state_shardings)
+        return self._place(jitted(state))
+
     def batch_shardings(self, batch_ndim: int = 2):
         ndim = batch_ndim + (1 if self.grad_accum > 1 else 0)
         if self.grad_accum > 1:
@@ -214,7 +287,10 @@ class Trainer:
     # ---- init --------------------------------------------------------------
     def _fresh_state(self, params, train_rng) -> TrainState:
         """The single definition of a step-0 TrainState (shared by random init
-        and pretrained load, so the two paths can't drift)."""
+        and pretrained load, so the two paths can't drift). Applies the
+        precision policy's param storage dtype, so both init paths land in
+        policy storage."""
+        params = self.precision.cast_params(params)
         return TrainState(step=jnp.zeros((), jnp.int32), params=params,
                           opt_state=self.optimizer.init(params),
                           rng=jax.random.key_data(train_rng))
@@ -450,7 +526,11 @@ class Trainer:
                 def accum(carry, mb):
                     loss_sum, extras_sum, grads_sum = carry
                     (loss, extras), grads = grad_fn(params, mb)
-                    grads_sum = jax.tree.map(jnp.add, grads_sum, grads)
+                    # the buffer dtype is the policy's accum_dtype — cast the
+                    # microbatch grads INTO it so promotion can't silently
+                    # re-widen a bf16 buffer back to fp32
+                    grads_sum = jax.tree.map(
+                        lambda a, g: a + g.astype(a.dtype), grads_sum, grads)
                     if grad_sh is not None:
                         # ZeRO-2: the persistent accum buffer stays sharded
                         # over the data axes (reduce-scatter per microbatch)
@@ -460,7 +540,8 @@ class Trainer:
                             jax.tree.map(jnp.add, extras_sum, extras),
                             grads_sum), None
 
-                zeros = jax.tree.map(lambda p: jnp.zeros(p.shape, jnp.float32),
+                accum_dtype = self.precision.accum_dtype
+                zeros = jax.tree.map(lambda p: jnp.zeros(p.shape, accum_dtype),
                                      params)
                 zero_extras = {k: jnp.zeros((), jnp.float32) for k in extra_keys}
                 (loss_sum, extras, grads), _ = jax.lax.scan(

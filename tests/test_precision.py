@@ -1,0 +1,284 @@
+"""Precision-policy runtime (train/precision.py): block-quantized 8-bit Adam
+moments, bf16 storage with fp32-computed updates, and the policy threading
+through step/sharding/guards/preflight/checkpoint.
+
+Acceptance pins from ISSUE 2: adam8bit tracks fp32 AdamW within 2% relative
+loss after 50 steps; preflight reports >= 3.5x optimizer-state reduction for
+adam8bit and >= 1.9x total-state for bf16-master; quantized state survives a
+checkpoint round-trip bit-exactly; an fp32 checkpoint restores into an
+adam8bit run by re-quantizing with a logged warning; the guard `skip` policy
+reverts quantized moments.
+"""
+import logging
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_training_guide_tpu.models import get_model
+from distributed_training_guide_tpu.parallel import make_mesh, make_plan
+from distributed_training_guide_tpu.train import (Quantized, Trainer,
+                                                  adamw_cosine,
+                                                  dequantize_blockwise,
+                                                  quantize_blockwise,
+                                                  resolve_policy)
+
+pytestmark = pytest.mark.precision
+
+
+def _run(policy, steps=10, lr=1e-3, seed=0, **trainer_kw):
+    bundle = get_model("llama-debug")
+    t = Trainer(bundle=bundle, optimizer=adamw_cosine(lr), precision=policy,
+                **trainer_kw)
+    state = t.init_state(seed)
+    ids = np.random.RandomState(seed).randint(0, bundle.config.vocab_size,
+                                              (8, 64))
+    batch = {k: jax.device_put(jnp.asarray(ids), t.batch_shardings()[k])
+             for k in ("input_ids", "labels")}
+    losses = []
+    for _ in range(steps):
+        state, m = t.step_fn(state, batch)
+        losses.append(float(m["loss"]))
+    return t, state, losses, batch
+
+
+def _quantized_leaves(tree):
+    return [l for l in jax.tree.leaves(
+        tree, is_leaf=lambda n: isinstance(n, Quantized))
+        if isinstance(l, Quantized)]
+
+
+def _tree_bytes(tree):
+    return sum(l.size * l.dtype.itemsize for l in jax.tree.leaves(tree))
+
+
+# ---- quantization primitive -------------------------------------------------
+
+def test_quantize_roundtrip_error_bound_per_block():
+    """Absmax int8: per-element error <= half a quantization step of ITS
+    block (scale/2), across ragged trailing dims and wild dynamic range."""
+    key = jax.random.key(0)
+    for d in (64, 100, 300, 512):
+        x = (jax.random.normal(jax.random.key(d), (3, d))
+             * jnp.exp(3 * jax.random.normal(key, (3, d))))
+        qt = quantize_blockwise(x, 128)
+        assert qt.q.shape == x.shape and qt.q.dtype == jnp.int8
+        dq = dequantize_blockwise(qt)
+        bs = -(-d // qt.scale.shape[-1])
+        step = np.repeat(np.asarray(qt.scale), bs, axis=-1)[..., :d]
+        err = np.abs(np.asarray(dq) - np.asarray(x, np.float32))
+        assert (err <= step / 2 + 1e-12).all()
+
+
+def test_quantize_sqrt_domain_alignment():
+    """nu (second moment) quantizes in the sqrt domain: an element survives
+    in nu exactly when it survives in mu — otherwise mu/(sqrt(0)+eps)
+    explodes for mid-magnitude elements."""
+    g = np.zeros((256,), np.float32)
+    g[0] = 1.0          # the block outlier
+    g[1] = 1e-2         # survives mu linear quant (1e-2 > 1/254)...
+    qt_nu = quantize_blockwise(jnp.asarray(g) ** 2, 256, sqrt_domain=True)
+    nu = np.asarray(dequantize_blockwise(qt_nu, sqrt_domain=True))
+    assert nu[1] > 0    # ...so it must survive in nu too
+    assert (nu >= 0).all()
+    # linear quantization of g^2 would have zeroed it: documents the hazard
+    lin = np.asarray(dequantize_blockwise(quantize_blockwise(
+        jnp.asarray(g) ** 2, 256)))
+    assert lin[1] == 0
+
+
+def test_resolve_policy_names_and_composition():
+    assert resolve_policy("fp32").is_noop
+    comp = resolve_policy("bf16-master+adam8bit")
+    assert comp.quantize_moments and comp.param_dtype == jnp.bfloat16
+    assert comp.accum_dtype == jnp.bfloat16
+    with pytest.raises(ValueError, match="precision policy"):
+        resolve_policy("fp16-master")
+
+
+# ---- trajectory parity (acceptance pin: 2% over 50 steps) -------------------
+
+def test_adam8bit_matches_fp32_loss_trajectory():
+    _, s0, l0, _ = _run("fp32", steps=50, donate=False)
+    _, s8, l8, _ = _run("adam8bit", steps=50, donate=False)
+    rel = abs(l8[-1] - l0[-1]) / abs(l0[-1])
+    assert rel < 0.02, (l0[-1], l8[-1], rel)
+    assert l8[-1] < l8[0] - 0.5           # actually trained, not just agreed
+    # the whole point: both moments stored int8 + per-block fp32 scales
+    qs = _quantized_leaves(s8.opt_state)
+    assert qs and all(q.q.dtype == jnp.int8 and q.scale.dtype == jnp.float32
+                      for q in qs)
+    # byte math: opt state well under half of AdamW's 2x-fp32 mirror
+    param_bytes = _tree_bytes(s0.params)
+    assert _tree_bytes(s8.opt_state) < 0.6 * param_bytes
+
+
+def test_bf16_master_trains_and_halves_state():
+    _, s0, l0, _ = _run("fp32", steps=20, donate=False)
+    _, sb, lb, _ = _run("bf16-master", steps=20, donate=False)
+    assert abs(lb[-1] - l0[-1]) / abs(l0[-1]) < 0.02
+    assert jax.tree.leaves(sb.params)[0].dtype == jnp.bfloat16
+    assert _tree_bytes(sb.params) * 2 == _tree_bytes(s0.params)
+    # moments stored bf16 (the fp32 master is transient inside the step)
+    mu = sb.opt_state[0].mu
+    assert all(l.dtype == jnp.bfloat16 for l in jax.tree.leaves(mu))
+
+
+# ---- sharding ---------------------------------------------------------------
+
+def test_quantized_state_shards_under_zero1(eight_devices):
+    """ZeRO-1: the int8 payload shards exactly like the moment it encodes,
+    and the per-block scales ride alongside (not replicated) when the block
+    tiling divides."""
+    t, state, losses, _ = _run("adam8bit", steps=2, donate=False,
+                               plan=make_plan("zero1", make_mesh()))
+    assert np.isfinite(losses).all()
+    mu = state.opt_state[0].mu["layers"]["attn"]["wq"]
+    assert isinstance(mu, Quantized)
+    assert any(s is not None for s in mu.q.sharding.spec)
+    assert any(s is not None for s in mu.scale.sharding.spec)
+
+
+def test_composed_policy_with_zero2_accum(eight_devices):
+    """bf16-master+adam8bit under ZeRO-2 with grad accumulation: the accum
+    buffer takes the policy dtype and the sharded step still trains."""
+    bundle = get_model("llama-debug")
+    t = Trainer(bundle=bundle, optimizer=adamw_cosine(1e-3),
+                precision="bf16-master+adam8bit",
+                plan=make_plan("zero2", make_mesh()), grad_accum=2,
+                donate=False)
+    state = t.init_state(0)
+    ids = np.random.RandomState(0).randint(0, 512, (2, 8, 64))
+    batch = {k: jax.device_put(jnp.asarray(ids), t.batch_shardings()[k])
+             for k in ("input_ids", "labels")}
+    l0 = None
+    for _ in range(3):
+        state, m = t.step_fn(state, batch)
+        l0 = l0 if l0 is not None else float(m["loss"])
+    assert float(m["loss"]) < l0
+
+
+# ---- guards -----------------------------------------------------------------
+
+def test_guard_skip_reverts_quantized_moments(monkeypatch):
+    from distributed_training_guide_tpu.utils.faults import ENV_NAN_LOSS_STEP
+
+    monkeypatch.setenv(ENV_NAN_LOSS_STEP, "1")
+    t, s1, _, batch = _run("adam8bit", steps=1, donate=False,
+                           guard_policy="skip")
+    before = [np.asarray(x) for x in
+              jax.tree.leaves(jax.device_get(s1.opt_state))]
+    s2, m2 = t.step_fn(s1, batch)           # state.step==1: poisoned
+    assert float(m2["notfinite"]) == 1.0
+    after = [np.asarray(x) for x in
+             jax.tree.leaves(jax.device_get(s2.opt_state))]
+    for a, b in zip(before, after):         # int8 payloads AND fp32 scales
+        np.testing.assert_array_equal(a, b)
+    assert int(s2.step) == 2                # schedule still advances
+
+
+# ---- preflight accounting (acceptance pins: 3.5x opt / 1.9x total) ----------
+
+def test_preflight_prices_the_policy():
+    from distributed_training_guide_tpu.train.preflight import run_preflight
+
+    bundle = get_model("llama-debug")
+
+    def report(policy):
+        t = Trainer(bundle=bundle, optimizer=adamw_cosine(1e-3),
+                    precision=policy)
+        return run_preflight(t, global_batch=8, seq_length=64)
+
+    r32 = report("fp32")
+    assert r32["precision"]["opt_state_reduction"] == 1.0
+    r8 = report("adam8bit")
+    assert r8["precision"]["opt_state_reduction"] >= 3.5
+    assert (r8["per_device_opt_state_bytes"]
+            < r32["per_device_opt_state_bytes"] / 3.5)
+    rb = report("bf16-master")
+    assert rb["precision"]["total_state_reduction"] >= 1.9
+    rc = report("bf16-master+adam8bit")
+    assert (rc["precision"]["total_state_reduction"]
+            > rb["precision"]["total_state_reduction"])
+
+
+# ---- checkpoints ------------------------------------------------------------
+
+def test_quantized_checkpoint_roundtrip_bit_exact(tmp_path):
+    from distributed_training_guide_tpu.checkpoint import (CheckpointIO,
+                                                           restore_train_state)
+    from distributed_training_guide_tpu.train.state import host_state_dict
+
+    t, state, _, batch = _run("adam8bit", steps=1, donate=False)
+    io = CheckpointIO(tmp_path / "exp")
+    host = host_state_dict()
+    host["global_step"] = 1
+    io.save(state, host)
+    restored, _ = restore_train_state(io, t)
+    for a, b in zip(jax.tree.leaves(jax.device_get(state.opt_state)),
+                    jax.tree.leaves(jax.device_get(restored.opt_state))):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    # continuing from the restored state is bit-identical to continuing live
+    _, m_live = t.step_fn(state, batch)
+    _, m_rest = t.step_fn(restored, batch)
+    assert float(m_live["loss"]) == float(m_rest["loss"])
+
+
+def test_fp32_checkpoint_requantizes_into_adam8bit(tmp_path, caplog):
+    """Restoring a pre-policy (fp32) checkpoint into an adam8bit run falls
+    back to the fp32 layout, re-quantizes with a logged warning, and keeps
+    the PR-1 manifest/host-state chain intact."""
+    from distributed_training_guide_tpu.checkpoint import (CheckpointIO,
+                                                           restore_train_state)
+    from distributed_training_guide_tpu.train.state import host_state_dict
+
+    t32, s32, _, _ = _run("fp32", steps=1, donate=False)
+    io = CheckpointIO(tmp_path / "exp")
+    host = host_state_dict()
+    host["global_step"] = 1
+    io.save(s32, host)
+
+    t8, _, _, batch = _run("adam8bit", steps=1, donate=False)
+    with caplog.at_level(logging.WARNING):
+        restored, host2 = restore_train_state(io, t8)
+    assert any("re-encoding" in r.message for r in caplog.records)
+    assert host2["global_step"] == 1
+    qs = _quantized_leaves(restored.opt_state)
+    assert qs, "moments were not re-quantized"
+    # params carried over exactly (fp32 -> fp32), training continues finite
+    for a, b in zip(jax.tree.leaves(jax.device_get(s32.params)),
+                    jax.tree.leaves(jax.device_get(restored.params))):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    _, m = t8.step_fn(restored, batch)
+    assert np.isfinite(float(m["loss"]))
+
+
+def test_policy_checkpoint_into_fp32_run_fails_loudly(tmp_path):
+    """Dropping --precision-policy on restart must NOT silently fall back
+    through the retention chain: the manifest's policy stamp turns the
+    layout mismatch into an error naming both policies."""
+    from distributed_training_guide_tpu.checkpoint import (CheckpointIO,
+                                                           restore_train_state)
+    from distributed_training_guide_tpu.train.state import host_state_dict
+
+    t8, s8, _, _ = _run("adam8bit", steps=1, donate=False)
+    io = CheckpointIO(tmp_path / "exp")
+    host = host_state_dict()
+    host["global_step"] = 1
+    host["precision_policy"] = "adam8bit"  # what cli/engine save paths stamp
+    io.save(s8, host)
+
+    t32, _, _, _ = _run("fp32", steps=1, donate=False)
+    with pytest.raises(ValueError, match="adam8bit.*fp32"):
+        restore_train_state(io, t32)
+
+
+def test_fp32_policy_is_bit_identical_to_unwrapped():
+    """The default policy must be a true no-op: same optimizer object, same
+    state structure, so every pre-policy test/checkpoint stays valid."""
+    bundle = get_model("llama-debug")
+    tx = adamw_cosine(1e-3)
+    t = Trainer(bundle=bundle, optimizer=tx)
+    assert t.optimizer is tx
+    assert t.base_optimizer is tx
