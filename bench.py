@@ -244,6 +244,8 @@ def run_rung(rung: dict) -> None:
         overrides["max_position_embeddings"] = rung["max_position"]
     if rung.get("sliding_window"):  # banded flash kernel (SWA) rungs
         overrides["sliding_window"] = rung["sliding_window"]
+    if rung.get("moe_dispatch"):  # "ragged" = dropless sorted dispatch rungs
+        overrides["moe_dispatch"] = rung["moe_dispatch"]
     bundle = get_model(rung["model"], **overrides)
     cfg = bundle.config
     seq = min(rung["seq"], cfg.max_position_embeddings)
@@ -303,6 +305,8 @@ def run_rung(rung: dict) -> None:
                    if rung.get("offload_opt_state") else {}),
                 **({"sliding_window": rung["sliding_window"]}
                    if rung.get("sliding_window") else {}),
+                **({"moe_dispatch": rung["moe_dispatch"]}
+                   if rung.get("moe_dispatch") else {}),
                 "loss": round(loss, 4),
                 "steps_timed": steps_timed,
             },
@@ -517,6 +521,16 @@ SWEEP_QUEUE = [
     dict(name="bf16master_adam8bit_attnmlp_b16", model="llama-650m",
          batch=16, seq=2048, remat=True, remat_policy="attn_mlp",
          precision="bf16-master+adam8bit"),
+    # --- dropless MoE A/B (models/moe.py moe_dispatch="ragged": sorted
+    # dispatch + grouped GEMMs, no [E, C, D] capacity padding). Same shape
+    # as the 20.0%-MFU moe1b_adafactor_b8 rung so the pair is a direct
+    # dense-vs-ragged measurement; queued ahead of the fence entries (the
+    # fence4 ordering note below) so the next healthy window prices it.
+    # Ragged is the ONLY new variable here — the fence cross lives further
+    # down beside its dense sibling, per the one-new-variable stall policy.
+    dict(name="moe1b_ragged_adafactor_b8", model="moe-1b-8e", batch=8,
+         seq=2048, remat=True, remat_policy="attn", optimizer="adafactor",
+         moe_dispatch="ragged"),
     # LAST on purpose: fence_every=4 dispatches 4 steps ahead, the exact
     # pattern this pool's documented failure mode punishes — its first
     # attempt (2026-07-31 03:50) stalled and the pool went down with it.
@@ -599,6 +613,12 @@ SWEEP_QUEUE = [
     dict(name="moe1b_adafactor_fence4_b8_gather", model="moe-1b-8e", batch=8,
          seq=2048, remat=True, remat_policy="attn", optimizer="adafactor",
          fence_every=4),
+    # ragged x fence cross, beside its dense sibling above: by the time the
+    # queue reaches here both the plain ragged rung and the dense fence4
+    # rung have measured, so the fence is again the only new variable
+    dict(name="moe1b_ragged_adafactor_fence4_b8", model="moe-1b-8e", batch=8,
+         seq=2048, remat=True, remat_policy="attn", optimizer="adafactor",
+         moe_dispatch="ragged", fence_every=4),
     # --- the head-dim experiment: llama-1b-hd128 is tinyllama's size with
     # 16x128 heads instead of 32x64. If the 33.6% tinyllama measurement was
     # the half-width MXU tiles, these should land near the 650m numbers —

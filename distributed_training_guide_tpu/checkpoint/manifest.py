@@ -16,6 +16,16 @@ trusting a checkpoint and falls back through the retention chain
 CRC32 (zlib) rather than sha256: the point is detecting torn/partial/bit-rotted
 writes, not adversarial tampering, and CRC streams at memory bandwidth so
 manifest verification stays negligible next to the TensorStore read itself.
+
+Cost scaling: a full-file CRC on process 0 is O(checkpoint bytes) over the
+shared filesystem every save — at pod scale (multi-GB TensorStore shards)
+that read dominates the save. Files beyond ``SAMPLE_THRESHOLD`` therefore
+get a *sampled* CRC by default: head + tail + evenly strided interior
+windows (deterministic in the file size, so verification recomputes the
+identical byte set), capping per-file manifest I/O at a few MiB while still
+catching truncation (size check), torn head/tail writes, and stride-scale
+corruption. ``full_crc=True`` (CLI ``--checkpoint-full-crc``) restores the
+exhaustive scan.
 """
 from __future__ import annotations
 
@@ -30,7 +40,18 @@ from typing import Optional
 LOGGER = logging.getLogger(__name__)
 
 MANIFEST_FORMAT = 1
+# manifests containing sampled-CRC entries declare format 2: their crc32
+# values cover only the sampled windows, which a format-1 verifier would
+# full-scan and misread as corruption. (A pre-sampling release rolled back
+# onto format-2 manifests still fails verification — loudly, via the
+# retention-chain fallback — since it never reads the format field; that
+# one-way hazard is inherent to any manifest extension.)
+MANIFEST_FORMAT_SAMPLED = 2
 _CHUNK = 1 << 20
+# files larger than this get the sampled CRC (unless full_crc); the cap
+# bounds a sampled file's manifest read at _SAMPLE_WINDOWS * _CHUNK bytes
+SAMPLE_THRESHOLD = 64 << 20
+_SAMPLE_WINDOWS = 8  # head + tail + up to 6 strided interior windows
 
 
 def manifest_path(exp_dir: Path, ckpt_name: str) -> Path:
@@ -49,12 +70,52 @@ def _crc32_file(path: Path) -> int:
             crc = zlib.crc32(chunk, crc)
 
 
+def _sample_offsets(size: int, chunk: int = _CHUNK,
+                    windows: int = _SAMPLE_WINDOWS) -> list[int]:
+    """Window start offsets for the sampled CRC — a pure function of the
+    file SIZE and the (chunk, windows) parameters, so verification
+    recomputes the exact byte set: first and last ``chunk`` plus evenly
+    strided interior windows. The parameters are recorded in the manifest
+    (``sample_params``) so manifests stay verifiable if the module
+    defaults ever change."""
+    last = max(size - chunk, 0)
+    offsets = {0, last}
+    interior = windows - 2
+    for i in range(1, interior + 1):
+        offsets.add(min((size * i) // (interior + 1), last))
+    return sorted(offsets)
+
+
+def _crc32_file_sampled(path: Path, size: int, chunk: int = _CHUNK,
+                        windows: int = _SAMPLE_WINDOWS) -> tuple[int, int]:
+    """(crc, bytes_read) over the deterministic sample windows."""
+    crc = 0
+    read = 0
+    with open(path, "rb") as fp:
+        for off in _sample_offsets(size, chunk, windows):
+            fp.seek(off)
+            data = fp.read(chunk)
+            crc = zlib.crc32(data, crc)
+            read += len(data)
+    return crc, read
+
+
+def _entry_crc(path: Path, size: int, full_crc: bool) -> dict:
+    if full_crc or size <= SAMPLE_THRESHOLD:
+        return {"crc32": _crc32_file(path)}
+    crc, read = _crc32_file_sampled(path, size)
+    return {"crc32": crc, "crc_mode": "sampled", "sampled_bytes": read}
+
+
 def _walk_files(ckpt_dir: Path) -> list[Path]:
     return sorted(p for p in Path(ckpt_dir).rglob("*") if p.is_file())
 
 
-def write_manifest(ckpt_dir: Path, step: int, host_state: dict) -> Path:
-    """Checksum every file under ``ckpt_dir`` and write the manifest.
+def write_manifest(ckpt_dir: Path, step: int, host_state: dict, *,
+                   full_crc: bool = False) -> Path:
+    """Checksum every file under ``ckpt_dir`` (as it is enumerated — one
+    pass) and write the manifest. Files beyond ``SAMPLE_THRESHOLD`` get the
+    size-capped sampled CRC unless ``full_crc``.
 
     Called by process 0 after the Orbax write committed (the dir rename) and
     before state.json publishes the checkpoint — a crash in between leaves an
@@ -62,16 +123,20 @@ def write_manifest(ckpt_dir: Path, step: int, host_state: dict) -> Path:
     checkpoint without a manifest.
     """
     ckpt_dir = Path(ckpt_dir)
-    files = [
-        {
-            "path": str(p.relative_to(ckpt_dir)),
-            "size": p.stat().st_size,
-            "crc32": _crc32_file(p),
-        }
-        for p in _walk_files(ckpt_dir)
-    ]
+    files = []
+    for p in _walk_files(ckpt_dir):
+        size = p.stat().st_size
+        files.append({"path": str(p.relative_to(ckpt_dir)), "size": size,
+                      **_entry_crc(p, size, full_crc)})
+    sampled = any(f.get("crc_mode") == "sampled" for f in files)
     payload = {
-        "format": MANIFEST_FORMAT,
+        "format": MANIFEST_FORMAT_SAMPLED if sampled else MANIFEST_FORMAT,
+        # the window schedule the sampled entries were computed with —
+        # verification uses THESE, not the module defaults, so changing
+        # the defaults never invalidates existing manifests
+        **({"sample_params": {"chunk": _CHUNK,
+                              "windows": _SAMPLE_WINDOWS}} if sampled
+           else {}),
         "checkpoint": ckpt_dir.name,
         "step": int(step),
         "host_state": dict(host_state),
@@ -119,7 +184,16 @@ def verify_manifest(ckpt_dir: Path, manifest: dict) -> list[str]:
         if size != entry["size"]:
             problems.append(f"size mismatch: {rel} ({size} != {entry['size']})")
             continue
-        crc = _crc32_file(p)
+        if entry.get("crc_mode") == "sampled":
+            # recompute over the identical window set: offsets derive from
+            # the recorded size (which just matched) and the manifest's own
+            # recorded sample parameters (module defaults may have moved)
+            sp = manifest.get("sample_params", {})
+            crc, _ = _crc32_file_sampled(
+                p, size, sp.get("chunk", _CHUNK),
+                sp.get("windows", _SAMPLE_WINDOWS))
+        else:
+            crc = _crc32_file(p)
         if crc != entry["crc32"]:
             problems.append(f"checksum mismatch: {rel}")
     extra = {str(p.relative_to(ckpt_dir)) for p in _walk_files(ckpt_dir)} - set(expected)

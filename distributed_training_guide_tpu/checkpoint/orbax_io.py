@@ -68,12 +68,16 @@ class CheckpointIO:
 
     def __init__(self, exp_dir: str | Path, *, async_save: bool = False,
                  keep_n: int = 2, save_retries: int = 2,
-                 retry_backoff_s: float = 0.5):
+                 retry_backoff_s: float = 0.5, full_crc: bool = False):
         self.exp_dir = Path(exp_dir)
         import orbax.checkpoint as ocp
 
         self._ocp = ocp
         self.async_save = async_save
+        # full_crc: exhaustively CRC every file in the integrity manifest
+        # (default: size-capped sampled CRC for multi-GB TensorStore shards
+        # — see manifest.SAMPLE_THRESHOLD)
+        self.full_crc = bool(full_crc)
         self.keep_n = max(1, int(keep_n))
         self.save_retries = max(0, int(save_retries))
         self.retry_backoff_s = retry_backoff_s
@@ -165,7 +169,8 @@ class CheckpointIO:
             # manifest before state.json: a crash in between leaves an
             # unreferenced dir+manifest pair (swept later), never a
             # referenced checkpoint without integrity data
-            manifest_mod.write_manifest(path, step, host_state)
+            manifest_mod.write_manifest(path, step, host_state,
+                                        full_crc=self.full_crc)
             retained = [path.name] + [n for n in retained_before
                                       if n != path.name]
             keep = retained[:self.keep_n]

@@ -23,7 +23,9 @@ Restart policy (the part torchrun leaves to the operator):
   shape/sharding errors, and guard-abort NaNs are deterministic functions of
   the config — restarting reproduces them, so the supervisor stops
   immediately instead of burning every attempt (``--restart-on-poison``
-  opts back into blind restarts).
+  opts back into blind restarts). Error files are unlinked before each
+  (re)start and mtime-fenced against the worker's launch time, so a stale
+  preset ``$ERROR_FILE`` from a previous incarnation can never classify.
 
 Hang detection: each worker gets ``HEARTBEAT_FILE`` pointed into its attempt
 dir; the training loop writes step+timestamp there every iteration
@@ -52,15 +54,56 @@ from pathlib import Path
 from .errors import classify_error
 
 
-def _poison_reason(error_file: Path) -> str | None:
-    """First poison classification across the attempt's error files (the
-    direct ERROR_FILE plus any per-rank suffixed files a gang produced)."""
-    candidates = [error_file] + sorted(
+def _error_file_candidates(error_file: Path) -> list[Path]:
+    return [error_file] + sorted(
         error_file.parent.glob(error_file.name + ".rank*"))
-    for path in candidates:
+
+
+def _fence_stale_error_files(error_file: Path) -> None:
+    """Remove leftover error files BEFORE (re)starting a worker: when the
+    operator presets ``$ERROR_FILE`` in the environment, the same path
+    persists across attempts AND across supervisor incarnations, so a stale
+    payload from a previous run would classify as a poison pill and wrongly
+    stop the restart loop. Best-effort — an unremovable file is additionally
+    fenced by mtime in ``_poison_reason``."""
+    for path in _error_file_candidates(error_file):
+        try:
+            path.unlink()
+        except OSError:
+            pass
+
+
+def _launch_stamp(attempt_dir: Path) -> float:
+    """Filesystem timestamp of 'now', taken by touching a sentinel in the
+    attempt dir: the fence below compares error-file mtimes against THIS
+    (same filesystem, same clock), so an NFS server whose clock skews from
+    the supervisor host can't make a genuine poison file look stale.
+    Falls back to host time if the touch fails."""
+    stamp = attempt_dir / ".launch_stamp"
+    try:
+        stamp.touch()
+        return stamp.stat().st_mtime
+    except OSError:
+        return time.time()
+
+
+def _poison_reason(error_file: Path, launched_at: float = 0.0) -> str | None:
+    """First poison classification across the attempt's error files (the
+    direct ERROR_FILE plus any per-rank suffixed files a gang produced).
+    Files whose mtime predates ``launched_at`` are ignored: only errors the
+    just-failed worker actually wrote may classify (the unlink fence above
+    can fail on odd filesystems/permissions). ``launched_at`` comes from a
+    sentinel touched on the same filesystem at launch, so the comparison is
+    clock-consistent; a 2s slack absorbs coarse mtime granularity — worker
+    writes are strictly after launch."""
+    for path in _error_file_candidates(error_file):
         if not path.is_file():
             continue
         try:
+            if path.stat().st_mtime < launched_at - 2.0:
+                print(f"[supervisor] ignoring stale error file {path.name} "
+                      f"(predates this worker's launch)", flush=True)
+                continue
             with open(path) as fp:
                 payload = json.load(fp)
         except (OSError, json.JSONDecodeError):
@@ -84,10 +127,12 @@ def run_supervised(cmd: list[str], max_restarts: int, log_dir: Path,
         env = dict(os.environ)
         env.setdefault("ERROR_FILE", str(attempt_dir / "error.json"))
         env["HEARTBEAT_FILE"] = str(attempt_dir / "heartbeat.json")
+        _fence_stale_error_files(Path(env["ERROR_FILE"]))
         stdout = open(attempt_dir / "stdout.log", "ab")
         stderr = open(attempt_dir / "stderr.log", "ab")
         print(f"[supervisor] attempt {attempt}: {' '.join(cmd)} -> {attempt_dir}",
               flush=True)
+        launched_at = _launch_stamp(attempt_dir)
         proc = subprocess.Popen(cmd, env=env, stdout=stdout, stderr=stderr)
 
         try:
@@ -109,7 +154,7 @@ def run_supervised(cmd: list[str], max_restarts: int, log_dir: Path,
         print(f"[supervisor] attempt {attempt} failed rc={rc} "
               f"(error file: {env['ERROR_FILE']})", flush=True)
         if stop_on_poison:
-            reason = _poison_reason(Path(env["ERROR_FILE"]))
+            reason = _poison_reason(Path(env["ERROR_FILE"]), launched_at)
             if reason:
                 print(f"[supervisor] non-retryable failure ({reason}); "
                       f"not restarting — fix the config/data and relaunch",

@@ -29,6 +29,17 @@ GShard one-hot [T, E, C] dispatch/combine tensors, whose memory grows
 O(T^2 * k / E * E) = O(T^2 * k) at fixed capacity factor. The router also
 reports the dropped-(token, choice) fraction, surfaced as the
 ``moe_dropped_frac`` train metric.
+
+``moe_dispatch="ragged"`` swaps the capacity buffers for MegaBlocks-style
+DROPLESS dispatch (Gale et al., arXiv:2211.15841): sort the kT pairs by
+expert id and run the three expert matmuls as grouped GEMMs over the ragged
+[kT, D] sorted buffer (``ops/grouped_matmul.py``) — no padding compute, no
+capacity/quality trade, ``moe_dropped_frac`` identically 0. On sharded
+meshes the Trainer threads ``make_ragged_ep_dispatch`` (a manual shard_map
+over the data axes: ep > 1 exchanges sorted groups by all-gather +
+reduce-scatter; plain dp/fsdp bodies are collective-free). The decode
+``no_drop`` path always runs ragged — O(t*k*d) transients instead of the
+old worst-case O(E*k*t*d) capacity buffers.
 """
 from __future__ import annotations
 
@@ -44,6 +55,10 @@ from jax.ad_checkpoint import checkpoint_name
 from . import llama
 from .llama import _rmsnorm, attention_sublayer
 from ..ops.collectives import psum as _psum
+from ..ops.collectives import psum_scatter as _psum_scatter
+from ..ops.grouped_matmul import grouped_matmul
+
+MOE_DISPATCH_MODES = ("dense", "ragged")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -71,6 +86,12 @@ class MoELlamaConfig:
     # EVERY token, its output scaled by sigmoid(x @ shared_gate) and added
     # to the routed combine. None = no shared expert (Mixtral/Qwen3-MoE)
     shared_expert_intermediate: Optional[int] = None
+    # expert-dispatch backend: "dense" = static [E, C, D] capacity buffers
+    # (Switch/GShard; overflow drops to the residual), "ragged" = dropless
+    # sort-based dispatch + grouped GEMMs over the [kT, D] sorted buffer
+    # (MegaBlocks, arXiv:2211.15841) — no padding compute, no capacity knob,
+    # dropped_frac identically 0. The decode/no_drop path always runs ragged
+    moe_dispatch: str = "dense"
     head_dim: Optional[int] = None
     max_position_embeddings: int = 4096
     rope_theta: float = 10000.0
@@ -218,37 +239,110 @@ def param_logical_axes(config: MoELlamaConfig) -> dict:
     return axes
 
 
-def _moe_ffn(config: MoELlamaConfig, x: jnp.ndarray, moe: dict,
-             tp_axis: Optional[str] = None, no_drop: bool = False):
-    """Top-k routed FFN with index-based, gather-only dispatch. x: [B, S, D].
-    Returns (y, aux_loss, dropped_frac).
+def _ragged_expert_compute(x_rows: jnp.ndarray, gate, up, down,
+                           group_sizes: jnp.ndarray, cdt) -> jnp.ndarray:
+    """The three expert matmuls as grouped GEMMs over a group-sorted row
+    buffer (rows beyond ``sum(group_sizes)`` come back zero — the EP local
+    slice rides that contract)."""
+    h = jax.nn.silu(grouped_matmul(x_rows, gate.astype(cdt), group_sizes))
+    h = h * grouped_matmul(x_rows, up.astype(cdt), group_sizes)
+    # tagged for REMAT_POLICIES["attn_mlp"] (the [kT, F] inner activation;
+    # same role as the dense path's [E, C, F] / llama's mlp_act)
+    h = checkpoint_name(h, "mlp_act")
+    return grouped_matmul(h, down.astype(cdt), group_sizes)
 
-    Dispatch is O(k*T) index arrays + [E, C, D] expert buffers — the round-1
-    one-hot formulation materialized [T, E, C] dispatch/combine tensors
-    (O(T^2 * k) floats at fixed capacity factor, ~640 MB at T=8k, k=2).
-    Row data moves by GATHER only (the single scatter is the int32 slot-map
-    inversion; the combine is a reshape+sum, exploiting the choice-rank-major
-    pair layout) — TPU scatters serialize on write hazards and dominated the
-    first on-chip MoE measurement (BENCH.md, 20% MFU).
-    Capacity priority is greedy by choice rank then token order (all rank-0
-    choices before any rank-1), identical to the old sequential assignment.
+
+def _ragged_sort(xt: jnp.ndarray, topk_idx, topk_probs, ex: int, k: int, cdt):
+    """Flatten (token, choice) pairs choice-rank-major, sort by expert id.
+    Returns (order, group_sizes, x_sorted [kT, D], weight_flat [kT]).
+
+    Pair i is token (i mod t): sorted rows gather straight from xt — row
+    movement is gather-only, like the dense path; the one int32 scatter
+    lives in ``_ragged_combine``'s permutation inversion."""
+    t = xt.shape[0]
+    expert_flat = topk_idx.T.reshape(k * t)                      # [kT]
+    weight_flat = topk_probs.T.reshape(k * t)
+    order = jnp.argsort(expert_flat, stable=True)
+    group_sizes = jnp.bincount(expert_flat, length=ex).astype(jnp.int32)
+    x_sorted = xt[order % t].astype(cdt)                         # [kT, D]
+    return order, group_sizes, x_sorted, weight_flat
+
+
+def _ragged_combine(out_sorted: jnp.ndarray, order, weight_flat,
+                    k: int, t: int, cdt) -> jnp.ndarray:
+    """Unsort (int32 inversion scatter + row gather), weight, and combine
+    the k contributions of each token (adjacent in the choice-rank-major
+    layout — a reshape and a dense sum, no scatter-add). -> [t, D]."""
+    m, d = k * t, out_sorted.shape[1]
+    inv = (jnp.zeros((m,), jnp.int32)
+           .at[order].set(jnp.arange(m, dtype=jnp.int32)))
+    y_choice = out_sorted[inv]                                   # pair order
+    return jnp.sum((y_choice * weight_flat[:, None].astype(cdt))
+                   .reshape(k, t, d), axis=0)
+
+
+def _ragged_dispatch(config: MoELlamaConfig, xt: jnp.ndarray, topk_idx,
+                     topk_probs, moe: dict, cdt) -> jnp.ndarray:
+    """Dropless sorted dispatch (single-shard form): sort (token, choice)
+    pairs by expert id, run the experts as grouped GEMMs over the sorted
+    [kT, D] buffer, unsort, weight, combine. No capacity buffers, no drops;
+    transients are O(k*T*D) — at decode (t == 1..few) that is O(t*k*d) vs
+    the dense no_drop path's O(E*k*t*d) worst-case buffers."""
+    t = xt.shape[0]
+    ex, k = config.num_experts, config.experts_per_token
+    order, group_sizes, x_sorted, weight_flat = _ragged_sort(
+        xt, topk_idx, topk_probs, ex, k, cdt)
+    out_sorted = _ragged_expert_compute(x_sorted, moe["gate"], moe["up"],
+                                        moe["down"], group_sizes, cdt)
+    return _ragged_combine(out_sorted, order, weight_flat, k, t, cdt)
+
+
+def _moe_ffn(config: MoELlamaConfig, x: jnp.ndarray, moe: dict,
+             tp_axis: Optional[str] = None, no_drop: bool = False,
+             moe_ep=None):
+    """Top-k routed FFN. x: [B, S, D]. Returns (y, aux_loss, dropped_frac).
+
+    Two dispatch backends, selected by ``config.moe_dispatch``:
+
+    - ``"dense"`` (default, the parity reference): index-based gather-only
+      dispatch into static [E, C, D] capacity buffers + batched expert
+      einsums. O(k*T) index arrays; overflow pairs drop to the residual
+      (Switch/GShard). Row data moves by GATHER only (the single scatter is
+      the int32 slot-map inversion; the combine is a reshape+sum over the
+      choice-rank-major pair layout) — TPU scatters serialize on write
+      hazards and dominated the first on-chip MoE measurement (BENCH.md,
+      20% MFU). Capacity priority is greedy by choice rank then token order.
+    - ``"ragged"``: dropless sorted dispatch + grouped GEMMs over the
+      [kT, D] sorted buffer (MegaBlocks, arXiv:2211.15841) — no padding
+      compute, no capacity/quality trade, ``dropped_frac`` identically 0.
+
+    ``no_drop`` (the decode path) always runs ragged: it is dropless by
+    construction at O(t*k*d) transients, where the old dense no_drop
+    allocated worst-case ``k*t`` capacity per expert — O(E*k*t*d), ~2 GiB a
+    layer on a 2k-token qwen1.5-moe prompt.
 
     ``tp_axis``: set inside a shard_map region where tp is a *manual* axis
     (the pipeline schedule). The router is replicated over tp, so every
     member computes identical dispatch indices; gate/up/down arrive as
     megatron mlp-dim shards and the combined output is a partial sum —
     combine is linear in the expert outputs, so one psum of y at the end is
-    exact (commutes with the gather and the reshape+sum combine).
+    exact for both backends (it commutes with gathers and the reshape+sum
+    combine, and grouped GEMMs contract the mlp dim only in ``down``).
+
+    ``moe_ep``: expert-parallel ragged dispatch callable built by
+    ``make_ragged_ep_dispatch`` (threaded in by the Trainer when the plan
+    has ep > 1 and the config says ragged); replaces the local sorted
+    dispatch with the shard_map'd sorted-group exchange.
     """
     b, s, d = x.shape
     t = b * s
     ex, k = config.num_experts, config.experts_per_token
-    # no_drop: worst-case capacity (every pair to one expert) — the decode
-    # path uses it so cached generation is routing-exact vs a full recompute
-    # regardless of capacity_factor (a serving-quality knob, not a training
-    # throughput one, at t == 1 per step)
-    capacity = (k * t if no_drop
-                else max(int(math.ceil(config.capacity_factor * k * t / ex)), 1))
+    dispatch = getattr(config, "moe_dispatch", "dense")
+    if dispatch not in MOE_DISPATCH_MODES:
+        raise ValueError(f"unknown moe_dispatch {dispatch!r}; choose from "
+                         f"{MOE_DISPATCH_MODES}")
+    if no_drop:
+        dispatch = "ragged"
     cdt = config.dtype
 
     xt = x.reshape(t, d)
@@ -262,54 +356,62 @@ def _moe_ffn(config: MoELlamaConfig, x: jnp.ndarray, moe: dict,
         # norm_topk_prob flag — off, the raw softmax mass is the weight)
         topk_probs = topk_probs / jnp.sum(topk_probs, axis=-1, keepdims=True)
 
-    # flatten (token, choice) pairs choice-rank-major -> greedy priority
-    expert_flat = topk_idx.T.reshape(k * t)                      # [kT]
-    weight_flat = topk_probs.T.reshape(k * t)
+    if dispatch == "ragged":
+        if moe_ep is not None:
+            y = moe_ep(xt, topk_idx, topk_probs,
+                       moe["gate"], moe["up"], moe["down"])
+        else:
+            y = _ragged_dispatch(config, xt, topk_idx, topk_probs, moe, cdt)
+        dropped_frac = jnp.zeros((), jnp.float32)  # dropless by construction
+    else:
+        capacity = max(int(math.ceil(config.capacity_factor * k * t / ex)), 1)
 
-    # slot within each expert's buffer = rank of this pair among same-expert
-    # pairs (stable sort keeps greedy priority order within a group)
-    order = jnp.argsort(expert_flat, stable=True)
-    sorted_e = expert_flat[order]
-    group_start = jnp.searchsorted(sorted_e, sorted_e, side="left")
-    pos_sorted = jnp.arange(k * t, dtype=jnp.int32) - group_start.astype(jnp.int32)
-    pos_flat = jnp.zeros((k * t,), jnp.int32).at[order].set(pos_sorted)
+        # flatten (token, choice) pairs choice-rank-major -> greedy priority
+        expert_flat = topk_idx.T.reshape(k * t)                  # [kT]
+        weight_flat = topk_probs.T.reshape(k * t)
 
-    keep = pos_flat < capacity
-    dropped_frac = 1.0 - jnp.mean(keep.astype(jnp.float32))
-    # overflow pairs target a sacrificial slot that is sliced off
-    dest = jnp.where(keep, expert_flat * capacity + pos_flat, ex * capacity)
+        # slot within each expert's buffer = rank of this pair among
+        # same-expert pairs (stable sort keeps greedy priority in-group)
+        order = jnp.argsort(expert_flat, stable=True)
+        sorted_e = expert_flat[order]
+        group_start = jnp.searchsorted(sorted_e, sorted_e, side="left")
+        pos_sorted = (jnp.arange(k * t, dtype=jnp.int32)
+                      - group_start.astype(jnp.int32))
+        pos_flat = jnp.zeros((k * t,), jnp.int32).at[order].set(pos_sorted)
 
-    # Fill the [E, C, D] buffers by GATHER, not by scattering rows: TPU
-    # scatters serialize on write hazards, and the original formulation paid
-    # two of them per layer on [kT, D] row data (dispatch .at[dest].set and
-    # the combine .at[token].add — the round-4 MoE bench rung measured 20%
-    # MFU with dispatch dominating). The only scatter left is int32: invert
-    # the slot map (which pair fills slot (e, c)?), then gather rows. Slots
-    # nobody fills keep the sentinel kT and gather the appended zero row —
-    # identical buffers to the scatter formulation.
-    inv = (jnp.full((ex * capacity + 1,), k * t, jnp.int32)
-           .at[dest].set(jnp.arange(k * t, dtype=jnp.int32), mode="drop")[:-1])
-    # pair i is token (i mod t) (choice-rank-major layout): gather straight
-    # from xt — no k-fold tiled copy — and mask empty slots (the sentinel
-    # k*t gathers row 0, then zeroes) to reproduce the zero-filled buffers
-    expert_in = jnp.where((inv < k * t)[:, None],
-                          xt[inv % t].astype(cdt), 0).reshape(ex, capacity, d)
+        keep = pos_flat < capacity
+        dropped_frac = 1.0 - jnp.mean(keep.astype(jnp.float32))
+        # overflow pairs target a sacrificial slot that is sliced off
+        dest = jnp.where(keep, expert_flat * capacity + pos_flat, ex * capacity)
 
-    h = jax.nn.silu(jnp.einsum("ecd,edf->ecf", expert_in, moe["gate"].astype(cdt)))
-    h = h * jnp.einsum("ecd,edf->ecf", expert_in, moe["up"].astype(cdt))
-    # tagged for REMAT_POLICIES["attn_mlp"] (the [E,C,F] inner activation;
-    # same role as llama's mlp_act)
-    h = checkpoint_name(h, "mlp_act")
-    expert_out = jnp.einsum("ecf,efd->ecd", h, moe["down"].astype(cdt))
+        # Fill the [E, C, D] buffers by GATHER, not by scattering rows: the
+        # only scatter is int32 — invert the slot map (which pair fills slot
+        # (e, c)?), then gather rows. Slots nobody fills keep the sentinel
+        # kT and gather the appended zero row.
+        inv = (jnp.full((ex * capacity + 1,), k * t, jnp.int32)
+               .at[dest].set(jnp.arange(k * t, dtype=jnp.int32),
+                             mode="drop")[:-1])
+        # pair i is token (i mod t): gather straight from xt — no k-fold
+        # tiled copy — and mask empty slots to reproduce zero-filled buffers
+        expert_in = jnp.where((inv < k * t)[:, None],
+                              xt[inv % t].astype(cdt), 0).reshape(ex, capacity, d)
 
-    out_flat = expert_out.reshape(ex * capacity, d)
-    y_choice = out_flat[jnp.clip(dest, 0, ex * capacity - 1)]
-    y_choice = jnp.where(keep[:, None], y_choice, 0)
-    # un-route without a scatter-add: pair i is token (i mod t), so the k
-    # contributions of each token are exactly the k rows of the
-    # choice-rank-major layout — a reshape and a dense sum
-    y = jnp.sum((y_choice * weight_flat[:, None].astype(cdt))
-                .reshape(k, t, d), axis=0)
+        h = jax.nn.silu(jnp.einsum("ecd,edf->ecf", expert_in,
+                                   moe["gate"].astype(cdt)))
+        h = h * jnp.einsum("ecd,edf->ecf", expert_in, moe["up"].astype(cdt))
+        # tagged for REMAT_POLICIES["attn_mlp"] (the [E,C,F] inner
+        # activation; same role as llama's mlp_act)
+        h = checkpoint_name(h, "mlp_act")
+        expert_out = jnp.einsum("ecf,efd->ecd", h, moe["down"].astype(cdt))
+
+        out_flat = expert_out.reshape(ex * capacity, d)
+        y_choice = out_flat[jnp.clip(dest, 0, ex * capacity - 1)]
+        y_choice = jnp.where(keep[:, None], y_choice, 0)
+        # un-route without a scatter-add: pair i is token (i mod t), so the
+        # k contributions of each token are exactly the k rows of the
+        # choice-rank-major layout — a reshape and a dense sum
+        y = jnp.sum((y_choice * weight_flat[:, None].astype(cdt))
+                    .reshape(k, t, d), axis=0)
     if "shared_gate" in moe:   # Qwen2-MoE shared expert: dense gated MLP on
         # every token, output scaled by a sigmoid scalar gate and ADDED to
         # the routed combine. Under manual tp its mlp-dim-sharded down-proj
@@ -336,15 +438,130 @@ def _moe_ffn(config: MoELlamaConfig, x: jnp.ndarray, moe: dict,
     return y.reshape(b, s, d), aux, dropped_frac
 
 
+def make_ragged_ep_dispatch(mesh, config: MoELlamaConfig, *,
+                            data_axes=("dp", "fsdp", "ep"), ep_axis="ep",
+                            embed_axis: Optional[str] = None):
+    """Sharded dropless dispatch: a shard_map over the data axes that
+    exchanges *sorted expert groups* instead of the dense path's [E, C, D]
+    capacity buffer.
+
+    Each (dp, fsdp) row all-gathers its token rows + routing over ``ep``,
+    sorts (token, choice) pairs by expert id, and runs the grouped GEMMs on
+    the slice of the sorted buffer belonging to its E/ep local experts (a
+    worst-case-static [kT, D] window whose garbage tail the grouped-matmul
+    contract zeroes); per-shard partial outputs reduce-scatter back to the
+    local token rows. The gather + reduce-scatter pair carries the same
+    O(T*D) bytes as the dense path's two GSPMD all-to-alls — what it removes
+    is the E/ep-fold capacity-padding compute and the drop/quality trade.
+
+    Also used WITHOUT an ep axis (plain dp/fsdp data sharding, ep == 1):
+    every shard then owns all experts and the body is collective-free —
+    local sort + grouped GEMMs over local tokens. Keeping the region manual
+    matters twice: GSPMD cannot partition the data-dependent sort/gather the
+    way it partitions the dense path's static einsums (on jax<0.5 CPU it
+    aborts outright with "PartitionId instruction is not supported"), and
+    on TPU the manual body guarantees zero cross-chip traffic for the
+    dp-only case instead of whatever the partitioner falls back to.
+    Returns None on a single-shard mesh (the plain local path IS the
+    program).
+
+    Autodiff works through the map because every collective is an
+    all_gather/psum_scatter pair (clean transposes of each other) and the
+    router math stays OUTSIDE the map (no replicated differentiable inputs).
+
+    ``embed_axis``: mesh axis sharding the weights' embed dim (ep_fsdp
+    plans pass "fsdp"); the body all-gathers that dim before compute and the
+    transpose reduce-scatters the weight cotangent — exactly FSDP semantics,
+    hand-spelled because the region is manual.
+    """
+    from jax.sharding import PartitionSpec as P
+
+    ex, k = config.num_experts, config.experts_per_token
+    ep = mesh.shape.get(ep_axis, 1)
+    if ep > 1 and ex % ep:
+        raise ValueError(
+            f"moe_dispatch='ragged' under expert parallelism needs "
+            f"num_experts divisible by the ep axis; got E={ex}, ep={ep} — "
+            f"change the mesh or use moe_dispatch='dense' (which falls back "
+            f"to replication on non-divisible dims)")
+    e_local = ex // ep
+    axes = tuple(a for a in data_axes if mesh.shape.get(a, 1) > 1)
+    if embed_axis is not None and mesh.shape.get(embed_axis, 1) <= 1:
+        embed_axis = None
+    if not axes and embed_axis is None:
+        return None  # single-shard mesh: the plain local path is the program
+    manual = set(axes) | ({embed_axis} if embed_axis else set())
+    cdt = config.dtype
+    row_spec = P(axes if axes else None, None)
+    gu_spec = P(ep_axis if ep > 1 else None, embed_axis, None)
+    down_spec = P(ep_axis if ep > 1 else None, None, embed_axis)
+
+    def body(xt, topk_idx, topk_probs, gate, up, down):
+        if embed_axis is not None:
+            gate = jax.lax.all_gather(gate, embed_axis, axis=1, tiled=True)
+            up = jax.lax.all_gather(up, embed_axis, axis=1, tiled=True)
+            down = jax.lax.all_gather(down, embed_axis, axis=2, tiled=True)
+        if ep > 1:  # pull the whole (dp, fsdp) row's tokens + routing in
+            xt = jax.lax.all_gather(xt, ep_axis, axis=0, tiled=True)
+            topk_idx = jax.lax.all_gather(topk_idx, ep_axis, axis=0,
+                                          tiled=True)
+            topk_probs = jax.lax.all_gather(topk_probs, ep_axis, axis=0,
+                                            tiled=True)
+        t_row, d = xt.shape
+        m = k * t_row
+        order, sizes, x_sorted, weight_flat = _ragged_sort(
+            xt, topk_idx, topk_probs, ex, k, cdt)
+        if ep > 1:
+            # this shard's experts occupy a contiguous run of the sorted
+            # buffer starting at the sum of earlier groups; slice a worst-
+            # case-static [m, D] window from a zero-padded copy (the tail
+            # past the local groups is garbage the grouped-matmul contract
+            # zeroes out)
+            e0 = jax.lax.axis_index(ep_axis) * e_local
+            local_sizes = jax.lax.dynamic_slice(sizes, (e0,), (e_local,))
+            start = jnp.sum(jnp.where(jnp.arange(ex) < e0, sizes, 0))
+            x_pad = jnp.concatenate([x_sorted, jnp.zeros_like(x_sorted)],
+                                    axis=0)
+            x_local = jax.lax.dynamic_slice(x_pad, (start, 0), (m, d))
+            out_local = _ragged_expert_compute(x_local, gate, up, down,
+                                               local_sizes, cdt)
+            out_pad = jnp.zeros((2 * m, d), out_local.dtype)
+            out_pad = jax.lax.dynamic_update_slice(out_pad, out_local,
+                                                   (start, 0))
+            out_sorted = out_pad[:m]  # zeros outside this shard's groups
+        else:
+            # no expert axis: every shard owns all experts and just runs
+            # its own tokens — purely local, no collectives at all
+            out_sorted = _ragged_expert_compute(x_sorted, gate, up, down,
+                                                sizes, cdt)
+        y = _ragged_combine(out_sorted, order, weight_flat, k, t_row, cdt)
+        if ep == 1:
+            return y
+        # partial per shard (only its experts' contributions): reduce-
+        # scatter sums them and lands each token back on its home shard
+        return _psum_scatter(y, ep_axis)
+
+    sm = jax.shard_map(body, mesh=mesh, axis_names=manual, check_vma=False,
+                       in_specs=(row_spec, row_spec, row_spec,
+                                 gu_spec, gu_spec, down_spec),
+                       out_specs=row_spec)
+
+    def dispatch(xt, topk_idx, topk_probs, gate, up, down):
+        return sm(xt, topk_idx, topk_probs, gate, up, down)
+
+    return dispatch
+
+
 def _block(config: MoELlamaConfig, carry, layer: dict, positions, attn_impl,
-           standard_layout=True, tp_axis=None):
+           standard_layout=True, tp_axis=None, moe_ep=None):
     x, aux_acc, dropped_acc = carry
     attn = attention_sublayer(config, x, layer["attn"], layer["input_norm"],
                               positions, attn_impl, standard_layout, tp_axis)
     x = x + attn
 
     h = _rmsnorm(x, layer["post_attn_norm"], config.rms_norm_eps)
-    y, aux, dropped = _moe_ffn(config, h, layer["moe"], tp_axis)
+    y, aux, dropped = _moe_ffn(config, h, layer["moe"], tp_axis,
+                               moe_ep=moe_ep)
     return (x + y, aux_acc + aux, dropped_acc + dropped)
 
 
@@ -360,14 +577,18 @@ def apply_with_aux(
     activation_sharding: Optional[Any] = None,
     return_metrics: bool = False,
     return_hidden: bool = False,
+    moe_ep=None,
 ):
     """Forward -> (logits [B,S,V] fp32, mean router aux loss[, metrics]).
 
     ``return_metrics`` adds a dict of routing observability scalars
     (currently ``dropped_frac``: mean fraction of (token, choice) pairs that
-    overflowed expert capacity) without changing the stable 2-tuple API.
-    ``return_hidden`` swaps the logits for the final-normed hidden states
-    [B, S, E] (chunked-loss path — pair with ``output_weights``)."""
+    overflowed expert capacity — identically 0 under ragged dispatch)
+    without changing the stable 2-tuple API. ``return_hidden`` swaps the
+    logits for the final-normed hidden states [B, S, E] (chunked-loss path —
+    pair with ``output_weights``). ``moe_ep``: expert-parallel ragged
+    dispatch callable (``make_ragged_ep_dispatch``), threaded to every
+    layer's routed FFN."""
     standard_layout = positions is None
     if positions is None:
         positions = jnp.arange(input_ids.shape[1])[None, :]
@@ -376,7 +597,7 @@ def apply_with_aux(
     x = llama.embed_tokens(config, params, input_ids, positions)
 
     block = partial(_block, config, positions=positions, attn_impl=attn_impl,
-                    standard_layout=standard_layout)
+                    standard_layout=standard_layout, moe_ep=moe_ep)
 
     def scan_body(carry, layer_params):
         new_carry = block(carry, layer_params)
@@ -424,7 +645,10 @@ tp_embed = llama.tp_embed
 # block body. Expert dispatch runs with ``no_drop=True`` — a single decode
 # token's k choices can exceed a capacity_factor-derived capacity of 1
 # (both choices on one expert), and a qualitative sampling path must be
-# routing-exact vs the full recompute, not throughput-shaped.
+# routing-exact vs the full recompute, not throughput-shaped. no_drop
+# resolves to the RAGGED backend: dropless by construction at O(t*k*d)
+# transients (the old dense no_drop allocated worst-case C = k*t per-expert
+# buffers — O(E*k*t*d), ~2 GiB/layer on a 2k-token qwen1.5-moe prompt).
 # ---------------------------------------------------------------------------
 
 init_cache = llama.init_cache

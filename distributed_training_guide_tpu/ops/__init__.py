@@ -1,9 +1,11 @@
 from .attention import multihead_attention
 from .cross_entropy import causal_lm_loss, chunked_causal_lm_loss
+from .grouped_matmul import grouped_matmul
 from .rope import apply_rope, rope_frequencies
 
 __all__ = [
     "multihead_attention",
+    "grouped_matmul",
     "apply_rope",
     "rope_frequencies",
     "causal_lm_loss",

@@ -93,6 +93,14 @@ def multihead_attention(
     default head_dim**-0.5). ``logit_softcap``: Gemma-2 tanh capping —
     xla path only (auto falls back; forced flash fails loudly).
     """
+    if window is not None and not causal:
+        # the band is defined relative to the causal diagonal; the xla path
+        # builds its window mask inside the `if causal:` block and would
+        # otherwise silently IGNORE the window (the flash kernel raises) —
+        # both paths must fail loudly on this combination
+        raise ValueError(
+            "window (sliding-window attention) requires causal=True — a "
+            "non-causal banded mask is not implemented on either path")
     static_window = window is None or isinstance(window, int)
     if impl == "auto":
         on_tpu = jax.default_backend() == "tpu"

@@ -16,3 +16,15 @@ def psum(x: jnp.ndarray, axis) -> jnp.ndarray:
     if jax.default_backend() != "tpu" and x.dtype in (jnp.bfloat16, jnp.float16):
         return jax.lax.psum(x.astype(jnp.float32), axis).astype(x.dtype)
     return jax.lax.psum(x, axis)
+
+
+def psum_scatter(x: jnp.ndarray, axis, *, scatter_dimension: int = 0) -> jnp.ndarray:
+    """``jax.lax.psum_scatter(tiled=True)`` with the same sub-fp32 upcast
+    guard as ``psum`` (the reduction arithmetic hits the identical CPU
+    runtime abort); on TPU the native low-precision reduce-scatter runs."""
+    if jax.default_backend() != "tpu" and x.dtype in (jnp.bfloat16, jnp.float16):
+        return jax.lax.psum_scatter(
+            x.astype(jnp.float32), axis, scatter_dimension=scatter_dimension,
+            tiled=True).astype(x.dtype)
+    return jax.lax.psum_scatter(x, axis, scatter_dimension=scatter_dimension,
+                                tiled=True)

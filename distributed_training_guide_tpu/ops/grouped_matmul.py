@@ -1,0 +1,340 @@
+"""Grouped (ragged) matmul: the expert-compute primitive of dropless MoE.
+
+``grouped_matmul(lhs [M, K], rhs [G, K, N], group_sizes [G]) -> [M, N]``
+multiplies row-block ``g`` of ``lhs`` (rows ``offs[g]:offs[g+1]`` where
+``offs = cumsum(group_sizes)``) by ``rhs[g]``. Rows beyond
+``sum(group_sizes)`` produce zeros (and receive zero gradient) — callers
+exploit that contract for expert-parallel local slices, where a worst-case
+static buffer carries a garbage tail.
+
+Three implementations behind one dispatch:
+
+- ``pallas``: a Mosaic kernel in the MegaBlocks spirit (Gale et al.,
+  arXiv:2211.15841): the sorted token axis is tiled and the grid iterates a
+  precomputed (group, row-tile) *work list* built from the per-expert
+  offset/size metadata, so compute visits only tiles a group actually
+  intersects — no ``[E, capacity]`` padding FLOPs, no per-group dense pass.
+  Differentiable via custom_vjp (d_lhs is another grouped matmul against
+  ``rhs`` transposed; d_rhs is the transposed grouped matmul ``tgmm``).
+- ``scan``: a ``lax.scan`` over groups (mask the sorted rows to the group's
+  contiguous range, dense matmul, accumulate) — O(G) more FLOPs than ideal
+  but O(M*(K+N)) *memory*, pure jnp, differentiable. The off-TPU default:
+  correctness everywhere without the dense expansion below.
+- ``ragged``: ``jax.lax.ragged_dot`` (XLA's native ragged contraction,
+  differentiable as-is). NOTE: on backends without a native lowering
+  (CPU today) it decomposes to a dense ``[G, M, K]`` broadcast + batched
+  dot — O(G*M) transient memory, the very padding blowup dropless dispatch
+  exists to remove — which is why it is not the auto fallback.
+- ``einsum``: segment-one-hot masked einsum, O(G x) padding FLOPs and
+  contraction-order-dependent transients — the numerics cross-check in
+  tests.
+
+The Pallas kernels keep the whole K (contraction) dim resident per tile —
+fine for transformer hidden/FFN widths (K * block * 4 B must fit VMEM); a
+K-tiled variant is a follow-up if a model outgrows that.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+_HAS_RAGGED_DOT = hasattr(jax.lax, "ragged_dot")
+
+
+def _resolve_impl(impl: str) -> str:
+    if impl == "auto":
+        return "pallas" if jax.default_backend() == "tpu" else "scan"
+    if impl not in ("pallas", "scan", "ragged", "einsum"):
+        raise ValueError(f"unknown grouped_matmul impl {impl!r}; use "
+                         f"'auto', 'pallas', 'scan', 'ragged', or 'einsum'")
+    if impl == "ragged" and not _HAS_RAGGED_DOT:
+        raise ValueError("impl='ragged' needs jax.lax.ragged_dot, which this "
+                         "jax build lacks; use 'scan' (or 'auto')")
+    return impl
+
+
+# ---------------------------------------------------------------------------
+# XLA fallbacks (autodiff works through both as-is)
+# ---------------------------------------------------------------------------
+
+def _gmm_scan(lhs, rhs, group_sizes, out_dtype):
+    """scan over groups: mask the sorted rows to the group's contiguous
+    range, one dense matmul each, accumulate. O(M*(K+N)) transients — the
+    memory-safe XLA formulation (decode's no_drop path compiles through
+    this off-TPU, where ``ragged_dot`` would re-materialize the [G, M, K]
+    dense expansion)."""
+    m = lhs.shape[0]
+    ends = jnp.cumsum(group_sizes)
+    starts = ends - group_sizes
+    rows = jnp.arange(m, dtype=group_sizes.dtype)
+
+    def body(acc, inp):
+        start, end, w = inp
+        mask = (rows >= start) & (rows < end)
+        masked = jnp.where(mask[:, None], lhs, 0)
+        return acc + jnp.dot(masked, w,
+                             preferred_element_type=jnp.float32), None
+
+    acc0 = jnp.zeros((m, rhs.shape[2]), jnp.float32)
+    out, _ = jax.lax.scan(body, acc0, (starts, ends, rhs))
+    return out.astype(out_dtype)
+
+
+def _gmm_einsum(lhs, rhs, group_sizes, out_dtype):
+    """Segment-one-hot masked einsum. O(G) more FLOPs than ideal — the
+    correctness fallback, not the fast path."""
+    m, g = lhs.shape[0], rhs.shape[0]
+    ends = jnp.cumsum(group_sizes)
+    # row r belongs to group searchsorted(ends, r, 'right'); tail rows (r >=
+    # ends[-1]) resolve to G, whose one_hot row is all-zero -> zero output
+    seg = jnp.searchsorted(ends, jnp.arange(m, dtype=group_sizes.dtype),
+                           side="right")
+    onehot = jax.nn.one_hot(seg, g, dtype=lhs.dtype)
+    return jnp.einsum("mk,mg,gkn->mn", lhs, onehot, rhs,
+                      preferred_element_type=jnp.float32).astype(out_dtype)
+
+
+def _tgmm_einsum(lhs, dy, group_sizes, g, out_dtype):
+    """Transposed grouped matmul: d_rhs[g] = lhs_g^T @ dy_g -> [G, K, N]."""
+    m = lhs.shape[0]
+    ends = jnp.cumsum(group_sizes)
+    seg = jnp.searchsorted(ends, jnp.arange(m, dtype=group_sizes.dtype),
+                           side="right")
+    onehot = jax.nn.one_hot(seg, g, dtype=lhs.dtype)
+    return jnp.einsum("mg,mk,mn->gkn", onehot, lhs, dy,
+                      preferred_element_type=jnp.float32).astype(out_dtype)
+
+
+# ---------------------------------------------------------------------------
+# Pallas kernel (MegaBlocks-style work list over the sorted token axis)
+# ---------------------------------------------------------------------------
+
+def _work_list(group_sizes, m, bm, nw):
+    """Static-size (group, row-tile) work list + metadata scalars.
+
+    Groups are contiguous row ranges of the sorted buffer, so the number of
+    (group, tile) intersections is at most m_tiles + G (each group spans
+    ceil(size/bm) tiles plus at most one boundary tile) — ``nw`` is that
+    bound. Padding entries repeat the last real pair (so they trigger no
+    accumulator init/flush edges) and are masked off via ``n_valid``.
+    Enumeration is group-major; because groups tile a contiguous axis, the
+    emitted row-tile sequence is non-decreasing, which is what lets the
+    kernels treat "previous work item had a different tile/group" as the
+    accumulator edge."""
+    g = group_sizes.shape[0]
+    sizes = group_sizes.astype(jnp.int32)
+    offs = jnp.concatenate([jnp.zeros((1,), jnp.int32), jnp.cumsum(sizes)])
+    first_tile = offs[:-1] // bm
+    last_tile = jnp.maximum(offs[1:] - 1, offs[:-1]) // bm
+    spans = jnp.where(sizes > 0, last_tile - first_tile + 1, 0)
+    base = jnp.cumsum(spans)                       # inclusive
+    n_valid = base[-1]
+    w = jnp.arange(nw, dtype=jnp.int32)
+    wg_raw = jnp.searchsorted(base, w, side="right").astype(jnp.int32)
+    wg_c = jnp.minimum(wg_raw, g - 1)
+    start = base[wg_c] - spans[wg_c]               # exclusive base of group
+    wm_raw = first_tile[wg_c] + (w - start)
+    valid = w < n_valid
+    # padding repeats the last valid (group, tile) pair; all-empty input
+    # degenerates to pair (0, 0), whose contribution the valid mask kills
+    last = jnp.minimum(jnp.maximum(n_valid - 1, 0), nw - 1)
+    wg = jnp.where(valid, wg_c, wg_c[last])
+    wm = jnp.where(valid, wm_raw, wm_raw[last])
+    return offs, wg, wm, jnp.asarray(n_valid, jnp.int32)[None]
+
+
+def _gmm_kernel(offs_ref, wg_ref, wm_ref, nvalid_ref, lhs_ref, rhs_ref,
+                out_ref, acc_ref, *, bm, nw):
+    w = pl.program_id(1)
+    g = wg_ref[w]
+    mt = wm_ref[w]
+    is_first = jnp.logical_or(w == 0, wm_ref[jnp.maximum(w - 1, 0)] != mt)
+
+    @pl.when(is_first)
+    def _():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    rows = mt * bm + jax.lax.broadcasted_iota(jnp.int32, (bm,), 0)
+    member = ((rows >= offs_ref[g]) & (rows < offs_ref[g + 1])
+              & (w < nvalid_ref[0]))
+    x = jnp.where(member[:, None], lhs_ref[...], 0)
+    acc_ref[...] += jnp.dot(x, rhs_ref[0],
+                            preferred_element_type=jnp.float32)
+
+    is_last = jnp.logical_or(w == nw - 1,
+                             wm_ref[jnp.minimum(w + 1, nw - 1)] != mt)
+
+    @pl.when(is_last)
+    def _():
+        out_ref[...] = acc_ref[...].astype(out_ref.dtype)
+
+
+def _tgmm_kernel(offs_ref, wg_ref, wm_ref, nvalid_ref, lhs_ref, dy_ref,
+                 out_ref, acc_ref, *, bm, nw):
+    w = pl.program_id(1)
+    g = wg_ref[w]
+    mt = wm_ref[w]
+    is_first = jnp.logical_or(w == 0, wg_ref[jnp.maximum(w - 1, 0)] != g)
+
+    @pl.when(is_first)
+    def _():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    rows = mt * bm + jax.lax.broadcasted_iota(jnp.int32, (bm,), 0)
+    member = ((rows >= offs_ref[g]) & (rows < offs_ref[g + 1])
+              & (w < nvalid_ref[0]))
+    x = jnp.where(member[:, None], lhs_ref[...], 0)
+    acc_ref[...] += jnp.dot(x.T, dy_ref[...],
+                            preferred_element_type=jnp.float32)
+
+    is_last = jnp.logical_or(w == nw - 1,
+                             wg_ref[jnp.minimum(w + 1, nw - 1)] != g)
+
+    @pl.when(is_last)
+    def _():
+        out_ref[0] = acc_ref[...].astype(out_ref.dtype)
+
+
+def _pallas_gmm_raw(lhs, rhs, group_sizes, out_dtype, bm, bn, interpret):
+    from jax.experimental.pallas import tpu as pltpu
+
+    m, k = lhs.shape
+    g, _, n = rhs.shape
+    m_tiles = pl.cdiv(m, bm)
+    n_tiles = pl.cdiv(n, bn)
+    nw = m_tiles + g
+    offs, wg, wm, n_valid = _work_list(group_sizes, m, bm, nw)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=4,
+        grid=(n_tiles, nw),
+        in_specs=[
+            pl.BlockSpec((bm, k), lambda ni, w, offs, wg, wm, nv: (wm[w], 0)),
+            pl.BlockSpec((1, k, bn),
+                         lambda ni, w, offs, wg, wm, nv: (wg[w], 0, ni)),
+        ],
+        out_specs=pl.BlockSpec((bm, bn),
+                               lambda ni, w, offs, wg, wm, nv: (wm[w], ni)),
+        scratch_shapes=[pltpu.VMEM((bm, bn), jnp.float32)],
+    )
+    out = pl.pallas_call(
+        functools.partial(_gmm_kernel, bm=bm, nw=nw),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((m, n), out_dtype),
+        interpret=interpret,
+    )(offs, wg, wm, n_valid, lhs, rhs)
+    # row-tiles past the last group are never visited (their memory is
+    # whatever the buffer held); the contract says zeros
+    total = jnp.sum(group_sizes).astype(jnp.int32)
+    return jnp.where(jnp.arange(m, dtype=jnp.int32)[:, None] < total, out, 0)
+
+
+def _pallas_tgmm_raw(lhs, dy, group_sizes, g, out_dtype, bm, bn, interpret):
+    """d_rhs [G, K, N] = per-group lhs_g^T @ dy_g (the 'tgmm')."""
+    from jax.experimental.pallas import tpu as pltpu
+
+    m, k = lhs.shape
+    n = dy.shape[1]
+    m_tiles = pl.cdiv(m, bm)
+    n_tiles = pl.cdiv(n, bn)
+    nw = m_tiles + g
+    offs, wg, wm, n_valid = _work_list(group_sizes, m, bm, nw)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=4,
+        grid=(n_tiles, nw),
+        in_specs=[
+            pl.BlockSpec((bm, k), lambda ni, w, offs, wg, wm, nv: (wm[w], 0)),
+            pl.BlockSpec((bm, bn), lambda ni, w, offs, wg, wm, nv: (wm[w], ni)),
+        ],
+        out_specs=pl.BlockSpec((1, k, bn),
+                               lambda ni, w, offs, wg, wm, nv: (wg[w], 0, ni)),
+        scratch_shapes=[pltpu.VMEM((k, bn), jnp.float32)],
+    )
+    out = pl.pallas_call(
+        functools.partial(_tgmm_kernel, bm=bm, nw=nw),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((g, k, n), out_dtype),
+        interpret=interpret,
+    )(offs, wg, wm, n_valid, lhs, dy)
+    # empty groups own no work item, so their out block is never written
+    return jnp.where((group_sizes > 0)[:, None, None], out, 0)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _pallas_gmm(lhs, rhs, group_sizes, out_dtype, bm, bn, interpret):
+    return _pallas_gmm_raw(lhs, rhs, group_sizes, out_dtype, bm, bn, interpret)
+
+
+def _pallas_gmm_fwd(lhs, rhs, group_sizes, out_dtype, bm, bn, interpret):
+    out = _pallas_gmm_raw(lhs, rhs, group_sizes, out_dtype, bm, bn, interpret)
+    return out, (lhs, rhs, group_sizes)
+
+
+def _pallas_gmm_bwd(out_dtype, bm, bn, interpret, res, dy):
+    lhs, rhs, group_sizes = res
+    dy = dy.astype(jnp.float32)
+    # d_lhs: the same grouped matmul against rhs^T — rows outside every
+    # group get zero gradient (matching their zero primal output)
+    dlhs = _pallas_gmm_raw(dy, rhs.astype(jnp.float32).transpose(0, 2, 1),
+                           group_sizes, lhs.dtype, bm, bn, interpret)
+    drhs = _pallas_tgmm_raw(lhs.astype(jnp.float32), dy, group_sizes,
+                            rhs.shape[0], rhs.dtype, bm, bn, interpret)
+    return dlhs, drhs, None
+
+
+_pallas_gmm.defvjp(_pallas_gmm_fwd, _pallas_gmm_bwd)
+
+
+# ---------------------------------------------------------------------------
+# public entry
+# ---------------------------------------------------------------------------
+
+def grouped_matmul(
+    lhs: jnp.ndarray,          # [M, K] rows sorted by group
+    rhs: jnp.ndarray,          # [G, K, N] one matrix per group
+    group_sizes: jnp.ndarray,  # [G] int, sum <= M
+    *,
+    impl: str = "auto",
+    block_rows: int = 512,
+    block_cols: int = 512,
+    interpret: Optional[bool] = None,
+    preferred_element_type=None,
+) -> jnp.ndarray:
+    """Ragged grouped GEMM over a group-sorted row buffer -> [M, N].
+
+    ``impl``: "pallas" (Mosaic work-list kernel; ``interpret=True`` runs it
+    off-TPU for tests), "scan" (masked group-scan, O(M) memory), "ragged"
+    (``jax.lax.ragged_dot``), "einsum" (masked one-hot), or "auto" (pallas
+    on TPU, else scan). Rows at index >= ``sum(group_sizes)`` yield zeros
+    and propagate zero gradient.
+    """
+    if lhs.ndim != 2 or rhs.ndim != 3 or group_sizes.ndim != 1:
+        raise ValueError(f"grouped_matmul expects lhs [M,K], rhs [G,K,N], "
+                         f"group_sizes [G]; got {lhs.shape}, {rhs.shape}, "
+                         f"{group_sizes.shape}")
+    if lhs.shape[1] != rhs.shape[1] or rhs.shape[0] != group_sizes.shape[0]:
+        raise ValueError(f"grouped_matmul shape mismatch: lhs {lhs.shape}, "
+                         f"rhs {rhs.shape}, group_sizes {group_sizes.shape}")
+    impl = _resolve_impl(impl)
+    out_dtype = preferred_element_type or jnp.promote_types(lhs.dtype,
+                                                            rhs.dtype)
+    group_sizes = group_sizes.astype(jnp.int32)
+    if impl == "scan":
+        return _gmm_scan(lhs, rhs, group_sizes, out_dtype)
+    if impl == "ragged":
+        return jax.lax.ragged_dot(
+            lhs, rhs, group_sizes,
+            preferred_element_type=preferred_element_type)
+    if impl == "einsum":
+        return _gmm_einsum(lhs, rhs, group_sizes, out_dtype)
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    bm = min(block_rows, lhs.shape[0])
+    bn = min(block_cols, rhs.shape[2])
+    return _pallas_gmm(lhs, rhs, group_sizes, jnp.dtype(out_dtype), bm, bn,
+                       interpret)

@@ -86,8 +86,12 @@ STRATEGIES: dict[str, dict[str, Any]] = {
                    "mlp_vector": "tp",
                    "embed": "fsdp"},
     # chapter 10 (beyond the reference): MoE expert parallelism — the expert
-    # dim of stacked expert weights lives on ep; GSPMD derives the token
-    # all-to-all from the dispatch/combine einsums (models/moe.py)
+    # dim of stacked expert weights lives on ep. With moe_dispatch="dense"
+    # GSPMD derives the token all-to-all from the static capacity
+    # dispatch/combine einsums; with "ragged" (dropless sorted dispatch) the
+    # sort is data-dependent, so the Trainer threads a manual shard_map over
+    # the data axes that exchanges sorted expert groups instead
+    # (models/moe.py make_ragged_ep_dispatch) — same rules table either way
     "ep": {"experts": "ep"},
     "ep_fsdp": {"experts": "ep", "embed": "fsdp", "vocab": "fsdp"},
 }

@@ -146,6 +146,25 @@ def get_parser() -> argparse.ArgumentParser:
     parser.add_argument("--lora-targets", default="wq,wv",
                         help="comma list of adapted projections "
                              "(wq,wk,wv,wo,gate,up,down)")
+    parser.add_argument("--moe-dispatch", default=None,
+                        choices=["dense", "ragged"],
+                        help="MoE expert-dispatch backend (MoE models only): "
+                             "dense = static [E, C, D] capacity buffers "
+                             "(Switch/GShard; overflow tokens drop to the "
+                             "residual), ragged = dropless sort-based "
+                             "dispatch + grouped GEMMs over the [kT, D] "
+                             "sorted buffer (MegaBlocks) — no padding "
+                             "compute, no capacity knob, moe_dropped_frac "
+                             "identically 0. Default: the model config's "
+                             "moe_dispatch (dense)")
+    parser.add_argument("--checkpoint-full-crc", action="store_true",
+                        help="CRC32 every checkpoint file in full when "
+                             "writing integrity manifests. Default: files "
+                             "beyond a size threshold get a deterministic "
+                             "sampled CRC (head + tail + strided interior "
+                             "windows), keeping the per-save manifest cost "
+                             "bounded instead of O(checkpoint bytes) over "
+                             "the shared FS at pod scale")
     parser.add_argument("--sliding-window", default=None, type=int,
                         metavar="W",
                         help="sliding-window attention: each token attends "
@@ -245,7 +264,16 @@ def run_training(args, plan_factory: Callable, *, extra_log: Optional[dict] = No
                                     "float32": jnp.float32}[args.param_dtype]
     if getattr(args, "sliding_window", None):
         overrides["sliding_window"] = args.sliding_window
-    bundle = get_model(args.model_name, **overrides)
+    if getattr(args, "moe_dispatch", None):
+        overrides["moe_dispatch"] = args.moe_dispatch
+    try:
+        bundle = get_model(args.model_name, **overrides)
+    except TypeError as exc:
+        if "moe_dispatch" in overrides:
+            raise SystemExit(
+                f"--moe-dispatch is only valid for MoE models; "
+                f"{args.model_name!r} rejected it ({exc})")
+        raise
     cfg = bundle.config
     optimizer = OPTIMIZERS[args.optimizer](args.lr)
     lora_rank = getattr(args, "lora_rank", 0)
@@ -320,7 +348,8 @@ def run_training(args, plan_factory: Callable, *, extra_log: Optional[dict] = No
     if is_experiment:
         exp_dir = exp_dir / args.experiment_name
     io = (CheckpointIO(exp_dir, async_save=args.async_checkpoint,
-                       keep_n=getattr(args, "keep_checkpoints", 2))
+                       keep_n=getattr(args, "keep_checkpoints", 2),
+                       full_crc=getattr(args, "checkpoint_full_crc", False))
           if is_experiment else None)
 
     host_state = host_state_dict()

@@ -15,7 +15,8 @@ stages to sharding plans:
 and covers the WHOLE strategy space beyond the reference's engine:
 ``tensor_parallel``, ``pipeline_parallel`` (+ ``pp_microbatches``),
 ``context_parallel`` (+ ``context_impl``: "ring"/"ulysses"),
-``expert_parallel``, ``attn_impl``, ``loss_chunks``, and
+``expert_parallel``, ``moe_dispatch`` ("dense" capacity buffers / "ragged"
+dropless sorted dispatch, MoE models only), ``attn_impl``, ``loss_chunks``, and
 ``activation_checkpointing`` as a bool or
 ``{"enabled": true, "policy": "attn"}`` (a REMAT_POLICIES key). Storage
 precision is a named policy (``train/precision.py``): spell it
@@ -80,8 +81,19 @@ class TrainingEngine:
         import jax.numpy as jnp
 
         bf16 = config.get("bf16", {}).get("enabled", True)
-        bundle = get_model(config["model"],
-                           dtype=jnp.bfloat16 if bf16 else jnp.float32)
+        overrides = {"dtype": jnp.bfloat16 if bf16 else jnp.float32}
+        if config.get("moe_dispatch"):
+            # "dense" (capacity buffers) | "ragged" (dropless sorted dispatch
+            # + grouped GEMMs, models/moe.py) — MoE families only
+            overrides["moe_dispatch"] = config["moe_dispatch"]
+        try:
+            bundle = get_model(config["model"], **overrides)
+        except TypeError as exc:
+            if "moe_dispatch" not in overrides:
+                raise
+            raise ValueError(
+                f"moe_dispatch={config['moe_dispatch']!r} is only valid "
+                f"for MoE models; {config['model']!r} rejected it ({exc})")
 
         stage = config.get("zero_optimization", {}).get("stage", 0)
         tp = config.get("tensor_parallel", 1)

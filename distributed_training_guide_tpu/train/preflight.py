@@ -233,6 +233,35 @@ def run_preflight(trainer, *, global_batch: int, seq_length: int,
         f"{report['precision']['total_state_reduction']:.2f}x smaller "
         f"({total32_b * gib:.2f} -> {total_b * gib:.2f} GiB per device)")
 
+    cfg = trainer.bundle.config
+    if hasattr(cfg, "num_experts"):
+        # price the MoE dispatch transients per layer at this (batch, seq):
+        # dense = the [E, C, D] input + [E, C, F] inner + [E, C, D] output
+        # capacity buffers (padding included); ragged = the same three over
+        # the [kT, *] sorted buffer — the dense/ragged ratio IS the padding
+        # waste (E*C / kT), what moe_dispatch="ragged" deletes
+        import math as _math
+
+        t = global_batch * seq_length
+        k, e_cnt = cfg.experts_per_token, cfg.num_experts
+        cap = max(int(_math.ceil(cfg.capacity_factor * k * t / e_cnt)), 1)
+        itemsize = jax.numpy.dtype(cfg.dtype).itemsize
+        d_model, f_ff = cfg.hidden_size, cfg.intermediate_size
+        dense_b = e_cnt * cap * (2 * d_model + f_ff) * itemsize
+        ragged_b = k * t * (2 * d_model + f_ff) * itemsize
+        mode = getattr(cfg, "moe_dispatch", "dense")
+        report["moe_dispatch"] = {
+            "mode": mode,
+            "per_layer_dense_dispatch_bytes": dense_b,
+            "per_layer_ragged_dispatch_bytes": ragged_b,
+            "dense_over_ragged": round(dense_b / ragged_b, 2),
+        }
+        LOGGER.info(
+            f"moe dispatch '{mode}': per-layer transients dense "
+            f"{dense_b / 2**20:.0f} MiB ([E={e_cnt}, C={cap}] capacity "
+            f"buffers) vs ragged {ragged_b / 2**20:.0f} MiB ([kT={k * t}] "
+            f"sorted buffer) — {dense_b / ragged_b:.2f}x padding")
+
     if target_device is None and jax.default_backend() != "tpu":
         target_device = "v5p"  # the 405B recipe's stated target pod
     comm = comm_roofline(trainer, global_batch=global_batch,

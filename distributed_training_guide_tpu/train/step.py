@@ -185,6 +185,55 @@ class Trainer:
                 "attention logit softcapping / query_pre_attn_scalar "
                 "(Gemma-2) are not implemented under context parallelism; "
                 "use dp/fsdp/tp plans")
+        if callable(self.attn_impl) and (
+                getattr(self.bundle.config, "attn_logit_softcap", None)
+                is not None
+                or getattr(self.bundle.config, "query_pre_attn_scalar", None)
+                or getattr(self.bundle.config, "layer_windows", None)):
+            # mirror of the cp>1 check above: the callable contract carries
+            # no softcap/scale/per-layer windows, so a user-supplied
+            # attn_impl would SILENTLY drop Gemma-2's attention math at cp=1
+            raise ValueError(
+                "a user-supplied attn_impl callable cannot receive the "
+                "Gemma-2 attention extras (attn_logit_softcap / "
+                "query_pre_attn_scalar / layer_windows) — they would be "
+                "silently dropped; use attn_impl='auto' or 'xla'")
+        moe_dispatch = getattr(self.bundle.config, "moe_dispatch", None)
+        if moe_dispatch is not None:
+            from ..models.moe import MOE_DISPATCH_MODES
+
+            if moe_dispatch not in MOE_DISPATCH_MODES:
+                raise ValueError(
+                    f"unknown moe_dispatch {moe_dispatch!r}; choose from "
+                    f"{MOE_DISPATCH_MODES}")
+            if (moe_dispatch == "ragged"
+                    and self.plan.mesh.shape.get("cp", 1) > 1):
+                raise ValueError(
+                    "moe_dispatch='ragged' under context parallelism is "
+                    "not implemented (the sorted-group dispatch is manual "
+                    "over the data axes and would need cp-aware row "
+                    "layouts); use moe_dispatch='dense' or cp=1")
+            if (moe_dispatch == "ragged"
+                    and self.plan.mesh.shape.get("pp", 1) > 1):
+                # the pipeline's manual region can't nest the data-axes
+                # shard_map the ragged backend needs, and handing the
+                # data-dependent sort to GSPMD instead is exactly the
+                # replication/all-gather trap ch.10 documents
+                raise ValueError(
+                    "moe_dispatch='ragged' under pipeline parallelism is "
+                    "not implemented (the sorted-group dispatch's "
+                    "data-axes shard_map cannot nest in the pp-manual "
+                    "region); use moe_dispatch='dense' or pp=1")
+            if (moe_dispatch == "ragged"
+                    and self.plan.mesh.shape.get("tp", 1) > 1):
+                # tp plans shard gate/up/down on the mlp dim; the grouped
+                # GEMMs would need tp-aware partial sums the shard_map does
+                # not implement, and outside it the data-dependent sort
+                # lands in GSPMD auto-partitioning (the same trap as above)
+                raise ValueError(
+                    "moe_dispatch='ragged' under tensor parallelism is "
+                    "not implemented (grouped GEMMs over mlp-sharded "
+                    "expert weights); use moe_dispatch='dense' or tp=1")
         if self.offload_opt_state or self.offload_params:
             kinds = {m.kind for m in jax.local_devices()[0].addressable_memories()}
             if "pinned_host" not in kinds:
@@ -470,6 +519,24 @@ class Trainer:
             apply_aux = self.bundle.apply_with_aux
             aux_coef = getattr(cfg, "router_aux_coef", 0.0)
             extra_keys = ("moe_dropped_frac",)
+            # ragged dropless dispatch on a sharded mesh: the sorted-group
+            # dispatch runs in a manual shard_map over the data axes (GSPMD
+            # cannot partition the data-dependent sort the way it does the
+            # dense path's static capacity einsums), built once here against
+            # the plan's mesh and threaded to every layer. ep > 1 adds the
+            # gather/reduce-scatter group exchange; plain dp/fsdp meshes get
+            # a collective-free local body. None on single-shard meshes.
+            moe_ep = None
+            if (getattr(cfg, "moe_dispatch", "dense") == "ragged"
+                    and self.plan.mesh.shape.get("pp", 1) == 1):
+                from ..models.moe import make_ragged_ep_dispatch
+
+                embed_axis = (self.plan.rules.get("embed")
+                              if self.plan.mesh.shape.get("fsdp", 1) > 1
+                              else None)
+                moe_ep = make_ragged_ep_dispatch(
+                    self.plan.mesh, cfg, data_axes=self.plan.data_axes,
+                    embed_axis=embed_axis)
 
             def loss_on_microbatch(params, mb):
                 out, aux, moe_metrics = apply_aux(
@@ -478,7 +545,7 @@ class Trainer:
                     remat=self.remat, remat_policy=policy,
                     attn_impl=attn_impl,
                     activation_sharding=act_sharding, return_metrics=True,
-                    return_hidden=chunked_ce is not None)
+                    return_hidden=chunked_ce is not None, moe_ep=moe_ep)
                 if chunked_ce is not None:
                     ce = chunked_ce(params, out, mb["labels"])
                 else:

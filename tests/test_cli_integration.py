@@ -344,7 +344,31 @@ def test_engine_full_strategy_space(tmp_path, eight_devices):
                     "pipeline_parallel": 2})
 
 
+def test_engine_moe_dispatch_key(eight_devices):
+    """Top-level moe_dispatch threads to the model config and trains (the
+    dp-sharded ragged path runs in the manual shard_map); non-MoE models
+    reject the key loudly."""
+    import jax.numpy as jnp
+
+    from distributed_training_guide_tpu.train.engine import initialize
+
+    engine = initialize({"model": "moe-debug", "moe_dispatch": "ragged",
+                         "bf16": {"enabled": False}})
+    assert engine.trainer.bundle.config.moe_dispatch == "ragged"
+    ids = np.random.RandomState(0).randint(0, 512, (8, 16))
+    batch_sh = engine.trainer.batch_shardings()
+    batch = {k: jax.device_put(ids, batch_sh[k])
+             for k in ("input_ids", "labels")}
+    m = engine.train_batch(batch)
+    assert np.isfinite(float(m["loss"]))
+    assert float(m["moe_dropped_frac"]) == 0.0
+    with pytest.raises(ValueError, match="moe_dispatch"):
+        initialize({"model": "llama-debug", "moe_dispatch": "ragged"})
+
+
 def test_preflight_budget_and_lowering(eight_devices):
+    import jax.numpy as jnp
+
     from distributed_training_guide_tpu.models import get_model
     from distributed_training_guide_tpu.parallel import make_mesh, make_plan
     from distributed_training_guide_tpu.train import Trainer, adamw_cosine
@@ -355,6 +379,23 @@ def test_preflight_budget_and_lowering(eight_devices):
                 plan=make_plan("fsdp", make_mesh(fsdp=8)), donate=False)
     rep = run_preflight(t, global_batch=8, seq_length=64)
     assert rep["lowered"] and rep["n_devices"] == 8
+    assert "moe_dispatch" not in rep   # dense families aren't priced
+
+    # MoE configs get the dispatch-transient pricing (dense-vs-ragged bytes)
+    moe_t = Trainer(bundle=get_model("moe-debug", dtype=jnp.float32),
+                    optimizer=adamw_cosine(1e-3),
+                    plan=make_plan("ep", make_mesh(ep=8)), donate=False)
+    moe_rep = run_preflight(moe_t, global_batch=8, seq_length=64)
+    md = moe_rep["moe_dispatch"]
+    cfg = moe_t.bundle.config
+    t_tok, k = 8 * 64, cfg.experts_per_token
+    assert md["mode"] == "dense"
+    assert md["per_layer_ragged_dispatch_bytes"] == (
+        k * t_tok * (2 * cfg.hidden_size + cfg.intermediate_size) * 4)
+    assert md["per_layer_dense_dispatch_bytes"] > 0
+    assert md["dense_over_ragged"] == pytest.approx(
+        md["per_layer_dense_dispatch_bytes"]
+        / md["per_layer_ragged_dispatch_bytes"], rel=0.01)
 
     total_param_bytes = sum(
         np.prod(l.shape) * l.dtype.itemsize

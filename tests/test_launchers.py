@@ -6,6 +6,8 @@ test_multiprocess.py; these pin the launcher mechanics themselves, including
 paths the integration tests can't reach (launcher crash mid-spawn, heartbeat
 kill).
 """
+import json
+import os
 import subprocess
 import sys
 import time
@@ -201,6 +203,41 @@ def test_supervisor_poison_in_foreign_error_file_shape(tmp_path):
                         restart_backoff=0.05)
     assert rc == 1
     assert not (tmp_path / "logs" / "attempt_1").exists()   # stopped cleanly
+
+
+def test_supervisor_ignores_stale_preset_error_file(tmp_path, monkeypatch):
+    """An operator-preset $ERROR_FILE left over from a PREVIOUS incarnation
+    (poison payload already on disk before launch) must not classify: the
+    supervisor unlinks it before starting the worker, and mtime-fences any
+    survivor against the launch time — a crashing-but-transient worker
+    keeps its restart budget."""
+    stale = tmp_path / "err.json"
+    stale.write_text(json.dumps({"message": {
+        "error": "XlaRuntimeError('RESOURCE_EXHAUSTED: OOM from last week')"}}))
+    monkeypatch.setenv("ERROR_FILE", str(stale))
+    # worker fails WITHOUT writing an error file -> with the stale file
+    # fenced there is no poison verdict, so the supervisor must restart
+    rc = run_supervised([PY, "-c", "import sys; sys.exit(1)"],
+                        max_restarts=1, log_dir=tmp_path / "logs",
+                        restart_backoff=0.05)
+    assert rc == 1
+    assert (tmp_path / "logs" / "attempt_1").is_dir()   # restart happened
+    assert not stale.exists()                           # fence unlinked it
+
+
+def test_supervisor_mtime_fence_without_unlink(tmp_path):
+    """The mtime fence alone (unlink defeated) must also ignore a stale
+    payload: backdate a poison error file past the launch slack and check
+    classification skips it."""
+    from distributed_training_guide_tpu.launch.supervisor import _poison_reason
+
+    err = tmp_path / "error.json"
+    err.write_text(json.dumps({"message": {
+        "error": "RESOURCE_EXHAUSTED: out of memory"}}))
+    old = time.time() - 3600
+    os.utime(err, (old, old))
+    assert _poison_reason(err, launched_at=time.time()) is None
+    assert _poison_reason(err, launched_at=old - 10) is not None
 
 
 def test_supervisor_transient_error_file_still_restarts(tmp_path):

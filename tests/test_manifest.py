@@ -73,6 +73,60 @@ def test_load_manifest_absent_or_garbage(tmp_path):
     assert load_manifest(tmp_path, "checkpoint-9") is None
 
 
+def test_sampled_crc_over_threshold(tmp_path, monkeypatch):
+    """Files beyond SAMPLE_THRESHOLD get a size-capped sampled CRC (head +
+    tail + strided interior windows) that still catches truncation and
+    head/tail corruption; --checkpoint-full-crc restores the full scan."""
+    from distributed_training_guide_tpu.checkpoint import manifest as mmod
+
+    monkeypatch.setattr(mmod, "SAMPLE_THRESHOLD", 4096)
+    d = tmp_path / "checkpoint-1"
+    d.mkdir()
+    big = bytes(range(256)) * 64          # 16 KiB > patched threshold
+    (d / "big.bin").write_bytes(big)
+    (d / "small.bin").write_bytes(b"tiny")
+    write_manifest(d, 1, {"global_step": 1})
+    man = load_manifest(tmp_path, "checkpoint-1")
+    entries = {f["path"]: f for f in man["files"]}
+    assert entries["big.bin"].get("crc_mode") == "sampled"
+    assert 0 < entries["big.bin"]["sampled_bytes"] <= len(big)
+    assert "crc_mode" not in entries["small.bin"]   # small files: full CRC
+    assert verify_manifest(d, man) == []
+
+    # head corruption is inside the first sampled window -> caught
+    raw = bytearray(big)
+    raw[0] ^= 0xFF
+    (d / "big.bin").write_bytes(bytes(raw))
+    assert any("checksum mismatch: big.bin" in p for p in verify_manifest(d, man))
+    # tail corruption -> caught (last window is always sampled)
+    raw = bytearray(big)
+    raw[-1] ^= 0xFF
+    (d / "big.bin").write_bytes(bytes(raw))
+    assert any("checksum mismatch: big.bin" in p for p in verify_manifest(d, man))
+    # truncation -> size mismatch, no CRC needed
+    (d / "big.bin").write_bytes(big[:-10])
+    assert any("size mismatch: big.bin" in p for p in verify_manifest(d, man))
+
+    # full_crc: every entry exhaustive regardless of size
+    (d / "big.bin").write_bytes(big)
+    write_manifest(d, 1, {"global_step": 1}, full_crc=True)
+    man_full = load_manifest(tmp_path, "checkpoint-1")
+    assert all("crc_mode" not in f for f in man_full["files"])
+    assert verify_manifest(d, man_full) == []
+
+
+def test_sampled_crc_offsets_deterministic_in_size():
+    """Verification must recompute the exact byte set from the recorded
+    size alone — the offset schedule is a pure function of the size."""
+    from distributed_training_guide_tpu.checkpoint.manifest import _sample_offsets
+
+    for size in (1, 100, 1 << 20, (64 << 20) + 12345, 5 << 30):
+        offs = _sample_offsets(size)
+        assert offs == _sample_offsets(size)
+        assert offs[0] == 0 and offs[-1] == max(size - (1 << 20), 0)
+        assert all(0 <= o <= max(size - 1, 0) or o == 0 for o in offs)
+
+
 # ---- retention + fallback ---------------------------------------------------
 
 def test_keep_n_retention_chain(tmp_path):

@@ -80,6 +80,11 @@ def test_window_requires_causal():
     q = jnp.zeros((1, 8, 2, 64))
     with pytest.raises(ValueError, match="requires causal"):
         flash_attention(q, q, q, causal=False, window=4, interpret=True)
+    # the dispatcher must fail loudly on BOTH paths: the xla path used to
+    # silently IGNORE the window when causal=False (advisor round 5)
+    for impl in ("xla", "flash", "auto"):
+        with pytest.raises(ValueError, match="requires causal"):
+            multihead_attention(q, q, q, causal=False, window=4, impl=impl)
 
 
 def test_xla_swa_with_explicit_positions():
@@ -196,6 +201,26 @@ def test_cp_rejects_gemma2_attention_extras():
     plan = make_plan("ddp", make_mesh(cp=2, devices=jax.devices()[:2]))
     with pytest.raises(ValueError, match="softcapping"):
         Trainer(bundle=bundle, optimizer=adamw_cosine(1e-4), plan=plan)
+
+
+def test_callable_attn_impl_rejects_gemma2_attention_extras():
+    """Mirror of the cp>1 check at cp=1: a user-supplied *callable*
+    attn_impl carries no softcap/scale/layer_windows, so Gemma-2 extras
+    would be silently dropped — the Trainer must reject the combination at
+    build time (advisor round 5)."""
+    from distributed_training_guide_tpu.models import get_model
+    from distributed_training_guide_tpu.train import Trainer, adamw_cosine
+
+    def custom_attn(q, k, v, **kw):  # pragma: no cover — never reached
+        return q
+
+    bundle = get_model("llama-debug", attn_logit_softcap=50.0)
+    with pytest.raises(ValueError, match="user-supplied attn_impl"):
+        Trainer(bundle=bundle, optimizer=adamw_cosine(1e-4),
+                attn_impl=custom_attn)
+    # plain configs keep accepting callables (the supported extension point)
+    Trainer(bundle=get_model("llama-debug"), optimizer=adamw_cosine(1e-4),
+            attn_impl=custom_attn)
 
 
 def test_swa_train_step_and_ulysses_compose():
