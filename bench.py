@@ -426,6 +426,45 @@ def run_flash_check() -> None:
     _emit(results)
 
 
+def run_decode_check() -> None:
+    """Serving rung: decode tokens/sec through the continuous-batching
+    paged-KV engine (serve/) at n_slots 1 and 8 on llama-debug — the
+    inference trajectory recorded next to the training MFU rungs. The
+    1-slot number is the latency-style floor; 8 slots shows what
+    iteration-level batching buys at full occupancy."""
+    _configure_jax_cache()
+    import jax
+    import jax.numpy as jnp
+
+    from distributed_training_guide_tpu.models import get_model
+    from distributed_training_guide_tpu.serve.api import (generate_many,
+                                                          throughput_stats)
+    from distributed_training_guide_tpu.serve.engine import ServeEngine
+    from distributed_training_guide_tpu.serve.scheduler import Request
+
+    bundle = get_model("llama-debug", dtype=jnp.float32)
+    params = bundle.init(bundle.config, jax.random.key(0))
+    out = {"metric": "decode_tput", "model": "llama-debug",
+           "unit": "tokens_per_s", "value": 0.0}
+    for n_slots in (1, 8):
+        engine = ServeEngine(bundle, params, n_slots=n_slots, page_size=16,
+                             max_len=128)
+        # compile outside the timed window, then zero the step counters so
+        # occupancy reflects only the measured batch
+        generate_many(engine, [Request(prompt_ids=[3, 17, 42],
+                                       max_new_tokens=4)])
+        engine.decode_steps = engine.decode_tokens = 0
+        reqs = [Request(prompt_ids=[3 + i, 17, 42], max_new_tokens=64,
+                        seed=i) for i in range(8)]
+        t0 = time.perf_counter()
+        results = generate_many(engine, reqs)
+        stats = throughput_stats(results, time.perf_counter() - t0, engine)
+        out[f"slots{n_slots}"] = stats
+        out["value"] = stats["tokens_per_s"]   # headline: the last (8-slot)
+        _emit({**out, "partial": True})        # survives a stall mid-check
+    _emit(out)
+
+
 # ---------------------------------------------------------------------------
 # parent: ladder orchestration (never touches the TPU itself)
 # ---------------------------------------------------------------------------
@@ -890,6 +929,7 @@ def main() -> None:
     parser.add_argument("--rung", default=None, help=argparse.SUPPRESS)
     parser.add_argument("--probe", action="store_true", help=argparse.SUPPRESS)
     parser.add_argument("--check-flash", action="store_true", help=argparse.SUPPRESS)
+    parser.add_argument("--check-decode", action="store_true", help=argparse.SUPPRESS)
     args = parser.parse_args()
     if args.remat is False and args.remat_policy:
         parser.error("--no-remat contradicts --remat-policy "
@@ -901,6 +941,8 @@ def main() -> None:
         return run_probe()
     if args.check_flash:
         return run_flash_check()
+    if args.check_decode:
+        return run_decode_check()
     if args.sweep:
         return run_sweep(args.watchdog)
 
@@ -1114,6 +1156,16 @@ def main() -> None:
             else:
                 _save_flash_good(record, final.get("detail", {}).get("device"))
             final["detail"]["flash_check"] = record
+    # serving rung (any platform — llama-debug): decode tokens/sec at
+    # n_slots 1 vs 8 through serve/'s paged engine, recorded beside the
+    # training rungs so the BENCH_*.json history tracks inference too
+    remaining = deadline - time.time()
+    if remaining > 60:
+        dec, kind = _run_child(["--check-decode"], budget=min(300, remaining))
+        record = dec[-1] if dec else {}
+        if kind != "ok":
+            record = {**record, "error": kind}
+        final["detail"]["decode_tput"] = record
     _Best.result = dict(final)
     _Best.emitted = True
     _emit(_attach_last_good(final))

@@ -247,7 +247,7 @@ def attention_sublayer(config, x: jnp.ndarray, attn_params: dict, norm_scale,
                        standard_layout: bool = True,
                        tp_axis: Optional[str] = None,
                        kv_cache=None, return_kv: bool = False,
-                       window_override=None):
+                       window_override=None, attend_override=None):
     """norm -> rope'd GQA attention -> output proj (residual added by caller).
 
     Shared by the dense Llama block and the MoE family (config is duck-typed:
@@ -265,7 +265,15 @@ def attention_sublayer(config, x: jnp.ndarray, attn_params: dict, norm_scale,
     kv_positions keep the causal mask exact; zero rows beyond ``pos`` are
     masked out by it). ``return_kv=True`` additionally returns the (rope'd,
     possibly cache-merged) k/v. Both default off — the training path is
-    untouched."""
+    untouched.
+
+    ``attend_override`` (the serving engine's paged-KV hook): a callable
+    ``(q, k, v, *, window, scale, softcap) -> (attn, aux)`` replacing the
+    cache merge + attend entirely — it receives the rope'd/normed per-head
+    projections and the family-resolved attention extras, and whatever
+    functional cache state it updates rides back through ``aux`` (returned
+    in place of (k, v) when ``return_kv``). Mutually exclusive with
+    ``kv_cache``."""
     b, s, e = x.shape
     d = config.head_size
     cdt = config.dtype
@@ -311,6 +319,13 @@ def attention_sublayer(config, x: jnp.ndarray, attn_params: dict, norm_scale,
     qpas = getattr(config, "query_pre_attn_scalar", None)
     attn_scale = (qpas ** -0.5) if qpas else None
     softcap = getattr(config, "attn_logit_softcap", None)
+    if attend_override is not None:
+        attn, aux = attend_override(q, k, v, window=window, scale=attn_scale,
+                                    softcap=softcap)
+        out = attn.reshape(b, s, -1) @ attn_params["wo"].astype(cdt)
+        if tp_axis is not None:
+            out = _psum(out, tp_axis)
+        return (out, aux) if return_kv else out
     if kv_cache is not None:
         ck, cv, pos = kv_cache
         k = jax.lax.dynamic_update_slice(ck, k.astype(ck.dtype), (0, pos, 0, 0))
@@ -555,9 +570,11 @@ def init_cache(config: LlamaConfig, batch: int, max_len: int) -> dict:
 
 
 def prefill(config: LlamaConfig, params: dict, input_ids: jnp.ndarray,
-            cache: dict):
+            cache: dict, last_pos=None):
     """Causal forward over the prompt, writing each layer's rope'd k/v into
-    cache[:, :, :prompt_len]. Returns (last-position logits [B, V], cache)."""
+    cache[:, :, :prompt_len]. Returns (logits [B, V] at ``last_pos`` —
+    default the final position; the serving engine pads prompts to a bucket
+    and passes the real last index as a traced scalar — and the cache)."""
     b, p = input_ids.shape
     positions = jnp.broadcast_to(jnp.arange(p)[None, :], (b, p))
     x = embed_tokens(config, params, input_ids, positions)
@@ -584,7 +601,9 @@ def prefill(config: LlamaConfig, params: dict, input_ids: jnp.ndarray,
     # slice BEFORE the head: projecting all P positions to [B, P, V] fp32
     # only to keep one row would cost P x the lm_head matmul and a
     # prompt-length-scaled logits buffer (norm + projection are per-position)
-    return (lm_head_logits(config, params, x[:, -1:])[:, 0],
+    x_last = (x[:, -1:] if last_pos is None
+              else jax.lax.dynamic_slice_in_dim(x, last_pos, 1, axis=1))
+    return (lm_head_logits(config, params, x_last)[:, 0],
             {"k": ks, "v": vs})
 
 
@@ -607,6 +626,48 @@ def decode_step(config: LlamaConfig, params: dict, token_ids: jnp.ndarray,
             "xla", kv_cache=(ck, cv, pos), return_kv=True, window_override=w)
         x, _ = _decode_residuals(config, x, layer, attn)
         return x, (nk, nv)
+
+    if wins is None:
+        body_fn = lambda x, inp: body(x, (*inp, None))
+        xs = (params["layers"], cache["k"], cache["v"])
+    else:
+        body_fn, xs = body, (params["layers"], cache["k"], cache["v"], wins)
+    x, (ks, vs) = jax.lax.scan(body_fn, x, xs)
+    return lm_head_logits(config, params, x)[:, -1], {"k": ks, "v": vs}
+
+
+def paged_decode_step(config: LlamaConfig, params: dict,
+                      token_ids: jnp.ndarray, positions: jnp.ndarray,
+                      cache: dict, attend):
+    """One decode step over a PAGED multi-request cache (serve/engine.py):
+    ``token_ids`` [S, 1] are each slot's current token at PER-SLOT position
+    ``positions`` [S] (the contiguous-cache ``decode_step`` shares one
+    scalar ``pos`` across the batch — useless for continuous batching).
+    ``cache`` holds the page pools ``{"k","v"}: [L, n_pages, page, kvh, hd]``
+    and ``attend(q, k, v, kp, vp, *, window, scale, softcap)`` (built by
+    serve/kv_pages.py) scatters the new k/v into the layer's pages and
+    attends each slot over its own block table. Returns
+    (logits [S, V], updated cache)."""
+    s = token_ids.shape[0]
+    pos2d = jnp.broadcast_to(positions[:, None], (s, 1))
+    x = embed_tokens(config, params, token_ids, pos2d)
+
+    wins = _layer_window_column(config)
+
+    def body(x, inputs):
+        layer, kp, vp, w = inputs
+
+        def override(q, k, v, *, window, scale, softcap):
+            return attend(q, k, v, kp, vp, window=window, scale=scale,
+                          softcap=softcap)
+
+        attn, (nkp, nvp) = attention_sublayer(
+            config, x, layer["attn"],
+            None if config.post_norm else layer["input_norm"], pos2d,
+            "xla", return_kv=True, window_override=w,
+            attend_override=override)
+        x, _ = _decode_residuals(config, x, layer, attn)
+        return x, (nkp, nvp)
 
     if wins is None:
         body_fn = lambda x, inp: body(x, (*inp, None))

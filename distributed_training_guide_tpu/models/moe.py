@@ -655,9 +655,10 @@ init_cache = llama.init_cache
 
 
 def prefill(config: MoELlamaConfig, params: dict, input_ids: jnp.ndarray,
-            cache: dict):
+            cache: dict, last_pos=None):
     """Causal forward over the prompt, writing each layer's rope'd k/v into
-    the cache. Returns (last-position logits [B, V], cache)."""
+    the cache. Returns (logits [B, V] at ``last_pos``, default final
+    position, and the cache)."""
     b, p = input_ids.shape
     positions = jnp.broadcast_to(jnp.arange(p)[None, :], (b, p))
     x = embed_tokens(config, params, input_ids, positions)
@@ -679,7 +680,9 @@ def prefill(config: MoELlamaConfig, params: dict, input_ids: jnp.ndarray,
                                          cache["k"], cache["v"]))
     # slice BEFORE the head (llama.prefill rationale: don't project all P
     # positions to [B, P, V] fp32 to keep one row)
-    return (lm_head_logits(config, params, x[:, -1:])[:, 0],
+    x_last = (x[:, -1:] if last_pos is None
+              else jax.lax.dynamic_slice_in_dim(x, last_pos, 1, axis=1))
+    return (lm_head_logits(config, params, x_last)[:, 0],
             {"k": ks, "v": vs})
 
 
@@ -702,6 +705,38 @@ def decode_step(config: MoELlamaConfig, params: dict, token_ids: jnp.ndarray,
         y, _, _ = _moe_ffn(config, h, layer["moe"], no_drop=True)
         x = x + y
         return x, (nk, nv)
+
+    x, (ks, vs) = jax.lax.scan(body, x, (params["layers"],
+                                         cache["k"], cache["v"]))
+    return lm_head_logits(config, params, x)[:, -1], {"k": ks, "v": vs}
+
+
+def paged_decode_step(config: MoELlamaConfig, params: dict,
+                      token_ids: jnp.ndarray, positions: jnp.ndarray,
+                      cache: dict, attend):
+    """Paged multi-request decode step (llama.paged_decode_step contract):
+    the routed FFN runs drop-free (ragged backend) on the [S, 1] decoded
+    tokens — per-token routing is independent of the co-resident slots, so
+    continuous batching cannot perturb a request's expert choices."""
+    s = token_ids.shape[0]
+    pos2d = jnp.broadcast_to(positions[:, None], (s, 1))
+    x = embed_tokens(config, params, token_ids, pos2d)
+
+    def body(x, inputs):
+        layer, kp, vp = inputs
+
+        def override(q, k, v, *, window, scale, softcap):
+            return attend(q, k, v, kp, vp, window=window, scale=scale,
+                          softcap=softcap)
+
+        attn, (nkp, nvp) = attention_sublayer(
+            config, x, layer["attn"], layer["input_norm"], pos2d,
+            "xla", return_kv=True, attend_override=override)
+        x = x + attn
+        h = _rmsnorm(x, layer["post_attn_norm"], config.rms_norm_eps)
+        y, _, _ = _moe_ffn(config, h, layer["moe"], no_drop=True)
+        x = x + y
+        return x, (nkp, nvp)
 
     x, (ks, vs) = jax.lax.scan(body, x, (params["layers"],
                                          cache["k"], cache["v"]))

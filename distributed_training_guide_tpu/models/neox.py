@@ -162,10 +162,12 @@ def _rope_partial(x: jnp.ndarray, positions: jnp.ndarray, config) -> jnp.ndarray
 
 
 def _attn_branch(config, y, layer, positions, attn_impl,
-                 standard_layout=True, kv_cache=None, return_kv=False):
+                 standard_layout=True, kv_cache=None, return_kv=False,
+                 attend_override=None):
     """ln'd input -> fused QKV -> partial rope -> attention -> out proj
     (no residual, no psum — the block owns those). ``kv_cache``/
-    ``return_kv`` follow llama.attention_sublayer's decode contract."""
+    ``return_kv``/``attend_override`` follow llama.attention_sublayer's
+    decode contract."""
     b, s, e = y.shape
     d = config.head_size
     cdt = config.dtype
@@ -179,6 +181,11 @@ def _attn_branch(config, y, layer, positions, attn_impl,
     v = qkv[:, :, 2].reshape(b, s, h_loc, d)
     q = _rope_partial(q, positions, config)
     k = _rope_partial(k, positions, config)
+    if attend_override is not None:
+        attn, aux = attend_override(q, k, v, window=None, scale=None,
+                                    softcap=None)
+        out = attn.reshape(b, s, e_loc) @ layer["attn"]["wo"].astype(cdt)
+        return (out, aux) if return_kv else out
     if kv_cache is not None:
         ck, cv, pos = kv_cache
         k = jax.lax.dynamic_update_slice(ck, k.astype(ck.dtype), (0, pos, 0, 0))
@@ -338,14 +345,14 @@ def init_cache(config: NeoXConfig, batch: int, max_len: int) -> dict:
             "v": jnp.zeros(shape, config.dtype)}
 
 
-def _cached_block(config, x, layer, positions, kv_cache):
+def _cached_block(config, x, layer, positions, kv_cache, attend_override=None):
     """Parallel- or sequential-residual block through the cache path;
     returns (x, (k, v))."""
     eps = config.layer_norm_eps
     cdt = config.dtype
     attn, kv = _attn_branch(config, _layernorm(x, layer["ln1"], eps),
                             layer, positions, "xla", kv_cache=kv_cache,
-                            return_kv=True)
+                            return_kv=True, attend_override=attend_override)
     if config.use_parallel_residual:
         update = attn + _mlp_branch(config, _layernorm(x, layer["ln2"], eps),
                                     layer)
@@ -358,9 +365,10 @@ def _cached_block(config, x, layer, positions, kv_cache):
 
 
 def prefill(config: NeoXConfig, params: dict, input_ids: jnp.ndarray,
-            cache: dict):
+            cache: dict, last_pos=None):
     """Causal forward over the prompt, filling cache[:, :, :prompt_len];
-    returns (last-position logits [B, V], cache)."""
+    returns (logits [B, V] at ``last_pos``, default final position, and the
+    cache)."""
     b, p = input_ids.shape
     positions = jnp.broadcast_to(jnp.arange(p)[None, :], (b, p))
     x = embed_tokens(config, params, input_ids, positions)
@@ -374,7 +382,9 @@ def prefill(config: NeoXConfig, params: dict, input_ids: jnp.ndarray,
 
     x, (ks, vs) = jax.lax.scan(body, x, (params["layers"],
                                          cache["k"], cache["v"]))
-    return (lm_head_logits(config, params, x[:, -1:])[:, 0],
+    x_last = (x[:, -1:] if last_pos is None
+              else jax.lax.dynamic_slice_in_dim(x, last_pos, 1, axis=1))
+    return (lm_head_logits(config, params, x_last)[:, 0],
             {"k": ks, "v": vs})
 
 
@@ -391,6 +401,31 @@ def decode_step(config: NeoXConfig, params: dict, token_ids: jnp.ndarray,
         x, (nk, nv) = _cached_block(config, x, layer, positions,
                                     (ck, cv, pos))
         return x, (nk, nv)
+
+    x, (ks, vs) = jax.lax.scan(body, x, (params["layers"],
+                                         cache["k"], cache["v"]))
+    return lm_head_logits(config, params, x)[:, -1], {"k": ks, "v": vs}
+
+
+def paged_decode_step(config: NeoXConfig, params: dict,
+                      token_ids: jnp.ndarray, positions: jnp.ndarray,
+                      cache: dict, attend):
+    """Paged multi-request decode step (llama.paged_decode_step contract)
+    through ``_cached_block`` — the same parallel-/sequential-residual body
+    the contiguous decode runs."""
+    s = token_ids.shape[0]
+    pos2d = jnp.broadcast_to(positions[:, None], (s, 1))
+    x = embed_tokens(config, params, token_ids, pos2d)
+
+    def body(x, inputs):
+        layer, kp, vp = inputs
+
+        def override(q, k, v, *, window, scale, softcap):
+            del window, scale, softcap  # no neox attention extras
+            return attend(q, k, v, kp, vp)
+
+        return _cached_block(config, x, layer, pos2d, None,
+                             attend_override=override)
 
     x, (ks, vs) = jax.lax.scan(body, x, (params["layers"],
                                          cache["k"], cache["v"]))

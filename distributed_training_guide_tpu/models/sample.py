@@ -1,14 +1,14 @@
 """Minimal text sampling from any model in the zoo — a qualitative check
 for trained / converted checkpoints.
 
-Neither the reference nor this guide is an inference framework; this is
-the smallest honest sampler. Default mode re-runs the FULL forward over a
-fixed-size buffer per token (any family, one compile); ``--kv-cache``
-switches to prefill + single-token decode steps over a functional KV
-cache carried through the layer scan (the llama family incl. qwen3/
-olmo2/gemma2 wirings, gpt2, neox, and moe — the routed FFN runs
-drop-free per decoded token; same tokens, pinned per family by test). Either way: a qualitative check for checkpoints, not a
-serving path.
+Default mode re-runs the FULL forward over a fixed-size buffer per token
+(any family, one compile) — the hermetic numerics reference. ``--kv-cache``
+delegates to the serving runtime (``serve/``): the continuous-batching
+paged-KV engine at n_slots=1 — prefill + cached one-token decode steps for
+the llama family incl. qwen3/olmo2/gemma2 wirings, gpt2, neox, and moe
+(routed FFN drop-free per decoded token; same greedy tokens, pinned per
+family by test). The real serving path (multi-request, HTTP) lives at
+``python -m distributed_training_guide_tpu.serve``.
 
     # hermetic (no tokenizer): raw token ids in, ids out
     python -m distributed_training_guide_tpu.models.sample \\
@@ -33,9 +33,13 @@ def make_sampler(bundle, temperature: float = 0.0, kv_cache: bool = False):
       fixed buffer and the token at ``pos`` is written — O(steps x
       forward(prompt+steps));
     - ``kv_cache=True`` (families exporting ``init_cache``/``prefill``/
-      ``decode_step`` — the llama family, gpt2, neox, moe): one prefill over the
-      prompt, then one single-token program per step attending over the
-      cache — O(forward(prompt) + steps x token).
+      ``paged_decode_step`` — the llama family, gpt2, neox, moe): the
+      serving engine (serve/engine.py) at n_slots=1 — one bucketed prefill
+      over the prompt, then one single-token program per step attending
+      over the paged cache — O(forward(prompt) + steps x token). Same
+      greedy tokens as recompute (pinned per family by tests/test_sample.py);
+      at temperature > 0 draws come from the engine's per-request
+      fold_in(seed, position) stream (deterministic in ``rng``).
 
     Greedy when ``temperature == 0`` (a Python constant — each mode is its
     own single compile)."""
@@ -64,28 +68,32 @@ def make_sampler(bundle, temperature: float = 0.0, kv_cache: bool = False):
         if not hasattr(mod, "decode_step"):
             raise ValueError(f"family {bundle.family!r} has no KV-cached "
                              f"decode; use kv_cache=False")
-        prefill_j = jax.jit(partial(mod.prefill, bundle.config))
-        step_j = jax.jit(partial(mod.decode_step, bundle.config),
-                         donate_argnums=(3,))
+        engines: dict = {}
 
         def sample(params, prompt_ids, steps: int,
                    rng: Optional[jax.Array] = None) -> list[int]:
+            from ..serve.api import generate_many
+            from ..serve.engine import ServeEngine
+            from ..serve.scheduler import Request
+
             rng = rng if rng is not None else jax.random.key(0)
             n = len(prompt_ids)
             check_length(n, steps)
-            cache = mod.init_cache(bundle.config, 1, n + steps)
-            ids = jnp.asarray(prompt_ids, jnp.int32)[None, :]
-            logit, cache = prefill_j(params, ids, cache)
-            out = list(prompt_ids)
-            for t in range(n, n + steps):
-                rng, key = jax.random.split(rng)
-                nxt = pick(logit[0], key)
-                out.append(int(nxt))
-                if t + 1 == n + steps:
-                    break
-                logit, cache = step_j(params, nxt.astype(jnp.int32)[None, None],
-                                      jnp.asarray(t), cache)
-            return out
+            page = 16
+            capacity = -(-(n + steps) // page) * page
+            # one engine (== one compiled prefill/decode pair) per page-
+            # rounded capacity; the engine holds its params so the id key
+            # stays pinned to the live object
+            eng = engines.get((id(params), capacity))
+            if eng is None:
+                eng = ServeEngine(bundle, params, n_slots=1, page_size=page,
+                                  max_len=capacity)
+                engines[(id(params), capacity)] = eng
+            seed = int(jax.random.randint(rng, (), 0, 2**31 - 1))
+            res = generate_many(eng, [Request(
+                prompt_ids=[int(t) for t in prompt_ids],
+                max_new_tokens=steps, temperature=temperature, seed=seed)])
+            return res[0].token_ids
 
         return sample
 
@@ -150,13 +158,8 @@ def main(argv=None) -> None:
     else:
         prompt_ids = [int(t) for t in args.prompt_ids.split(",")]
 
-    max_pos = getattr(bundle.config, "max_position_embeddings", None)
-    if max_pos and len(prompt_ids) + args.steps > max_pos:
-        # gpt2's learned table clamps out-of-range positions under jit —
-        # silent garbage, so refuse instead
-        raise SystemExit(
-            f"prompt ({len(prompt_ids)}) + steps ({args.steps}) exceeds the "
-            f"model's max_position_embeddings ({max_pos})")
+    # over-long generations are refused by check_length inside the sampler
+    # (the library guard) — no CLI copy to drift out of sync
 
     if args.pretrained:
         from .hf_convert import load_pretrained

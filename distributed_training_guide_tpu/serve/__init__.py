@@ -1,0 +1,29 @@
+"""Serving runtime: continuous-batching decode engine + paged KV cache.
+
+The training side of this repo ends at checkpoints; this package is the
+inference side — iteration-level (Orca) scheduling over a block-table
+paged (vLLM/PagedAttention) KV cache, reusing each model family's
+``init_cache``/``prefill``/``decode_step`` layouts and the training
+sharding plans. See related-topics/serving/README.md for the chapter.
+
+    from distributed_training_guide_tpu.serve import (
+        Request, ServeEngine, generate_many)
+"""
+from .engine import ServeEngine
+from .kv_pages import PagePool, kv_page_bytes, pages_for_tokens
+from .scheduler import Request, RequestResult, Scheduler
+
+__all__ = [
+    "PagePool", "Request", "RequestResult", "Scheduler", "ServeEngine",
+    "generate_many", "kv_page_bytes", "pages_for_tokens", "serve_http",
+]
+
+
+def __getattr__(name):
+    # generate_many / serve_http live in api.py, which imports http.server;
+    # keep the package import light for library users
+    if name in ("generate_many", "serve_http", "throughput_stats"):
+        from . import api
+
+        return getattr(api, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

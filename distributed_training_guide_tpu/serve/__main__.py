@@ -1,0 +1,135 @@
+"""Serve a model from the zoo: offline batch generation or an HTTP
+endpoint, both through the continuous-batching paged-KV engine.
+
+    # offline: three hermetic requests co-batched on 4 slots
+    python -m distributed_training_guide_tpu.serve -m llama-debug \\
+        --prompt-ids 3,17,42 --prompt-ids 5,6 --prompt-ids 9 \\
+        --steps 16 --n-slots 4
+
+    # online: HTTP endpoint (POST /generate, GET /healthz)
+    python -m distributed_training_guide_tpu.serve -m gpt2 \\
+        --pretrained /ckpts/gpt2-conv --http-port 8000
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import time
+
+
+def main(argv=None) -> None:
+    parser = argparse.ArgumentParser(
+        description=__doc__.splitlines()[0],
+        prog="python -m distributed_training_guide_tpu.serve")
+    parser.add_argument("-m", "--model-name", required=True)
+    parser.add_argument("--prompt-ids", action="append", default=[],
+                        metavar="IDS", help="comma-separated token ids; "
+                        "repeat for several requests (hermetic path)")
+    parser.add_argument("--prompt", action="append", default=[],
+                        help="text prompt (needs the model's tokenizer in "
+                        "the local cache); repeatable")
+    parser.add_argument("--steps", type=int, default=32,
+                        help="max new tokens per request")
+    parser.add_argument("--temperature", type=float, default=0.0)
+    parser.add_argument("--top-k", type=int, default=0)
+    parser.add_argument("--top-p", type=float, default=1.0)
+    parser.add_argument("--eos-id", type=int, default=None)
+    parser.add_argument("--seed", type=int, default=0)
+    parser.add_argument("--n-slots", type=int, default=4,
+                        help="concurrent decode slots (the compiled batch)")
+    parser.add_argument("--page-size", type=int, default=16,
+                        help="tokens per KV page")
+    parser.add_argument("--n-pages", type=int, default=None,
+                        help="KV pool size in pages (default: full "
+                        "residency; smaller engages admission backpressure)")
+    parser.add_argument("--max-len", type=int, default=None,
+                        help="max prompt+generation context per request "
+                        "(default: the model's position table)")
+    parser.add_argument("--pretrained", default=None, metavar="DIR",
+                        help="converted checkpoint dir (models/hf_convert); "
+                        "random init otherwise")
+    parser.add_argument("--http-port", type=int, default=None,
+                        help="serve an HTTP endpoint on this port instead "
+                        "of running the offline batch")
+    args = parser.parse_args(argv)
+
+    import jax
+    import jax.numpy as jnp
+
+    from ..models.registry import get_model
+    from .api import generate_many, serve_http, throughput_stats
+    from .engine import ServeEngine
+    from .scheduler import Request
+
+    bundle = get_model(args.model_name, dtype=jnp.float32)
+    tokenizer = None
+    if args.prompt or args.http_port is not None:
+        try:
+            from ..data import get_tokenizer
+
+            tokenizer = get_tokenizer(args.model_name)
+        except Exception:
+            if args.prompt:
+                raise
+    if args.pretrained:
+        from ..models.hf_convert import load_pretrained
+        from ..parallel import make_mesh, make_plan
+
+        plan = make_plan("single", make_mesh(devices=jax.devices()[:1]))
+        shapes = jax.eval_shape(
+            lambda: bundle.init(bundle.config, jax.random.key(0)))
+        shardings = plan.param_shardings(
+            bundle.param_logical_axes(bundle.config), shapes)
+        params = load_pretrained(bundle, shardings, args.pretrained)
+    else:
+        params = bundle.init(bundle.config, jax.random.key(args.seed))
+
+    engine = ServeEngine(bundle, params, n_slots=args.n_slots,
+                         page_size=args.page_size, n_pages=args.n_pages,
+                         max_len=args.max_len)
+    report = engine.kv_report()
+    print(json.dumps({"kv_report": report}))
+
+    if args.http_port is not None:
+        server, worker = serve_http(engine, port=args.http_port,
+                                    tokenizer=tokenizer)
+        print(json.dumps({"serving": f"http://127.0.0.1:{args.http_port}",
+                          "endpoints": ["/generate", "/healthz"]}))
+        try:
+            while True:
+                time.sleep(3600)
+        except KeyboardInterrupt:
+            server.shutdown()
+            worker.stop()
+        return
+
+    prompts = [[int(t) for t in ids.split(",")] for ids in args.prompt_ids]
+    for text in args.prompt:
+        ids = tokenizer(text)["input_ids"]
+        if ids and isinstance(ids[0], list):
+            ids = ids[0]
+        prompts.append(ids)
+    if not prompts:
+        raise SystemExit("pass at least one --prompt-ids / --prompt "
+                         "(or --http-port for the online endpoint)")
+    requests = [Request(prompt_ids=p, max_new_tokens=args.steps,
+                        temperature=args.temperature, top_k=args.top_k,
+                        top_p=args.top_p, seed=args.seed + i,
+                        eos_id=args.eos_id)
+                for i, p in enumerate(prompts)]
+    t0 = time.perf_counter()
+    results = generate_many(engine, requests)
+    wall = time.perf_counter() - t0
+    for res in results:
+        line = {"request_id": res.request_id,
+                "finish_reason": res.finish_reason,
+                "latency_s": round(res.latency_s, 4),
+                "token_ids": res.token_ids}
+        if tokenizer is not None:
+            line["text"] = tokenizer.decode(res.token_ids)
+        print(json.dumps(line))
+    print(json.dumps({"stats": throughput_stats(results, wall, engine)}))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,236 @@
+"""Serving front-ends over the engine: an offline batch API and a minimal
+stdlib HTTP endpoint. Both emit per-request latency and aggregate
+tokens/sec (the numbers bench.py's ``decode_tput`` rung records).
+
+``generate_many`` is synchronous continuous batching: all requests enter
+the scheduler queue up front and the engine iterates until the queue
+drains — requests of different lengths still interleave at iteration
+granularity (an early finisher's slot is re-admitted mid-flight).
+
+``serve_http`` is ONLINE continuous batching: a single background engine
+thread owns all device work and loops over ``engine.step()``; HTTP handler
+threads only enqueue requests and wait on a per-request event. Concurrent
+clients therefore genuinely co-batch — two requests in flight share decode
+steps, which is the throughput story of iteration-level scheduling.
+"""
+from __future__ import annotations
+
+import json
+import logging
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+from .engine import ServeEngine
+from .scheduler import Request, RequestResult
+
+LOGGER = logging.getLogger(__name__)
+
+
+def generate_many(engine: ServeEngine, requests: list[Request],
+                  max_iterations: Optional[int] = None) -> list[RequestResult]:
+    """Run a batch of requests to completion; results in submit order.
+
+    ``max_iterations`` bounds the loop for tests; the natural bound is
+    total decode steps ~= sum(max_new_tokens) + admission stalls.
+    """
+    ids = [engine.submit(r) for r in requests]
+    done: dict[int, RequestResult] = {}
+    iters = 0
+    while engine.has_work:
+        for res in engine.step():
+            done[res.request_id] = res
+        iters += 1
+        if max_iterations is not None and iters > max_iterations:
+            raise RuntimeError(
+                f"generate_many exceeded {max_iterations} iterations with "
+                f"{len(ids) - len(done)} requests unfinished — scheduler "
+                f"stall (this is a bug, not load)")
+    missing = [i for i in ids if i not in done]
+    assert not missing, f"engine drained but requests {missing} never finished"
+    return [done[i] for i in ids]
+
+
+def throughput_stats(results: list[RequestResult],
+                     wall_s: float, engine: ServeEngine) -> dict:
+    """Aggregate serving metrics for a completed batch."""
+    gen = sum(len(r.generated_ids) for r in results)
+    lat = sorted(r.latency_s for r in results)
+    return {
+        "n_requests": len(results),
+        "generated_tokens": gen,
+        "wall_s": round(wall_s, 4),
+        "tokens_per_s": round(gen / wall_s, 2) if wall_s else 0.0,
+        "decode_steps": engine.decode_steps,
+        # slot occupancy of the decode program: 1.0 = every lane of every
+        # step carried a live request (continuous batching's win over
+        # static batching shows up here)
+        "decode_occupancy": round(
+            engine.decode_tokens / (engine.decode_steps * engine.n_slots), 3)
+        if engine.decode_steps else 0.0,
+        "latency_s_p50": round(lat[len(lat) // 2], 4) if lat else 0.0,
+        "latency_s_max": round(lat[-1], 4) if lat else 0.0,
+        "admission_blocked": engine.scheduler.stats["admission_blocked"],
+    }
+
+
+class _EngineWorker(threading.Thread):
+    """The single thread that touches the device. Handlers enqueue via
+    ``submit`` (engine + futures under one lock) and wait on an event."""
+
+    def __init__(self, engine: ServeEngine):
+        super().__init__(daemon=True, name="serve-engine")
+        self.engine = engine
+        self.lock = threading.Lock()
+        self.wakeup = threading.Event()
+        self.futures: dict[int, dict] = {}
+        self.dead: Optional[BaseException] = None
+        self._stop = False
+
+    def submit(self, request: Request) -> dict:
+        fut = {"event": threading.Event(), "result": None, "error": None,
+               "submitted": time.monotonic()}
+        with self.lock:
+            if self.dead is not None:
+                raise RuntimeError(f"engine thread died: {self.dead!r}")
+            rid = self.engine.submit(request)   # raises -> handler reports 400
+            self.futures[rid] = fut
+        self.wakeup.set()
+        return fut
+
+    def run(self) -> None:
+        while not self._stop:
+            try:
+                with self.lock:
+                    busy = self.engine.has_work
+                    finished = self.engine.step() if busy else []
+                    for res in finished:
+                        fut = self.futures.pop(res.request_id, None)
+                        if fut is not None:
+                            fut["result"] = res
+                            fut["event"].set()
+            except Exception as exc:
+                # an engine error must fail every waiter LOUDLY — a silent
+                # thread death would hang all pending requests forever while
+                # /healthz kept answering ok
+                LOGGER.exception("serve engine thread died")
+                with self.lock:
+                    self.dead = exc
+                    for fut in self.futures.values():
+                        fut["error"] = exc
+                        fut["event"].set()
+                    self.futures.clear()
+                return
+            if not busy:
+                self.wakeup.wait(timeout=0.05)
+                self.wakeup.clear()
+        # clean stop: anything still in flight must fail its waiter — a
+        # handler thread blocked on fut["event"] with no timeout would
+        # otherwise hang (with its client) past server.shutdown()
+        with self.lock:
+            if self.futures:
+                exc = RuntimeError("server shutting down")
+                self.dead = exc
+                for fut in self.futures.values():
+                    fut["error"] = exc
+                    fut["event"].set()
+                self.futures.clear()
+
+    def stop(self) -> None:
+        self._stop = True
+        self.wakeup.set()
+
+
+def serve_http(engine: ServeEngine, host: str = "127.0.0.1", port: int = 8000,
+               tokenizer=None):
+    """Start the HTTP endpoint; returns (server, worker) — call
+    ``server.shutdown()`` + ``worker.stop()`` to tear down.
+
+    POST /generate  {"prompt_ids": [...]} or {"prompt": "..."} (needs a
+                    tokenizer), plus optional max_new_tokens / temperature /
+                    top_k / top_p / seed / eos_id
+    GET  /healthz   liveness + queue depth
+    """
+    worker = _EngineWorker(engine)
+
+    class Handler(BaseHTTPRequestHandler):
+        def log_message(self, fmt, *args):  # route to logging, not stderr
+            LOGGER.debug("http: " + fmt, *args)
+
+        def _reply(self, code: int, payload: dict) -> None:
+            body = json.dumps(payload).encode()
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def do_GET(self):
+            if self.path != "/healthz":
+                return self._reply(404, {"error": "unknown path"})
+            with worker.lock:
+                payload = {
+                    "ok": worker.dead is None,
+                    **({"error": repr(worker.dead)}
+                       if worker.dead is not None else {}),
+                    "queued": len(engine.scheduler.queue),
+                    "active_slots": len(engine.scheduler.active_indices()),
+                    "n_slots": engine.n_slots,
+                    "pages_free": engine.scheduler.pool.n_free,
+                    "decode_steps": engine.decode_steps,
+                }
+            self._reply(200, payload)
+
+        def do_POST(self):
+            if self.path != "/generate":
+                return self._reply(404, {"error": "unknown path"})
+            try:
+                n = int(self.headers.get("Content-Length", 0))
+                body = json.loads(self.rfile.read(n) or b"{}")
+                prompt_ids = body.get("prompt_ids")
+                if prompt_ids is None and body.get("prompt") is not None:
+                    if tokenizer is None:
+                        raise ValueError(
+                            "text 'prompt' needs a tokenizer; pass "
+                            "'prompt_ids' for the hermetic path")
+                    prompt_ids = tokenizer(body["prompt"])["input_ids"]
+                    if prompt_ids and isinstance(prompt_ids[0], list):
+                        prompt_ids = prompt_ids[0]
+                req = Request(
+                    prompt_ids=[int(t) for t in (prompt_ids or [])],
+                    max_new_tokens=int(body.get("max_new_tokens", 32)),
+                    temperature=float(body.get("temperature", 0.0)),
+                    top_k=int(body.get("top_k", 0)),
+                    top_p=float(body.get("top_p", 1.0)),
+                    seed=int(body.get("seed", 0)),
+                    eos_id=(int(body["eos_id"])
+                            if body.get("eos_id") is not None else None))
+                fut = worker.submit(req)
+            except (ValueError, KeyError, json.JSONDecodeError) as exc:
+                return self._reply(400, {"error": str(exc)})
+            except RuntimeError as exc:     # engine thread already dead
+                return self._reply(503, {"error": str(exc)})
+            fut["event"].wait()
+            if fut["error"] is not None:
+                return self._reply(500, {"error": repr(fut["error"])})
+            res: RequestResult = fut["result"]
+            payload = {
+                "token_ids": res.token_ids,
+                "generated_ids": res.generated_ids,
+                "finish_reason": res.finish_reason,
+                "latency_s": round(res.latency_s, 4),
+                "queue_s": round(res.queue_s, 4),
+            }
+            if tokenizer is not None:
+                payload["text"] = tokenizer.decode(res.token_ids)
+            self._reply(200, payload)
+
+    server = ThreadingHTTPServer((host, port), Handler)
+    worker.start()
+    threading.Thread(target=server.serve_forever, daemon=True,
+                     name="serve-http").start()
+    LOGGER.info(f"serving on http://{host}:{server.server_address[1]} "
+                f"(n_slots={engine.n_slots}, "
+                f"pool={engine.scheduler.pool.n_pages} pages)")
+    return server, worker

@@ -1,0 +1,281 @@
+"""Continuous-batching decode engine over the paged KV cache.
+
+Compile surface (the whole point — requests come and go, programs don't):
+
+- ONE batched decode program over the fixed ``[n_slots]`` slot array.
+  Block tables / lengths / sampling knobs are int/float ARRAY arguments,
+  idle slots compute into the trash page and are masked at the sample —
+  admission and eviction never retrace anything.
+- One prefill program per LENGTH BUCKET (powers of two up to ``max_len``):
+  a prompt pads to the smallest covering bucket, runs the family's
+  existing ``prefill`` at batch 1 with the real last index passed as a
+  traced scalar, and a per-bucket commit scatter moves the dense bucket
+  cache into the slot's pages (pad tail -> trash page).
+- One sampling program (temperature / top-k / top-p, per-slot scalars so
+  co-resident requests can run different settings under one compile) and
+  its batch-1 twin for prefill logits.
+
+Sampling keys are ``fold_in(key(seed), absolute position of the sampled
+token)`` — a pure function of (request seed, position), so a request's
+tokens are identical whatever slot it lands in, whenever it is admitted,
+and whoever it shares the batch with. That property IS the
+order-invariance test in tests/test_serve.py.
+
+Sharded weights ride the existing ``parallel/plans.py`` meshes: pass
+``plan=`` (tp / fsdp / single) and params are device_put to the plan's
+param shardings while KV pages and per-step host arrays stay replicated —
+GSPMD partitions the decode matmuls exactly as it does the training
+forward. (Pages sharded over dp is future work; replicated is always
+correct.)
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..models.registry import ModelBundle, family_module
+from .kv_pages import (PagePool, commit_prefill, init_pages, kv_page_bytes,
+                       make_attend, pages_for_tokens)
+from .scheduler import Request, RequestResult, Scheduler
+
+
+def _sample_tokens(logits, seeds, positions, temps, top_ks, top_ps):
+    """Per-slot temperature / top-k / top-p sampling, greedy at temp 0.
+
+    logits [S, V] fp32; all knobs are [S] arrays (per-slot scalars). The
+    filters run in sorted space (one descending sort), the draw is
+    categorical over the surviving set, and the sampled rank maps back to
+    a vocab id through the sort order — no threshold/tie ambiguity.
+    """
+    s, v = logits.shape
+    greedy = jnp.argmax(logits, axis=-1)
+    keys = jax.vmap(lambda sd, p: jax.random.fold_in(jax.random.key(sd), p))(
+        seeds, positions)
+    scaled = logits / jnp.maximum(temps, 1e-6)[:, None]
+    order = jnp.argsort(-scaled, axis=-1)                  # [S, V] vocab ids
+    sorted_desc = jnp.take_along_axis(scaled, order, axis=-1)
+    neg_inf = jnp.finfo(jnp.float32).min
+    # top-k: keep ranks < k (k <= 0 disables)
+    k_eff = jnp.where(top_ks > 0, top_ks, v).clip(1, v)
+    ranks = jnp.broadcast_to(jnp.arange(v)[None, :], (s, v))
+    kept = jnp.where(ranks < k_eff[:, None], sorted_desc, neg_inf)
+    # top-p on the k-filtered distribution: keep the smallest prefix whose
+    # cumulative prob reaches top_p (the first rank always survives)
+    probs = jax.nn.softmax(kept, axis=-1)
+    cum = jnp.cumsum(probs, axis=-1)
+    kept = jnp.where(cum - probs < top_ps[:, None], kept, neg_inf)
+    idx = jax.vmap(jax.random.categorical)(keys, kept)     # rank per slot
+    sampled = jnp.take_along_axis(order, idx[:, None], axis=-1)[:, 0]
+    return jnp.where(temps > 0, sampled, greedy).astype(jnp.int32)
+
+
+class ServeEngine:
+    """Multi-request generation over a model family's KV-cache decode.
+
+    Drive it either through ``serve/api.py`` (``generate_many`` /
+    ``serve_http``) or directly: ``submit(Request(...))`` then ``step()``
+    in a loop — each ``step`` is one scheduler iteration (admit + one
+    batched decode) and returns whatever finished.
+    """
+
+    def __init__(self, bundle: ModelBundle, params, *, n_slots: int = 8,
+                 page_size: int = 16, n_pages: Optional[int] = None,
+                 max_len: Optional[int] = None,
+                 prefill_buckets: Optional[tuple] = None, plan=None):
+        self.bundle = bundle
+        self.config = bundle.config
+        self.mod = family_module(bundle.family)
+        if not hasattr(self.mod, "paged_decode_step"):
+            raise ValueError(
+                f"family {bundle.family!r} has no KV-cached decode — the "
+                f"serving engine needs init_cache/prefill/paged_decode_step")
+        max_pos = getattr(self.config, "max_position_embeddings", None)
+        if max_len is None:
+            # bounded default: the full position table of a big preset
+            # (131k for llama3) would size BOTH the default full-residency
+            # pool (n_slots x max_pages pages) and the per-step gather
+            # transient to the dense worst case this module exists to
+            # remove — long contexts are opt-in via max_len=
+            max_len = min(max_pos, 2048) if max_pos else 2048
+        # max_len is CAPACITY (page-granular); requests are validated
+        # against min(capacity, position table) so a rounded-up capacity
+        # can't push gpt2 past its learned positions
+        self.max_model_len = min(max_len, max_pos) if max_pos else max_len
+        self.page_size = page_size
+        self.max_pages = pages_for_tokens(max_len, page_size)
+        self.n_slots = n_slots
+        if n_pages is None:
+            # default: full residency + the trash page — backpressure only
+            # engages when the caller sizes the pool below it
+            n_pages = 1 + n_slots * self.max_pages
+        pool = PagePool(n_pages, page_size)
+        self.scheduler = Scheduler(n_slots=n_slots, pool=pool,
+                                   max_len=self.max_model_len,
+                                   max_pages_per_slot=self.max_pages)
+        if prefill_buckets is None:
+            cap = self.max_pages * page_size
+            b, buckets = page_size, []
+            while b < cap:
+                buckets.append(b)
+                b *= 2
+            buckets.append(cap)
+            prefill_buckets = tuple(buckets)
+        self.prefill_buckets = tuple(sorted(prefill_buckets))
+        # buckets must cover every admissible prompt (Scheduler.submit
+        # accepts up to max_model_len - 1 prompt tokens) and stay inside the
+        # page capacity (commit_prefill indexes table_row[t // page]) — an
+        # unservable bucket config fails HERE, not after a request has been
+        # admitted and holds a slot + pages
+        cap = self.max_pages * page_size
+        if self.prefill_buckets[-1] < min(self.max_model_len - 1, cap):
+            raise ValueError(
+                f"prefill_buckets {self.prefill_buckets} cannot cover the "
+                f"largest admissible prompt "
+                f"({min(self.max_model_len - 1, cap)} tokens)")
+        if self.prefill_buckets[-1] > cap:
+            raise ValueError(
+                f"prefill bucket {self.prefill_buckets[-1]} exceeds the "
+                f"per-slot page capacity {cap}")
+
+        self.plan = plan
+        if plan is not None:
+            shapes = jax.tree.map(
+                lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype), params)
+            shardings = plan.param_shardings(
+                bundle.param_logical_axes(self.config), shapes)
+            params = jax.device_put(params, shardings)
+        self.params = params
+        self.pages = init_pages(self.config, n_pages, page_size)
+        if plan is not None:
+            self.pages = jax.device_put(self.pages, plan.replicated())
+
+        self._prefill_fns = {}
+        # one jit wrapper; each prefill bucket's [L, Pb, ...] shape gets its
+        # own cached executable automatically
+        self._commit_fn = jax.jit(commit_prefill, donate_argnums=(0, 1))
+        self._decode_fn = jax.jit(self._decode, donate_argnums=(1, 2))
+        self._sample_one = jax.jit(
+            lambda logit, seed, pos, t, tk, tp: _sample_tokens(
+                logit[None], seed[None], pos[None], t[None], tk[None],
+                tp[None])[0])
+        # decode throughput counters (api.py metrics)
+        self.decode_steps = 0
+        self.decode_tokens = 0
+
+    # ---- compiled programs -------------------------------------------------
+    def _decode(self, params, kp, vp, tokens, lengths, tables, seeds, temps,
+                top_ks, top_ps, actives):
+        attend = make_attend(tables, lengths)
+        logits, cache = self.mod.paged_decode_step(
+            self.config, params, tokens[:, None], lengths,
+            {"k": kp, "v": vp}, attend)
+        nxt = _sample_tokens(logits.astype(jnp.float32), seeds, lengths + 1,
+                             temps, top_ks, top_ps)
+        return jnp.where(actives, nxt, 0), cache["k"], cache["v"]
+
+    def _prefill_for(self, bucket: int):
+        if bucket not in self._prefill_fns:
+            def fn(params, ids, last_pos):
+                cache = self.mod.init_cache(self.config, 1, bucket)
+                logit, cache = self.mod.prefill(self.config, params, ids,
+                                                cache, last_pos=last_pos)
+                return logit[0], cache["k"][:, 0], cache["v"][:, 0]
+
+            self._prefill_fns[bucket] = jax.jit(fn)
+        return self._prefill_fns[bucket]
+
+    # ---- serving loop ------------------------------------------------------
+    def submit(self, request: Request) -> int:
+        # range-check ids here (the scheduler is model-agnostic): under jit
+        # the embedding gather CLAMPS out-of-range ids, so an unchecked
+        # prompt would return garbage generations with a 200 instead of
+        # being refused
+        v = self.config.vocab_size
+        bad = [t for t in request.prompt_ids if not 0 <= int(t) < v]
+        if bad:
+            raise ValueError(
+                f"prompt ids {bad[:5]} out of range for vocab_size {v}")
+        return self.scheduler.submit(request)
+
+    @property
+    def has_work(self) -> bool:
+        return self.scheduler.has_work
+
+    def kv_cache_bytes(self) -> int:
+        """Resident KV bytes — scales with the page pool, NOT with
+        n_slots x max_len (the memory pin in tests/test_serve.py)."""
+        return int(self.pages["k"].nbytes + self.pages["v"].nbytes)
+
+    def _bucket_for(self, n: int) -> int:
+        for b in self.prefill_buckets:
+            if b >= n:
+                return b
+        raise ValueError(f"prompt length {n} exceeds the largest prefill "
+                         f"bucket {self.prefill_buckets[-1]}")
+
+    def _admit(self, slot_idx: int, req: Request) -> Optional[RequestResult]:
+        n = len(req.prompt_ids)
+        bucket = self._bucket_for(n)
+        ids = np.zeros((1, bucket), np.int32)
+        ids[0, :n] = req.prompt_ids
+        logit, kd, vd = self._prefill_for(bucket)(
+            self.params, jnp.asarray(ids), jnp.asarray(n - 1))
+        table_row = jnp.asarray(self.scheduler.table_row(slot_idx))
+        self.pages["k"], self.pages["v"] = self._commit_fn(
+            self.pages["k"], self.pages["v"], kd, vd, table_row,
+            jnp.asarray(n))
+        t0 = self._sample_one(
+            logit.astype(jnp.float32), jnp.asarray(req.seed, jnp.int32),
+            jnp.asarray(n, jnp.int32),
+            jnp.asarray(req.temperature, jnp.float32),
+            jnp.asarray(req.top_k, jnp.int32),
+            jnp.asarray(req.top_p, jnp.float32))
+        return self.scheduler.record_token(slot_idx, int(t0),
+                                           from_decode=False)
+
+    def step(self) -> list[RequestResult]:
+        """One scheduler iteration: admit whatever fits (each admission is
+        one bucketed prefill + page commit + first-token sample), then ONE
+        batched decode over the active slots. Returns finished requests."""
+        finished = []
+        for slot_idx, req in self.scheduler.try_admit():
+            res = self._admit(slot_idx, req)
+            if res is not None:        # eos/length on the very first token
+                finished.append(res)
+
+        active = self.scheduler.active_indices()
+        if active:
+            arr = self.scheduler.decode_arrays()
+            nxt, self.pages["k"], self.pages["v"] = self._decode_fn(
+                self.params, self.pages["k"], self.pages["v"],
+                jnp.asarray(arr["tokens"]), jnp.asarray(arr["lengths"]),
+                jnp.asarray(arr["tables"]), jnp.asarray(arr["seeds"]),
+                jnp.asarray(arr["temps"]), jnp.asarray(arr["top_ks"]),
+                jnp.asarray(arr["top_ps"]), jnp.asarray(arr["actives"]))
+            nxt = np.asarray(nxt)
+            self.decode_steps += 1
+            self.decode_tokens += len(active)
+            for slot_idx in active:
+                res = self.scheduler.record_token(slot_idx, int(nxt[slot_idx]),
+                                                  from_decode=True)
+                if res is not None:
+                    finished.append(res)
+        return finished
+
+    def kv_report(self) -> dict:
+        """The preflight-style byte table for this engine's pool."""
+        pool = self.scheduler.pool
+        return {
+            "page_size": self.page_size,
+            "n_pages": pool.n_pages,
+            "pages_free": pool.n_free,
+            "bytes_per_page": kv_page_bytes(self.config,
+                                            page_size=self.page_size),
+            "pool_bytes": self.kv_cache_bytes(),
+            "dense_equivalent_bytes": kv_page_bytes(
+                self.config, page_size=self.page_size,
+                n_pages=self.n_slots * self.max_pages),
+        }

@@ -1,0 +1,188 @@
+"""Paged KV cache: fixed-size blocks, a free-list allocator, per-sequence
+block tables, and a jit-compatible gather-based attend over the table.
+
+The contiguous decode cache (``models/<family>.init_cache``) is
+``[L, B, max_len, kvh, hd]`` — a serving engine sized that way pays
+``n_slots x max_len`` resident bytes whether or not the slots are full
+(vLLM measures 60-80% of such memory as waste). Here the resident cache is
+a POOL of pages ``[L, n_pages, page_size, kvh, hd]`` (PagedAttention, Kwon
+et al., arXiv:2309.06180): a sequence owns ``ceil(tokens / page_size)``
+pages wired together by an int32 block table, pages return to the free
+list on eviction, and cache memory is O(allocated pages) — priced by
+``kv_page_bytes`` and pinned by ``tests/test_serve.py``.
+
+Physical page 0 is RESERVED as the trash page: it is never allocated, so a
+write routed to it (an idle slot in the fixed ``[n_slots]`` decode batch,
+the padded tail of a bucketed prefill) lands harmlessly — active block
+tables never reference it, so garbage in page 0 can never enter a live
+slot's attend. That convention is what lets ONE compiled decode program
+serve any mix of active/idle slots with plain scatters, no recompiles.
+
+Device-side pieces (``paged_attend``, ``commit_prefill``) are pure
+functions of array arguments — block tables and lengths arrive as int32
+arrays, so requests coming and going never change a traced shape. The
+allocator (``PagePool``) is host-side Python owned by the scheduler.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..ops.attention import multihead_attention
+
+TRASH_PAGE = 0  # physical page id reserved for masked/idle writes
+
+
+def pages_for_tokens(n_tokens: int, page_size: int) -> int:
+    """Pages a sequence of ``n_tokens`` occupies (admission reserves this
+    worst-case up front so a running sequence can never hit exhaustion)."""
+    return -(-n_tokens // page_size)
+
+
+def num_kv_heads(config) -> int:
+    """KV head count across families (gpt2/neox cache full heads)."""
+    return getattr(config, "num_kv_heads", config.num_heads)
+
+
+def kv_page_bytes(config, *, page_size: int, n_pages: int = 1) -> int:
+    """Resident bytes of ``n_pages`` KV pages for this model:
+    pages x layers x 2 (k and v) x page_size x kv_heads x head_dim x
+    itemsize — the per-slot serving cost is this at
+    ``n_pages = pages_for_tokens(context)`` (train/preflight.py reports
+    that table)."""
+    itemsize = jnp.dtype(config.dtype).itemsize
+    return (n_pages * config.num_layers * 2 * page_size
+            * num_kv_heads(config) * config.head_size * itemsize)
+
+
+def init_pages(config, n_pages: int, page_size: int) -> dict:
+    """Zeroed page pools {"k","v"}: [L, n_pages, page_size, kvh, hd]."""
+    shape = (config.num_layers, n_pages, page_size, num_kv_heads(config),
+             config.head_size)
+    return {"k": jnp.zeros(shape, config.dtype),
+            "v": jnp.zeros(shape, config.dtype)}
+
+
+class PagePool:
+    """Host-side free-list allocator over physical page ids 1..n_pages-1
+    (page 0 is the trash page). Allocation is all-or-nothing: a request
+    either gets every page it may ever need or none (backpressure — the
+    scheduler refuses admission instead of corrupting a running sequence).
+    """
+
+    def __init__(self, n_pages: int, page_size: int):
+        if n_pages < 2:
+            raise ValueError(f"n_pages must be >= 2 (page {TRASH_PAGE} is "
+                             f"the reserved trash page), got {n_pages}")
+        if page_size < 1:
+            raise ValueError(f"page_size must be >= 1, got {page_size}")
+        self.n_pages = n_pages
+        self.page_size = page_size
+        # LIFO free list: recently-freed pages are re-issued first, keeping
+        # the hot working set compact
+        self._free = list(range(n_pages - 1, TRASH_PAGE, -1))
+
+    @property
+    def capacity(self) -> int:
+        """Total allocatable pages (trash page excluded)."""
+        return self.n_pages - 1
+
+    @property
+    def n_free(self) -> int:
+        return len(self._free)
+
+    def alloc(self, n: int) -> Optional[list[int]]:
+        """``n`` pages or None (never a partial grant)."""
+        if n < 0:
+            raise ValueError(f"cannot allocate {n} pages")
+        if n > len(self._free):
+            return None
+        if n == 0:
+            return []
+        pages = self._free[-n:]
+        del self._free[-n:]
+        return pages
+
+    def free(self, pages: list[int]) -> None:
+        for p in pages:
+            if not (TRASH_PAGE < p < self.n_pages):
+                raise ValueError(f"freeing invalid page id {p}")
+            if p in self._free:
+                raise ValueError(f"double free of page {p}")
+        self._free.extend(pages)
+
+
+def paged_attend(q, k_new, v_new, k_pages, v_pages, tables, lengths, *,
+                 window=None, scale=None, softcap=None):
+    """Scatter each slot's new k/v into its current page, then attend q
+    over the slot's gathered block-table view.
+
+    q [S, 1, Hq, D]; k_new/v_new [S, 1, Hkv, D]; k_pages/v_pages
+    [P, page, Hkv, D] (ONE layer's pool — the layer scan feeds slices);
+    tables [S, M] int32 physical page ids (0-filled rows/tails route to
+    the trash page); lengths [S] int32 = tokens already cached per slot,
+    which is exactly the new token's position.
+
+    The gather materialises a [S, M*page, Hkv, D] logical view per layer —
+    a TRANSIENT the size of the attended context (any attend reads that
+    much); the RESIDENT cache stays the [P, page] pool. Positions past
+    ``lengths`` hold garbage (trash page / stale pages) and are cut by the
+    causal mask — logical position of token j in the view is j, so the
+    standard (positions, kv_positions) masking applies unchanged, window/
+    scale/softcap included (Gemma-2 decodes through this same path).
+
+    Returns (attn [S, 1, Hq, D], (k_pages, v_pages) updated).
+    """
+    s = q.shape[0]
+    page = k_pages.shape[1]
+    slot = jnp.arange(s)
+    phys = tables[slot, lengths // page]          # [S] current page per slot
+    off = lengths % page
+    k_pages = k_pages.at[phys, off].set(k_new[:, 0].astype(k_pages.dtype))
+    v_pages = v_pages.at[phys, off].set(v_new[:, 0].astype(v_pages.dtype))
+
+    kg = k_pages[tables]                          # [S, M, page, Hkv, D]
+    vg = v_pages[tables]
+    t = kg.shape[1] * page
+    kg = kg.reshape(s, t, *kg.shape[3:])
+    vg = vg.reshape(s, t, *vg.shape[3:])
+    kv_pos = jnp.broadcast_to(jnp.arange(t)[None, :], (s, t))
+    attn = multihead_attention(q, kg, vg, causal=True,
+                               positions=lengths[:, None],
+                               kv_positions=kv_pos, impl="xla",
+                               standard_layout=False, window=window,
+                               scale=scale, logit_softcap=softcap)
+    return attn, (k_pages, v_pages)
+
+
+def make_attend(tables, lengths):
+    """Bind (tables, lengths) into the per-layer attend callback the family
+    ``paged_decode_step`` hooks expect."""
+
+    def attend(q, k_new, v_new, k_pages, v_pages, *, window=None, scale=None,
+               softcap=None):
+        return paged_attend(q, k_new, v_new, k_pages, v_pages, tables,
+                            lengths, window=window, scale=scale,
+                            softcap=softcap)
+
+    return attend
+
+
+def commit_prefill(k_pages, v_pages, k_dense, v_dense, table_row, n_tokens):
+    """Scatter a bucketed prefill's dense cache into one slot's pages.
+
+    k_dense/v_dense [L, Pb, Hkv, D] (family ``prefill`` output, batch dim
+    squeezed; Pb = the padded bucket length); table_row [M] the slot's
+    block table; n_tokens the REAL prompt length — positions >= n_tokens
+    (pad garbage) route to the trash page. Returns the updated pools.
+    """
+    pb = k_dense.shape[1]
+    page = k_pages.shape[2]
+    t = jnp.arange(pb)
+    phys = jnp.where(t < n_tokens, table_row[t // page], TRASH_PAGE)
+    off = t % page
+    k_pages = k_pages.at[:, phys, off].set(k_dense.astype(k_pages.dtype))
+    v_pages = v_pages.at[:, phys, off].set(v_dense.astype(v_pages.dtype))
+    return k_pages, v_pages

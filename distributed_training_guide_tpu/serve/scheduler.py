@@ -1,0 +1,260 @@
+"""Orca-style iteration-level (continuous-batching) scheduler — host side.
+
+The unit of scheduling is one ITERATION, not one request (Yu et al., OSDI
+2022): after every batched decode step the engine asks the scheduler again
+— finished sequences leave their slot immediately and queued requests take
+it at the very next step, instead of the whole batch draining before any
+admission (static batching wastes every early-finisher's slot for the
+duration of the longest request).
+
+Policy, deliberately boring and provable:
+
+- FIFO admission. The queue head admits when a slot is free AND the page
+  pool can grant its WORST-CASE reservation (``pages_for_tokens(prompt +
+  max_new)``); otherwise admission stops — strict order, no lookahead, so
+  a big request is never starved by small ones slipping past it.
+- Worst-case reservation at admission is the backpressure contract: a
+  running sequence already owns every page it can ever touch, so page
+  exhaustion can ONLY refuse new admissions — it can never corrupt a
+  decode in flight (no mid-flight allocation, no preemption machinery).
+- Eviction on EOS or length cap, at the iteration boundary; pages return
+  to the free list and the slot re-enters admission the same iteration.
+
+This module is pure host Python (no jax): deterministic, unit-testable,
+and the only owner of slot/page bookkeeping. The engine consumes its state
+as flat numpy arrays shaped ``[n_slots]``/``[n_slots, max_pages]`` — the
+ONE compiled decode step is a function of those arrays, so scheduling
+decisions never trigger a recompile.
+"""
+from __future__ import annotations
+
+import dataclasses
+import itertools
+import time
+from collections import deque
+from typing import Optional
+
+import numpy as np
+
+from .kv_pages import PagePool, pages_for_tokens
+
+
+@dataclasses.dataclass
+class Request:
+    """One generation request. ``temperature == 0`` is greedy; ``top_k <= 0``
+    and ``top_p >= 1`` disable those filters. ``seed`` drives the slot's
+    private RNG stream (sampling keys are fold_in(seed, absolute token
+    position) — deterministic per request, independent of admission order
+    and co-residents)."""
+
+    prompt_ids: list
+    max_new_tokens: int = 32
+    temperature: float = 0.0
+    top_k: int = 0
+    top_p: float = 1.0
+    seed: int = 0
+    eos_id: Optional[int] = None
+    request_id: Optional[int] = None  # assigned at submit
+
+
+@dataclasses.dataclass
+class RequestResult:
+    request_id: int
+    prompt_ids: list
+    generated_ids: list
+    finish_reason: str              # "eos" | "length"
+    submitted_at: float
+    admitted_at: float
+    finished_at: float
+
+    @property
+    def token_ids(self) -> list:
+        return list(self.prompt_ids) + list(self.generated_ids)
+
+    @property
+    def latency_s(self) -> float:
+        return self.finished_at - self.submitted_at
+
+    @property
+    def queue_s(self) -> float:
+        return self.admitted_at - self.submitted_at
+
+
+@dataclasses.dataclass
+class _Slot:
+    request: Request
+    pages: list
+    generated: list
+    cache_len: int                  # tokens currently IN the kv pages
+    admitted_at: float
+
+
+class Scheduler:
+    """Slot + page bookkeeping for the engine. All mutation goes through
+    ``submit`` / ``try_admit`` / ``record_token`` so the invariants (page
+    ownership, FIFO order, reservation-covers-lifetime) live in one place.
+    """
+
+    def __init__(self, *, n_slots: int, pool: PagePool, max_len: int,
+                 max_pages_per_slot: int, clock=time.monotonic):
+        if n_slots < 1:
+            raise ValueError(f"n_slots must be >= 1, got {n_slots}")
+        self.n_slots = n_slots
+        self.pool = pool
+        self.max_len = max_len
+        self.max_pages = max_pages_per_slot
+        self.slots: list[Optional[_Slot]] = [None] * n_slots
+        self.queue: deque = deque()
+        self._ids = itertools.count()
+        self._clock = clock
+        self._submit_times: dict[int, float] = {}
+        self.stats = {"admission_blocked": 0, "admitted": 0, "finished": 0}
+
+    # ---- admission ---------------------------------------------------------
+    def submit(self, request: Request) -> int:
+        """Validate + enqueue; returns the request id. Raises on requests
+        that could NEVER run (empty prompt, context past max_len, worst-case
+        pages past the whole pool) — refusing at submit keeps the FIFO head
+        from deadlocking the queue forever."""
+        n = len(request.prompt_ids)
+        if n < 1:
+            raise ValueError("empty prompt")
+        if request.max_new_tokens < 1:
+            raise ValueError(
+                f"max_new_tokens must be >= 1, got {request.max_new_tokens}")
+        if not 0.0 <= request.temperature:
+            raise ValueError(f"temperature must be >= 0, got "
+                             f"{request.temperature}")
+        if not 0.0 < request.top_p <= 1.0:
+            raise ValueError(f"top_p must be in (0, 1], got {request.top_p}")
+        if not 0 <= request.seed < 2 ** 31:
+            # the engine carries seeds as int32 arrays; refusing here beats
+            # an OverflowError mid-flight with the slot already admitted
+            raise ValueError(
+                f"seed must fit int32 (0 <= seed < 2**31), got {request.seed}")
+        if not -(2 ** 31) <= request.top_k < 2 ** 31:
+            # same int32 path as seed (decode_arrays): an unchecked top_k
+            # would overflow AFTER admission and kill the engine thread
+            # (top_k <= 0 stays a valid "disabled")
+            raise ValueError(
+                f"top_k must fit int32, got {request.top_k}")
+        total = n + request.max_new_tokens
+        if total > self.max_len:
+            raise ValueError(
+                f"prompt ({n}) + max_new_tokens ({request.max_new_tokens}) "
+                f"= {total} exceeds the engine's max_len ({self.max_len})")
+        if pages_for_tokens(total, self.pool.page_size) > self.pool.capacity:
+            raise ValueError(
+                f"request needs {pages_for_tokens(total, self.pool.page_size)}"
+                f" pages, more than the whole pool ({self.pool.capacity}) — "
+                f"it could never be admitted")
+        request = dataclasses.replace(request,
+                                      request_id=next(self._ids))
+        self._submit_times[request.request_id] = self._clock()
+        self.queue.append(request)
+        return request.request_id
+
+    def try_admit(self) -> list[tuple[int, Request]]:
+        """Admit FIFO-head requests while a slot is free and the pool grants
+        the worst-case reservation. Returns [(slot_idx, request)] — the
+        engine must prefill each and then call ``start_slot``'s bookkeeping
+        via ``record_token`` for the first sampled token."""
+        admissions = []
+        while self.queue:
+            slot_idx = next((i for i, s in enumerate(self.slots)
+                             if s is None), None)
+            if slot_idx is None:
+                break
+            req = self.queue[0]
+            need = pages_for_tokens(
+                len(req.prompt_ids) + req.max_new_tokens,
+                self.pool.page_size)
+            pages = self.pool.alloc(need)
+            if pages is None:
+                # backpressure: head blocks (strict FIFO), decode goes on
+                self.stats["admission_blocked"] += 1
+                break
+            self.queue.popleft()
+            self.slots[slot_idx] = _Slot(
+                request=req, pages=pages, generated=[],
+                cache_len=len(req.prompt_ids), admitted_at=self._clock())
+            self.stats["admitted"] += 1
+            admissions.append((slot_idx, req))
+        return admissions
+
+    # ---- decode bookkeeping ------------------------------------------------
+    def record_token(self, slot_idx: int, token: int, *,
+                     from_decode: bool) -> Optional[RequestResult]:
+        """Append one sampled token. ``from_decode=True`` means a decode
+        step just wrote the PREVIOUS token's k/v into the cache (cache_len
+        advances); the first token (sampled off prefill logits) doesn't.
+        Returns the RequestResult if the sequence just finished (slot freed
+        and pages returned), else None."""
+        slot = self.slots[slot_idx]
+        assert slot is not None, f"record_token on idle slot {slot_idx}"
+        if from_decode:
+            slot.cache_len += 1
+        slot.generated.append(int(token))
+        req = slot.request
+        finished = None
+        if req.eos_id is not None and token == req.eos_id:
+            finished = "eos"
+        elif len(slot.generated) >= req.max_new_tokens:
+            finished = "length"
+        if finished is None:
+            return None
+        self.pool.free(slot.pages)
+        self.slots[slot_idx] = None
+        self.stats["finished"] += 1
+        return RequestResult(
+            request_id=req.request_id, prompt_ids=list(req.prompt_ids),
+            generated_ids=list(slot.generated), finish_reason=finished,
+            submitted_at=self._submit_times.pop(req.request_id),
+            admitted_at=slot.admitted_at, finished_at=self._clock())
+
+    # ---- engine-facing state views ----------------------------------------
+    def active_indices(self) -> list[int]:
+        return [i for i, s in enumerate(self.slots) if s is not None]
+
+    @property
+    def has_work(self) -> bool:
+        return bool(self.queue) or any(s is not None for s in self.slots)
+
+    def table_row(self, slot_idx: int) -> np.ndarray:
+        """The slot's [max_pages] block table (0 = trash beyond the
+        reservation — the causal mask keeps those positions out of any
+        attend)."""
+        row = np.zeros(self.max_pages, np.int32)
+        slot = self.slots[slot_idx]
+        if slot is not None:
+            row[:len(slot.pages)] = slot.pages
+        return row
+
+    def decode_arrays(self) -> dict:
+        """Flat numpy views of the active set, shaped for the ONE compiled
+        decode step: idle slots carry token 0 / length 0 / zero table rows,
+        i.e. their lane computes into the trash page and is discarded."""
+        s = self.n_slots
+        out = {
+            "tokens": np.zeros(s, np.int32),
+            "lengths": np.zeros(s, np.int32),
+            "tables": np.zeros((s, self.max_pages), np.int32),
+            "seeds": np.zeros(s, np.int32),
+            "temps": np.zeros(s, np.float32),
+            "top_ks": np.zeros(s, np.int32),
+            "top_ps": np.ones(s, np.float32),
+            "actives": np.zeros(s, bool),
+        }
+        for i, slot in enumerate(self.slots):
+            if slot is None:
+                continue
+            req = slot.request
+            out["tokens"][i] = slot.generated[-1]
+            out["lengths"][i] = slot.cache_len
+            out["tables"][i] = self.table_row(i)
+            out["seeds"][i] = req.seed
+            out["temps"][i] = req.temperature
+            out["top_ks"][i] = req.top_k
+            out["top_ps"][i] = req.top_p
+            out["actives"][i] = True
+        return out

@@ -218,9 +218,11 @@ def get_parser() -> argparse.ArgumentParser:
                         help="don't train: abstractly trace + SPMD-lower the "
                              "full step for this (model, mesh, flags) and "
                              "print the per-device HBM budget + ICI comm "
-                             "roofline, then exit — catches sharding/"
-                             "divisibility/fit problems without touching an "
-                             "accelerator")
+                             "roofline + the serving-side KV-page pricing "
+                             "(bytes per decode slot at this context, "
+                             "related-topics/serving/), then exit — catches "
+                             "sharding/divisibility/fit problems without "
+                             "touching an accelerator")
     parser.add_argument("--preflight-target", default=None, metavar="KIND",
                         help="chip kind the comm roofline prices (e.g. v5p, "
                              "v5e) when preflighting a pod plan from a "

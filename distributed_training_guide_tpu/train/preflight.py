@@ -262,6 +262,37 @@ def run_preflight(trainer, *, global_batch: int, seq_length: int,
             f"buffers) vs ragged {ragged_b / 2**20:.0f} MiB ([kT={k * t}] "
             f"sorted buffer) — {dense_b / ragged_b:.2f}x padding")
 
+    # serving-side KV pricing (serve/kv_pages.py): what ONE decode slot of
+    # this model costs at the training context, in pages — pages x layers x
+    # 2 (k,v) x page_size x kv_heads x head_dim bytes. Training answers
+    # "does the step fit"; this row answers the follow-on "how many
+    # concurrent requests fit next to the weights when the checkpoint
+    # serves" before anyone sizes a pool by trial and error.
+    from ..serve.kv_pages import kv_page_bytes, pages_for_tokens
+
+    page_size = 16
+    pages_per_slot = pages_for_tokens(seq_length, page_size)
+    per_page = kv_page_bytes(cfg, page_size=page_size)
+    per_slot = per_page * pages_per_slot
+    report["serve_kv"] = {
+        "page_size": page_size,
+        "pages_per_slot_at_seq": pages_per_slot,
+        "bytes_per_page": per_page,
+        "bytes_per_slot_at_seq": per_slot,
+        # dense-cache equivalent: a contiguous [slots, max_position] cache
+        # pays the POSITION TABLE per slot whatever the live context is —
+        # the ratio is what the paged pool saves at this seq_length
+        "dense_bytes_per_slot": kv_page_bytes(
+            cfg, page_size=1, n_pages=cfg.max_position_embeddings),
+    }
+    LOGGER.info(
+        f"serve KV pricing: {per_page / 2**10:.1f} KiB/page "
+        f"({page_size} tokens) -> {per_slot / 2**20:.2f} MiB per decode "
+        f"slot at context {seq_length} ({pages_per_slot} pages; a dense "
+        f"max_position cache would hold "
+        f"{report['serve_kv']['dense_bytes_per_slot'] / 2**20:.2f} MiB "
+        f"per slot)")
+
     if target_device is None and jax.default_backend() != "tpu":
         target_device = "v5p"  # the 405B recipe's stated target pod
     comm = comm_roofline(trainer, global_batch=global_batch,

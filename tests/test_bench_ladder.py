@@ -65,6 +65,9 @@ class FakeChildren:
             return [{"platform": self.platform, "n_devices": 1}], "ok"
         if mode_args == ["--check-flash"]:
             return [{"flash_ms": 70.0, "xla_ms": 95.0, "ok": True}], "ok"
+        if mode_args == ["--check-decode"]:
+            return [{"metric": "decode_tput", "value": 321.0,
+                     "model": "llama-debug"}], "ok"
         assert mode_args[0] == "--rung"
         if not self.rung_responses:
             return [], "stalled"
@@ -96,8 +99,9 @@ def test_headline_success_records_ab_and_flash(monkeypatch, capsys):
     statuses = [e["status"] for e in final["detail"]["ladder"]]
     assert statuses == ["ok", "ok"]
     assert final["detail"]["flash_check"]["ok"] is True
-    # never reached rungs 3/4: 1 probe + 2 rungs + 1 flash check
-    assert len(fake.calls) == 4
+    assert final["detail"]["decode_tput"]["value"] == 321.0  # serving rung
+    # never reached rungs 3/4: 1 probe + 2 rungs + flash + decode checks
+    assert len(fake.calls) == 5
 
 
 def test_stalled_flash_check_attaches_cached_record(monkeypatch, capsys):

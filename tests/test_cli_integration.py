@@ -381,6 +381,19 @@ def test_preflight_budget_and_lowering(eight_devices):
     assert rep["lowered"] and rep["n_devices"] == 8
     assert "moe_dispatch" not in rep   # dense families aren't priced
 
+    # serving-side KV pricing rides every preflight (serve/kv_pages.py):
+    # pages x layers x 2 (k,v) x page_size x kv_heads x head_dim bytes
+    sk = rep["serve_kv"]
+    dcfg = bundle.config
+    assert sk["pages_per_slot_at_seq"] == 4          # ceil(64 / 16)
+    assert sk["bytes_per_page"] == (
+        dcfg.num_layers * 2 * 16 * dcfg.num_kv_heads * dcfg.head_size
+        * jnp.dtype(dcfg.dtype).itemsize)
+    assert sk["bytes_per_slot_at_seq"] == 4 * sk["bytes_per_page"]
+    # the dense column pays the full position table per slot
+    assert sk["dense_bytes_per_slot"] == (
+        sk["bytes_per_page"] // 16 * dcfg.max_position_embeddings)
+
     # MoE configs get the dispatch-transient pricing (dense-vs-ragged bytes)
     moe_t = Trainer(bundle=get_model("moe-debug", dtype=jnp.float32),
                     optimizer=adamw_cosine(1e-3),

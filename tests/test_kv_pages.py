@@ -1,0 +1,164 @@
+"""Paged-KV primitives: allocator invariants, the gather-based attend vs
+the contiguous-cache reference, and the byte pricing the preflight report
+uses. Pure serve/kv_pages.py coverage — the engine-level behavior
+(scheduling, parity, backpressure) lives in test_serve.py."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_training_guide_tpu.ops.attention import multihead_attention
+from distributed_training_guide_tpu.serve.kv_pages import (
+    TRASH_PAGE, PagePool, commit_prefill, kv_page_bytes, paged_attend,
+    pages_for_tokens)
+
+pytestmark = pytest.mark.serve
+
+
+# ---- allocator --------------------------------------------------------------
+
+def test_pool_never_hands_out_the_trash_page():
+    pool = PagePool(n_pages=8, page_size=4)
+    got = pool.alloc(pool.capacity)
+    assert got is not None and TRASH_PAGE not in got
+    assert sorted(got) == list(range(1, 8))
+
+
+def test_pool_all_or_nothing_and_backpressure():
+    pool = PagePool(n_pages=6, page_size=4)   # 5 usable
+    a = pool.alloc(3)
+    assert len(a) == 3 and pool.n_free == 2
+    assert pool.alloc(3) is None              # refuse, don't partially grant
+    assert pool.n_free == 2                   # refusal left the pool intact
+    pool.free(a)
+    assert pool.alloc(5) is not None
+
+
+def test_pool_free_validates():
+    pool = PagePool(n_pages=6, page_size=4)
+    pages = pool.alloc(2)
+    pool.free(pages)
+    with pytest.raises(ValueError, match="double free"):
+        pool.free([pages[0]])
+    with pytest.raises(ValueError, match="invalid page"):
+        pool.free([TRASH_PAGE])
+    with pytest.raises(ValueError, match="invalid page"):
+        pool.free([99])
+
+
+def test_pages_for_tokens_rounds_up():
+    assert pages_for_tokens(1, 16) == 1
+    assert pages_for_tokens(16, 16) == 1
+    assert pages_for_tokens(17, 16) == 2
+
+
+def test_kv_page_bytes_formula():
+    from distributed_training_guide_tpu.models import get_model
+
+    cfg = get_model("llama-debug", dtype=jnp.float32).config
+    # pages x layers x 2 (k,v) x page_size x kv_heads x head_dim x 4 bytes
+    expect = 3 * cfg.num_layers * 2 * 16 * cfg.num_kv_heads * cfg.head_size * 4
+    assert kv_page_bytes(cfg, page_size=16, n_pages=3) == expect
+
+
+# ---- device-side ops --------------------------------------------------------
+
+def _contiguous_reference(q, k_ctx, v_ctx, length):
+    """Attend q over the first ``length`` contiguous positions (the
+    dense-cache decode math)."""
+    t = k_ctx.shape[0]
+    kv_pos = jnp.arange(t)[None, :]
+    return multihead_attention(
+        q[None], k_ctx[None], v_ctx[None], causal=True,
+        positions=jnp.asarray([[length]]), kv_positions=kv_pos,
+        impl="xla", standard_layout=False)[0]
+
+
+def test_paged_attend_matches_contiguous_cache():
+    """Scatter a known contiguous k/v history into shuffled physical pages,
+    then paged_attend must equal attention over the contiguous buffer —
+    per slot, at different lengths, including the freshly written token."""
+    page, n_pages, hkv, hq, d = 4, 16, 2, 4, 8
+    s, m = 3, 4                               # 3 slots, 4 logical pages each
+    rng = np.random.default_rng(0)
+    lengths = np.array([5, 0, 11], np.int32)  # new token positions per slot
+    # physical layout: shuffled non-overlapping pages per slot
+    phys = rng.permutation(np.arange(1, n_pages))
+    tables = np.zeros((s, m), np.int32)
+    for i in range(s):
+        tables[i] = phys[i * m:(i + 1) * m]
+
+    ctx = rng.standard_normal((s, m * page, hkv, d)).astype(np.float32)
+    k_pages = np.zeros((n_pages, page, hkv, d), np.float32)
+    v_pages = np.zeros((n_pages, page, hkv, d), np.float32)
+    vctx = rng.standard_normal((s, m * page, hkv, d)).astype(np.float32)
+    for i in range(s):
+        for t in range(int(lengths[i])):      # history: tokens 0..len-1
+            k_pages[tables[i, t // page], t % page] = ctx[i, t]
+            v_pages[tables[i, t // page], t % page] = vctx[i, t]
+
+    q = rng.standard_normal((s, 1, hq, d)).astype(np.float32)
+    k_new = rng.standard_normal((s, 1, hkv, d)).astype(np.float32)
+    v_new = rng.standard_normal((s, 1, hkv, d)).astype(np.float32)
+
+    out, (nkp, nvp) = jax.jit(paged_attend)(
+        q, k_new, v_new, jnp.asarray(k_pages), jnp.asarray(v_pages),
+        jnp.asarray(tables), jnp.asarray(lengths))
+
+    for i in range(s):
+        n = int(lengths[i])
+        k_ctx = np.concatenate([ctx[i, :n], k_new[i]], axis=0)
+        v_ctx = np.concatenate([vctx[i, :n], v_new[i]], axis=0)
+        ref = _contiguous_reference(jnp.asarray(q[i]), jnp.asarray(k_ctx),
+                                    jnp.asarray(v_ctx), n)
+        np.testing.assert_allclose(np.asarray(out[i]), np.asarray(ref),
+                                   rtol=1e-5, atol=1e-5)
+        # the write landed at the slot's own (page, offset)
+        np.testing.assert_array_equal(
+            np.asarray(nkp[tables[i, n // page], n % page]), k_new[i, 0])
+
+
+def test_paged_attend_idle_slot_writes_to_trash():
+    """A zeroed table row + length 0 (an idle lane of the fixed decode
+    batch) must scatter into page 0 only — allocated pages stay bitwise
+    untouched."""
+    page, n_pages, h, d = 4, 6, 2, 8
+    k_pages = jnp.asarray(
+        np.random.default_rng(1).standard_normal((n_pages, page, h, d)),
+        jnp.float32)
+    v_pages = k_pages + 1
+    tables = jnp.zeros((1, 2), jnp.int32)
+    q = jnp.ones((1, 1, h, d), jnp.float32)
+    kv = jnp.ones((1, 1, h, d), jnp.float32)
+    _, (nkp, nvp) = paged_attend(q, kv, kv, k_pages, v_pages, tables,
+                                 jnp.zeros(1, jnp.int32))
+    np.testing.assert_array_equal(np.asarray(nkp[1:]),
+                                  np.asarray(k_pages[1:]))
+    np.testing.assert_array_equal(np.asarray(nvp[1:]),
+                                  np.asarray(v_pages[1:]))
+    np.testing.assert_array_equal(np.asarray(nkp[TRASH_PAGE, 0]),
+                                  np.ones((h, d), np.float32))
+
+
+def test_commit_prefill_routes_pad_tail_to_trash():
+    """Bucketed prefill: real tokens land in the slot's pages in logical
+    order, the padded tail goes to page 0, other pages untouched."""
+    layers, page, n_pages, h, d = 2, 4, 8, 2, 4
+    bucket, n_tokens = 8, 6
+    rng = np.random.default_rng(2)
+    k_pages = jnp.zeros((layers, n_pages, page, h, d), jnp.float32)
+    v_pages = jnp.zeros_like(k_pages)
+    k_dense = rng.standard_normal((layers, bucket, h, d)).astype(np.float32)
+    v_dense = rng.standard_normal((layers, bucket, h, d)).astype(np.float32)
+    table_row = jnp.asarray([5, 3, 0, 0], jnp.int32)
+
+    nkp, nvp = jax.jit(commit_prefill)(
+        k_pages, v_pages, jnp.asarray(k_dense), jnp.asarray(v_dense),
+        table_row, jnp.asarray(n_tokens))
+    nkp, nvp = np.asarray(nkp), np.asarray(nvp)
+    for t in range(n_tokens):
+        pg = [5, 3][t // page]
+        np.testing.assert_array_equal(nkp[:, pg, t % page], k_dense[:, t])
+        np.testing.assert_array_equal(nvp[:, pg, t % page], v_dense[:, t])
+    untouched = [p for p in range(1, n_pages) if p not in (5, 3)]
+    assert not nkp[:, untouched].any() and not nvp[:, untouched].any()

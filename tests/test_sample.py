@@ -224,9 +224,11 @@ def test_cli_hermetic_path(capsys):
 
 
 def test_cli_refuses_past_position_table():
+    """The CLI has no guard of its own anymore (it drifted against the
+    library's): make_sampler's check_length surfaces through main()."""
     import pytest
 
-    with pytest.raises(SystemExit, match="max_position_embeddings"):
+    with pytest.raises(ValueError, match="max_position_embeddings"):
         main(["-m", "gpt2-debug", "--prompt-ids", "1,2",
               "--steps", "4000"])
 

@@ -1,0 +1,363 @@
+"""Serving engine correctness: continuous-batching output must be
+token-identical to the batch-1 sampler whatever the admission order,
+co-residency, or slot reuse; KV residency must scale with allocated pages;
+backpressure must refuse admission without corrupting running sequences.
+
+Everything here runs debug-size models (2 layers, 64 wide) — each engine
+is a handful of tiny compiles, so the suite stays inside tier-1.
+"""
+import threading
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_training_guide_tpu.models import get_model
+from distributed_training_guide_tpu.models.sample import make_sampler
+from distributed_training_guide_tpu.serve import (Request, ServeEngine,
+                                                  kv_page_bytes)
+from distributed_training_guide_tpu.serve.api import (generate_many,
+                                                      serve_http,
+                                                      throughput_stats)
+
+pytestmark = pytest.mark.serve
+
+
+@pytest.fixture(scope="module")
+def llama():
+    bundle = get_model("llama-debug", dtype=jnp.float32)
+    return bundle, bundle.init(bundle.config, jax.random.key(0))
+
+
+def _batch1(bundle, params, prompt, steps):
+    """The batch-1 kv-cache reference (= the engine at n_slots=1, which
+    test_sample.py pins against the independent full-recompute sampler)."""
+    return make_sampler(bundle, kv_cache=True)(params, prompt, steps)
+
+
+# ---- order invariance / continuous batching parity -------------------------
+
+@pytest.mark.parametrize("name", ["llama-debug", "gpt2-debug", "moe-debug"])
+def test_engine_matches_batch1_under_continuous_batching(name):
+    """8 requests of different lengths through 3 slots: co-residency,
+    eviction mid-flight, slot reuse — every request's tokens must equal its
+    own batch-1 generation, in BOTH admission orders."""
+    over = {"capacity_factor": 4.0} if name == "moe-debug" else {}
+    bundle = get_model(name, dtype=jnp.float32, **over)
+    params = bundle.init(bundle.config, jax.random.key(0))
+    reqs = [Request(prompt_ids=[3 + i, 17, 42][:(i % 3) + 1],
+                    max_new_tokens=3 + (i % 4), seed=i) for i in range(8)]
+    expect = {i: _batch1(bundle, params, r.prompt_ids, r.max_new_tokens)
+              for i, r in enumerate(reqs)}
+
+    for order in (list(range(8)), [5, 2, 7, 0, 3, 6, 1, 4]):
+        eng = ServeEngine(bundle, params, n_slots=3, page_size=4, max_len=16)
+        res = generate_many(eng, [reqs[i] for i in order])
+        for pos, i in enumerate(order):
+            assert res[pos].token_ids == expect[i], (
+                f"{name}: request {i} diverged when admitted at {pos}")
+
+
+def test_engine_matches_independent_recompute_reference(llama):
+    """Close the loop on the delegation: multi-slot engine output equals
+    the FULL-RECOMPUTE sampler (a genuinely independent program — no kv
+    cache, no paging), not just the batch-1 engine."""
+    bundle, params = llama
+    reqs = [Request(prompt_ids=[3, 17, 42, 7], max_new_tokens=6),
+            Request(prompt_ids=[5, 6], max_new_tokens=8)]
+    res = generate_many(
+        ServeEngine(bundle, params, n_slots=2, page_size=4, max_len=32),
+        reqs)
+    for r in res:
+        assert r.token_ids == make_sampler(bundle)(
+            params, r.prompt_ids, len(r.generated_ids))
+
+
+def test_temperature_stream_is_admission_order_invariant(llama):
+    """Sampling keys are fold_in(seed, position): a stochastic request
+    draws the same tokens whichever slot/iteration it lands in."""
+    bundle, params = llama
+    reqs = [Request(prompt_ids=[3, 17], max_new_tokens=6, temperature=0.9,
+                    top_k=40, top_p=0.9, seed=7),
+            Request(prompt_ids=[9, 2, 5], max_new_tokens=6, temperature=0.7,
+                    seed=8),
+            Request(prompt_ids=[4], max_new_tokens=4, temperature=1.3,
+                    seed=9)]
+    a = generate_many(
+        ServeEngine(bundle, params, n_slots=2, page_size=4, max_len=16),
+        reqs)
+    b = generate_many(
+        ServeEngine(bundle, params, n_slots=3, page_size=4, max_len=16),
+        list(reversed(reqs)))
+    for i in range(3):
+        assert a[i].token_ids == b[2 - i].token_ids
+    v = bundle.config.vocab_size
+    assert all(0 <= t < v for r in a for t in r.generated_ids)
+
+
+# ---- slot lifecycle ---------------------------------------------------------
+
+def test_eos_evicts_early_and_frees_the_slot(llama):
+    """Set eos to a token the greedy run is known to emit mid-stream: the
+    engine must stop there (finish_reason="eos", eos included), free the
+    slot, and the queued request behind it must still match batch-1."""
+    bundle, params = llama
+    prompt = [3, 17, 42, 7]
+    full = _batch1(bundle, params, prompt, 6)
+    eos = full[len(prompt) + 2]               # greedy emits it as token #3
+    reqs = [Request(prompt_ids=prompt, max_new_tokens=6, eos_id=eos),
+            Request(prompt_ids=[5, 6], max_new_tokens=8),
+            Request(prompt_ids=[9, 2], max_new_tokens=4)]
+    eng = ServeEngine(bundle, params, n_slots=1, page_size=4, max_len=16)
+    res = generate_many(eng, reqs)
+    assert res[0].finish_reason == "eos"
+    assert res[0].token_ids == full[:len(prompt) + 3]
+    assert res[1].finish_reason == "length"
+    assert res[1].token_ids == _batch1(bundle, params, [5, 6], 8)
+    assert res[2].token_ids == _batch1(bundle, params, [9, 2], 4)
+    assert eng.scheduler.pool.n_free == eng.scheduler.pool.capacity
+
+
+def test_backpressure_refuses_admission_never_corrupts(llama):
+    """Pool sized for ~1.5 requests: the FIFO head blocks while a running
+    sequence holds its reservation, every running sequence finishes
+    byte-identical to batch-1, and the blocked-admission stat records the
+    backpressure events."""
+    bundle, params = llama
+    # each request: 3 prompt + 5 new = 8 tokens = 2 pages of 4; pool of 3
+    # usable pages fits ONE resident request (worst-case reservation)
+    eng = ServeEngine(bundle, params, n_slots=4, page_size=4, max_len=8,
+                      n_pages=4)
+    reqs = [Request(prompt_ids=[3 + i, 17, 42], max_new_tokens=5, seed=i)
+            for i in range(3)]
+    res = generate_many(eng, reqs)
+    for r in res:
+        assert r.token_ids == _batch1(bundle, params, r.prompt_ids, 5)
+    assert eng.scheduler.stats["admission_blocked"] > 0
+    assert eng.scheduler.pool.n_free == eng.scheduler.pool.capacity
+
+
+def test_impossible_request_refused_at_submit(llama):
+    bundle, params = llama
+    eng = ServeEngine(bundle, params, n_slots=2, page_size=4, max_len=16,
+                      n_pages=3)
+    with pytest.raises(ValueError, match="whole pool"):
+        eng.submit(Request(prompt_ids=[1, 2, 3], max_new_tokens=10))
+    with pytest.raises(ValueError, match="max_len"):
+        eng.submit(Request(prompt_ids=[1] * 10, max_new_tokens=10))
+    with pytest.raises(ValueError, match="empty prompt"):
+        eng.submit(Request(prompt_ids=[]))
+
+
+def test_unservable_configs_refused_up_front(llama):
+    """Requests/configs that would crash mid-flight (seed past int32,
+    buckets that can't cover an admissible prompt) must refuse at submit /
+    construction, before any slot or page is committed."""
+    bundle, params = llama
+    eng = ServeEngine(bundle, params, n_slots=1, page_size=4, max_len=16)
+    with pytest.raises(ValueError, match="seed"):
+        eng.submit(Request(prompt_ids=[1], seed=2 ** 31))
+    with pytest.raises(ValueError, match="top_k"):
+        eng.submit(Request(prompt_ids=[1], top_k=2 ** 31))
+    with pytest.raises(ValueError, match="vocab_size"):
+        eng.submit(Request(prompt_ids=[bundle.config.vocab_size]))
+    with pytest.raises(ValueError, match="cover"):
+        ServeEngine(bundle, params, n_slots=1, page_size=4, max_len=32,
+                    prefill_buckets=(4, 8))
+    with pytest.raises(ValueError, match="capacity"):
+        ServeEngine(bundle, params, n_slots=1, page_size=4, max_len=16,
+                    prefill_buckets=(64,))
+
+
+def test_engine_thread_death_fails_waiters_loudly(llama, monkeypatch):
+    """If the engine thread hits an unexpected error, pending HTTP waiters
+    get a 500 (not an eternal hang), /healthz flips unhealthy, and new
+    submits are refused with 503."""
+    import http.client
+    import json
+    import time as _t
+
+    bundle, params = llama
+    eng = ServeEngine(bundle, params, n_slots=1, page_size=4, max_len=16)
+
+    def boom(*a, **k):
+        raise RuntimeError("injected engine fault")
+
+    monkeypatch.setattr(eng, "step", boom)
+    server, worker = serve_http(eng, port=0)
+    port = server.server_address[1]
+    try:
+        conn = http.client.HTTPConnection("127.0.0.1", port, timeout=60)
+        conn.request("POST", "/generate",
+                     json.dumps({"prompt_ids": [3], "max_new_tokens": 2}))
+        resp = conn.getresponse()
+        assert resp.status == 500
+        assert "injected engine fault" in json.loads(resp.read())["error"]
+        deadline = _t.monotonic() + 10
+        while worker.dead is None and _t.monotonic() < deadline:
+            _t.sleep(0.01)
+        conn.request("GET", "/healthz")
+        assert json.loads(conn.getresponse().read())["ok"] is False
+        conn.request("POST", "/generate",
+                     json.dumps({"prompt_ids": [3], "max_new_tokens": 2}))
+        assert conn.getresponse().status == 503
+        conn.close()
+    finally:
+        server.shutdown()
+        worker.stop()
+
+
+def test_throughput_stats_shape(llama):
+    bundle, params = llama
+    eng = ServeEngine(bundle, params, n_slots=2, page_size=4, max_len=16)
+    import time as _t
+
+    t0 = _t.perf_counter()
+    res = generate_many(eng, [Request(prompt_ids=[3, 17], max_new_tokens=4,
+                                      seed=s) for s in range(2)])
+    stats = throughput_stats(res, _t.perf_counter() - t0, eng)
+    assert stats["generated_tokens"] == 8
+    assert stats["tokens_per_s"] > 0
+    assert 0 < stats["decode_occupancy"] <= 1.0
+    assert stats["n_requests"] == 2
+
+
+# ---- memory pin -------------------------------------------------------------
+
+def test_kv_residency_scales_with_pages_not_slots_times_maxlen(llama):
+    """The acceptance-criteria pin. (a) live buffers: the engine's resident
+    KV bytes equal the page-pool formula and sit well under the dense
+    n_slots x max_len cache; (b) lowered HLO: the compiled decode step's
+    cache operands/results ARE the pool shape — the program carries no
+    [n_slots, max_len] resident cache."""
+    bundle, params = llama
+    cfg = bundle.config
+    n_slots, page, max_len = 8, 16, 256
+    # pool sized at 1/4 of full residency: 32 usable pages + trash
+    eng = ServeEngine(bundle, params, n_slots=n_slots, page_size=page,
+                      max_len=max_len, n_pages=33)
+
+    assert eng.kv_cache_bytes() == kv_page_bytes(cfg, page_size=page,
+                                                 n_pages=33)
+    from distributed_training_guide_tpu.models import llama as llama_mod
+
+    dense = llama_mod.init_cache(cfg, n_slots, max_len)
+    dense_bytes = dense["k"].nbytes + dense["v"].nbytes
+    assert eng.kv_cache_bytes() < dense_bytes / 3.5
+
+    # (b) lower the ONE decode program and inspect its kv operands
+    arr = eng.scheduler.decode_arrays()
+    lowered = eng._decode_fn.lower(
+        eng.params, eng.pages["k"], eng.pages["v"],
+        jnp.asarray(arr["tokens"]), jnp.asarray(arr["lengths"]),
+        jnp.asarray(arr["tables"]), jnp.asarray(arr["seeds"]),
+        jnp.asarray(arr["temps"]), jnp.asarray(arr["top_ks"]),
+        jnp.asarray(arr["top_ps"]), jnp.asarray(arr["actives"]))
+    pool_shape = (cfg.num_layers, 33, page, cfg.num_kv_heads, cfg.head_size)
+    avals = jax.tree.leaves(lowered.in_avals)
+    assert sum(a.shape == pool_shape for a in avals) == 2   # k and v pools
+    dense_shape = (cfg.num_layers, n_slots, max_len, cfg.num_kv_heads,
+                   cfg.head_size)
+    assert not any(a.shape == dense_shape for a in avals)
+    out_avals = jax.tree.leaves(lowered.out_info)
+    assert sum(tuple(a.shape) == pool_shape for a in out_avals) == 2
+
+    # the under-provisioned pool still serves (backpressure, not OOM): 8
+    # co-resident 40-token requests would need 8x3=24 pages of the 32
+    reqs = [Request(prompt_ids=[3 + i, 5], max_new_tokens=38, seed=i)
+            for i in range(8)]
+    res = generate_many(eng, reqs)
+    assert all(len(r.generated_ids) == 38 for r in res)
+
+
+# ---- sharded weights --------------------------------------------------------
+
+def test_engine_runs_on_tp_mesh(llama, eight_devices):
+    """Sharded weights through the existing plans: tp=2 params, replicated
+    pages — tokens must match the single-device engine exactly."""
+    from distributed_training_guide_tpu.parallel import make_mesh, make_plan
+
+    bundle, params = llama
+    plan = make_plan("tp", make_mesh(tp=2, devices=eight_devices[:2]))
+    reqs = [Request(prompt_ids=[3, 17, 42], max_new_tokens=5, seed=1),
+            Request(prompt_ids=[5, 6], max_new_tokens=6, seed=2)]
+    sharded = generate_many(
+        ServeEngine(bundle, params, n_slots=2, page_size=4, max_len=16,
+                    plan=plan), reqs)
+    single = generate_many(
+        ServeEngine(bundle, params, n_slots=2, page_size=4, max_len=16),
+        reqs)
+    for a, b in zip(sharded, single):
+        assert a.token_ids == b.token_ids
+
+
+# ---- HTTP endpoint ----------------------------------------------------------
+
+def test_http_endpoint_concurrent_requests(llama):
+    """Two clients hitting the endpoint concurrently co-batch in the
+    engine thread; responses carry tokens + latency and match batch-1."""
+    import http.client
+    import json
+
+    bundle, params = llama
+    eng = ServeEngine(bundle, params, n_slots=2, page_size=4, max_len=16)
+    server, worker = serve_http(eng, port=0)
+    port = server.server_address[1]
+    try:
+        payloads = [{"prompt_ids": [3, 17, 42], "max_new_tokens": 5},
+                    {"prompt_ids": [5, 6], "max_new_tokens": 6}]
+        out = [None, None]
+
+        def post(i):
+            conn = http.client.HTTPConnection("127.0.0.1", port, timeout=120)
+            conn.request("POST", "/generate", json.dumps(payloads[i]))
+            resp = conn.getresponse()
+            out[i] = (resp.status, json.loads(resp.read()))
+            conn.close()
+
+        threads = [threading.Thread(target=post, args=(i,)) for i in (0, 1)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=180)
+        for i, payload in enumerate(payloads):
+            status, body = out[i]
+            assert status == 200
+            assert body["token_ids"] == _batch1(
+                bundle, params, payload["prompt_ids"],
+                payload["max_new_tokens"])
+            assert body["finish_reason"] == "length"
+            assert body["latency_s"] >= 0
+
+        conn = http.client.HTTPConnection("127.0.0.1", port, timeout=30)
+        conn.request("GET", "/healthz")
+        health = json.loads(conn.getresponse().read())
+        assert health["ok"] and health["n_slots"] == 2
+        conn.request("POST", "/generate", json.dumps({"prompt_ids": []}))
+        assert conn.getresponse().status == 400   # scheduler refusal -> 400
+        conn.close()
+    finally:
+        server.shutdown()
+        worker.stop()
+
+
+def test_serve_cli_offline_batch(capsys):
+    """python -m distributed_training_guide_tpu.serve hermetic path: one
+    JSON line per request + the aggregate stats line."""
+    import json
+
+    from distributed_training_guide_tpu.serve.__main__ import main
+
+    main(["-m", "llama-debug", "--prompt-ids", "3,17,42",
+          "--prompt-ids", "5,6", "--steps", "4", "--n-slots", "2",
+          "--page-size", "4", "--max-len", "16"])
+    lines = [json.loads(l) for l in capsys.readouterr().out.splitlines()
+             if l.startswith("{")]
+    assert "kv_report" in lines[0]
+    results = [l for l in lines if "token_ids" in l]
+    assert len(results) == 2
+    assert all(len(r["token_ids"]) == len(p) + 4
+               for r, p in zip(results, ([3, 17, 42], [5, 6])))
+    stats = lines[-1]["stats"]
+    assert stats["n_requests"] == 2 and stats["generated_tokens"] == 8
