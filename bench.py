@@ -276,6 +276,14 @@ def run_rung(rung: dict) -> None:
     fpt = transformer_flops_per_token(bundle.num_active_params(), cfg.num_layers,
                                       cfg.hidden_size, seq, vocab_size=cfg.vocab_size)
     peak = device_peak_flops(devices[0])
+    # banded preflight pricing for windowed configs (uniform or per-layer):
+    # MFU above keeps the conventional dense-causal count so the column stays
+    # comparable across rungs — this reports the honest O(S*window) cost
+    # beside it (attn_kv_len = mean keys/query; matches preflight's roofline)
+    from distributed_training_guide_tpu.utils.mfu import (
+        banded_attention_kv_length)
+
+    attn_kv = banded_attention_kv_length(cfg, seq)
 
     def result(dt: float, loss: float, steps_timed: int, partial: bool) -> dict:
         tokens_per_s = global_batch * seq / dt
@@ -305,6 +313,15 @@ def run_rung(rung: dict) -> None:
                    if rung.get("offload_opt_state") else {}),
                 **({"sliding_window": rung["sliding_window"]}
                    if rung.get("sliding_window") else {}),
+                **({"attn_impl": rung["attn_impl"]}
+                   if rung.get("attn_impl") else {}),
+                **({"attn_kv_len": attn_kv,
+                    "banded_flops_per_token": int(
+                        transformer_flops_per_token(
+                            bundle.num_active_params(), cfg.num_layers,
+                            cfg.hidden_size, seq, vocab_size=cfg.vocab_size,
+                            attn_kv_len=attn_kv))}
+                   if attn_kv < seq else {}),
                 **({"moe_dispatch": rung["moe_dispatch"]}
                    if rung.get("moe_dispatch") else {}),
                 "loss": round(loss, 4),
@@ -696,6 +713,23 @@ SWEEP_QUEUE = [
          batch=2, seq=16384, max_position=16384, sliding_window=2048,
          remat=True, remat_policy="attn", optimizer="adafactor",
          fence_every=4),
+    # --- Gemma-2 flash-vs-xla A/B (round 6: softcap, query_pre_attn_scalar
+    # and the alternating per-layer windows now run IN the Pallas kernel —
+    # the force-xla guard is gone). Same shape both rungs, attn_impl the
+    # ONLY variable (one-new-variable stall policy); the xla twin is the
+    # O(S^2)-memory program every Gemma-2 run compiled before this round.
+    # seq 8192 > the 4096 window so the even layers genuinely band (the
+    # banded O(S*window) pricing rides the result detail as attn_kv_len /
+    # banded_flops_per_token, matching preflight's roofline); bf16 state +
+    # adafactor + attn remat to fit the 2.6B model on one chip.
+    dict(name="gemma2_2b_flash_fence4_b1", model="gemma2-2b", batch=1,
+         seq=8192, attn_impl="flash", remat=True, remat_policy="attn",
+         optimizer="adafactor", param_dtype="bfloat16", fence_every=4,
+         loss_chunks=8),
+    dict(name="gemma2_2b_xla_fence4_b1", model="gemma2-2b", batch=1,
+         seq=8192, attn_impl="xla", remat=True, remat_policy="attn",
+         optimizer="adafactor", param_dtype="bfloat16", fence_every=4,
+         loss_chunks=8),
 ]
 
 

@@ -242,6 +242,17 @@ def _flat_rmsnorm(x: jnp.ndarray, scale: jnp.ndarray, eps: float,
     return (normed * scale.astype(jnp.float32)).astype(x.dtype)
 
 
+def attention_extras(config):
+    """Gemma-2 attention extras as (score_scale, logit_softcap) — None/None
+    everywhere else. The ONE derivation of ``query_pre_attn_scalar ** -0.5``
+    shared by the model dispatch and the Trainer's wrapper factories (both
+    paths must bake the identical scale or flash/CP would silently diverge
+    from xla)."""
+    qpas = getattr(config, "query_pre_attn_scalar", None)
+    return ((qpas ** -0.5) if qpas else None,
+            getattr(config, "attn_logit_softcap", None))
+
+
 def attention_sublayer(config, x: jnp.ndarray, attn_params: dict, norm_scale,
                        positions: jnp.ndarray, attn_impl,
                        standard_layout: bool = True,
@@ -314,11 +325,7 @@ def attention_sublayer(config, x: jnp.ndarray, attn_params: dict, norm_scale,
     window = getattr(config, "sliding_window", None)
     if window_override is not None:  # per-layer pattern (Gemma-2): a traced
         window = window_override     # scalar, already 0 -> "no band" resolved
-    # Gemma-2 attention extras (None everywhere else): score-scale override
-    # and tanh logit capping — both force the xla path via auto dispatch
-    qpas = getattr(config, "query_pre_attn_scalar", None)
-    attn_scale = (qpas ** -0.5) if qpas else None
-    softcap = getattr(config, "attn_logit_softcap", None)
+    attn_scale, softcap = attention_extras(config)
     if attend_override is not None:
         attn, aux = attend_override(q, k, v, window=window, scale=attn_scale,
                                     softcap=softcap)
@@ -337,9 +344,17 @@ def attention_sublayer(config, x: jnp.ndarray, attn_params: dict, norm_scale,
                                    standard_layout=False, window=window,
                                    scale=attn_scale, logit_softcap=softcap)
     elif callable(attn_impl):  # e.g. ring attention under context parallelism
-        # Trainer-built wrappers carry the window themselves (the sharded
-        # flash factory) or reject it (ring/ulysses CP, Trainer validation)
-        attn = attn_impl(q, k, v, standard_layout=standard_layout)
+        # Trainer-built wrappers (sharded flash, ring, ulysses) declare
+        # accepts_window and take the per-call window — uniform bands come
+        # through unchanged and traced per-layer schedules (Gemma-2) ride
+        # each wrapper's dynamic band plumbing; softcap/scale are baked in
+        # by the Trainer factories. Other callables keep the bare contract
+        # (Trainer validation rejects them when extras are configured).
+        if getattr(attn_impl, "accepts_window", False):
+            attn = attn_impl(q, k, v, standard_layout=standard_layout,
+                             window=window)
+        else:
+            attn = attn_impl(q, k, v, standard_layout=standard_layout)
     else:
         attn = multihead_attention(q, k, v, causal=True, positions=positions,
                                    kv_positions=positions, impl=attn_impl,
@@ -558,7 +573,26 @@ def _layer_window_column(config):
     lw = getattr(config, "layer_windows", None)
     if not lw:
         return None
+    bad = [w for w in lw if w < 0]
+    if bad:
+        # a window <= 0 reaching the kernels as a traced value would mask
+        # every score and return all-zero attention with no error; 0 is the
+        # sanctioned "full attention" encoding, anything below is a bug
+        raise ValueError(f"layer_windows entries must be >= 0 "
+                         f"(0 = full attention); got {bad}")
     return jnp.asarray([w if w else 2 ** 30 for w in lw], jnp.int32)
+
+
+def _scan_kv_layers(body, x, params, cache, wins):
+    """``lax.scan`` the per-layer decode ``body`` over (layer, k, v, window)
+    columns — the one adapter shared by every family's prefill/decode scans.
+    ``wins`` None (uniform window config) scans without the window column so
+    the traced program stays identical to the pre-schedule form."""
+    if wins is None:
+        return jax.lax.scan(lambda c, inp: body(c, (*inp, None)), x,
+                            (params["layers"], cache["k"], cache["v"]))
+    return jax.lax.scan(body, x,
+                        (params["layers"], cache["k"], cache["v"], wins))
 
 
 def init_cache(config: LlamaConfig, batch: int, max_len: int) -> dict:
@@ -592,12 +626,7 @@ def prefill(config: LlamaConfig, params: dict, input_ids: jnp.ndarray,
         nv = jax.lax.dynamic_update_slice(cv, v.astype(cv.dtype), (0, 0, 0, 0))
         return x, (nk, nv)
 
-    if wins is None:
-        body_fn = lambda x, inp: body(x, (*inp, None))
-        xs = (params["layers"], cache["k"], cache["v"])
-    else:
-        body_fn, xs = body, (params["layers"], cache["k"], cache["v"], wins)
-    x, (ks, vs) = jax.lax.scan(body_fn, x, xs)
+    x, (ks, vs) = _scan_kv_layers(body, x, params, cache, wins)
     # slice BEFORE the head: projecting all P positions to [B, P, V] fp32
     # only to keep one row would cost P x the lm_head matmul and a
     # prompt-length-scaled logits buffer (norm + projection are per-position)
@@ -627,12 +656,7 @@ def decode_step(config: LlamaConfig, params: dict, token_ids: jnp.ndarray,
         x, _ = _decode_residuals(config, x, layer, attn)
         return x, (nk, nv)
 
-    if wins is None:
-        body_fn = lambda x, inp: body(x, (*inp, None))
-        xs = (params["layers"], cache["k"], cache["v"])
-    else:
-        body_fn, xs = body, (params["layers"], cache["k"], cache["v"], wins)
-    x, (ks, vs) = jax.lax.scan(body_fn, x, xs)
+    x, (ks, vs) = _scan_kv_layers(body, x, params, cache, wins)
     return lm_head_logits(config, params, x)[:, -1], {"k": ks, "v": vs}
 
 
@@ -669,12 +693,7 @@ def paged_decode_step(config: LlamaConfig, params: dict,
         x, _ = _decode_residuals(config, x, layer, attn)
         return x, (nkp, nvp)
 
-    if wins is None:
-        body_fn = lambda x, inp: body(x, (*inp, None))
-        xs = (params["layers"], cache["k"], cache["v"])
-    else:
-        body_fn, xs = body, (params["layers"], cache["k"], cache["v"], wins)
-    x, (ks, vs) = jax.lax.scan(body_fn, x, xs)
+    x, (ks, vs) = _scan_kv_layers(body, x, params, cache, wins)
     return lm_head_logits(config, params, x)[:, -1], {"k": ks, "v": vs}
 
 

@@ -97,6 +97,10 @@ class MoELlamaConfig:
     rope_theta: float = 10000.0
     rope_scaling: Optional[tuple] = None  # frozen HF rope_scaling (ops/rope.py)
     sliding_window: Optional[int] = None  # SWA band (Mixtral 8x7B ships 4096)
+    # per-layer window pattern (an L-tuple, 0 = full attention that layer) —
+    # same contract as the dense family's Gemma-2 schedule; rides the layer
+    # scans as a traced column (llama._layer_window_column)
+    layer_windows: Optional[tuple] = None
     rms_norm_eps: float = 1e-5
     tie_word_embeddings: bool = False
     dtype: Any = jnp.bfloat16
@@ -553,10 +557,12 @@ def make_ragged_ep_dispatch(mesh, config: MoELlamaConfig, *,
 
 
 def _block(config: MoELlamaConfig, carry, layer: dict, positions, attn_impl,
-           standard_layout=True, tp_axis=None, moe_ep=None):
+           standard_layout=True, tp_axis=None, moe_ep=None,
+           window_override=None):
     x, aux_acc, dropped_acc = carry
     attn = attention_sublayer(config, x, layer["attn"], layer["input_norm"],
-                              positions, attn_impl, standard_layout, tp_axis)
+                              positions, attn_impl, standard_layout, tp_axis,
+                              window_override=window_override)
     x = x + attn
 
     h = _rmsnorm(x, layer["post_attn_norm"], config.rms_norm_eps)
@@ -599,8 +605,14 @@ def apply_with_aux(
     block = partial(_block, config, positions=positions, attn_impl=attn_impl,
                     standard_layout=standard_layout, moe_ep=moe_ep)
 
-    def scan_body(carry, layer_params):
-        new_carry = block(carry, layer_params)
+    wins = llama._layer_window_column(config)
+
+    def scan_body(carry, xs):
+        if wins is not None:   # per-layer window column rides the scan
+            layer_params, w = xs
+            new_carry = block(carry, layer_params, window_override=w)
+        else:
+            new_carry = block(carry, xs)
         if activation_sharding is not None:
             new_carry = (jax.lax.with_sharding_constraint(new_carry[0],
                                                           activation_sharding),
@@ -612,8 +624,9 @@ def apply_with_aux(
         scan_body = jax.checkpoint(scan_body, policy=policy, prevent_cse=False)
 
     zero = jnp.zeros((), jnp.float32)
-    (x, aux, dropped), _ = jax.lax.scan(scan_body, (x, zero, zero),
-                                        params["layers"])
+    scan_xs = (params["layers"] if wins is None
+               else (params["layers"], wins))
+    (x, aux, dropped), _ = jax.lax.scan(scan_body, (x, zero, zero), scan_xs)
 
     out = (llama.final_hidden(config, params, x) if return_hidden
            else llama.lm_head_logits(config, params, x))
@@ -663,11 +676,13 @@ def prefill(config: MoELlamaConfig, params: dict, input_ids: jnp.ndarray,
     positions = jnp.broadcast_to(jnp.arange(p)[None, :], (b, p))
     x = embed_tokens(config, params, input_ids, positions)
 
+    wins = llama._layer_window_column(config)
+
     def body(x, inputs):
-        layer, ck, cv = inputs
+        layer, ck, cv, w = inputs
         attn, (k, v) = attention_sublayer(
             config, x, layer["attn"], layer["input_norm"], positions,
-            "xla", return_kv=True)
+            "xla", return_kv=True, window_override=w)
         x = x + attn
         h = _rmsnorm(x, layer["post_attn_norm"], config.rms_norm_eps)
         y, _, _ = _moe_ffn(config, h, layer["moe"], no_drop=True)
@@ -676,8 +691,7 @@ def prefill(config: MoELlamaConfig, params: dict, input_ids: jnp.ndarray,
         nv = jax.lax.dynamic_update_slice(cv, v.astype(cv.dtype), (0, 0, 0, 0))
         return x, (nk, nv)
 
-    x, (ks, vs) = jax.lax.scan(body, x, (params["layers"],
-                                         cache["k"], cache["v"]))
+    x, (ks, vs) = llama._scan_kv_layers(body, x, params, cache, wins)
     # slice BEFORE the head (llama.prefill rationale: don't project all P
     # positions to [B, P, V] fp32 to keep one row)
     x_last = (x[:, -1:] if last_pos is None
@@ -695,19 +709,20 @@ def decode_step(config: MoELlamaConfig, params: dict, token_ids: jnp.ndarray,
     positions = jnp.broadcast_to(jnp.asarray(pos)[None, None], (b, 1))
     x = embed_tokens(config, params, token_ids, positions)
 
+    wins = llama._layer_window_column(config)
+
     def body(x, inputs):
-        layer, ck, cv = inputs
+        layer, ck, cv, w = inputs
         attn, (nk, nv) = attention_sublayer(
             config, x, layer["attn"], layer["input_norm"], positions,
-            "xla", kv_cache=(ck, cv, pos), return_kv=True)
+            "xla", kv_cache=(ck, cv, pos), return_kv=True, window_override=w)
         x = x + attn
         h = _rmsnorm(x, layer["post_attn_norm"], config.rms_norm_eps)
         y, _, _ = _moe_ffn(config, h, layer["moe"], no_drop=True)
         x = x + y
         return x, (nk, nv)
 
-    x, (ks, vs) = jax.lax.scan(body, x, (params["layers"],
-                                         cache["k"], cache["v"]))
+    x, (ks, vs) = llama._scan_kv_layers(body, x, params, cache, wins)
     return lm_head_logits(config, params, x)[:, -1], {"k": ks, "v": vs}
 
 
@@ -722,8 +737,10 @@ def paged_decode_step(config: MoELlamaConfig, params: dict,
     pos2d = jnp.broadcast_to(positions[:, None], (s, 1))
     x = embed_tokens(config, params, token_ids, pos2d)
 
+    wins = llama._layer_window_column(config)
+
     def body(x, inputs):
-        layer, kp, vp = inputs
+        layer, kp, vp, w = inputs
 
         def override(q, k, v, *, window, scale, softcap):
             return attend(q, k, v, kp, vp, window=window, scale=scale,
@@ -731,15 +748,15 @@ def paged_decode_step(config: MoELlamaConfig, params: dict,
 
         attn, (nkp, nvp) = attention_sublayer(
             config, x, layer["attn"], layer["input_norm"], pos2d,
-            "xla", return_kv=True, attend_override=override)
+            "xla", return_kv=True, window_override=w,
+            attend_override=override)
         x = x + attn
         h = _rmsnorm(x, layer["post_attn_norm"], config.rms_norm_eps)
         y, _, _ = _moe_ffn(config, h, layer["moe"], no_drop=True)
         x = x + y
         return x, (nkp, nvp)
 
-    x, (ks, vs) = jax.lax.scan(body, x, (params["layers"],
-                                         cache["k"], cache["v"]))
+    x, (ks, vs) = llama._scan_kv_layers(body, x, params, cache, wins)
     return lm_head_logits(config, params, x)[:, -1], {"k": ks, "v": vs}
 
 

@@ -17,6 +17,8 @@ import jax
 import jax.numpy as jnp
 from jax.ad_checkpoint import checkpoint_name
 
+from .flash_attention import check_static_window
+
 
 def _xla_attention(
     q: jnp.ndarray,
@@ -86,12 +88,14 @@ def multihead_attention(
     (flash on TPU when causal, tile-aligned, and the caller confirms the
     standard contiguous position layout via ``standard_layout`` — sequence-
     sharded/CP callers pass False and get the mask-aware xla path).
-    ``window``: sliding-window attention. Static ints run on both paths
-    (the flash kernel skips out-of-band kv tiles for an O(S*window) cost);
-    a TRACED window (per-layer patterns, Gemma-2) runs on the xla path.
+    ``window``: sliding-window attention, on both paths. Static ints bake
+    the band into the flash kernel; a TRACED window (per-layer patterns,
+    Gemma-2) rides the kernel's dynamic band operand — either way
+    out-of-band kv tiles are skipped for an O(S*window) cost.
     ``scale``: score scale override (Gemma-2's query_pre_attn_scalar**-0.5;
-    default head_dim**-0.5). ``logit_softcap``: Gemma-2 tanh capping —
-    xla path only (auto falls back; forced flash fails loudly).
+    default head_dim**-0.5). ``logit_softcap``: Gemma-2 tanh capping of the
+    scaled scores — both paths, with the (1 - tanh^2) backward term on the
+    flash path.
     """
     if window is not None and not causal:
         # the band is defined relative to the causal diagonal; the xla path
@@ -101,22 +105,17 @@ def multihead_attention(
         raise ValueError(
             "window (sliding-window attention) requires causal=True — a "
             "non-causal banded mask is not implemented on either path")
-    static_window = window is None or isinstance(window, int)
+    check_static_window(window)
     if impl == "auto":
         on_tpu = jax.default_backend() == "tpu"
         aligned = (q.shape[1] % 128 == 0 and k.shape[1] % 128 == 0
                    and q.shape[-1] % 64 == 0)
-        impl = ("flash" if (on_tpu and aligned and causal and standard_layout
-                            and logit_softcap is None and scale is None
-                            and static_window) else "xla")
+        impl = ("flash" if (on_tpu and aligned and causal and standard_layout)
+                else "xla")
     if impl == "flash":
-        if logit_softcap is not None or scale is not None or not static_window:
-            raise ValueError(
-                "impl='flash' does not implement logit softcapping, scale "
-                "overrides, or traced (per-layer) windows — use impl='xla' "
-                "(auto falls back by itself)")
         from .flash_attention import flash_attention
 
-        return flash_attention(q, k, v, causal=causal, window=window)
+        return flash_attention(q, k, v, causal=causal, window=window,
+                               scale=scale, logit_softcap=logit_softcap)
     return _xla_attention(q, k, v, causal, positions, kv_positions, window,
                           scale, logit_softcap)

@@ -33,6 +33,7 @@ from typing import Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax.ad_checkpoint import checkpoint_name
 from jax.experimental import pallas as pl
 
@@ -58,37 +59,66 @@ def _pick_block(s: int, preferred: int = 512) -> int:
 # forward
 # ---------------------------------------------------------------------------
 
-def _band_live(causal, window, iq, ik, block_q, block_k):
+def _band_live(causal, window, iq, ik, block_q, block_k, q_off=0, k_off=0):
     """Block-level skip predicate: False when NO (q_pos, k_pos) pair in the
     (iq, ik) tile satisfies the causal/sliding-window band. The whole tile's
     compute is skipped via ``pl.when`` — this is where SWA's speedup comes
     from (tiles strictly below the band cost zero, so work is O(S*W) not
-    O(S^2) once S >> window)."""
+    O(S^2) once S >> window). ``window``/``q_off``/``k_off`` may be traced
+    scalars (per-layer window schedules, ring chunk offsets) — program_id is
+    runtime-valued anyway, so the predicate was never a compile-time skip."""
     if not causal:
         return True
-    live = ik * block_k <= iq * block_q + block_q - 1
+    live = ik * block_k + k_off <= iq * block_q + block_q - 1 + q_off
     if window is not None:
         # newest key in the tile still inside the OLDEST query's window
-        live &= ik * block_k + block_k - 1 >= iq * block_q - (window - 1)
+        live &= (ik * block_k + block_k - 1 + k_off
+                 >= iq * block_q + q_off - (window - 1))
     return live
 
 
-def _band_mask(causal, window, iq, ik, block_q, block_k, shape):
+def _band_mask(causal, window, iq, ik, block_q, block_k, shape,
+               q_off=0, k_off=0):
     """Element mask for a live tile (None = nothing masked)."""
     if not causal:
         return None
-    q_pos = iq * block_q + jax.lax.broadcasted_iota(jnp.int32, shape, 0)
-    k_pos = ik * block_k + jax.lax.broadcasted_iota(jnp.int32, shape, 1)
+    q_pos = iq * block_q + q_off + jax.lax.broadcasted_iota(jnp.int32, shape, 0)
+    k_pos = ik * block_k + k_off + jax.lax.broadcasted_iota(jnp.int32, shape, 1)
     mask = q_pos >= k_pos
     if window is not None:
         mask &= (q_pos - k_pos) < window
     return mask
 
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
-                *, scale, causal, window, block_q, block_k, num_kv_blocks):
+def _unpack_band(band_ref, window):
+    """Kernel-side band parameters: (window, q_off, k_off).
+
+    ``band_ref`` is the optional [3] int32 SMEM operand carrying a DYNAMIC
+    band — [window, q_offset, k_offset] — used for traced per-layer windows
+    (Gemma-2's alternating schedule rides a lax.scan) and the ring's global
+    chunk offsets. When absent, ``window`` is the static compile-time int
+    (or None = no band) and offsets are zero, exactly the pre-dynamic
+    behavior."""
+    if band_ref is not None:
+        return band_ref[0], band_ref[1], band_ref[2]
+    return window, 0, 0
+
+
+def _softcap_fwd(s, softcap):
+    """tanh logit capping (Gemma-2): cap * tanh(s / cap), scores-side."""
+    return jnp.tanh(s / softcap) * softcap
+
+
+def _fwd_kernel(*refs, scale, softcap, causal, window, banded, block_q,
+                block_k, num_kv_blocks):
+    if banded:  # inputs carry the trailing dynamic [3] band operand
+        q_ref, k_ref, v_ref, band_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr = refs
+    else:
+        q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr = refs
+        band_ref = None
     iq = pl.program_id(2)
     ik = pl.program_id(3)
+    window, q_off, k_off = _unpack_band(band_ref, window)
 
     @pl.when(ik == 0)
     def _init():
@@ -97,7 +127,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
         acc_scr[:] = jnp.zeros_like(acc_scr)
 
     # band: kv block fully outside the causal/window band -> skip all compute
-    live = _band_live(causal, window, iq, ik, block_q, block_k)
+    live = _band_live(causal, window, iq, ik, block_q, block_k, q_off, k_off)
 
     @pl.when(live)
     def _compute():
@@ -105,7 +135,10 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
         k = k_ref[0, 0].astype(jnp.float32)          # [BK, D]
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * scale
-        mask = _band_mask(causal, window, iq, ik, block_q, block_k, s.shape)
+        if softcap is not None:  # Gemma-2: tanh cap BEFORE the mask
+            s = _softcap_fwd(s, softcap)
+        mask = _band_mask(causal, window, iq, ik, block_q, block_k, s.shape,
+                          q_off, k_off)
         if mask is not None:
             s = jnp.where(mask, s, NEG_INF)
 
@@ -139,37 +172,89 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
         lse_ref[0, 0] = jnp.broadcast_to(lse, lse_ref.shape[2:]).astype(jnp.float32)
 
 
-def _flash_fwd(q, k, v, causal, window, block_q, block_k, interpret):
+def check_static_window(window):
+    """A static ``window < 1`` masks EVERY score: the kernel's safe_l path
+    (and the xla softmax) would return all-zero attention with no error —
+    silently-dead attention. Raise instead, at every entry point. Traced
+    windows can't be checked here; their sanctioned producer
+    (``_layer_window_column``) validates its config inputs."""
+    if isinstance(window, int) and window < 1:
+        raise ValueError(f"window must be >= 1, got {window}")
+
+
+def _pack_band(window, q_off=0, k_off=0):
+    """The kernels' [window, q_offset, k_offset] int32 band operand (SMEM).
+    This layout — and the 2**30 "full attention" encoding packed for a None
+    window — is the one dynamic-band contract shared by _resolve_band, the
+    sharded wrapper's per-call override, and the ring's chunk-offset pairs."""
+    return jnp.stack([jnp.asarray(2 ** 30 if window is None else window),
+                      jnp.asarray(q_off),
+                      jnp.asarray(k_off)]).astype(jnp.int32)
+
+
+def _resolve_band(window):
+    """Split a caller's window into the kernels' static ``window`` +
+    optional dynamic [3] int32 band operand ([window, q_offset, k_offset];
+    offsets zero here — the ring packs nonzero chunk offsets directly).
+
+    Static path (window None or a Python int): no operand — the band is
+    baked into the kernel, byte-identical to the pre-dynamic program.
+    Dynamic path (traced window): the band rides a tiny SMEM operand. A
+    traced window of 2**30 (= "full attention this layer",
+    _layer_window_column's encoding of 0) is wider than any supported
+    sequence, so the banded program degenerates to plain causal numerics."""
+    if window is None or isinstance(window, int):
+        return window, None
+    return None, _pack_band(window)
+
+
+def _band_spec():
+    return pl.BlockSpec(memory_space=pltpu.SMEM if pltpu is not None else None)
+
+
+def _flash_fwd(q, k, v, causal, window, block_q, block_k, interpret,
+               scale=None, softcap=None, band=None):
     b, hq, sq, d = q.shape
     _, hkv, sk, _ = k.shape
     groups = hq // hkv
     block_q = _pick_block(sq, block_q)
     block_k = _pick_block(sk, block_k)
     nq, nk = sq // block_q, sk // block_k
-    scale = 1.0 / (d ** 0.5)
+    if scale is None:
+        scale = 1.0 / (d ** 0.5)
+    if band is None:
+        window, band = _resolve_band(window)
+    else:
+        window = None  # caller-packed dynamic band (the custom_vjp/ring path)
 
     grid = (b, hq, nq, nk)
     kernel = functools.partial(
-        _fwd_kernel, scale=scale, causal=causal, window=window,
-        block_q=block_q, block_k=block_k, num_kv_blocks=nk)
+        _fwd_kernel, scale=scale, softcap=softcap, causal=causal,
+        window=window, banded=band is not None, block_q=block_q,
+        block_k=block_k, num_kv_blocks=nk)
 
     out_shape = (
         jax.ShapeDtypeStruct((b, hq, sq, d), q.dtype),
         jax.ShapeDtypeStruct((b, hq, sq, 128), jnp.float32),  # lse (lane-padded)
     )
+    in_specs = [
+        pl.BlockSpec((1, 1, block_q, d), lambda b_, h, iq, ik: (b_, h, iq, 0),
+                     memory_space=_VMEM),
+        pl.BlockSpec((1, 1, block_k, d),
+                     lambda b_, h, iq, ik, g=groups: (b_, h // g, ik, 0),
+                     memory_space=_VMEM),
+        pl.BlockSpec((1, 1, block_k, d),
+                     lambda b_, h, iq, ik, g=groups: (b_, h // g, ik, 0),
+                     memory_space=_VMEM),
+    ]
+    args = [q, k, v]
+    if band is not None:
+        in_specs.append(_band_spec())
+        args.append(band)
     o, lse = pl.pallas_call(
         kernel,
         grid=grid,
-        in_specs=[
-            pl.BlockSpec((1, 1, block_q, d), lambda b_, h, iq, ik: (b_, h, iq, 0),
-                         memory_space=_VMEM),
-            pl.BlockSpec((1, 1, block_k, d),
-                         lambda b_, h, iq, ik, g=groups: (b_, h // g, ik, 0),
-                         memory_space=_VMEM),
-            pl.BlockSpec((1, 1, block_k, d),
-                         lambda b_, h, iq, ik, g=groups: (b_, h // g, ik, 0),
-                         memory_space=_VMEM),
-        ],
+        in_specs=in_specs,
         out_specs=(
             pl.BlockSpec((1, 1, block_q, d), lambda b_, h, iq, ik: (b_, h, iq, 0),
                          memory_space=_VMEM),
@@ -183,7 +268,7 @@ def _flash_fwd(q, k, v, causal, window, block_q, block_k, interpret):
         ],
         out_shape=out_shape,
         interpret=interpret,
-    )(q, k, v)
+    )(*args)
     return o, lse[..., 0]
 
 
@@ -191,16 +276,41 @@ def _flash_fwd(q, k, v, causal, window, block_q, block_k, interpret):
 # backward
 # ---------------------------------------------------------------------------
 
-def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dq_scr,
-               *, scale, causal, window, block_q, block_k, num_kv_blocks):
+def _bwd_scores(q, k, lse, scale, softcap, mask):
+    """Shared bwd-side score recompute: (p, softcap_grad) where ``p`` is the
+    softmax probability rebuilt from the GLOBAL lse and ``softcap_grad`` the
+    tanh chain factor (1 - tanh^2), None without capping. Masked lanes need
+    no explicit zeroing: lse is finite (every causal row keeps its own key
+    in-window), so exp(NEG_INF - lse) underflows to exactly 0."""
+    s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32) * scale
+    cap_grad = None
+    if softcap is not None:
+        t = jnp.tanh(s / softcap)
+        s = t * softcap
+        cap_grad = 1.0 - t * t   # d(cap*tanh(u/cap))/du, threaded into ds
+    if mask is not None:
+        s = jnp.where(mask, s, NEG_INF)
+    return jnp.exp(s - lse), cap_grad
+
+
+def _dq_kernel(*refs, scale, softcap, causal, window, banded, block_q,
+               block_k, num_kv_blocks):
+    if banded:
+        (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, band_ref,
+         dq_ref, dq_scr) = refs
+    else:
+        q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dq_scr = refs
+        band_ref = None
     iq = pl.program_id(2)
     ik = pl.program_id(3)
+    window, q_off, k_off = _unpack_band(band_ref, window)
 
     @pl.when(ik == 0)
     def _init():
         dq_scr[:] = jnp.zeros_like(dq_scr)
 
-    live = _band_live(causal, window, iq, ik, block_q, block_k)
+    live = _band_live(causal, window, iq, ik, block_q, block_k, q_off, k_off)
 
     @pl.when(live)
     def _compute():
@@ -211,18 +321,15 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dq_scr,
         lse = lse_ref[0, 0][:, 0:1]
         delta = delta_ref[0, 0][:, 0:1]
 
-        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32) * scale
-        mask = _band_mask(causal, window, iq, ik, block_q, block_k, s.shape)
-        if mask is not None:
-            s = jnp.where(mask, s, NEG_INF)
-        # masked lanes need no explicit zeroing here: lse is the GLOBAL
-        # logsumexp (finite — every causal row keeps its own key in-window),
-        # so exp(NEG_INF - lse) underflows to exactly 0
-        p = jnp.exp(s - lse)                                  # [BQ, BK]
+        mask = _band_mask(causal, window, iq, ik, block_q, block_k,
+                          (block_q, block_k), q_off, k_off)
+        p, cap_grad = _bwd_scores(q, k, lse, scale, softcap, mask)
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
-        ds = p * (dp - delta) * scale
+        ds = p * (dp - delta)
+        if cap_grad is not None:   # tanh backward: ds flows through the cap
+            ds = ds * cap_grad
+        ds = ds * scale
         dq_scr[:] += jax.lax.dot_general(ds, k, (((1,), (0,)), ((), ())),
                                          preferred_element_type=jnp.float32)
 
@@ -231,23 +338,29 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dq_scr,
         dq_ref[0, 0] = dq_scr[:].astype(dq_ref.dtype)
 
 
-def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                dk_ref, dv_ref, dk_scr, dv_scr,
-                *, scale, causal, window, block_q, block_k, num_q_blocks,
-                groups):
+def _dkv_kernel(*refs, scale, softcap, causal, window, banded, block_q,
+                block_k, num_q_blocks, groups):
     # grid (b, hkv, ik, ig, iq): the kv-block ik is OUTER to the (group,
     # q-block) accumulation dims, so the scratch is initialized exactly when a
     # new dk/dv output block is first visited and flushed when last visited.
+    if banded:
+        (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, band_ref,
+         dk_ref, dv_ref, dk_scr, dv_scr) = refs
+    else:
+        (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+         dk_ref, dv_ref, dk_scr, dv_scr) = refs
+        band_ref = None
     ik = pl.program_id(2)
     ig = pl.program_id(3)   # GQA group member
     iq = pl.program_id(4)
+    window, q_off, k_off = _unpack_band(band_ref, window)
 
     @pl.when((iq == 0) & (ig == 0))
     def _init():
         dk_scr[:] = jnp.zeros_like(dk_scr)
         dv_scr[:] = jnp.zeros_like(dv_scr)
 
-    live = _band_live(causal, window, iq, ik, block_q, block_k)
+    live = _band_live(causal, window, iq, ik, block_q, block_k, q_off, k_off)
 
     @pl.when(live)
     def _compute():
@@ -258,17 +371,17 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         lse = lse_ref[0, 0][:, 0:1]
         delta = delta_ref[0, 0][:, 0:1]
 
-        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32) * scale
-        mask = _band_mask(causal, window, iq, ik, block_q, block_k, s.shape)
-        if mask is not None:
-            s = jnp.where(mask, s, NEG_INF)
-        p = jnp.exp(s - lse)                                  # [BQ, BK]
+        mask = _band_mask(causal, window, iq, ik, block_q, block_k,
+                          (block_q, block_k), q_off, k_off)
+        p, cap_grad = _bwd_scores(q, k, lse, scale, softcap, mask)
         dv_scr[:] += jax.lax.dot_general(p, do, (((0,), (0,)), ((), ())),
                                          preferred_element_type=jnp.float32)
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
-        ds = p * (dp - delta) * scale                         # [BQ, BK]
+        ds = p * (dp - delta)                                 # [BQ, BK]
+        if cap_grad is not None:
+            ds = ds * cap_grad
+        ds = ds * scale
         dk_scr[:] += jax.lax.dot_general(ds, q, (((0,), (0,)), ((), ())),
                                          preferred_element_type=jnp.float32)
 
@@ -279,13 +392,17 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 
 def flash_bwd_with_stats(q, k, v, do, lse, delta, *, causal, window=None,
-                         block_q=512, block_k=512, interpret=False):
+                         block_q=512, block_k=512, interpret=False,
+                         scale=None, softcap=None, band=None):
     """Flash backward from caller-supplied softmax stats -> (dq, dk, dv).
 
     ``lse``/``delta`` ([B, Hq, Sq] fp32) are normally the forward's
     logsumexp and ``rowsum(do * o)``; ring attention passes the *global*
     (cross-chunk) stats here to get each chunk pair's exact gradient
     contribution without rebuilding the full attention matrix.
+    ``scale``/``softcap``/``band`` mirror ``_flash_fwd``: the same score
+    recompute (including the tanh cap, whose ``(1 - tanh^2)`` factor
+    threads through ds) must run in backward for the identity to hold.
     """
     b, hq, sq, d = q.shape
     _, hkv, sk, _ = k.shape
@@ -293,7 +410,12 @@ def flash_bwd_with_stats(q, k, v, do, lse, delta, *, causal, window=None,
     block_q = _pick_block(sq, block_q)
     block_k = _pick_block(sk, block_k)
     nq, nk = sq // block_q, sk // block_k
-    scale = 1.0 / (d ** 0.5)
+    if scale is None:
+        scale = 1.0 / (d ** 0.5)
+    if band is None:
+        window, band = _resolve_band(window)
+    else:
+        window = None
 
     lse_l = jnp.broadcast_to(lse[..., None], (*lse.shape, 128))
     delta_l = jnp.broadcast_to(delta[..., None], (*delta.shape, 128))
@@ -306,17 +428,23 @@ def flash_bwd_with_stats(q, k, v, do, lse, delta, *, causal, window=None,
     stat_spec = pl.BlockSpec((1, 1, block_q, 128), lambda b_, h, iq, ik: (b_, h, iq, 0),
                              memory_space=_VMEM)
 
+    dq_in_specs = [q_spec, kv_spec, kv_spec, q_spec, stat_spec, stat_spec]
+    dq_args = [q, k, v, do, lse_l, delta_l]
+    if band is not None:
+        dq_in_specs.append(_band_spec())
+        dq_args.append(band)
     dq = pl.pallas_call(
-        functools.partial(_dq_kernel, scale=scale, causal=causal,
-                          window=window,
+        functools.partial(_dq_kernel, scale=scale, softcap=softcap,
+                          causal=causal, window=window,
+                          banded=band is not None,
                           block_q=block_q, block_k=block_k, num_kv_blocks=nk),
         grid=(b, hq, nq, nk),
-        in_specs=[q_spec, kv_spec, kv_spec, q_spec, stat_spec, stat_spec],
+        in_specs=dq_in_specs,
         out_specs=q_spec,
         scratch_shapes=[_VMEM((block_q, d), jnp.float32)],
         out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
         interpret=interpret,
-    )(q, k, v, do, lse_l, delta_l)
+    )(*dq_args)
 
     # dk/dv: walk (b, kv-head, kv-block, group-member, q-block); q-side refs
     # index head = hkv * groups + ig
@@ -326,19 +454,25 @@ def flash_bwd_with_stats(q, k, v, do, lse, delta, *, causal, window=None,
     def kv_idx(b_, hkv_, ik, ig, iq):
         return (b_, hkv_, ik, 0)
 
+    dkv_in_specs = [
+        pl.BlockSpec((1, 1, block_q, d), q_idx, memory_space=_VMEM),
+        pl.BlockSpec((1, 1, block_k, d), kv_idx, memory_space=_VMEM),
+        pl.BlockSpec((1, 1, block_k, d), kv_idx, memory_space=_VMEM),
+        pl.BlockSpec((1, 1, block_q, d), q_idx, memory_space=_VMEM),
+        pl.BlockSpec((1, 1, block_q, 128), q_idx, memory_space=_VMEM),
+        pl.BlockSpec((1, 1, block_q, 128), q_idx, memory_space=_VMEM),
+    ]
+    dkv_args = [q, k, v, do, lse_l, delta_l]
+    if band is not None:
+        dkv_in_specs.append(_band_spec())
+        dkv_args.append(band)
     dk, dv = pl.pallas_call(
-        functools.partial(_dkv_kernel, scale=scale, causal=causal,
-                          window=window, block_q=block_q, block_k=block_k,
-                          num_q_blocks=nq, groups=groups),
+        functools.partial(_dkv_kernel, scale=scale, softcap=softcap,
+                          causal=causal, window=window,
+                          banded=band is not None, block_q=block_q,
+                          block_k=block_k, num_q_blocks=nq, groups=groups),
         grid=(b, hkv, nk, groups, nq),
-        in_specs=[
-            pl.BlockSpec((1, 1, block_q, d), q_idx, memory_space=_VMEM),
-            pl.BlockSpec((1, 1, block_k, d), kv_idx, memory_space=_VMEM),
-            pl.BlockSpec((1, 1, block_k, d), kv_idx, memory_space=_VMEM),
-            pl.BlockSpec((1, 1, block_q, d), q_idx, memory_space=_VMEM),
-            pl.BlockSpec((1, 1, block_q, 128), q_idx, memory_space=_VMEM),
-            pl.BlockSpec((1, 1, block_q, 128), q_idx, memory_space=_VMEM),
-        ],
+        in_specs=dkv_in_specs,
         out_specs=(
             pl.BlockSpec((1, 1, block_k, d), kv_idx, memory_space=_VMEM),
             pl.BlockSpec((1, 1, block_k, d), kv_idx, memory_space=_VMEM),
@@ -348,40 +482,50 @@ def flash_bwd_with_stats(q, k, v, do, lse, delta, *, causal, window=None,
         out_shape=(jax.ShapeDtypeStruct(k.shape, k.dtype),
                    jax.ShapeDtypeStruct(v.shape, v.dtype)),
         interpret=interpret,
-    )(q, k, v, do, lse_l, delta_l)
+    )(*dkv_args)
 
     return dq, dk, dv
 
 
-def _flash_bwd(causal, window, block_q, block_k, interpret, residuals, g):
-    q, k, v, o, lse = residuals
+def _flash_bwd(causal, window, block_q, block_k, interpret, scale, softcap,
+               residuals, g):
+    q, k, v, o, lse, band = residuals
     do = g
     delta = jnp.einsum("bhsd,bhsd->bhs", do.astype(jnp.float32),
                        o.astype(jnp.float32))                  # [B,H,S]
-    return flash_bwd_with_stats(q, k, v, do, lse, delta, causal=causal,
-                                window=window, block_q=block_q,
-                                block_k=block_k, interpret=interpret)
+    grads = flash_bwd_with_stats(q, k, v, do, lse, delta, causal=causal,
+                                 window=window, block_q=block_q,
+                                 block_k=block_k, interpret=interpret,
+                                 scale=scale, softcap=softcap, band=band)
+    # the dynamic band is integer-valued: its cotangent type is float0
+    dband = (None if band is None
+             else np.zeros(band.shape, jax.dtypes.float0))
+    return (*grads, dband)
 
 
 # ---------------------------------------------------------------------------
 # public entry
 # ---------------------------------------------------------------------------
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
-def _flash(q, k, v, causal, window, block_q, block_k, interpret):
-    o, _ = _flash_fwd(q, k, v, causal, window, block_q, block_k, interpret)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8, 9, 10))
+def _flash(q, k, v, band, causal, window, block_q, block_k, interpret,
+           scale, softcap):
+    o, _ = _flash_fwd(q, k, v, causal, window, block_q, block_k, interpret,
+                      scale=scale, softcap=softcap, band=band)
     return o
 
 
-def _flash_vjp_fwd(q, k, v, causal, window, block_q, block_k, interpret):
-    o, lse = _flash_fwd(q, k, v, causal, window, block_q, block_k, interpret)
+def _flash_vjp_fwd(q, k, v, band, causal, window, block_q, block_k,
+                   interpret, scale, softcap):
+    o, lse = _flash_fwd(q, k, v, causal, window, block_q, block_k, interpret,
+                        scale=scale, softcap=softcap, band=band)
     # checkpoint_name tags let a remat policy keep the kernel's backward
     # residuals (o + lse; q/k/v are cheap projections) so the forward kernel
     # is not re-run inside the backward pass — see train/step.py
     # REMAT_POLICIES["attn"]
     o = checkpoint_name(o, "flash_out")
     lse = checkpoint_name(lse, "flash_lse")
-    return o, (q, k, v, o, lse)
+    return o, (q, k, v, o, lse, band)
 
 
 _flash.defvjp(_flash_vjp_fwd, _flash_bwd)
@@ -447,6 +591,9 @@ def attention_divisibility_error(batch_axes, head_axis, tp, batch_div,
             f"{'; '.join(problems)} — pad, or drop the unused mesh axis")
 
 
+_UNSET = object()   # per-call window sentinel: "use the factory default"
+
+
 def make_sharded_flash_attention(
     mesh,
     *,
@@ -458,6 +605,8 @@ def make_sharded_flash_attention(
     block_k: int = 512,
     forced: bool = False,
     fallback=None,
+    scale: Optional[float] = None,
+    logit_softcap: Optional[float] = None,
 ):
     """Flash attention that PARTITIONS over batch/head mesh axes.
 
@@ -499,6 +648,7 @@ def make_sharded_flash_attention(
     """
     from jax.sharding import PartitionSpec as P
 
+    check_static_window(window)
     batch_axes, head_axis, tp, batch_div, b_spec, manual = \
         resolve_attention_manual_axes(mesh, batch_axes, head_axis)
     if not manual:
@@ -511,7 +661,7 @@ def make_sharded_flash_attention(
     def fwd_body(q, k, v):
         qt, kt, vt = (x.transpose(0, 2, 1, 3) for x in (q, k, v))
         o, lse = _flash_fwd(qt, kt, vt, causal, window, block_q, block_k,
-                            interpret)
+                            interpret, scale=scale, softcap=logit_softcap)
         # ONLY the primal output + lse leave the map: a shard_map eqn is
         # atomic under jax.checkpoint's partial-eval, so any residual-only
         # output (the in-map transposes, or a separate kernel-layout o)
@@ -522,19 +672,47 @@ def make_sharded_flash_attention(
         return o.transpose(0, 2, 1, 3), lse
 
     def bwd_body(qt, kt, vt, o, lse, do):
-        dq, dk, dv = _flash_bwd(causal, window, block_q, block_k, interpret,
-                                (qt, kt, vt, o, lse), do.transpose(0, 2, 1, 3))
+        dq, dk, dv, _ = _flash_bwd(causal, window, block_q, block_k,
+                                   interpret, scale, logit_softcap,
+                                   (qt, kt, vt, o, lse, None),
+                                   do.transpose(0, 2, 1, 3))
+        return tuple(g.transpose(0, 2, 1, 3) for g in (dq, dk, dv))
+
+    # dynamic-window twins: the per-layer window (Gemma-2's alternating
+    # schedule) arrives as a traced scalar per call, packed into the [3]
+    # band operand and riding the maps as a replicated arg — the kernels'
+    # tile skipping is a runtime predicate either way
+    def fwd_body_dyn(band, q, k, v):
+        qt, kt, vt = (x.transpose(0, 2, 1, 3) for x in (q, k, v))
+        o, lse = _flash_fwd(qt, kt, vt, causal, None, block_q, block_k,
+                            interpret, scale=scale, softcap=logit_softcap,
+                            band=band)
+        return o.transpose(0, 2, 1, 3), lse
+
+    def bwd_body_dyn(band, qt, kt, vt, o, lse, do):
+        dq, dk, dv, _ = _flash_bwd(causal, None, block_q, block_k,
+                                   interpret, scale, logit_softcap,
+                                   (qt, kt, vt, o, lse, band),
+                                   do.transpose(0, 2, 1, 3))
         return tuple(g.transpose(0, 2, 1, 3) for g in (dq, dk, dv))
 
     res_specs = (spec_bhsd, spec_bhsd, spec_bhsd, spec_bhsd, spec_bhs)
+    band_spec = P(None)   # [3] int32, replicated across every manual axis
 
-    def _maps():
+    def _maps(dyn=False):
         sm = functools.partial(jax.shard_map, mesh=resolve_wrapper_mesh(mesh),
                                axis_names=manual, check_vma=False)
-        fwd = sm(fwd_body, in_specs=(spec_bshd,) * 3,
-                 out_specs=(spec_bshd, spec_bhs))
-        bwd = sm(bwd_body, in_specs=(*res_specs, spec_bshd),
-                 out_specs=(spec_bshd,) * 3)
+        if dyn:
+            fwd = sm(fwd_body_dyn, in_specs=(band_spec, *(spec_bshd,) * 3),
+                     out_specs=(spec_bshd, spec_bhs))
+            bwd = sm(bwd_body_dyn,
+                     in_specs=(band_spec, *res_specs, spec_bshd),
+                     out_specs=(spec_bshd,) * 3)
+        else:
+            fwd = sm(fwd_body, in_specs=(spec_bshd,) * 3,
+                     out_specs=(spec_bshd, spec_bhs))
+            bwd = sm(bwd_body, in_specs=(*res_specs, spec_bshd),
+                     out_specs=(spec_bshd,) * 3)
         return fwd, bwd
 
     @jax.custom_vjp
@@ -559,14 +737,39 @@ def make_sharded_flash_attention(
         return _maps()[1](qt, kt, vt, o, lse, do)
 
     sharded_flash.defvjp(vjp_fwd, vjp_bwd)
+
+    @jax.custom_vjp
+    def sharded_flash_dyn(q, k, v, band):
+        return _maps(dyn=True)[0](band, q, k, v)[0]
+
+    def vjp_fwd_dyn(q, k, v, band):
+        out, lse = _maps(dyn=True)[0](band, q, k, v)
+        out = checkpoint_name(out, "flash_out")
+        lse = checkpoint_name(lse, "flash_lse")
+        return out, (q, k, v, out, lse, band)
+
+    def vjp_bwd_dyn(res, do):
+        q, k, v, out, lse, band = res
+        qt, kt, vt, o = (x.transpose(0, 2, 1, 3) for x in (q, k, v, out))
+        grads = _maps(dyn=True)[1](band, qt, kt, vt, o, lse, do)
+        return (*grads, np.zeros(band.shape, jax.dtypes.float0))
+
+    sharded_flash_dyn.defvjp(vjp_fwd_dyn, vjp_bwd_dyn)
     # partial-manual shard_map resolves auto-axis shardings only under jit,
     # so every top-level call — eager OR traced — goes through this jit.
     # ONLY manual-context callers (the pipeline) bypass it for the raw
     # custom_vjp: this jit's cache must hold concrete-mesh programs
     # exclusively, never a context-mesh trace
     sharded_flash_eager = jax.jit(sharded_flash)
+    sharded_flash_dyn_eager = jax.jit(sharded_flash_dyn)
 
-    def attention(q, k, v, standard_layout: bool = True, **kwargs):
+    window_default = window
+
+    def attention(q, k, v, standard_layout: bool = True, window=_UNSET,
+                  **kwargs):
+        # per-call window (traced per-layer schedules) overrides the
+        # factory default; _UNSET keeps the baked-in band
+        wcall = window_default if window is _UNSET else window
         if not standard_layout:
             # the callable contract carries no positions, so a correct mask
             # for packed/sharded-seq layouts is unbuildable here — fail loud
@@ -596,15 +799,32 @@ def make_sharded_flash_attention(
                     f"impl='xla'")
             if fallback is not None:
                 return fallback(q, k, v, standard_layout=standard_layout,
-                                **kwargs)
+                                window=wcall, **kwargs)
             from .attention import multihead_attention
 
-            return multihead_attention(q, k, v, causal=causal, window=window,
+            return multihead_attention(q, k, v, causal=causal, window=wcall,
+                                       scale=scale,
+                                       logit_softcap=logit_softcap,
                                        impl="xla")
-        if _in_manual_context():  # nested in the pipeline: caller's jit is
-            return sharded_flash(q, k, v)  # already above us
-        return sharded_flash_eager(q, k, v)
+        in_manual = _in_manual_context()
+        if wcall is window_default or (isinstance(wcall, int)
+                                       and wcall == window_default):
+            # static band (or none): the factory-baked maps
+            if in_manual:  # nested in the pipeline: caller's jit is above us
+                return sharded_flash(q, k, v)
+            return sharded_flash_eager(q, k, v)
+        # per-call override (traced per-layer window, an int differing from
+        # the factory default, or None against a windowed factory): pack it
+        # into the dynamic-band operand explicitly — _resolve_band would
+        # treat a static int as "bake it in", which here would silently
+        # replace the requested band with the 2**30 no-band encoding
+        check_static_window(wcall)
+        band = _pack_band(wcall)
+        if in_manual:
+            return sharded_flash_dyn(q, k, v, band)
+        return sharded_flash_dyn_eager(q, k, v, band)
 
+    attention.accepts_window = True
     return attention
 
 
@@ -614,10 +834,12 @@ def flash_attention(
     v: jnp.ndarray,
     *,
     causal: bool = True,
-    window: Optional[int] = None,
+    window=None,
     block_q: int = 512,
     block_k: int = 512,
     interpret: Optional[bool] = None,
+    scale: Optional[float] = None,
+    logit_softcap: Optional[float] = None,
 ) -> jnp.ndarray:
     """Blockwise fused attention; returns [B, S, Hq, D] in q.dtype.
 
@@ -625,11 +847,17 @@ def flash_attention(
     query i attends keys j with 0 <= i - j < window). kv tiles fully below
     the band are SKIPPED, so cost is O(S*window) once S >> window — the
     reference inherits the same trick from flash-attn's window_size
-    (``05-training-llama-405b/train_llm.py:93``)."""
+    (``05-training-llama-405b/train_llm.py:93``). A TRACED window (Gemma-2's
+    per-layer schedule riding a lax.scan) rides a [3] int32 SMEM operand
+    instead of the baked constant — tile skipping is a runtime predicate
+    either way, so the banded cost model is unchanged.
+
+    ``scale``: score-scale override (Gemma-2 ``query_pre_attn_scalar**-0.5``;
+    default head_dim**-0.5). ``logit_softcap``: Gemma-2 tanh capping of the
+    scaled scores, with the exact ``(1 - tanh^2)`` term in backward."""
     if window is not None and not causal:
         raise ValueError("window (sliding-window attention) requires causal=True")
-    if window is not None and window < 1:
-        raise ValueError(f"window must be >= 1, got {window}")
+    check_static_window(window)
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
     d = q.shape[-1]
@@ -645,5 +873,7 @@ def flash_attention(
     qt = q.transpose(0, 2, 1, 3)
     kt = k.transpose(0, 2, 1, 3)
     vt = v.transpose(0, 2, 1, 3)
-    o = _flash(qt, kt, vt, causal, window, block_q, block_k, interpret)
+    static_window, band = _resolve_band(window)
+    o = _flash(qt, kt, vt, band, causal, static_window, block_q, block_k,
+               interpret, scale, logit_softcap)
     return o.transpose(0, 2, 1, 3)

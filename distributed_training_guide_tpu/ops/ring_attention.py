@@ -51,10 +51,12 @@ from typing import Callable
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax.ad_checkpoint import checkpoint_name
 from jax.sharding import Mesh, PartitionSpec as P
 
-from .flash_attention import _flash_fwd, flash_bwd_with_stats
+from .flash_attention import (_flash_fwd, _pack_band, check_static_window,
+                              flash_bwd_with_stats)
 
 NEG_INF = -1e30
 
@@ -128,8 +130,22 @@ def _relation(kv_chunk, q_chunk, causal):
                      jnp.where(kv_chunk < q_chunk, 0, 2))
 
 
+def _pair_live(kv_chunk, q_chunk, s_c, window):
+    """Banded-mode chunk-pair skip predicate: a (q-chunk, kv-chunk) pair is
+    dead when it is FUTURE (kv newer than q) or when every key in the kv
+    chunk falls below the sliding-window band of every query in the q chunk
+    — the zigzag analogue of the kernel's ``_band_live`` tile skip, at
+    chunk granularity. ``window`` is a traced scalar (per-layer schedules);
+    2**30 encodes "full attention this layer" and keeps every past pair
+    live."""
+    live = kv_chunk <= q_chunk
+    # newest key in the kv chunk still inside the OLDEST query's window
+    live &= (kv_chunk + 1) * s_c - 1 >= q_chunk * s_c - (window - 1)
+    return live
+
+
 def _build_ring(axis_name: str, cp: int, causal: bool, interpret: bool,
-                use_scan: bool):
+                use_scan: bool, scale=None, softcap=None):
     """Per-shard fwd/bwd ring bodies (flash kernel per chunk pair). The
     custom_vjp pairing them lives OUTSIDE the shard_map (make_ring_attention)
     so shard_map's own transpose machinery is never engaged.
@@ -150,31 +166,57 @@ def _build_ring(axis_name: str, cp: int, causal: bool, interpret: bool,
     picks scan automatically at large cp."""
     ring = [(i, (i + 1) % cp) for i in range(cp)]
 
-    def _fwd_pairs(qz, k_blk, v_blk, o, lse, my_chunks, kv_chunks):
+    def _fwd_pairs(qz, k_blk, v_blk, o, lse, my_chunks, kv_chunks,
+                   window=None, s_c=None):
         """The 4 (q-chunk, kv-chunk) flash calls of one hop, merged into
-        the running (o, lse). Future pairs skip inside the cond — merge
-        included — so they issue no work."""
+        the running (o, lse). Future pairs — and, in banded mode, pairs
+        fully below the sliding-window band — skip inside the cond, merge
+        included, so they issue no work. In banded mode (``window`` a
+        traced scalar) every live pair runs the kernel causal with its
+        GLOBAL chunk offsets riding the dynamic band operand: diagonal and
+        past pairs share one program, and the in-kernel band mask is exact
+        across chunk boundaries."""
         for a in range(2):
             for c in range(2):
-                rel = _relation(kv_chunks[c], my_chunks[a], causal)
                 qa, kc, vc = qz[a], k_blk[c], v_blk[c]
                 o_a, lse_a = o[a], lse[a]
 
-                def live(masked, qa=qa, kc=kc, vc=vc, o_a=o_a, lse_a=lse_a):
-                    o_i, lse_i = _flash_fwd(qa, kc, vc, masked, None, 512,
-                                            512, interpret)
-                    return _merge(o_a, lse_a, o_i.astype(jnp.float32), lse_i)
+                if window is not None:
+                    band = _pack_band(window, my_chunks[a] * s_c,
+                                      kv_chunks[c] * s_c)
 
-                o_a, lse_a = jax.lax.cond(
-                    rel >= 2, lambda: (o_a, lse_a),
-                    lambda: jax.lax.cond(rel == 1,
-                                         functools.partial(live, True),
-                                         functools.partial(live, False)))
+                    def live_banded(qa=qa, kc=kc, vc=vc, o_a=o_a,
+                                    lse_a=lse_a, band=band):
+                        o_i, lse_i = _flash_fwd(
+                            qa, kc, vc, True, None, 512, 512, interpret,
+                            scale=scale, softcap=softcap, band=band)
+                        return _merge(o_a, lse_a, o_i.astype(jnp.float32),
+                                      lse_i)
+
+                    o_a, lse_a = jax.lax.cond(
+                        _pair_live(kv_chunks[c], my_chunks[a], s_c, window),
+                        live_banded, lambda: (o_a, lse_a))
+                else:
+                    rel = _relation(kv_chunks[c], my_chunks[a], causal)
+
+                    def live(masked, qa=qa, kc=kc, vc=vc, o_a=o_a,
+                             lse_a=lse_a):
+                        o_i, lse_i = _flash_fwd(qa, kc, vc, masked, None,
+                                                512, 512, interpret,
+                                                scale=scale, softcap=softcap)
+                        return _merge(o_a, lse_a, o_i.astype(jnp.float32),
+                                      lse_i)
+
+                    o_a, lse_a = jax.lax.cond(
+                        rel >= 2, lambda: (o_a, lse_a),
+                        lambda: jax.lax.cond(rel == 1,
+                                             functools.partial(live, True),
+                                             functools.partial(live, False)))
                 o = o.at[a].set(o_a)
                 lse = lse.at[a].set(lse_a)
         return o, lse
 
-    def ring_fwd_body(member, q, k, v):
+    def ring_fwd_body(member, q, k, v, window=None):
         idx = member[0]
         b, s_loc, hq, d = q.shape
         hkv = k.shape[2]
@@ -189,6 +231,7 @@ def _build_ring(axis_name: str, cp: int, causal: bool, interpret: bool,
         vz = _to_zigzag(v, idx, axis_name, cp).transpose(1, 0, 3, 2, 4)
 
         my_chunks = (idx, 2 * cp - 1 - idx)
+        w = None if window is None else window[0]
 
         o = jnp.zeros((2, b, hq, s_c, d), jnp.float32)
         lse = jnp.full((2, b, hq, s_c), NEG_INF, jnp.float32)
@@ -198,7 +241,7 @@ def _build_ring(axis_name: str, cp: int, causal: bool, interpret: bool,
                 k_blk, v_blk, o, lse = carry
                 src = (idx - i) % cp
                 o, lse = _fwd_pairs(qz, k_blk, v_blk, o, lse, my_chunks,
-                                    (src, 2 * cp - 1 - src))
+                                    (src, 2 * cp - 1 - src), w, s_c)
                 k_blk = jax.lax.ppermute(k_blk, axis_name, ring)
                 v_blk = jax.lax.ppermute(v_blk, axis_name, ring)
                 return (k_blk, v_blk, o, lse), None
@@ -213,7 +256,7 @@ def _build_ring(axis_name: str, cp: int, causal: bool, interpret: bool,
                     k_nxt = jax.lax.ppermute(k_blk, axis_name, ring)
                     v_nxt = jax.lax.ppermute(v_blk, axis_name, ring)
                 o, lse = _fwd_pairs(qz, k_blk, v_blk, o, lse, my_chunks,
-                                    (src, 2 * cp - 1 - src))
+                                    (src, 2 * cp - 1 - src), w, s_c)
                 if i < cp - 1:
                     k_blk, v_blk = k_nxt, v_nxt
 
@@ -230,10 +273,11 @@ def _build_ring(axis_name: str, cp: int, causal: bool, interpret: bool,
         lse_seq = _from_zigzag(lse.transpose(1, 0, 3, 2), idx, axis_name, cp)
         return out, lse_seq
 
-    def ring_bwd_body(member, q, k, v, out, lse_seq, do):
+    def ring_bwd_body(member, q, k, v, out, lse_seq, do, window=None):
         in_dtype = q.dtype
         idx = member[0]
         my_chunks = (idx, 2 * cp - 1 - idx)
+        w = None if window is None else window[0]
 
         # rebuild the zigzag/kernel layouts the fwd used (cheap ppermutes;
         # see the fwd-body note on why these are not residuals)
@@ -255,30 +299,58 @@ def _build_ring(axis_name: str, cp: int, causal: bool, interpret: bool,
         dk = jnp.zeros(kz.shape, jnp.float32)
         dv = jnp.zeros(vz.shape, jnp.float32)
 
+        s_c = qz.shape[3]
+
         def _bwd_pairs(k_blk, v_blk, dq, dk, dv, kv_chunks):
             """One hop's 4 flash-bwd calls; accumulation runs INSIDE the
-            cond so skipped pairs cost nothing in the backward either."""
+            cond so skipped pairs cost nothing in the backward either.
+            Banded mode mirrors the forward exactly: the same global-offset
+            band rides the bwd kernels (the score recompute must reproduce
+            the fwd mask for the flash-bwd identity to hold), and the same
+            chunk-pair skip predicate keeps dead pairs free."""
             for a in range(2):
                 for c in range(2):
-                    rel = _relation(kv_chunks[c], my_chunks[a], causal)
                     qa, kc, vc = qz[a], k_blk[c], v_blk[c]
                     doa, lsea, dta = doz[a], lse[a], delta[a]
                     dq_a, dk_c, dv_c = dq[a], dk[c], dv[c]
 
-                    def live(masked, qa=qa, kc=kc, vc=vc, doa=doa, lsea=lsea,
-                             dta=dta, dq_a=dq_a, dk_c=dk_c, dv_c=dv_c):
-                        dq_i, dk_i, dv_i = flash_bwd_with_stats(
-                            qa, kc, vc, doa.astype(qa.dtype), lsea, dta,
-                            causal=masked, interpret=interpret)
-                        return (dq_a + dq_i.astype(jnp.float32),
-                                dk_c + dk_i.astype(jnp.float32),
-                                dv_c + dv_i.astype(jnp.float32))
+                    if w is not None:
+                        band = _pack_band(w, my_chunks[a] * s_c,
+                                          kv_chunks[c] * s_c)
 
-                    dq_a, dk_c, dv_c = jax.lax.cond(
-                        rel >= 2, lambda: (dq_a, dk_c, dv_c),
-                        lambda: jax.lax.cond(rel == 1,
-                                             functools.partial(live, True),
-                                             functools.partial(live, False)))
+                        def live_banded(qa=qa, kc=kc, vc=vc, doa=doa,
+                                        lsea=lsea, dta=dta, dq_a=dq_a,
+                                        dk_c=dk_c, dv_c=dv_c, band=band):
+                            dq_i, dk_i, dv_i = flash_bwd_with_stats(
+                                qa, kc, vc, doa.astype(qa.dtype), lsea, dta,
+                                causal=True, interpret=interpret,
+                                scale=scale, softcap=softcap, band=band)
+                            return (dq_a + dq_i.astype(jnp.float32),
+                                    dk_c + dk_i.astype(jnp.float32),
+                                    dv_c + dv_i.astype(jnp.float32))
+
+                        dq_a, dk_c, dv_c = jax.lax.cond(
+                            _pair_live(kv_chunks[c], my_chunks[a], s_c, w),
+                            live_banded, lambda: (dq_a, dk_c, dv_c))
+                    else:
+                        rel = _relation(kv_chunks[c], my_chunks[a], causal)
+
+                        def live(masked, qa=qa, kc=kc, vc=vc, doa=doa,
+                                 lsea=lsea, dta=dta, dq_a=dq_a, dk_c=dk_c,
+                                 dv_c=dv_c):
+                            dq_i, dk_i, dv_i = flash_bwd_with_stats(
+                                qa, kc, vc, doa.astype(qa.dtype), lsea, dta,
+                                causal=masked, interpret=interpret,
+                                scale=scale, softcap=softcap)
+                            return (dq_a + dq_i.astype(jnp.float32),
+                                    dk_c + dk_i.astype(jnp.float32),
+                                    dv_c + dv_i.astype(jnp.float32))
+
+                        dq_a, dk_c, dv_c = jax.lax.cond(
+                            rel >= 2, lambda: (dq_a, dk_c, dv_c),
+                            lambda: jax.lax.cond(
+                                rel == 1, functools.partial(live, True),
+                                functools.partial(live, False)))
                     dq = dq.at[a].set(dq_a)
                     dk = dk.at[c].set(dk_c)
                     dv = dv.at[c].set(dv_c)
@@ -328,7 +400,10 @@ def _build_ring(axis_name: str, cp: int, causal: bool, interpret: bool,
 def make_ring_attention(mesh: Mesh, *, axis_name: str = "cp",
                         data_axes=("dp", "fsdp", "ep"), head_axis: str = "tp",
                         causal: bool = True,
-                        hop_loop: str = "auto") -> Callable:
+                        hop_loop: str = "auto",
+                        window=None,
+                        scale=None,
+                        logit_softcap=None) -> Callable:
     """Returns an attention callable with the ``multihead_attention``
     signature, internally a shard_map ring over ``axis_name``.
 
@@ -340,12 +415,27 @@ def make_ring_attention(mesh: Mesh, *, axis_name: str = "cp",
     cp=1 path). The body needs no collectives over those axes, so the ring
     logic is unchanged; only cp carries ppermutes. The round-1 partitioner
     CHECK that forced partial-manual was auto-*tp on weights* inside a
-    manual region — q/k/v here are activations, already projected."""
-    from .flash_attention import (_in_manual_context,
+    manual region — q/k/v here are activations, already projected.
+
+    ``window``: sliding-window attention (HF semantics) through the zigzag
+    ring. Every live (q-chunk, kv-chunk) pair runs the kernel with its
+    GLOBAL chunk offsets on the dynamic band operand, so the band mask is
+    exact across chunk boundaries, and chunk pairs fully below the band are
+    skipped at the hop level (``_pair_live``) on top of the kernel's own
+    tile skipping. A per-call ``window`` (traced per-layer schedules,
+    Gemma-2) overrides the factory default. ``scale``/``logit_softcap``:
+    Gemma-2 score scale / tanh capping, threaded into every per-pair kernel
+    call forward and backward (the (o, lse) merge is softcap-agnostic — the
+    cap applies per score before each pair's softmax)."""
+    from .flash_attention import (_UNSET, _in_manual_context,
                                   attention_divisibility_error,
                                   resolve_attention_manual_axes,
                                   resolve_wrapper_mesh)
 
+    if window is not None and not causal:
+        raise ValueError(
+            "window (sliding-window attention) requires causal=True")
+    check_static_window(window)
     cp = mesh.shape[axis_name]
     batch_axes, head_axis, tp, batch_div, b_spec, manual = \
         resolve_attention_manual_axes(mesh, data_axes, head_axis)
@@ -363,20 +453,34 @@ def make_ring_attention(mesh: Mesh, *, axis_name: str = "cp",
     # large cp scan is strictly better and 'auto' switches over.
     use_scan = cp >= 8 if hop_loop == "auto" else hop_loop == "scan"
     fwd_body, bwd_body = _build_ring(axis_name, cp, causal, interpret,
-                                     use_scan)
+                                     use_scan, scale=scale,
+                                     softcap=logit_softcap)
 
-    def _maps():
+    def _maps(banded=False):
         # check_vma=False: pallas interpret mode (the CPU test path) trips
         # the vma checker inside its own lowering ("dynamic_slice requires
         # varying manual axes to match")
         sm = functools.partial(jax.shard_map, mesh=resolve_wrapper_mesh(mesh),
                                axis_names=manual, check_vma=False)
         member = P(axis_name)   # [cp] iota -> each member's ring position
-        fwd = sm(fwd_body, in_specs=(member, spec, spec, spec),
-                 out_specs=(spec, lse_spec))
-        bwd = sm(bwd_body,
-                 in_specs=(member, spec, spec, spec, spec, lse_spec, spec),
-                 out_specs=(spec, spec, spec))
+        if banded:
+            # the window rides as a replicated [1] int32 operand so traced
+            # per-layer schedules (a lax.scan column) reach every member
+            wspec = P(None)
+            fwd = sm(lambda m, w, q, k, v: fwd_body(m, q, k, v, w),
+                     in_specs=(member, wspec, spec, spec, spec),
+                     out_specs=(spec, lse_spec))
+            bwd = sm(lambda m, w, *a: bwd_body(m, *a, window=w),
+                     in_specs=(member, wspec, spec, spec, spec, spec,
+                               lse_spec, spec),
+                     out_specs=(spec, spec, spec))
+        else:
+            fwd = sm(fwd_body, in_specs=(member, spec, spec, spec),
+                     out_specs=(spec, lse_spec))
+            bwd = sm(bwd_body,
+                     in_specs=(member, spec, spec, spec, spec, lse_spec,
+                               spec),
+                     out_specs=(spec, spec, spec))
         return fwd, bwd
 
     # the custom_vjp sits OUTSIDE the shard_maps: jax.grad never transposes
@@ -401,14 +505,41 @@ def make_ring_attention(mesh: Mesh, *, axis_name: str = "cp",
         return _maps()[1](members, *res, do)
 
     ring.defvjp(ring_vjp_fwd, ring_vjp_bwd)
+
+    # banded twin: same rings with the [1] int32 window operand (integer-
+    # valued, so its cotangent is float0 like the flash wrapper's band)
+    @jax.custom_vjp
+    def ring_banded(q, k, v, w):
+        members = jnp.arange(cp, dtype=jnp.int32)
+        return _maps(banded=True)[0](members, w, q, k, v)[0]
+
+    def ring_banded_vjp_fwd(q, k, v, w):
+        members = jnp.arange(cp, dtype=jnp.int32)
+        out, lse_seq = _maps(banded=True)[0](members, w, q, k, v)
+        out = checkpoint_name(out, "flash_out")
+        lse_seq = checkpoint_name(lse_seq, "flash_lse")
+        return out, (q, k, v, out, lse_seq, w)
+
+    def ring_banded_vjp_bwd(res, do):
+        *res_, w = res
+        members = jnp.arange(cp, dtype=jnp.int32)
+        grads = _maps(banded=True)[1](members, w, *res_, do)
+        return (*grads, np.zeros(w.shape, jax.dtypes.float0))
+
+    ring_banded.defvjp(ring_banded_vjp_fwd, ring_banded_vjp_bwd)
     # partial-manual shard_map only resolves its auto-axes shardings under
     # jit (the eager path rejects the specs), so every top-level call —
     # eager OR traced — goes through this jit. ONLY manual-context callers
     # (the pipeline) bypass it for the raw custom_vjp: this jit's cache must
     # hold concrete-mesh programs exclusively, never a context-mesh trace
     ring_eager = jax.jit(ring)
+    ring_banded_eager = jax.jit(ring_banded)
 
-    def attention(q, k, v, standard_layout: bool = True, **kwargs):
+    window_default = window
+
+    def attention(q, k, v, standard_layout: bool = True, window=_UNSET,
+                  **kwargs):
+        wcall = window_default if window is _UNSET else window
         if not interpret and (q.shape[1] % (16 * cp) or q.shape[-1] % 64):
             # mirror flash_attention's loud guard: per-chunk seq must tile
             # (S/(2cp) % 8) and head_dim must fill MXU lanes, else Mosaic
@@ -424,17 +555,28 @@ def make_ring_attention(mesh: Mesh, *, axis_name: str = "cp",
                 "[r*S/cp, (r+1)*S/cp)); caller-supplied positions would "
                 "desynchronize the causal mask — don't pass explicit "
                 "positions under context parallelism")
+        if wcall is not None and not causal:
+            raise ValueError(
+                "window (sliding-window attention) requires causal=True")
+        check_static_window(wcall)
         hq, hkv = q.shape[2], k.shape[2]
         if hq % tp or hkv % tp or q.shape[0] % batch_div:
             raise ValueError(attention_divisibility_error(
                 batch_axes, head_axis, tp, batch_div, hq, hkv, q.shape[0],
                 "ring attention"))
-        if _in_manual_context():
-            # nested in the pipeline's manual region — by construction under
-            # the caller's jit already; the raw custom_vjp builds its maps
-            # against the context mesh (the eager jit's cache must never mix
-            # top-level and in-pipeline programs)
-            return ring(q, k, v)
-        return ring_eager(q, k, v)
+        in_manual = _in_manual_context()
+        if wcall is None:
+            if in_manual:
+                # nested in the pipeline's manual region — by construction
+                # under the caller's jit already; the raw custom_vjp builds
+                # its maps against the context mesh (the eager jit's cache
+                # must never mix top-level and in-pipeline programs)
+                return ring(q, k, v)
+            return ring_eager(q, k, v)
+        warr = jnp.reshape(jnp.asarray(wcall, jnp.int32), (1,))
+        if in_manual:
+            return ring_banded(q, k, v, warr)
+        return ring_banded_eager(q, k, v, warr)
 
+    attention.accepts_window = True
     return attention

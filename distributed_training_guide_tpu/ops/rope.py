@@ -17,10 +17,11 @@ each shard sees a slice of the sequence (reference passes explicit
 Seq-length-dependent flavors (``dynamic``, ``longrope``'s short/long switch)
 use ``max(positions) + 1`` — a *traced* scalar, so the compiled program handles
 any batch, exactly like HF's ``@dynamic_rope_update`` recomputing from
-``position_ids.max() + 1``. Caveat (documented, deliberate): under context
-parallelism each sequence shard sees only its slice of positions, so shards
-would disagree on the traced length — the trainer therefore rejects
-seq-dependent rope types combined with CP rather than silently diverging.
+``position_ids.max() + 1``. Under context parallelism this max is computed in
+GSPMD-land OUTSIDE the attention shard_maps: ``positions`` is one global
+(cp-sharded) array, so XLA lowers the reduction as a cp-collective max and
+every sequence shard derives the SAME frequencies — no rejection needed
+(pinned by the dynamic-rope cp parity test in tests/test_rope_scaling.py).
 """
 from __future__ import annotations
 
@@ -32,8 +33,8 @@ import jax.numpy as jnp
 ROPE_TYPES = ("default", "linear", "dynamic", "yarn", "longrope", "llama3")
 
 # rope types whose frequencies depend on the runtime sequence length (traced
-# from positions) — incompatible with sequence-sharded positions (see module
-# docstring); everything else is static at trace time
+# from positions via a global max — a cp-collective under sequence sharding,
+# see module docstring); everything else is static at trace time
 SEQ_DEPENDENT_ROPE_TYPES = ("dynamic", "longrope")
 
 

@@ -44,12 +44,22 @@ from .flash_attention import (make_sharded_flash_attention,
 def make_ulysses_attention(mesh: Mesh, *, axis_name: str = "cp",
                            data_axes=("dp", "fsdp", "ep"),
                            head_axis="tp", causal: bool = True,
-                           window=None, impl: str = "auto"):
+                           window=None, impl: str = "auto",
+                           scale=None, logit_softcap=None):
     """Attention callable (``make_ring_attention`` contract) running the
     Ulysses layout flip over ``axis_name``. ``impl`` as in
     ``multihead_attention``: 'flash' forces the manual-axes kernel wrapper,
-    'xla' the constraint-based einsum path, 'auto' picks flash on TPU."""
+    'xla' the constraint-based einsum path, 'auto' picks flash on TPU.
+
+    ``window``/``scale``/``logit_softcap`` (Gemma-2 per-layer windows,
+    ``query_pre_attn_scalar``, tanh softcapping) pass straight through:
+    every device sees the FULL sequence for its head slice, so the band
+    mask and per-score cap stay exact without any cross-chunk math. A
+    per-call ``window`` (traced per-layer schedules) overrides the factory
+    default on both paths."""
     import jax
+
+    from .flash_attention import _UNSET
 
     head_axes = (head_axis,) if isinstance(head_axis, str) else tuple(head_axis or ())
     # resolve_attention_manual_axes (called by both paths below) drops
@@ -72,7 +82,10 @@ def make_ulysses_attention(mesh: Mesh, *, axis_name: str = "cp",
                                   tuple(a for a in (heads_t or ())
                                         if a != axis_name) or None, None))
 
-    def attention(q, k, v, standard_layout: bool = True, **kwargs):
+    window_default = window
+
+    def attention(q, k, v, standard_layout: bool = True, window=_UNSET,
+                  **kwargs):
         if not standard_layout:
             raise ValueError(
                 "ulysses attention assumes the standard contiguous position "
@@ -80,20 +93,26 @@ def make_ulysses_attention(mesh: Mesh, *, axis_name: str = "cp",
                 "parallelism")
         from .attention import multihead_attention
 
+        wcall = window_default if window is _UNSET else window
         qc, kc, vc = (jax.lax.with_sharding_constraint(x, inner)
                       for x in (q, k, v))
         # window passes straight through: every device sees the FULL
-        # sequence for its head slice, so the band mask stays exact
-        out = multihead_attention(qc, kc, vc, causal=causal, window=window,
+        # sequence for its head slice, so the band mask stays exact (a
+        # traced per-layer window just rides the xla mask comparisons)
+        out = multihead_attention(qc, kc, vc, causal=causal, window=wcall,
+                                  scale=scale, logit_softcap=logit_softcap,
                                   impl="xla")
         # flip back to the sequence sharding the surrounding blocks carry
         return jax.lax.with_sharding_constraint(out, outer)
+
+    attention.accepts_window = True
 
     if impl == "flash":
         flash = make_sharded_flash_attention(
             mesh, batch_axes=data_axes, head_axis=ulysses_heads,
             causal=causal, window=window, forced=not auto,
-            fallback=attention if auto else None)
+            fallback=attention if auto else None,
+            scale=scale, logit_softcap=logit_softcap)
         assert flash is not None  # cp > 1 guarantees a manual axis
         return flash
 

@@ -56,8 +56,8 @@ def comm_roofline(trainer, *, global_batch: int, seq_length: int,
     table + derived times; does not claim overlap it can't see — both the
     overlapped (max) and serial (sum) MFU ceilings are reported.
     """
-    from ..utils.mfu import (device_ici_bandwidth, device_peak_flops,
-                             transformer_flops_per_token)
+    from ..utils.mfu import (banded_attention_kv_length, device_ici_bandwidth,
+                             device_peak_flops, transformer_flops_per_token)
 
     cfg = trainer.bundle.config
     mesh = trainer.plan.mesh.shape
@@ -106,13 +106,21 @@ def comm_roofline(trainer, *, global_batch: int, seq_length: int,
     ici = device_ici_bandwidth(device_kind=device_kind)
     peak = device_peak_flops(device_kind=device_kind)
     # active params (MoE: k of E experts), matching the trainer's own MFU
-    # accounting (cli.py) — total params would overstate compute ~E/k x
+    # accounting (cli.py) — total params would overstate compute ~E/k x.
+    # Attention is priced BANDED — O(S*window) per the config's window
+    # schedule, not dense O(S^2) — because the roofline's job is the honest
+    # time estimate for THIS program (the banded kernel skips out-of-band
+    # kv tiles); bench/cli MFU keep the conventional dense count so numbers
+    # stay comparable with published figures (compare step_ms across
+    # windowed A/Bs, not the MFU column)
+    attn_kv = banded_attention_kv_length(cfg, seq_length)
     flops_per_token = transformer_flops_per_token(
         trainer.bundle.num_active_params(), n_layers, e, seq_length,
-        vocab_size=cfg.vocab_size)
+        vocab_size=cfg.vocab_size, attn_kv_len=attn_kv)
     t_comp = (flops_per_token * global_batch * seq_length) / (peak * n_chips)
     t_comm = comm_bytes / ici
     report = {
+        "attn_kv_len": attn_kv,   # mean keys/query: < seq_length iff banded
         "per_collective_bytes_per_chip": {k: int(v) for k, v in table.items()},
         "comm_bytes_per_chip": int(comm_bytes),
         "ici_bytes_per_s": ici,
@@ -301,12 +309,15 @@ def run_preflight(trainer, *, global_batch: int, seq_length: int,
     mib = 1 / 2**20
     rows = "; ".join(f"{k} {v * mib:.0f} MiB" for k, v in
                      comm["per_collective_bytes_per_chip"].items() if v)
+    banded = (f"; attention priced banded (mean {comm['attn_kv_len']:.0f} "
+              f"keys/query vs dense {seq_length})"
+              if comm["attn_kv_len"] < seq_length else "")
     LOGGER.info(
         f"comm roofline ({target_device or 'local device'}): "
         f"{rows or 'no cross-chip collectives'} | "
         f"t_comm {comm['t_comm_s'] * 1e3:.1f} ms vs t_compute "
         f"{comm['t_compute_s'] * 1e3:.1f} ms -> MFU ceiling "
         f"{comm['mfu_ceiling_overlapped']:.1%} overlapped / "
-        f"{comm['mfu_ceiling_serial']:.1%} serial")
+        f"{comm['mfu_ceiling_serial']:.1%} serial{banded}")
     del lowered
     return report

@@ -25,6 +25,7 @@ import jax.numpy as jnp
 import optax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+from ..models import llama
 from ..models.registry import ModelBundle
 from ..ops.cross_entropy import causal_lm_loss
 from ..parallel.mesh import make_mesh
@@ -155,49 +156,42 @@ class Trainer:
         if self.plan is None:
             self.plan = make_plan("single", make_mesh(devices=jax.devices()[:1]))
         # seq-dependent rope types (dynamic NTK, longrope) trace their
-        # frequencies from max(positions)+1; under context parallelism each
-        # sequence shard sees only its slice, so shards would compute
-        # DIFFERENT frequencies — reject loudly instead of silently diverging
-        if self.plan.mesh.shape.get("cp", 1) > 1:
-            from ..ops.rope import SEQ_DEPENDENT_ROPE_TYPES, rope_type_of
-
-            rt = rope_type_of(getattr(self.bundle.config, "rope_scaling", None))
-            if rt in SEQ_DEPENDENT_ROPE_TYPES:
-                raise ValueError(
-                    f"rope_scaling type {rt!r} computes frequencies from the "
-                    f"runtime sequence length and cannot run under context "
-                    f"parallelism (sequence shards would disagree); use a "
-                    f"static rope type (linear/yarn/llama3) or cp=1")
+        # frequencies from max(positions)+1. Under context parallelism that
+        # max runs in GSPMD-land OUTSIDE the attention shard_maps — positions
+        # are a global array, so the reduction is a global (cp-collective)
+        # max and every sequence shard derives the SAME frequencies; pinned
+        # by the dynamic-rope cp parity test (tests/test_rope_scaling.py)
+        # that replaced the old blanket rejection here.
         if getattr(self.bundle.config, "layer_windows", None) and (
-                self.plan.mesh.shape.get("cp", 1) > 1
-                or self.plan.mesh.shape.get("pp", 1) > 1):
+                self.plan.mesh.shape.get("pp", 1) > 1):
+            # cp composes (the kernels' dynamic band operand + the CP
+            # wrappers' per-call window); the pipeline's manual region is
+            # the one place the traced per-layer window is still unplumbed
             raise ValueError(
                 "per-layer sliding-window patterns (Gemma-2 layer_windows) "
-                "are not implemented under context or pipeline parallelism; "
-                "use dp/fsdp/tp plans")
-        if self.plan.mesh.shape.get("cp", 1) > 1 and (
-                getattr(self.bundle.config, "attn_logit_softcap", None)
-                is not None
-                or getattr(self.bundle.config, "query_pre_attn_scalar", None)):
-            # the ring/ulysses wrappers don't thread the softcap/scale —
-            # running them would SILENTLY drop Gemma-2's attention math
-            raise ValueError(
-                "attention logit softcapping / query_pre_attn_scalar "
-                "(Gemma-2) are not implemented under context parallelism; "
-                "use dp/fsdp/tp plans")
+                "are not implemented under pipeline parallelism; "
+                "use dp/fsdp/tp/cp plans")
         if callable(self.attn_impl) and (
                 getattr(self.bundle.config, "attn_logit_softcap", None)
                 is not None
                 or getattr(self.bundle.config, "query_pre_attn_scalar", None)
-                or getattr(self.bundle.config, "layer_windows", None)):
-            # mirror of the cp>1 check above: the callable contract carries
-            # no softcap/scale/per-layer windows, so a user-supplied
-            # attn_impl would SILENTLY drop Gemma-2's attention math at cp=1
+                or ((getattr(self.bundle.config, "layer_windows", None)
+                     or getattr(self.bundle.config, "sliding_window", None))
+                    and not getattr(self.attn_impl, "accepts_window",
+                                    False))):
+            # a user-supplied callable's contract carries no softcap/scale
+            # (the Trainer-built wrappers bake them in from the config), so
+            # Gemma-2 extras would be SILENTLY dropped; windows (uniform or
+            # per-layer) alone are fine when the callable declares
+            # accepts_window (the model passes window= per call, like the
+            # built wrappers)
             raise ValueError(
                 "a user-supplied attn_impl callable cannot receive the "
-                "Gemma-2 attention extras (attn_logit_softcap / "
-                "query_pre_attn_scalar / layer_windows) — they would be "
-                "silently dropped; use attn_impl='auto' or 'xla'")
+                "configured attention extras (attn_logit_softcap / "
+                "query_pre_attn_scalar / sliding_window / layer_windows) — "
+                "they would be silently dropped; use attn_impl='auto' or "
+                "'xla', or set accepts_window=True on a callable that "
+                "takes the per-call window")
         moe_dispatch = getattr(self.bundle.config, "moe_dispatch", None)
         if moe_dispatch is not None:
             from ..models.moe import MOE_DISPATCH_MODES
@@ -400,6 +394,12 @@ class Trainer:
         plan_head_axis = ("tp" if not under_pp
                           and self.plan.rules.get("heads") == "tp" else None)
         window = getattr(cfg, "sliding_window", None)
+        # Gemma-2 attention extras: the score-scale override and tanh logit
+        # cap are baked into whichever wrapper is built below (flash, ring,
+        # ulysses — all thread them into the kernel with the (1 - tanh^2)
+        # backward term); per-layer windows ride each wrapper's per-call
+        # window argument from the families' layer scans
+        attn_scale, attn_softcap = llama.attention_extras(cfg)
         if self.plan.mesh.shape["cp"] > 1 and not callable(attn_impl):
             if self.context_impl == "ulysses":
                 # all-to-all CP: heads shard over cp (x tp) during
@@ -420,40 +420,26 @@ class Trainer:
                 attn_impl = make_ulysses_attention(
                     self.plan.mesh, data_axes=self.plan.data_axes,
                     head_axis=plan_head_axis, window=window,
+                    scale=attn_scale, logit_softcap=attn_softcap,
                     impl="flash" if under_pp else attn_impl)
             elif self.context_impl == "ring":
                 # cp carries the ring's ppermutes; batch/head axes are
                 # manual too (local Pallas calls — GSPMD would gather
                 # them), with heads manual only when this plan actually
-                # tp-shards them
+                # tp-shards them. The window (uniform or per-layer) rides
+                # the banded ring: every live chunk pair runs the kernel
+                # with its GLOBAL offsets, dead pairs skip at the hop level
                 from ..ops.ring_attention import make_ring_attention
 
-                if window is not None:
-                    raise ValueError(
-                        "sliding_window + ring context parallelism is not "
-                        "implemented (the zigzag hop schedule would need "
-                        "band-aware skipping); use --context-impl ulysses "
-                        "(the window passes through its full-sequence "
-                        "layout) or cp=1")
                 attn_impl = make_ring_attention(
                     self.plan.mesh, data_axes=self.plan.data_axes,
-                    head_axis=plan_head_axis, hop_loop=self.cp_hop_loop)
+                    head_axis=plan_head_axis, hop_loop=self.cp_hop_loop,
+                    window=window, scale=attn_scale,
+                    logit_softcap=attn_softcap)
             else:
                 raise ValueError(f"unknown context_impl "
                                  f"{self.context_impl!r}; use 'ring' or "
                                  f"'ulysses'")
-        elif (not callable(attn_impl)
-              and (getattr(cfg, "attn_logit_softcap", None) is not None
-                   or getattr(cfg, "query_pre_attn_scalar", None)
-                   or getattr(cfg, "layer_windows", None))):
-            # Gemma-2 attention extras run on the xla path only — wrapping
-            # the sharded flash kernel here would silently drop the softcap
-            if attn_impl == "flash":
-                raise ValueError(
-                    "attn_impl='flash' does not implement logit softcapping "
-                    "/ score-scale overrides / per-layer windows (Gemma-2); "
-                    "drop --attn-impl (auto resolves to the xla path)")
-            attn_impl = "xla"
         elif (not callable(attn_impl)
               and (attn_impl == "flash"
                    or (attn_impl == "auto"
@@ -471,6 +457,7 @@ class Trainer:
             wrapped = make_sharded_flash_attention(
                 self.plan.mesh, batch_axes=self.plan.data_axes,
                 head_axis=plan_head_axis, window=window,
+                scale=attn_scale, logit_softcap=attn_softcap,
                 forced=attn_impl == "flash")
             if wrapped is not None:
                 attn_impl = wrapped

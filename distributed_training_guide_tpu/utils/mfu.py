@@ -19,14 +19,38 @@ def transformer_flops_per_token(
     seq_len: int,
     include_embedding: bool = False,
     vocab_size: int = 0,
+    attn_kv_len: float | None = None,
 ) -> float:
-    """Training FLOPs (fwd+bwd) per token."""
+    """Training FLOPs (fwd+bwd) per token.
+
+    ``attn_kv_len``: mean keys each query actually attends (defaults to
+    ``seq_len``, the conventional dense-causal count). Banded attention
+    (sliding windows, per-layer schedules) computes O(S*window), not
+    O(S^2) — pass ``banded_attention_kv_length(cfg, seq_len)`` for the
+    honest roofline; published-MFU comparisons keep the dense default."""
     params = n_params
     if not include_embedding and vocab_size:
         params = n_params - vocab_size * hidden_size
     matmul = 6.0 * params
-    attention = 12.0 * n_layers * hidden_size * seq_len
+    attention = 12.0 * n_layers * hidden_size * (
+        seq_len if attn_kv_len is None else attn_kv_len)
     return matmul + attention
+
+
+def banded_attention_kv_length(cfg, seq_len: int) -> float:
+    """Mean effective kv context per query across layers under the config's
+    window schedule — ``min(seq, window)`` per layer, averaged over a
+    per-layer pattern (``layer_windows``, 0 = full attention that layer) or
+    taken from the uniform ``sliding_window``; ``seq_len`` when unwindowed.
+    This is the O(S*window) attention cost the banded flash kernel (and the
+    matching xla mask's useful work) actually pays once S >> window."""
+    lw = getattr(cfg, "layer_windows", None)
+    if lw:
+        return sum(min(seq_len, w) if w else seq_len for w in lw) / len(lw)
+    w = getattr(cfg, "sliding_window", None)
+    if w:
+        return float(min(seq_len, w))
+    return float(seq_len)
 
 
 # Peak bf16 dense FLOP/s per chip by device kind substring.

@@ -334,3 +334,48 @@ def test_comm_model_kinds_match_compiled_hlo(eight_devices):
     hlo_f = t_f.step_fn.lower(state_f, batch_f).compile().as_text()
     assert ("reduce-scatter" in hlo_f) or ("all-reduce" in hlo_f), (
         "fsdp grad reduction missing from HLO in every spelling")
+
+
+def test_banded_attention_preflight_pricing():
+    """Windowed configs must be priced O(S*window), not dense O(S^2), in
+    the preflight roofline (the banded kernel skips out-of-band kv tiles —
+    a 2k-window 16k-seq config does ~1/8 the attention FLOPs). Pins the
+    kv-length translation (uniform window, per-layer schedules, window >=
+    seq) and that the roofline's compute time actually shrinks."""
+    from distributed_training_guide_tpu.parallel import make_mesh, make_plan
+    from distributed_training_guide_tpu.train import Trainer, adamw_cosine
+    from distributed_training_guide_tpu.train.preflight import comm_roofline
+    from distributed_training_guide_tpu.utils.mfu import (
+        banded_attention_kv_length, transformer_flops_per_token)
+
+    # the kv-length translation
+    full = get_model("llama-debug").config
+    assert banded_attention_kv_length(full, 1024) == 1024
+    swa = get_model("llama-debug", sliding_window=128).config
+    assert banded_attention_kv_length(swa, 1024) == 128
+    assert banded_attention_kv_length(swa, 64) == 64  # window wider than seq
+    gemma_ish = get_model("llama-debug", layer_windows=(128, 0)).config
+    # alternating 128-band / full at seq 1024 -> mean (128 + 1024) / 2
+    assert banded_attention_kv_length(gemma_ish, 1024) == (128 + 1024) / 2
+
+    # banded pricing flows into FLOPs/token and the roofline's t_compute
+    dense_fpt = transformer_flops_per_token(1000, 2, 64, 1024)
+    banded_fpt = transformer_flops_per_token(1000, 2, 64, 1024,
+                                             attn_kv_len=128.0)
+    assert banded_fpt < dense_fpt
+    assert banded_fpt == transformer_flops_per_token(1000, 2, 64, 128)
+
+    plan = make_plan("single", make_mesh(devices=jax.devices()[:1]))
+
+    def roofline(**overrides):
+        bundle = get_model("llama-debug", max_position_embeddings=1024,
+                           **overrides)
+        t = Trainer(bundle=bundle, optimizer=adamw_cosine(1e-3), plan=plan,
+                    donate=False)
+        return comm_roofline(t, global_batch=4, seq_length=1024,
+                             device_kind="v5p")
+
+    dense = roofline()
+    banded = roofline(sliding_window=128)
+    assert dense["attn_kv_len"] == 1024 and banded["attn_kv_len"] == 128
+    assert banded["t_compute_s"] < dense["t_compute_s"]

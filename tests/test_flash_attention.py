@@ -217,3 +217,128 @@ def test_attn_remat_policy_through_sharded_wrapper(eight_devices):
 
     assert n_pallas("attn") < n_pallas("all"), \
         (n_pallas("attn"), n_pallas("all"))
+
+
+# ---------------------------------------------------------------------------
+# Gemma-2 attention extras: the {softcap, scale, window, per-layer windows}
+# feature grid vs the XLA reference — fwd and all three grads, fp32
+# interpret mode, GQA included. One combination per row so a regression
+# names the feature that broke.
+# ---------------------------------------------------------------------------
+
+EXTRAS_GRID = [
+    dict(logit_softcap=50.0),
+    dict(scale=24.0 ** -0.5),
+    dict(window=24),
+    dict(logit_softcap=30.0, scale=24.0 ** -0.5),
+    dict(logit_softcap=30.0, window=24),
+    dict(logit_softcap=30.0, scale=24.0 ** -0.5, window=24),  # full Gemma-2
+]
+
+
+@pytest.mark.parametrize("extras", EXTRAS_GRID,
+                         ids=lambda e: "+".join(sorted(e)))
+@pytest.mark.parametrize("hq,hkv", [(4, 4), (4, 2)])
+def test_attention_extras_fwd_and_grads_match_xla(extras, hq, hkv):
+    from distributed_training_guide_tpu.ops.attention import (
+        multihead_attention)
+
+    q, k, v = make_qkv(1, 64, hq, hkv, 32, seed=3)
+
+    def loss(attn_fn):
+        def f(q, k, v):
+            o = attn_fn(q, k, v)
+            return jnp.mean(o * jnp.cos(o))
+        return f
+
+    def flash_fn(q, k, v):
+        return flash_attention(q, k, v, causal=True, block_q=32, block_k=32,
+                               interpret=True, **extras)
+
+    def xla_fn(q, k, v):
+        return multihead_attention(q, k, v, causal=True, impl="xla", **extras)
+
+    out = flash_fn(q, k, v)
+    ref = xla_fn(q, k, v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-5, atol=1e-5)
+
+    g_flash = jax.grad(loss(flash_fn), argnums=(0, 1, 2))(q, k, v)
+    g_ref = jax.grad(loss(xla_fn), argnums=(0, 1, 2))(q, k, v)
+    for name, a, b in zip("qkv", g_flash, g_ref):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-5, atol=1e-5, err_msg=f"d{name}")
+
+
+def test_traced_window_matches_static_and_xla():
+    """A TRACED window (Gemma-2's per-layer schedule rides a lax.scan) takes
+    the dynamic-band operand path — it must match both the static-int band
+    and the xla mask, fwd and grads, including the 2**30 'full attention
+    this layer' encoding of window 0."""
+    from distributed_training_guide_tpu.ops.attention import (
+        multihead_attention)
+
+    q, k, v = make_qkv(1, 64, 4, 2, 32, seed=4)
+
+    @jax.jit
+    def traced(q, k, v, w):
+        return flash_attention(q, k, v, causal=True, window=w,
+                               block_q=32, block_k=32, interpret=True)
+
+    w = jnp.asarray(24, jnp.int32)
+    static = flash_attention(q, k, v, causal=True, window=24,
+                             block_q=32, block_k=32, interpret=True)
+    np.testing.assert_allclose(np.asarray(traced(q, k, v, w)),
+                               np.asarray(static), rtol=1e-6, atol=1e-6)
+
+    # grads through the dynamic band (the band's own cotangent is float0)
+    def loss_traced(q, k, v):
+        o = traced(q, k, v, w)
+        return jnp.mean(o * o)
+
+    def loss_xla(q, k, v):
+        o = multihead_attention(q, k, v, causal=True, window=24, impl="xla")
+        return jnp.mean(o * o)
+
+    g_t = jax.grad(loss_traced, argnums=(0, 1, 2))(q, k, v)
+    g_x = jax.grad(loss_xla, argnums=(0, 1, 2))(q, k, v)
+    for name, a, b in zip("qkv", g_t, g_x):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-5, atol=1e-5, err_msg=f"d{name}")
+
+    # 2**30 = "full attention this layer" (_layer_window_column's encoding
+    # of 0) degenerates to plain causal numerics
+    full = traced(q, k, v, jnp.asarray(2 ** 30, jnp.int32))
+    causal_ref = flash_attention(q, k, v, causal=True, block_q=32,
+                                 block_k=32, interpret=True)
+    np.testing.assert_allclose(np.asarray(full), np.asarray(causal_ref),
+                               rtol=1e-6, atol=1e-6)
+
+
+def test_per_layer_window_scan_matches_unrolled():
+    """The Gemma-2 shape of the plumbing: a window COLUMN riding lax.scan
+    (one traced window per layer, softcap + scale active) must equal the
+    per-layer unrolled static calls — the kernel grid sees one program, the
+    band operand varies per scan step."""
+    q, k, v = make_qkv(1, 64, 4, 2, 32, seed=5)
+    extras = dict(scale=24.0 ** -0.5, logit_softcap=30.0)
+    wins = jnp.asarray([24, 2 ** 30], jnp.int32)   # sliding, then full
+
+    @jax.jit
+    def scanned(q, k, v):
+        def body(carry, w):
+            o = flash_attention(q + carry, k, v, causal=True, window=w,
+                                block_q=32, block_k=32, interpret=True,
+                                **extras)
+            return o, None
+        out, _ = jax.lax.scan(body, jnp.zeros_like(q), wins)
+        return out
+
+    got = scanned(q, k, v)
+    want = jnp.zeros_like(q)
+    for w in (24, None):   # 2**30 == no band
+        want = flash_attention(q + want, k, v, causal=True, window=w,
+                               block_q=32, block_k=32, interpret=True,
+                               **extras)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-6, atol=1e-6)

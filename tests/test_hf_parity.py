@@ -336,6 +336,14 @@ def test_gemma2_parity(tmp_path):
         theirs = model(torch.tensor(ids)).logits.float().numpy()
     np.testing.assert_allclose(ours, theirs, rtol=2e-4, atol=2e-4)
 
+    # the FLASH path (the production default on TPU — interpret mode runs
+    # the same kernels here): softcap, query_pre_attn_scalar and the
+    # alternating per-layer windows all inside the Pallas kernel, at seq 48
+    # > window 16 so the banded layers genuinely band
+    ours_flash = np.asarray(bundle.apply(bundle.config, params,
+                                         jnp.asarray(ids), attn_impl="flash"))
+    np.testing.assert_allclose(ours_flash, theirs, rtol=2e-4, atol=2e-4)
+
     # pretrained -> one optimizer step through the sandwich wiring
     assert np.isfinite(_one_train_step(bundle, plan, params, ids))
 

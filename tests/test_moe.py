@@ -299,3 +299,33 @@ def test_moe_dispatch_validation():
     ids = jnp.zeros((1, 8), jnp.int32)
     with pytest.raises(ValueError, match="unknown moe_dispatch"):
         bundle.apply_with_aux(bundle.config, params, ids)
+
+
+def test_moe_per_layer_windows_flash_matches_xla():
+    """MoE families thread the per-layer window column through their scan
+    too (VERDICT #8b): moe-debug with an alternating sliding/full pattern —
+    fwd+grad parity between the flash (interpret) and xla paths, and the
+    band must genuinely bind (different loss than unwindowed). seq 32 >
+    window 8, fp32."""
+    bundle = get_model("moe-debug", dtype=jnp.float32, layer_windows=(8, 0))
+    assert bundle.config.layer_windows == (8, 0)
+    params = bundle.init(bundle.config, jax.random.key(0))
+    ids = jax.random.randint(jax.random.key(1), (2, 32), 0,
+                             bundle.config.vocab_size)
+
+    def loss_fn(p, impl):
+        lg, ax = bundle.apply_with_aux(bundle.config, p, ids, attn_impl=impl)
+        return causal_lm_loss(lg, ids) + 0.01 * ax
+
+    lx, gx = jax.value_and_grad(loss_fn)(params, "xla")
+    lf, gf = jax.value_and_grad(loss_fn)(params, "flash")
+    np.testing.assert_allclose(float(lf), float(lx), rtol=1e-5)
+    for a, b in zip(jax.tree.leaves(gf), jax.tree.leaves(gx)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-4, atol=1e-5)
+
+    # the window binds: an unwindowed twin's logits must differ
+    full = get_model("moe-debug", dtype=jnp.float32)
+    lg_win, _ = bundle.apply_with_aux(bundle.config, params, ids)
+    lg_full, _ = full.apply_with_aux(full.config, params, ids)
+    assert float(jnp.max(jnp.abs(lg_win - lg_full))) > 1e-4

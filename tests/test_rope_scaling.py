@@ -197,19 +197,39 @@ def test_presets_carry_llama3_scaling():
     assert PRESETS["llama-650m"].rope_scaling is None
 
 
-def test_cp_rejects_seq_dependent_rope_types():
-    """Under context parallelism each sequence shard sees a slice of the
-    positions; dynamic/longrope would compute shard-dependent frequencies —
-    the Trainer must reject instead of silently diverging."""
+def test_cp_dynamic_rope_matches_single_device(eight_devices):
+    """Dynamic-NTK rope under context parallelism: the frequencies trace
+    from ``max(positions) + 1``, and positions are one GLOBAL (cp-sharded)
+    array in GSPMD-land outside the attention shard_maps — the reduction
+    lowers as a cp-collective max, so every sequence shard derives the SAME
+    frequencies. This parity test replaced the old blanket Trainer
+    rejection (VERDICT #8a). max_position is set BELOW the trained length
+    so the NTK multiplier genuinely engages: a shard-local max (shard 0
+    seeing only positions < S/2) would compute different frequencies and
+    diverge from the single-device trajectory."""
     from distributed_training_guide_tpu.train import Trainer, adamw_cosine
 
     assert "dynamic" in SEQ_DEPENDENT_ROPE_TYPES
-    bundle = get_model(
-        "llama-debug",
-        rope_scaling=freeze_rope_scaling({"rope_type": "dynamic", "factor": 2.0}))
-    plan = make_plan("ddp", make_mesh(cp=2, devices=jax.devices()[:2]))
-    with pytest.raises(ValueError, match="context parallelism"):
-        Trainer(bundle=bundle, optimizer=adamw_cosine(1e-4), plan=plan)
+    scaling = freeze_rope_scaling({"rope_type": "dynamic", "factor": 2.0})
+    ids = np.random.RandomState(7).randint(0, 512, (4, 32))
+
+    def run(plan):
+        bundle = get_model("llama-debug", rope_scaling=scaling,
+                           max_position_embeddings=16, dtype=jnp.float32)
+        t = Trainer(bundle=bundle, optimizer=adamw_cosine(1e-3), plan=plan,
+                    donate=False)
+        state = t.init_state(0)
+        batch = {k: jax.device_put(jnp.asarray(ids), t.batch_shardings()[k])
+                 for k in ("input_ids", "labels")}
+        losses = []
+        for _ in range(2):
+            state, m = t.step_fn(state, batch)
+            losses.append(float(m["loss"]))
+        return losses
+
+    golden = run(make_plan("single", make_mesh(devices=jax.devices()[:1])))
+    cp = run(make_plan("ddp", make_mesh(cp=2, devices=jax.devices()[:2])))
+    np.testing.assert_allclose(cp, golden, rtol=2e-4)
 
 
 def test_hf_export_roundtrips_rope_scaling(tmp_path):

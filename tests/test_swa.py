@@ -87,6 +87,50 @@ def test_window_requires_causal():
             multihead_attention(q, q, q, causal=False, window=4, impl=impl)
 
 
+def test_window_below_one_raises_everywhere(eight_devices):
+    """A static window < 1 masks every score — the kernel's safe_l path
+    would return all-ZERO attention with no error (review finding: the
+    per-call/dynamic paths skipped the static path's >= 1 guard). Every
+    entry point must raise instead; negative layer_windows entries (whose
+    traced column can't be checked at trace time) fail at the producer."""
+    from distributed_training_guide_tpu.ops.flash_attention import (
+        make_sharded_flash_attention)
+    from distributed_training_guide_tpu.ops.ring_attention import (
+        make_ring_attention)
+    from distributed_training_guide_tpu.parallel import make_mesh
+
+    q = jnp.zeros((2, 32, 4, 16))
+    with pytest.raises(ValueError, match="window must be >= 1"):
+        flash_attention(q, q, q, window=0, interpret=True)
+    with pytest.raises(ValueError, match="window must be >= 1"):
+        multihead_attention(q, q, q, causal=True, window=0, impl="xla")
+
+    mesh = make_mesh(fsdp=2, devices=jax.devices()[:2])
+    sharded = make_sharded_flash_attention(mesh, batch_axes=("fsdp",),
+                                           head_axis=None)
+    with pytest.raises(ValueError, match="window must be >= 1"):
+        sharded(q, q, q, window=0)   # the per-call override path
+    with pytest.raises(ValueError, match="window must be >= 1"):
+        make_sharded_flash_attention(mesh, batch_axes=("fsdp",),
+                                     head_axis=None, window=0)
+
+    cp_mesh = make_mesh(cp=2, devices=jax.devices()[:2])
+    ring = make_ring_attention(cp_mesh, data_axes=(), head_axis=None)
+    with pytest.raises(ValueError, match="window must be >= 1"):
+        ring(q, q, q, window=0)
+    with pytest.raises(ValueError, match="window must be >= 1"):
+        make_ring_attention(cp_mesh, data_axes=(), head_axis=None, window=0)
+
+    from distributed_training_guide_tpu.models.llama import (
+        LlamaConfig, _layer_window_column)
+
+    cfg = LlamaConfig(vocab_size=64, hidden_size=32, intermediate_size=64,
+                      num_layers=2, num_heads=2, num_kv_heads=2,
+                      layer_windows=(8, -1))
+    with pytest.raises(ValueError, match="layer_windows"):
+        _layer_window_column(cfg)
+
+
 def test_xla_swa_with_explicit_positions():
     """The decode path masks the KV cache through explicit kv_positions;
     the window must compose with them (cache rows beyond pos stay dead)."""
@@ -140,19 +184,46 @@ def test_mistral_swa_parity(tmp_path):
     np.testing.assert_allclose(ours, theirs, rtol=2e-4, atol=2e-4)
 
 
-def test_ring_cp_rejects_swa():
-    """ring CP + sliding_window must fail loudly (band-aware hop skipping is
-    not implemented), pointing at the ulysses path that does compose."""
+def _cp_trajectory(bundle_kwargs, plan, steps=2, seq=64, **trainer_kwargs):
+    """Short training trajectory (losses) for the CP parity goldens below."""
     from distributed_training_guide_tpu.models import get_model
-    from distributed_training_guide_tpu.parallel import make_mesh, make_plan
     from distributed_training_guide_tpu.train import Trainer, adamw_cosine
 
-    bundle = get_model("llama-debug", sliding_window=32)
-    plan = make_plan("ddp", make_mesh(cp=2, devices=jax.devices()[:2]))
-    trainer = Trainer(bundle=bundle, optimizer=adamw_cosine(1e-4), plan=plan,
-                      context_impl="ring")
-    with pytest.raises(ValueError, match="sliding_window \\+ ring"):
-        trainer.step_fn  # attention impl resolves lazily with the step fn
+    bundle = get_model("llama-debug", dtype=jnp.float32, **bundle_kwargs)
+    trainer = Trainer(bundle=bundle, optimizer=adamw_cosine(1e-3), plan=plan,
+                      donate=False, **trainer_kwargs)
+    ids = np.random.RandomState(0).randint(0, 512, (4, seq))
+    state = trainer.init_state(0)
+    batch = {k: jax.device_put(jnp.asarray(ids), trainer.batch_shardings()[k])
+             for k in ("input_ids", "labels")}
+    losses = []
+    for _ in range(steps):
+        state, m = trainer.step_fn(state, batch)
+        losses.append(float(m["loss"]))
+    return losses
+
+
+def test_ring_cp_swa_matches_single_device():
+    """sliding_window through the zigzag ring: every live chunk pair runs
+    the kernel with its GLOBAL offsets on the dynamic band operand, so the
+    band mask is exact across chunk boundaries — trajectory parity vs
+    single device (this replaced the old loud rejection). window 16 < the
+    32-token per-member slice, so the band crosses zigzag chunk boundaries
+    and out-of-band chunk pairs genuinely skip."""
+    from distributed_training_guide_tpu.parallel import make_mesh, make_plan
+
+    kwargs = dict(sliding_window=16)
+    golden = _cp_trajectory(
+        kwargs, make_plan("single", make_mesh(devices=jax.devices()[:1])))
+    ring = _cp_trajectory(
+        kwargs, make_plan("ddp", make_mesh(cp=2, devices=jax.devices()[:2])),
+        context_impl="ring")
+    np.testing.assert_allclose(ring, golden, rtol=2e-4)
+    # a deeper ring: cp=4 exercises multi-hop band skipping
+    ring4 = _cp_trajectory(
+        kwargs, make_plan("ddp", make_mesh(cp=4, devices=jax.devices()[:4])),
+        context_impl="ring")
+    np.testing.assert_allclose(ring4, golden, rtol=2e-4)
 
 
 def test_swa_remat_policy_keeps_banded_kernel_residuals():
@@ -189,18 +260,29 @@ def test_swa_remat_policy_keeps_banded_kernel_residuals():
         (n_pallas("attn"), n_pallas("all"))
 
 
-def test_cp_rejects_gemma2_attention_extras():
-    """Softcap / query_pre_attn_scalar under cp would be SILENTLY dropped
-    by the ring/ulysses wrappers — the Trainer must reject them loudly
-    (review-r5 finding), even without layer_windows set."""
-    from distributed_training_guide_tpu.models import get_model
+def test_cp_gemma2_extras_match_single_device():
+    """Gemma-2's attention extras — tanh softcap, query_pre_attn_scalar
+    score scale, and the alternating per-layer window schedule — through
+    BOTH CP schemes, trajectory parity vs single device (these combinations
+    were loudly rejected before the kernels threaded the extras). The ring
+    runs the banded per-pair kernels with softcap/scale baked in; ulysses
+    passes them through its full-sequence layout. layer_windows alternates
+    a 16-band with full attention at seq 64, so a uniform-window (or
+    dropped-softcap) implementation cannot match."""
     from distributed_training_guide_tpu.parallel import make_mesh, make_plan
-    from distributed_training_guide_tpu.train import Trainer, adamw_cosine
 
-    bundle = get_model("llama-debug", attn_logit_softcap=50.0)
-    plan = make_plan("ddp", make_mesh(cp=2, devices=jax.devices()[:2]))
-    with pytest.raises(ValueError, match="softcapping"):
-        Trainer(bundle=bundle, optimizer=adamw_cosine(1e-4), plan=plan)
+    kwargs = dict(attn_logit_softcap=30.0, query_pre_attn_scalar=24.0,
+                  layer_windows=(16, 0))
+    golden = _cp_trajectory(
+        kwargs, make_plan("single", make_mesh(devices=jax.devices()[:1])))
+    ring = _cp_trajectory(
+        kwargs, make_plan("ddp", make_mesh(cp=2, devices=jax.devices()[:2])),
+        context_impl="ring")
+    np.testing.assert_allclose(ring, golden, rtol=2e-4)
+    ulysses = _cp_trajectory(
+        kwargs, make_plan("ddp", make_mesh(cp=2, devices=jax.devices()[:2])),
+        context_impl="ulysses")
+    np.testing.assert_allclose(ulysses, golden, rtol=2e-4)
 
 
 def test_callable_attn_impl_rejects_gemma2_attention_extras():
@@ -221,6 +303,56 @@ def test_callable_attn_impl_rejects_gemma2_attention_extras():
     # plain configs keep accepting callables (the supported extension point)
     Trainer(bundle=get_model("llama-debug"), optimizer=adamw_cosine(1e-4),
             attn_impl=custom_attn)
+    # layer_windows ALONE composes with a callable that declares
+    # accepts_window (the model passes window= per call, like the
+    # Trainer-built wrappers); without the declaration it stays rejected
+    lw_bundle = get_model("llama-debug", layer_windows=(16, 0))
+    with pytest.raises(ValueError, match="user-supplied attn_impl"):
+        Trainer(bundle=lw_bundle, optimizer=adamw_cosine(1e-4),
+                attn_impl=custom_attn)
+
+    def windowed_attn(q, k, v, **kw):  # pragma: no cover — never reached
+        return q
+
+    windowed_attn.accepts_window = True
+    Trainer(bundle=lw_bundle, optimizer=adamw_cosine(1e-4),
+            attn_impl=windowed_attn)
+    # a UNIFORM sliding_window is gated the same way: silently training
+    # full-causal against an SWA config is the failure mode being guarded
+    sw_bundle = get_model("llama-debug", sliding_window=32)
+    with pytest.raises(ValueError, match="user-supplied attn_impl"):
+        Trainer(bundle=sw_bundle, optimizer=adamw_cosine(1e-4),
+                attn_impl=custom_attn)
+    Trainer(bundle=sw_bundle, optimizer=adamw_cosine(1e-4),
+            attn_impl=windowed_attn)
+
+
+def test_sharded_flash_per_call_static_window_override(eight_devices):
+    """A per-call STATIC int window differing from the factory default must
+    genuinely band (review finding: _resolve_band treats static ints as
+    bake-in, and the override path once substituted the 2**30 no-band
+    encoding — silently running full attention)."""
+    from distributed_training_guide_tpu.ops.flash_attention import (
+        make_sharded_flash_attention)
+    from distributed_training_guide_tpu.parallel import make_mesh
+
+    mesh = make_mesh(fsdp=2, devices=jax.devices()[:2])
+    attn = make_sharded_flash_attention(mesh, batch_axes=("fsdp",),
+                                        head_axis=None)
+    rng = np.random.RandomState(5)
+    q = jnp.asarray(rng.randn(2, 32, 4, 16), jnp.float32)
+    k = jnp.asarray(rng.randn(2, 32, 2, 16), jnp.float32)
+    v = jnp.asarray(rng.randn(2, 32, 2, 16), jnp.float32)
+    got = attn(q, k, v, window=8)
+    want = _dense_swa_reference(q, k, v, 8)
+    np.testing.assert_allclose(np.asarray(got), want, rtol=2e-5, atol=2e-5)
+    # and a per-call None against a WINDOWED factory lifts the band
+    attn_w = make_sharded_flash_attention(mesh, batch_axes=("fsdp",),
+                                          head_axis=None, window=8)
+    full = multihead_attention(q, k, v, causal=True, impl="xla")
+    got_full = attn_w(q, k, v, window=None)
+    np.testing.assert_allclose(np.asarray(got_full), np.asarray(full),
+                               rtol=2e-5, atol=2e-5)
 
 
 def test_swa_train_step_and_ulysses_compose():
