@@ -444,11 +444,19 @@ def run_flash_check() -> None:
 
 
 def run_decode_check() -> None:
-    """Serving rung: decode tokens/sec through the continuous-batching
-    paged-KV engine (serve/) at n_slots 1 and 8 on llama-debug — the
-    inference trajectory recorded next to the training MFU rungs. The
-    1-slot number is the latency-style floor; 8 slots shows what
-    iteration-level batching buys at full occupancy."""
+    """Serving rungs: decode tokens/sec through the continuous-batching
+    paged-KV engine (serve/) on llama-debug — the inference trajectory
+    recorded next to the training MFU rungs.
+
+    - slots1 / slots8: the PR-4 rungs (latency floor vs full-occupancy
+      batching), unchanged workload so the history stays comparable.
+    - prefix_shared8: n_slots 8 over a common 192-token prefix (the
+      system-prompt shape; llama-debug's 256-position table caps the
+      512-token nominal) — prefill amortization + refcounted residency.
+    - mixed_chunked: one 192-token prompt admitted while 4 decodes are
+      resident, prefill_chunk=32 — records the resident decodes' max
+      iteration gap, the number chunked prefill exists to bound.
+    """
     _configure_jax_cache()
     import jax
     import jax.numpy as jnp
@@ -479,6 +487,63 @@ def run_decode_check() -> None:
         out[f"slots{n_slots}"] = stats
         out["value"] = stats["tokens_per_s"]   # headline: the last (8-slot)
         _emit({**out, "partial": True})        # survives a stall mid-check
+
+    # prefix-shared rung: 8 slots, common 192-token prefix
+    prefix = [3 + (i % 200) for i in range(192)]
+    engine = ServeEngine(bundle, params, n_slots=8, page_size=16,
+                         max_len=256, prefill_chunk=64)
+    generate_many(engine, [Request(prompt_ids=prefix + [7],
+                                   max_new_tokens=4)])   # warm + register
+    engine.decode_steps = engine.decode_tokens = 0
+    reqs = [Request(prompt_ids=prefix + [10 + i], max_new_tokens=32,
+                    seed=i) for i in range(8)]
+    pool = engine.scheduler.pool
+    for r in reqs:
+        engine.submit(r)
+    results, peak = [], 0
+    t0 = time.perf_counter()
+    while engine.has_work:
+        results.extend(engine.step())
+        # peak sampled DURING co-residency — end-state would only show
+        # the cache-held pages after every slot has drained
+        peak = max(peak, pool.capacity - pool.n_free)
+    stats = throughput_stats(results, time.perf_counter() - t0, engine)
+    out["prefix_shared8"] = {
+        **stats,
+        "prefix_hits": engine.scheduler.stats["prefix_hits"],
+        "prefix_tokens_shared":
+            engine.scheduler.stats["prefix_tokens_shared"],
+        "resident_pages_peak": peak,
+        "unshared_pages_equivalent": 8 * (-(-(len(prefix) + 1 + 32) // 16)),
+    }
+    _emit({**out, "partial": True})
+
+    # mixed rung: long prefill chunked against resident decodes — the
+    # per-iteration decode gap is the latency chunking bounds
+    engine = ServeEngine(bundle, params, n_slots=5, page_size=16,
+                         max_len=256, prefill_chunk=32)
+    generate_many(engine, [Request(prompt_ids=[3, 17], max_new_tokens=4)])
+    residents = [Request(prompt_ids=[5 + i, 6], max_new_tokens=96, seed=i)
+                 for i in range(4)]
+    for r in residents:
+        engine.submit(r)
+    engine.step()
+    long_req = Request(prompt_ids=[3 + (i % 200) for i in range(192)],
+                       max_new_tokens=8, seed=99)
+    engine.submit(long_req)
+    gaps, t_prev = [], time.perf_counter()
+    while engine.has_work:
+        engine.step()
+        now = time.perf_counter()
+        gaps.append(now - t_prev)
+        t_prev = now
+    gaps.sort()
+    out["mixed_chunked"] = {
+        "prefill_chunk": 32,
+        "iterations": len(gaps),
+        "iter_ms_p50": round(1000 * gaps[len(gaps) // 2], 2),
+        "iter_ms_max": round(1000 * gaps[-1], 2),
+    }
     _emit(out)
 
 

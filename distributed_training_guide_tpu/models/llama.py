@@ -660,20 +660,42 @@ def decode_step(config: LlamaConfig, params: dict, token_ids: jnp.ndarray,
     return lm_head_logits(config, params, x)[:, -1], {"k": ks, "v": vs}
 
 
+def paged_positions(token_ids: jnp.ndarray,
+                    positions: jnp.ndarray) -> jnp.ndarray:
+    """[S, T] absolute positions for a paged decode/chunk call: slot s's
+    T tokens sit at ``positions[s] + 0..T-1`` (T == 1 is the decode step,
+    T > 1 a prefill chunk). Shared by every family's paged entry point."""
+    t = token_ids.shape[1]
+    return positions[:, None] + jnp.arange(t, dtype=positions.dtype)[None, :]
+
+
+def paged_logits_at(lm_head, config, params, x, last_index):
+    """Slice the hidden states at the position whose logits the caller
+    wants BEFORE the head projection (same rationale as ``prefill``: never
+    project a whole chunk to [S, T, V] fp32 to keep one row). ``None``
+    keeps the decode contract — the last position."""
+    x_last = (x[:, -1:] if last_index is None
+              else jax.lax.dynamic_slice_in_dim(x, last_index, 1, axis=1))
+    return lm_head(config, params, x_last)[:, 0]
+
+
 def paged_decode_step(config: LlamaConfig, params: dict,
                       token_ids: jnp.ndarray, positions: jnp.ndarray,
-                      cache: dict, attend):
-    """One decode step over a PAGED multi-request cache (serve/engine.py):
-    ``token_ids`` [S, 1] are each slot's current token at PER-SLOT position
-    ``positions`` [S] (the contiguous-cache ``decode_step`` shares one
-    scalar ``pos`` across the batch — useless for continuous batching).
-    ``cache`` holds the page pools ``{"k","v"}: [L, n_pages, page, kvh, hd]``
-    and ``attend(q, k, v, kp, vp, *, window, scale, softcap)`` (built by
-    serve/kv_pages.py) scatters the new k/v into the layer's pages and
-    attends each slot over its own block table. Returns
+                      cache: dict, attend, last_index=None):
+    """One step over a PAGED multi-request cache (serve/engine.py):
+    ``token_ids`` [S, T] are each slot's next T tokens starting at
+    PER-SLOT position ``positions`` [S] (the contiguous-cache
+    ``decode_step`` shares one scalar ``pos`` across the batch — useless
+    for continuous batching). T == 1 is the batched decode step; T > 1 is
+    a chunked-prefill call (S == 1 in practice) whose queries attend over
+    the committed history AND the chunk itself — ``last_index`` (traced)
+    then selects the real last token's logits out of a padded chunk.
+    ``cache`` holds the page pools ``{"k","v"}: [L, n_pages, page, kvh,
+    hd]`` and ``attend(q, k, v, kp, vp, *, window, scale, softcap)``
+    (built by serve/kv_pages.py) scatters the new k/v into the layer's
+    pages and attends each slot over its own block table. Returns
     (logits [S, V], updated cache)."""
-    s = token_ids.shape[0]
-    pos2d = jnp.broadcast_to(positions[:, None], (s, 1))
+    pos2d = paged_positions(token_ids, positions)
     x = embed_tokens(config, params, token_ids, pos2d)
 
     wins = _layer_window_column(config)
@@ -694,7 +716,8 @@ def paged_decode_step(config: LlamaConfig, params: dict,
         return x, (nkp, nvp)
 
     x, (ks, vs) = _scan_kv_layers(body, x, params, cache, wins)
-    return lm_head_logits(config, params, x)[:, -1], {"k": ks, "v": vs}
+    return (paged_logits_at(lm_head_logits, config, params, x, last_index),
+            {"k": ks, "v": vs})
 
 
 # ---------------------------------------------------------------------------

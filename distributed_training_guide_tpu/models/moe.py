@@ -728,13 +728,13 @@ def decode_step(config: MoELlamaConfig, params: dict, token_ids: jnp.ndarray,
 
 def paged_decode_step(config: MoELlamaConfig, params: dict,
                       token_ids: jnp.ndarray, positions: jnp.ndarray,
-                      cache: dict, attend):
-    """Paged multi-request decode step (llama.paged_decode_step contract):
-    the routed FFN runs drop-free (ragged backend) on the [S, 1] decoded
-    tokens — per-token routing is independent of the co-resident slots, so
-    continuous batching cannot perturb a request's expert choices."""
-    s = token_ids.shape[0]
-    pos2d = jnp.broadcast_to(positions[:, None], (s, 1))
+                      cache: dict, attend, last_index=None):
+    """Paged multi-request decode/chunk step (llama.paged_decode_step
+    contract): the routed FFN runs drop-free (ragged backend) on the
+    [S, T] tokens — per-token routing is independent of the co-resident
+    slots, so continuous batching cannot perturb a request's expert
+    choices."""
+    pos2d = llama.paged_positions(token_ids, positions)
     x = embed_tokens(config, params, token_ids, pos2d)
 
     wins = llama._layer_window_column(config)
@@ -757,7 +757,9 @@ def paged_decode_step(config: MoELlamaConfig, params: dict,
         return x, (nkp, nvp)
 
     x, (ks, vs) = llama._scan_kv_layers(body, x, params, cache, wins)
-    return lm_head_logits(config, params, x)[:, -1], {"k": ks, "v": vs}
+    return (llama.paged_logits_at(lm_head_logits, config, params, x,
+                                  last_index),
+            {"k": ks, "v": vs})
 
 
 PRESETS = {

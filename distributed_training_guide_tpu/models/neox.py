@@ -409,12 +409,13 @@ def decode_step(config: NeoXConfig, params: dict, token_ids: jnp.ndarray,
 
 def paged_decode_step(config: NeoXConfig, params: dict,
                       token_ids: jnp.ndarray, positions: jnp.ndarray,
-                      cache: dict, attend):
-    """Paged multi-request decode step (llama.paged_decode_step contract)
-    through ``_cached_block`` — the same parallel-/sequential-residual body
-    the contiguous decode runs."""
-    s = token_ids.shape[0]
-    pos2d = jnp.broadcast_to(positions[:, None], (s, 1))
+                      cache: dict, attend, last_index=None):
+    """Paged multi-request decode/chunk step (llama.paged_decode_step
+    contract) through ``_cached_block`` — the same parallel-/sequential-
+    residual body the contiguous decode runs."""
+    from .llama import paged_logits_at, paged_positions
+
+    pos2d = paged_positions(token_ids, positions)
     x = embed_tokens(config, params, token_ids, pos2d)
 
     def body(x, inputs):
@@ -429,7 +430,8 @@ def paged_decode_step(config: NeoXConfig, params: dict,
 
     x, (ks, vs) = jax.lax.scan(body, x, (params["layers"],
                                          cache["k"], cache["v"]))
-    return lm_head_logits(config, params, x)[:, -1], {"k": ks, "v": vs}
+    return (paged_logits_at(lm_head_logits, config, params, x, last_index),
+            {"k": ks, "v": vs})
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,189 @@
+"""Paged flash-decode: a Pallas kernel that attends one query token per
+slot over that slot's KV pages *through* the block table.
+
+The XLA reference path in ``serve/kv_pages.paged_attend`` gathers every
+slot's block table into a contiguous ``[S, M*page, Hkv, D]`` logical view
+before attending — per generated token that is an O(n_slots * max_len)
+HBM round-trip (read the pages, WRITE the gathered copy, read it back),
+whatever the live context actually is. This kernel is the PagedAttention
+decode analog of ``ops/flash_attention.py`` (Kwon et al.,
+arXiv:2309.06180): the grid walks (slot, kv-head, kv-page), the block
+table rides as a SCALAR-PREFETCH operand so each kv BlockSpec DMAs the
+slot's next *physical* page directly from the pool, and the online-softmax
+partial (m, l, acc) is carried across page steps in VMEM scratch — the
+same accumulation ``_fwd_kernel`` uses, with the band predicates
+(`_band_live`/`_band_mask`) reused verbatim at block_q=1. Nothing
+context-sized is ever materialized: reads are O(live pages) and the only
+write is the [S, Hq, D] output.
+
+Feature parity with the serving attend contract (Gemma-2 decodes through
+this): ``window`` (static, or traced per-layer schedules riding the same
+[3] int32 band operand the training kernel uses), ``scale``, and
+``softcap``. Positions past ``lengths`` (trash-page rows, stale tail
+garbage) are cut by the causal mask exactly as in the gather path.
+
+``interpret=True`` runs the kernel on CPU — the tier-1 parity grid in
+``tests/test_paged_decode.py`` pins it against the XLA gather path at
+1e-5 across GQA/window/scale/softcap and shuffled physical layouts.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+from .flash_attention import (NEG_INF, _band_live, _band_mask, _pack_band,
+                              check_static_window)
+
+try:  # pltpu imports on CPU builds; guard only for exotic setups
+    from jax.experimental.pallas import tpu as pltpu
+except Exception:  # pragma: no cover
+    pltpu = None
+
+
+def _decode_kernel(lens_ref, tabs_ref, band_ref, q_ref, k_ref, v_ref, o_ref,
+                   m_scr, l_scr, acc_scr, *, scale, softcap, page,
+                   num_page_blocks):
+    """Grid (slot, kv_head, page_block); page_block innermost so the
+    (m, l, acc) scratch carries the online softmax across the slot's
+    pages. One query row per slot: block_q == 1 with the query offset at
+    ``lengths[slot]`` drives the shared band machinery."""
+    s_idx = pl.program_id(0)
+    m_idx = pl.program_id(2)
+    q_pos = lens_ref[s_idx]          # the new token's position (see caller)
+    window = band_ref[0]             # [window, q_off, k_off] contract;
+                                     # 2**30 encodes "no window"
+
+    @pl.when(m_idx == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    # page fully outside the (causal, window) band -> no compute. Dead
+    # tiles past the slot's table alias the trash page (table rows are
+    # 0-filled), so consecutive skipped steps re-reference one block.
+    live = _band_live(True, window, 0, m_idx, 1, page, q_off=q_pos)
+
+    @pl.when(live)
+    def _compute():
+        q = q_ref[0, 0].astype(jnp.float32)          # [G, D] (GQA group)
+        k = k_ref[0, :, 0, :].astype(jnp.float32)    # [page, D]
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        if softcap is not None:  # Gemma-2: tanh cap BEFORE the mask
+            s = jnp.tanh(s / softcap) * softcap
+        # [1, page] mask at q_off = the slot's position, broadcast over G
+        mask = _band_mask(True, window, 0, m_idx, 1, page, (1, page),
+                          q_off=q_pos)
+        s = jnp.where(mask, s, NEG_INF)
+
+        m_prev = m_scr[:, 0:1]                       # [G, 1]
+        l_prev = l_scr[:, 0:1]
+        m_cur = jnp.max(s, axis=1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        alpha = jnp.exp(m_prev - m_new)
+        p = jnp.exp(s - m_new)                       # [G, page]
+        # a live page can still be fully masked for this query (the
+        # window's lower edge crosses it): exp(NEG_INF - NEG_INF) = 1
+        # would poison l — zero masked lanes explicitly, as the training
+        # kernel does for SWA tiles
+        p = jnp.where(mask, p, 0.0)
+        l_new = alpha * l_prev + jnp.sum(p, axis=1, keepdims=True)
+        v = v_ref[0, :, 0, :].astype(jnp.float32)    # [page, D]
+        pv = jax.lax.dot_general(p, v, (((1,), (0,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        acc_scr[:] = acc_scr[:] * alpha + pv
+        m_scr[:] = jnp.broadcast_to(m_new, m_scr.shape)
+        l_scr[:] = jnp.broadcast_to(l_new, l_scr.shape)
+
+    @pl.when(m_idx == num_page_blocks - 1)
+    def _finalize():
+        l = l_scr[:, 0:1]
+        safe_l = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0, 0] = (acc_scr[:] / safe_l).astype(o_ref.dtype)
+
+
+def paged_decode_eligible(head_dim: int, page_size: int) -> bool:
+    """Mosaic tile-divisibility gate for the COMPILED kernel (the interpret
+    path takes any shape): head_dim on the lane axis, page on sublanes."""
+    return head_dim % 64 == 0 and page_size % 8 == 0
+
+
+def paged_flash_decode(
+    q: jnp.ndarray,          # [S, Hq, D] — one query token per slot
+    k_pages: jnp.ndarray,    # [P, page, Hkv, D] — ONE layer's page pool
+    v_pages: jnp.ndarray,
+    tables: jnp.ndarray,     # [S, M] int32 physical page ids (0 = trash)
+    lengths: jnp.ndarray,    # [S] int32 — the query token's position; kv
+                             # positions j <= lengths[s] are live
+    *,
+    window=None,
+    scale: Optional[float] = None,
+    softcap: Optional[float] = None,
+    interpret: Optional[bool] = None,
+) -> jnp.ndarray:
+    """Flash decode through the block table; returns [S, Hq, D] in q.dtype.
+
+    The caller has already scattered the new token's k/v into the pages
+    (``serve/kv_pages.paged_attend`` owns that write), so position
+    ``lengths[s]`` is resident and the causal mask keeps everything past
+    it (trash page, stale garbage) out — identical semantics to the XLA
+    gather reference, without the gathered view.
+    """
+    check_static_window(window)
+    s, hq, d = q.shape
+    _, page, hkv, _ = k_pages.shape
+    m = tables.shape[1]
+    groups = hq // hkv
+    if scale is None:
+        scale = 1.0 / (d ** 0.5)
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    if not interpret and not paged_decode_eligible(d, page):
+        raise ValueError(
+            f"paged_flash_decode (compiled) needs head_dim % 64 == 0 and "
+            f"page_size % 8 == 0; got head_dim={d}, page_size={page} — "
+            f"use impl='xla' or adjust page_size")
+    band = _pack_band(window)     # [window|2**30, 0, 0] int32 — the same
+                                  # dynamic-band contract as the training
+                                  # kernels; traced per-layer windows ride it
+    qr = q.reshape(s, hkv, groups, d)
+
+    kernel = functools.partial(_decode_kernel, scale=scale, softcap=softcap,
+                               page=page, num_page_blocks=m)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=3,          # lengths, tables, band
+        grid=(s, hkv, m),
+        in_specs=[
+            pl.BlockSpec((1, 1, groups, d),
+                         lambda s_, h, m_, lens, tabs, band_: (s_, h, 0, 0)),
+            # the point of the kernel: the kv BlockSpec reads THROUGH the
+            # block table — step (s, h, m) DMAs physical page tables[s, m]
+            pl.BlockSpec((1, page, 1, d),
+                         lambda s_, h, m_, lens, tabs, band_:
+                         (tabs[s_, m_], 0, h, 0)),
+            pl.BlockSpec((1, page, 1, d),
+                         lambda s_, h, m_, lens, tabs, band_:
+                         (tabs[s_, m_], 0, h, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, 1, groups, d),
+                               lambda s_, h, m_, lens, tabs, band_:
+                               (s_, h, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((groups, 128), jnp.float32),   # running max
+            pltpu.VMEM((groups, 128), jnp.float32),   # running sum
+            pltpu.VMEM((groups, d), jnp.float32),     # output accumulator
+        ],
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((s, hkv, groups, d), q.dtype),
+        interpret=interpret,
+    )(lengths.astype(jnp.int32), tables.astype(jnp.int32), band, qr,
+      k_pages, v_pages)
+    return out.reshape(s, hq, d)

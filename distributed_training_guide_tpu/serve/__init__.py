@@ -2,20 +2,24 @@
 
 The training side of this repo ends at checkpoints; this package is the
 inference side — iteration-level (Orca) scheduling over a block-table
-paged (vLLM/PagedAttention) KV cache, reusing each model family's
-``init_cache``/``prefill``/``decode_step`` layouts and the training
-sharding plans. See related-topics/serving/README.md for the chapter.
+paged (vLLM/PagedAttention) KV cache with a Pallas flash-decode kernel
+(``ops/paged_decode.py``), refcounted copy-on-write prefix sharing,
+optimistic admission with preemption-by-recompute, and Sarathi-style
+chunked prefill — reusing each model family's ``init_cache``/``prefill``/
+``paged_decode_step`` layouts and the training sharding plans. See
+related-topics/serving/README.md for the chapter.
 
     from distributed_training_guide_tpu.serve import (
         Request, ServeEngine, generate_many)
 """
 from .engine import ServeEngine
 from .kv_pages import PagePool, kv_page_bytes, pages_for_tokens
-from .scheduler import Request, RequestResult, Scheduler
+from .scheduler import PrefixCache, Request, RequestResult, Scheduler
 
 __all__ = [
-    "PagePool", "Request", "RequestResult", "Scheduler", "ServeEngine",
-    "generate_many", "kv_page_bytes", "pages_for_tokens", "serve_http",
+    "PagePool", "PrefixCache", "Request", "RequestResult", "Scheduler",
+    "ServeEngine", "generate_many", "kv_page_bytes", "pages_for_tokens",
+    "serve_http",
 ]
 
 

@@ -45,6 +45,18 @@ def main(argv=None) -> None:
     parser.add_argument("--max-len", type=int, default=None,
                         help="max prompt+generation context per request "
                         "(default: the model's position table)")
+    parser.add_argument("--prefill-chunk", type=int, default=None,
+                        help="stream prompts in N-token chunks co-scheduled "
+                        "with resident decodes (Sarathi chunked prefill; "
+                        "default: one bucketed prefill per prompt)")
+    parser.add_argument("--no-prefix-cache", action="store_true",
+                        help="disable copy-on-write prefix sharing of "
+                        "prompt pages across requests")
+    parser.add_argument("--attend-impl", default="auto",
+                        choices=("auto", "flash", "xla"),
+                        help="decode attend: the Pallas block-table kernel "
+                        "('flash', TPU), the gather reference ('xla'), or "
+                        "platform auto-dispatch")
     parser.add_argument("--pretrained", default=None, metavar="DIR",
                         help="converted checkpoint dir (models/hf_convert); "
                         "random init otherwise")
@@ -86,7 +98,10 @@ def main(argv=None) -> None:
 
     engine = ServeEngine(bundle, params, n_slots=args.n_slots,
                          page_size=args.page_size, n_pages=args.n_pages,
-                         max_len=args.max_len)
+                         max_len=args.max_len,
+                         prefill_chunk=args.prefill_chunk,
+                         prefix_cache=not args.no_prefix_cache,
+                         attend_impl=args.attend_impl)
     report = engine.kv_report()
     print(json.dumps({"kv_report": report}))
 

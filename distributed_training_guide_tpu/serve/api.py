@@ -72,6 +72,13 @@ def throughput_stats(results: list[RequestResult],
         "latency_s_p50": round(lat[len(lat) // 2], 4) if lat else 0.0,
         "latency_s_max": round(lat[-1], 4) if lat else 0.0,
         "admission_blocked": engine.scheduler.stats["admission_blocked"],
+        # PagedAttention second-half counters: recompute preemptions,
+        # prefix-cache reuse, and copy-on-write forks (serve/scheduler.py)
+        "preempted": engine.scheduler.stats["preempted"],
+        "prefix_hits": engine.scheduler.stats["prefix_hits"],
+        "prefix_tokens_shared":
+            engine.scheduler.stats["prefix_tokens_shared"],
+        "cow_forks": engine.scheduler.stats["cow_forks"],
     }
 
 

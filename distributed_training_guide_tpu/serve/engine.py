@@ -5,21 +5,32 @@ Compile surface (the whole point — requests come and go, programs don't):
 - ONE batched decode program over the fixed ``[n_slots]`` slot array.
   Block tables / lengths / sampling knobs are int/float ARRAY arguments,
   idle slots compute into the trash page and are masked at the sample —
-  admission and eviction never retrace anything.
-- One prefill program per LENGTH BUCKET (powers of two up to ``max_len``):
-  a prompt pads to the smallest covering bucket, runs the family's
-  existing ``prefill`` at batch 1 with the real last index passed as a
-  traced scalar, and a per-bucket commit scatter moves the dense bucket
-  cache into the slot's pages (pad tail -> trash page).
+  admission, eviction, preemption, and page growth never retrace
+  anything. The decode attend defaults to the Pallas block-table kernel
+  on TPU (``ops/paged_decode.py`` — O(live pages) reads, no gathered
+  view); ``attend_impl=`` selects the XLA gather reference explicitly.
+- One prefill program per LENGTH BUCKET (powers of two up to ``max_len``)
+  — or, with ``prefill_chunk=N``, ONE chunk program: the prompt streams
+  through the paged decode path N tokens at a time, each chunk attending
+  over the already-committed pages, co-scheduled with resident decodes
+  (Sarathi-style chunked prefill, Agrawal et al. arXiv:2308.16369) so a
+  long prompt never stalls co-resident generation for its full length.
+  The chunk budget bounds the extra decode latency per iteration.
 - One sampling program (temperature / top-k / top-p, per-slot scalars so
   co-resident requests can run different settings under one compile) and
   its batch-1 twin for prefill logits.
 
+Between scheduler events (admission / eviction / preemption / growth) the
+decode arrays live ON DEVICE: the decode program returns next-step tokens
+and lengths alongside the samples, so a steady decode iteration transfers
+one int32 per slot to the host (bookkeeping) and nothing back.
+
 Sampling keys are ``fold_in(key(seed), absolute position of the sampled
 token)`` — a pure function of (request seed, position), so a request's
 tokens are identical whatever slot it lands in, whenever it is admitted,
-and whoever it shares the batch with. That property IS the
-order-invariance test in tests/test_serve.py.
+whoever it shares the batch with, and whether or not it was preempted and
+recomputed mid-flight. That property IS the order-invariance and
+preemption-identity tests in tests/test_serve.py.
 
 Sharded weights ride the existing ``parallel/plans.py`` meshes: pass
 ``plan=`` (tp / fsdp / single) and params are device_put to the plan's
@@ -37,9 +48,9 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..models.registry import ModelBundle, family_module
-from .kv_pages import (PagePool, commit_prefill, init_pages, kv_page_bytes,
-                       make_attend, pages_for_tokens)
-from .scheduler import Request, RequestResult, Scheduler
+from .kv_pages import (PagePool, commit_prefill, copy_pages, init_pages,
+                       kv_page_bytes, make_attend, pages_for_tokens)
+from .scheduler import Admission, Request, RequestResult, Scheduler
 
 
 def _sample_tokens(logits, seeds, positions, temps, top_ks, top_ps):
@@ -77,14 +88,29 @@ class ServeEngine:
 
     Drive it either through ``serve/api.py`` (``generate_many`` /
     ``serve_http``) or directly: ``submit(Request(...))`` then ``step()``
-    in a loop — each ``step`` is one scheduler iteration (admit + one
-    batched decode) and returns whatever finished.
+    in a loop — each ``step`` is one scheduler iteration (grow/preempt +
+    admit + prefill work + one batched decode) and returns whatever
+    finished.
+
+    ``prefix_cache`` (default on): committed prompt pages register in a
+    content-keyed cache so identical prefixes share physical pages across
+    requests (refcounted, copy-on-write). ``prefill_chunk=N`` streams
+    prompts through the paged path N tokens per iteration instead of one
+    bucketed prefill (long prompts stop stalling resident decodes; also
+    unlocks mid-page prefix reuse). ``attend_impl`` picks the decode
+    attend: "auto" (flash kernel on TPU, gather elsewhere), "flash",
+    "xla". Caveat: under a multi-device ``plan=``, GSPMD cannot partition
+    the Mosaic kernel — it runs replicated per device (correct; the
+    sharded-page-pool design that makes it efficient is ROADMAP item 2),
+    so sharded engines should keep "auto"/"xla" until then.
     """
 
     def __init__(self, bundle: ModelBundle, params, *, n_slots: int = 8,
                  page_size: int = 16, n_pages: Optional[int] = None,
                  max_len: Optional[int] = None,
-                 prefill_buckets: Optional[tuple] = None, plan=None):
+                 prefill_buckets: Optional[tuple] = None, plan=None,
+                 prefill_chunk: Optional[int] = None,
+                 prefix_cache: bool = True, attend_impl: str = "auto"):
         self.bundle = bundle
         self.config = bundle.config
         self.mod = family_module(bundle.family)
@@ -92,11 +118,19 @@ class ServeEngine:
             raise ValueError(
                 f"family {bundle.family!r} has no KV-cached decode — the "
                 f"serving engine needs init_cache/prefill/paged_decode_step")
+        if attend_impl not in ("auto", "flash", "xla"):
+            raise ValueError(f"attend_impl must be 'auto', 'flash' or "
+                             f"'xla', got {attend_impl!r}")
+        self.attend_impl = attend_impl
+        if prefill_chunk is not None and prefill_chunk < 1:
+            raise ValueError(f"prefill_chunk must be >= 1, got "
+                             f"{prefill_chunk}")
+        self.prefill_chunk = prefill_chunk
         max_pos = getattr(self.config, "max_position_embeddings", None)
         if max_len is None:
             # bounded default: the full position table of a big preset
             # (131k for llama3) would size BOTH the default full-residency
-            # pool (n_slots x max_pages pages) and the per-step gather
+            # pool (n_slots x max_pages pages) and the xla path's gather
             # transient to the dense worst case this module exists to
             # remove — long contexts are opt-in via max_len=
             max_len = min(max_pos, 2048) if max_pos else 2048
@@ -108,13 +142,17 @@ class ServeEngine:
         self.max_pages = pages_for_tokens(max_len, page_size)
         self.n_slots = n_slots
         if n_pages is None:
-            # default: full residency + the trash page — backpressure only
-            # engages when the caller sizes the pool below it
+            # default: full residency + the trash page — backpressure /
+            # preemption only engage when the caller sizes the pool below
             n_pages = 1 + n_slots * self.max_pages
         pool = PagePool(n_pages, page_size)
-        self.scheduler = Scheduler(n_slots=n_slots, pool=pool,
-                                   max_len=self.max_model_len,
-                                   max_pages_per_slot=self.max_pages)
+        self.scheduler = Scheduler(
+            n_slots=n_slots, pool=pool, max_len=self.max_model_len,
+            max_pages_per_slot=self.max_pages, prefix_cache=prefix_cache,
+            # mid-page prefix reuse needs the chunked path: a bucketed
+            # prefill recomputes from position 0 anyway, so only aligned
+            # (full-page) sharing pays for itself there
+            allow_partial_share=prefill_chunk is not None)
         if prefill_buckets is None:
             cap = self.max_pages * page_size
             b, buckets = page_size, []
@@ -153,14 +191,20 @@ class ServeEngine:
             self.pages = jax.device_put(self.pages, plan.replicated())
 
         self._prefill_fns = {}
+        self._chunk_fns = {}
         # one jit wrapper; each prefill bucket's [L, Pb, ...] shape gets its
         # own cached executable automatically
         self._commit_fn = jax.jit(commit_prefill, donate_argnums=(0, 1))
+        self._copy_fn = jax.jit(copy_pages, donate_argnums=(0, 1))
         self._decode_fn = jax.jit(self._decode, donate_argnums=(1, 2))
         self._sample_one = jax.jit(
             lambda logit, seed, pos, t, tk, tp: _sample_tokens(
                 logit[None], seed[None], pos[None], t[None], tk[None],
                 tp[None])[0])
+        # chunked-prefill state per slot + the device-resident steady
+        # decode arrays (None = rebuild from the scheduler next decode)
+        self._pending: dict[int, Admission] = {}
+        self._dev: Optional[dict] = None
         # decode throughput counters (api.py metrics)
         self.decode_steps = 0
         self.decode_tokens = 0
@@ -168,13 +212,17 @@ class ServeEngine:
     # ---- compiled programs -------------------------------------------------
     def _decode(self, params, kp, vp, tokens, lengths, tables, seeds, temps,
                 top_ks, top_ps, actives):
-        attend = make_attend(tables, lengths)
+        attend = make_attend(tables, lengths, impl=self.attend_impl)
         logits, cache = self.mod.paged_decode_step(
             self.config, params, tokens[:, None], lengths,
             {"k": kp, "v": vp}, attend)
         nxt = _sample_tokens(logits.astype(jnp.float32), seeds, lengths + 1,
                              temps, top_ks, top_ps)
-        return jnp.where(actives, nxt, 0), cache["k"], cache["v"]
+        nxt = jnp.where(actives, nxt, 0)
+        # the returned (tokens, lengths) ARE next step's inputs: a steady
+        # decode run round-trips nothing but the sampled ids to the host
+        return nxt, jnp.where(actives, lengths + 1, lengths), \
+            cache["k"], cache["v"]
 
     def _prefill_for(self, bucket: int):
         if bucket not in self._prefill_fns:
@@ -186,6 +234,25 @@ class ServeEngine:
 
             self._prefill_fns[bucket] = jax.jit(fn)
         return self._prefill_fns[bucket]
+
+    def _chunk_for(self, t: int):
+        """The ONE chunk-prefill program: [1, t] tokens run the paged
+        decode path (gather impl — a chunk is compute-bound and needs the
+        multi-token attend), writing their k/v into the slot's pages at
+        positions start..start+t-1 while attending over the committed
+        history. ``n_valid`` routes a final chunk's pad tail to the trash
+        page; ``last_index`` picks the real last token's logits."""
+        if t not in self._chunk_fns:
+            def fn(params, kp, vp, ids, start, table, last_index, n_valid):
+                attend = make_attend(table, start, impl="xla",
+                                     n_valid=n_valid)
+                logits, cache = self.mod.paged_decode_step(
+                    self.config, params, ids, start, {"k": kp, "v": vp},
+                    attend, last_index=last_index)
+                return logits[0], cache["k"], cache["v"]
+
+            self._chunk_fns[t] = jax.jit(fn, donate_argnums=(1, 2))
+        return self._chunk_fns[t]
 
     # ---- serving loop ------------------------------------------------------
     def submit(self, request: Request) -> int:
@@ -216,53 +283,152 @@ class ServeEngine:
         raise ValueError(f"prompt length {n} exceeds the largest prefill "
                          f"bucket {self.prefill_buckets[-1]}")
 
-    def _admit(self, slot_idx: int, req: Request) -> Optional[RequestResult]:
-        n = len(req.prompt_ids)
-        bucket = self._bucket_for(n)
-        ids = np.zeros((1, bucket), np.int32)
-        ids[0, :n] = req.prompt_ids
-        logit, kd, vd = self._prefill_for(bucket)(
-            self.params, jnp.asarray(ids), jnp.asarray(n - 1))
-        table_row = jnp.asarray(self.scheduler.table_row(slot_idx))
-        self.pages["k"], self.pages["v"] = self._commit_fn(
-            self.pages["k"], self.pages["v"], kd, vd, table_row,
-            jnp.asarray(n))
+    def _run_fork(self, adm: Admission) -> None:
+        """Device side of the CoW bookkeeping: the remainder prefill is
+        about to write into the partially-shared page, so its content is
+        copied into the slot's private replacement first."""
+        src, dst = adm.fork
+        self.pages["k"], self.pages["v"] = self._copy_fn(
+            self.pages["k"], self.pages["v"],
+            jnp.asarray(src, jnp.int32), jnp.asarray(dst, jnp.int32))
+
+    def _sample_first(self, adm: Admission, logit) -> Optional[RequestResult]:
+        """First token off the prefill logits (skipped for preempted
+        sequences — their next token was generated before preemption)."""
+        req = adm.request
+        n = len(adm.tokens)
         t0 = self._sample_one(
             logit.astype(jnp.float32), jnp.asarray(req.seed, jnp.int32),
             jnp.asarray(n, jnp.int32),
             jnp.asarray(req.temperature, jnp.float32),
             jnp.asarray(req.top_k, jnp.int32),
             jnp.asarray(req.top_p, jnp.float32))
-        return self.scheduler.record_token(slot_idx, int(t0),
+        return self.scheduler.record_token(adm.slot_idx, int(t0),
                                            from_decode=False)
 
-    def step(self) -> list[RequestResult]:
-        """One scheduler iteration: admit whatever fits (each admission is
-        one bucketed prefill + page commit + first-token sample), then ONE
-        batched decode over the active slots. Returns finished requests."""
-        finished = []
-        for slot_idx, req in self.scheduler.try_admit():
-            res = self._admit(slot_idx, req)
-            if res is not None:        # eos/length on the very first token
-                finished.append(res)
+    def _admit_bucket(self, adm: Admission) -> Optional[RequestResult]:
+        """Whole-context prefill through the family's bucketed program;
+        the commit scatter skips the shared prefix (those pages are other
+        sequences' territory) and the pad tail."""
+        tokens = adm.tokens
+        n = len(tokens)
+        bucket = self._bucket_for(n)
+        ids = np.zeros((1, bucket), np.int32)
+        ids[0, :n] = tokens
+        logit, kd, vd = self._prefill_for(bucket)(
+            self.params, jnp.asarray(ids), jnp.asarray(n - 1))
+        table_row = jnp.asarray(self.scheduler.table_row(adm.slot_idx))
+        self.pages["k"], self.pages["v"] = self._commit_fn(
+            self.pages["k"], self.pages["v"], kd, vd, table_row,
+            jnp.asarray(n), jnp.asarray(adm.shared_len))
+        self.scheduler.commit_tokens(adm.slot_idx, n - adm.shared_len)
+        if adm.resumed:
+            return None
+        return self._sample_first(adm, logit)
 
-        active = self.scheduler.active_indices()
-        if active:
-            arr = self.scheduler.decode_arrays()
-            nxt, self.pages["k"], self.pages["v"] = self._decode_fn(
+    def _advance_prefill(self) -> list[RequestResult]:
+        """Run up to ``prefill_chunk`` prompt tokens through the chunk
+        program, oldest prefilling slot first — the per-iteration budget
+        that bounds how much a long prompt can delay the co-resident
+        decode step that follows."""
+        finished = []
+        sched = self.scheduler
+        t = self.prefill_chunk
+        budget = t
+        for slot_idx in sched.prefilling_indices():
+            if budget <= 0:
+                break
+            adm = self._pending.get(slot_idx)
+            if adm is None:        # pre-chunking admission (mode switch)
+                continue
+            slot = sched.slots[slot_idx]
+            start = slot.cache_len
+            real = min(t, slot.target_len - start)
+            # budget is charged at the PROGRAM cost (the chunk is padded
+            # to t whatever `real` is) — charging real tokens would let N
+            # slots with short final chunks run N full-width forwards in
+            # one iteration, exactly the latency spike the budget bounds
+            budget -= t
+            ids = np.zeros((1, t), np.int32)
+            ids[0, :real] = adm.tokens[start:start + real]
+            logit, self.pages["k"], self.pages["v"] = self._chunk_for(t)(
                 self.params, self.pages["k"], self.pages["v"],
-                jnp.asarray(arr["tokens"]), jnp.asarray(arr["lengths"]),
-                jnp.asarray(arr["tables"]), jnp.asarray(arr["seeds"]),
-                jnp.asarray(arr["temps"]), jnp.asarray(arr["top_ks"]),
-                jnp.asarray(arr["top_ps"]), jnp.asarray(arr["actives"]))
-            nxt = np.asarray(nxt)
+                jnp.asarray(ids), jnp.asarray([start], jnp.int32),
+                jnp.asarray(sched.table_row(slot_idx)[None]),
+                jnp.asarray(real - 1, jnp.int32),
+                jnp.asarray([real], jnp.int32))
+            sched.commit_tokens(slot_idx, real)
+            if not sched.slots[slot_idx].prefilling:   # final chunk landed
+                self._pending.pop(slot_idx)
+                self._dev = None   # the slot joins the decode batch
+                if not adm.resumed:
+                    res = self._sample_first(adm, logit)
+                    if res is not None:
+                        finished.append(res)
+        return finished
+
+    def _drop_stale_pending(self) -> None:
+        """Preemption may have evicted a mid-prefill slot; its chunk state
+        must go with it (the slot will be re-admitted from the queue)."""
+        for idx in list(self._pending):
+            slot = self.scheduler.slots[idx]
+            adm = self._pending[idx]
+            if (slot is None
+                    or slot.request.request_id != adm.request.request_id):
+                del self._pending[idx]
+
+    def step(self) -> list[RequestResult]:
+        """One scheduler iteration: grow running decodes (preempting the
+        youngest on true exhaustion), admit whatever now fits (sharing
+        cached prefixes), advance prefill work (whole-bucket, or one
+        chunk-budget's worth), then ONE batched decode over the decoding
+        slots. Returns finished requests."""
+        finished = []
+        sched = self.scheduler
+        admissions = sched.try_admit()
+        for adm in admissions:
+            self._dev = None
+            if adm.fork is not None:
+                self._run_fork(adm)
+            if self.prefill_chunk is None:
+                res = self._admit_bucket(adm)
+                if res is not None:        # eos/length on the first token
+                    finished.append(res)
+            else:
+                self._pending[adm.slot_idx] = adm
+        if self._pending:
+            finished.extend(self._advance_prefill())
+
+        # growth runs LAST before the decode so every slot in the batch —
+        # including one admitted or chunk-completed this very iteration
+        # whose prefill ended exactly on a page boundary — owns the page
+        # its next write lands in
+        grown, preempted = sched.grow_for_decode()
+        if grown or preempted:
+            self._dev = None
+            if preempted:
+                self._drop_stale_pending()
+
+        active = sched.active_indices()
+        if active:
+            if self._dev is None:
+                self._dev = {k: jnp.asarray(v)
+                             for k, v in sched.decode_arrays().items()}
+            d = self._dev
+            nxt, new_len, self.pages["k"], self.pages["v"] = self._decode_fn(
+                self.params, self.pages["k"], self.pages["v"],
+                d["tokens"], d["lengths"], d["tables"], d["seeds"],
+                d["temps"], d["top_ks"], d["top_ps"], d["actives"])
+            d["tokens"], d["lengths"] = nxt, new_len
+            nxt_host = np.asarray(nxt)
             self.decode_steps += 1
             self.decode_tokens += len(active)
             for slot_idx in active:
-                res = self.scheduler.record_token(slot_idx, int(nxt[slot_idx]),
-                                                  from_decode=True)
+                res = sched.record_token(slot_idx, int(nxt_host[slot_idx]),
+                                         from_decode=True)
                 if res is not None:
                     finished.append(res)
+                    self._dev = None       # the slot left the batch
         return finished
 
     def kv_report(self) -> dict:
@@ -272,6 +438,7 @@ class ServeEngine:
             "page_size": self.page_size,
             "n_pages": pool.n_pages,
             "pages_free": pool.n_free,
+            "pages_cached": self.scheduler.cache_pages_held(),
             "bytes_per_page": kv_page_bytes(self.config,
                                             page_size=self.page_size),
             "pool_bytes": self.kv_cache_bytes(),

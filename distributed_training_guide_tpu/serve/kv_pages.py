@@ -1,5 +1,5 @@
-"""Paged KV cache: fixed-size blocks, a free-list allocator, per-sequence
-block tables, and a jit-compatible gather-based attend over the table.
+"""Paged KV cache: fixed-size blocks, a refcounted free-list allocator,
+per-sequence block tables, and the device-side attend over the table.
 
 The contiguous decode cache (``models/<family>.init_cache``) is
 ``[L, B, max_len, kvh, hd]`` — a serving engine sized that way pays
@@ -8,20 +8,39 @@ The contiguous decode cache (``models/<family>.init_cache``) is
 a POOL of pages ``[L, n_pages, page_size, kvh, hd]`` (PagedAttention, Kwon
 et al., arXiv:2309.06180): a sequence owns ``ceil(tokens / page_size)``
 pages wired together by an int32 block table, pages return to the free
-list on eviction, and cache memory is O(allocated pages) — priced by
-``kv_page_bytes`` and pinned by ``tests/test_serve.py``.
+list when their last reference drops, and cache memory is O(allocated
+pages) — priced by ``kv_page_bytes`` and pinned by ``tests/test_serve.py``.
+
+Pages are REFCOUNTED so identical prompt prefixes can share physical
+pages across slots (copy-on-write prefix sharing — the other half of
+PagedAttention): ``alloc`` hands out pages at refcount 1, ``share``
+takes additional references, and ``free`` releases one reference per
+call, returning the page to the free list only at zero. A write into a
+shared page must fork it first (``copy_pages`` is the device-side copy;
+the scheduler decides when — see serve/scheduler.py's prefix cache).
 
 Physical page 0 is RESERVED as the trash page: it is never allocated, so a
 write routed to it (an idle slot in the fixed ``[n_slots]`` decode batch,
-the padded tail of a bucketed prefill) lands harmlessly — active block
-tables never reference it, so garbage in page 0 can never enter a live
-slot's attend. That convention is what lets ONE compiled decode program
-serve any mix of active/idle slots with plain scatters, no recompiles.
+the padded tail of a bucketed prefill or prefill chunk) lands harmlessly —
+active block tables never reference it, so garbage in page 0 can never
+enter a live slot's attend. That convention is what lets ONE compiled
+decode program serve any mix of active/idle slots with plain scatters, no
+recompiles.
 
-Device-side pieces (``paged_attend``, ``commit_prefill``) are pure
-functions of array arguments — block tables and lengths arrive as int32
-arrays, so requests coming and going never change a traced shape. The
-allocator (``PagePool``) is host-side Python owned by the scheduler.
+``paged_attend`` has two implementations behind one dispatch:
+``impl="flash"`` (the Pallas ``ops/paged_decode.py`` kernel — reads k/v
+*through* the block table, O(live pages) traffic, the default on TPU)
+and ``impl="xla"`` (gather the table into a contiguous logical view and
+run the einsum reference — the parity baseline, and the off-TPU default:
+the kernel's interpret mode is for CI correctness, not CPU throughput).
+Multi-token calls (chunked prefill) always take the gather path — the
+kernel is the single-token decode specialist.
+
+Device-side pieces (``paged_attend``, ``commit_prefill``, ``copy_pages``)
+are pure functions of array arguments — block tables and lengths arrive
+as int32 arrays, so requests coming and going never change a traced
+shape. The allocator (``PagePool``) is host-side Python owned by the
+scheduler.
 """
 from __future__ import annotations
 
@@ -31,13 +50,13 @@ import jax
 import jax.numpy as jnp
 
 from ..ops.attention import multihead_attention
+from ..ops.paged_decode import paged_decode_eligible, paged_flash_decode
 
 TRASH_PAGE = 0  # physical page id reserved for masked/idle writes
 
 
 def pages_for_tokens(n_tokens: int, page_size: int) -> int:
-    """Pages a sequence of ``n_tokens`` occupies (admission reserves this
-    worst-case up front so a running sequence can never hit exhaustion)."""
+    """Pages a sequence of ``n_tokens`` occupies."""
     return -(-n_tokens // page_size)
 
 
@@ -66,10 +85,17 @@ def init_pages(config, n_pages: int, page_size: int) -> dict:
 
 
 class PagePool:
-    """Host-side free-list allocator over physical page ids 1..n_pages-1
-    (page 0 is the trash page). Allocation is all-or-nothing: a request
-    either gets every page it may ever need or none (backpressure — the
-    scheduler refuses admission instead of corrupting a running sequence).
+    """Host-side refcounted free-list allocator over physical page ids
+    1..n_pages-1 (page 0 is the trash page). Allocation is all-or-nothing:
+    a request either gets every page asked for or none (backpressure — the
+    scheduler refuses or preempts instead of corrupting a running
+    sequence). ``share`` adds references to live pages (prefix sharing);
+    ``free`` drops one reference per page and re-lists at zero.
+
+    The free list is LIFO (recently-freed pages re-issue first, keeping
+    the hot working set compact) with a parallel SET for membership — the
+    old ``p in list`` scan made ``free`` O(n_free) per page, quadratic
+    eviction at large pools.
     """
 
     def __init__(self, n_pages: int, page_size: int):
@@ -80,9 +106,9 @@ class PagePool:
             raise ValueError(f"page_size must be >= 1, got {page_size}")
         self.n_pages = n_pages
         self.page_size = page_size
-        # LIFO free list: recently-freed pages are re-issued first, keeping
-        # the hot working set compact
         self._free = list(range(n_pages - 1, TRASH_PAGE, -1))
+        self._free_set = set(self._free)
+        self._refs = [0] * n_pages      # live reference count per page
 
     @property
     def capacity(self) -> int:
@@ -93,8 +119,12 @@ class PagePool:
     def n_free(self) -> int:
         return len(self._free)
 
+    def refcount(self, page: int) -> int:
+        return self._refs[page]
+
     def alloc(self, n: int) -> Optional[list[int]]:
-        """``n`` pages or None (never a partial grant)."""
+        """``n`` pages at refcount 1 each, or None (never a partial
+        grant)."""
         if n < 0:
             raise ValueError(f"cannot allocate {n} pages")
         if n > len(self._free):
@@ -103,86 +133,160 @@ class PagePool:
             return []
         pages = self._free[-n:]
         del self._free[-n:]
+        self._free_set.difference_update(pages)
+        for p in pages:
+            self._refs[p] = 1
         return pages
 
+    def share(self, pages: list[int]) -> None:
+        """Take one additional reference on each (already-live) page."""
+        for p in pages:
+            if not (TRASH_PAGE < p < self.n_pages) or self._refs[p] < 1:
+                raise ValueError(f"sharing unallocated page id {p}")
+        for p in pages:
+            self._refs[p] += 1
+
     def free(self, pages: list[int]) -> None:
+        """Release one reference per page; a page re-enters the free list
+        exactly when its count hits zero. Validation (range, no release
+        past the live count — including duplicates within one call) runs
+        BEFORE any mutation, so a bad batch leaves the pool intact."""
+        releases: dict[int, int] = {}
         for p in pages:
             if not (TRASH_PAGE < p < self.n_pages):
                 raise ValueError(f"freeing invalid page id {p}")
-            if p in self._free:
+            releases[p] = releases.get(p, 0) + 1
+            if p in self._free_set or releases[p] > self._refs[p]:
                 raise ValueError(f"double free of page {p}")
-        self._free.extend(pages)
+        for p in pages:
+            self._refs[p] -= 1
+            if self._refs[p] == 0:
+                self._free.append(p)
+                self._free_set.add(p)
 
 
 def paged_attend(q, k_new, v_new, k_pages, v_pages, tables, lengths, *,
-                 window=None, scale=None, softcap=None):
-    """Scatter each slot's new k/v into its current page, then attend q
-    over the slot's gathered block-table view.
+                 window=None, scale=None, softcap=None, impl: str = "auto",
+                 n_valid=None):
+    """Scatter each slot's new k/v into its pages, then attend q over the
+    slot's block-table context.
 
-    q [S, 1, Hq, D]; k_new/v_new [S, 1, Hkv, D]; k_pages/v_pages
+    q [S, T, Hq, D]; k_new/v_new [S, T, Hkv, D]; k_pages/v_pages
     [P, page, Hkv, D] (ONE layer's pool — the layer scan feeds slices);
     tables [S, M] int32 physical page ids (0-filled rows/tails route to
-    the trash page); lengths [S] int32 = tokens already cached per slot,
-    which is exactly the new token's position.
+    the trash page); lengths [S] int32 = tokens already cached per slot —
+    the T new tokens land at positions ``lengths[s] + 0..T-1``. T == 1 is
+    the decode step; T > 1 is a prefill chunk attending over its own
+    (already-scattered) tokens plus the cached history. ``n_valid`` [S]
+    (default T) marks how many of the T tokens are REAL — the padded tail
+    of a final chunk scatters to the trash page and its query rows are
+    ignored by the caller's logit slice.
 
-    The gather materialises a [S, M*page, Hkv, D] logical view per layer —
-    a TRANSIENT the size of the attended context (any attend reads that
-    much); the RESIDENT cache stays the [P, page] pool. Positions past
-    ``lengths`` hold garbage (trash page / stale pages) and are cut by the
-    causal mask — logical position of token j in the view is j, so the
-    standard (positions, kv_positions) masking applies unchanged, window/
-    scale/softcap included (Gemma-2 decodes through this same path).
+    impl: "flash" routes single-token calls through the Pallas
+    block-table kernel (``ops/paged_decode.py``) — the decode step then
+    reads O(live pages) and materializes nothing context-sized. "xla"
+    gathers the table into a [S, M*page, Hkv, D] logical view (a
+    TRANSIENT the size of the attended context) and attends with the
+    einsum reference — the parity baseline. "auto" picks flash for
+    single-token calls on TPU when the shapes satisfy the Mosaic tile
+    gate, xla otherwise (off-TPU the kernel only runs interpreted — CI
+    exercises it explicitly; the gather path is the faster CPU program).
 
-    Returns (attn [S, 1, Hq, D], (k_pages, v_pages) updated).
+    Positions past ``lengths + n_valid`` hold garbage (trash page / stale
+    pages) and are cut by the causal mask — logical position of token j
+    in a slot's context is j, so the standard (positions, kv_positions)
+    masking applies unchanged, window/scale/softcap included (Gemma-2
+    decodes through this same path).
+
+    Returns (attn [S, T, Hq, D], (k_pages, v_pages) updated).
     """
-    s = q.shape[0]
+    s, t = q.shape[0], q.shape[1]
     page = k_pages.shape[1]
+    m = tables.shape[1]
     slot = jnp.arange(s)
-    phys = tables[slot, lengths // page]          # [S] current page per slot
-    off = lengths % page
-    k_pages = k_pages.at[phys, off].set(k_new[:, 0].astype(k_pages.dtype))
-    v_pages = v_pages.at[phys, off].set(v_new[:, 0].astype(v_pages.dtype))
+    t_idx = lengths[:, None] + jnp.arange(t)[None, :]          # [S, T]
+    # clip the page lookup (an out-of-range gather would CLAMP to the last
+    # table column — a real allocated page) and route anything past the
+    # valid token count to the trash page explicitly
+    phys = tables[slot[:, None], jnp.minimum(t_idx // page, m - 1)]
+    if n_valid is not None:
+        phys = jnp.where(t_idx < (lengths + n_valid)[:, None], phys,
+                         TRASH_PAGE)
+    off = t_idx % page
+    k_pages = k_pages.at[phys, off].set(k_new.astype(k_pages.dtype))
+    v_pages = v_pages.at[phys, off].set(v_new.astype(v_pages.dtype))
+
+    if impl == "auto":
+        impl = ("flash" if (t == 1 and jax.default_backend() == "tpu"
+                            and paged_decode_eligible(q.shape[-1], page))
+                else "xla")
+    if impl == "flash":
+        if t != 1:
+            raise ValueError(
+                f"impl='flash' is the single-token decode kernel; chunked "
+                f"prefill (T={t}) runs the gather path — use impl='auto' "
+                f"or 'xla'")
+        attn = paged_flash_decode(q[:, 0], k_pages, v_pages, tables,
+                                  lengths, window=window, scale=scale,
+                                  softcap=softcap)[:, None]
+        return attn, (k_pages, v_pages)
 
     kg = k_pages[tables]                          # [S, M, page, Hkv, D]
     vg = v_pages[tables]
-    t = kg.shape[1] * page
-    kg = kg.reshape(s, t, *kg.shape[3:])
-    vg = vg.reshape(s, t, *vg.shape[3:])
-    kv_pos = jnp.broadcast_to(jnp.arange(t)[None, :], (s, t))
+    tot = kg.shape[1] * page
+    kg = kg.reshape(s, tot, *kg.shape[3:])
+    vg = vg.reshape(s, tot, *vg.shape[3:])
+    kv_pos = jnp.broadcast_to(jnp.arange(tot)[None, :], (s, tot))
     attn = multihead_attention(q, kg, vg, causal=True,
-                               positions=lengths[:, None],
+                               positions=t_idx,
                                kv_positions=kv_pos, impl="xla",
                                standard_layout=False, window=window,
                                scale=scale, logit_softcap=softcap)
     return attn, (k_pages, v_pages)
 
 
-def make_attend(tables, lengths):
-    """Bind (tables, lengths) into the per-layer attend callback the family
-    ``paged_decode_step`` hooks expect."""
+def make_attend(tables, lengths, *, impl: str = "auto", n_valid=None):
+    """Bind (tables, lengths, impl, n_valid) into the per-layer attend
+    callback the family ``paged_decode_step`` hooks expect."""
 
     def attend(q, k_new, v_new, k_pages, v_pages, *, window=None, scale=None,
                softcap=None):
         return paged_attend(q, k_new, v_new, k_pages, v_pages, tables,
                             lengths, window=window, scale=scale,
-                            softcap=softcap)
+                            softcap=softcap, impl=impl, n_valid=n_valid)
 
     return attend
 
 
-def commit_prefill(k_pages, v_pages, k_dense, v_dense, table_row, n_tokens):
+def commit_prefill(k_pages, v_pages, k_dense, v_dense, table_row, n_tokens,
+                   start=0):
     """Scatter a bucketed prefill's dense cache into one slot's pages.
 
     k_dense/v_dense [L, Pb, Hkv, D] (family ``prefill`` output, batch dim
     squeezed; Pb = the padded bucket length); table_row [M] the slot's
     block table; n_tokens the REAL prompt length — positions >= n_tokens
-    (pad garbage) route to the trash page. Returns the updated pools.
+    (pad garbage) route to the trash page, as do positions < ``start``
+    (tokens already resident via a shared prefix: writing them would hit
+    pages other sequences read through — the fork discipline lives in the
+    scheduler, this scatter simply never touches shared territory).
+    Returns the updated pools.
     """
     pb = k_dense.shape[1]
     page = k_pages.shape[2]
+    m = table_row.shape[0]
     t = jnp.arange(pb)
-    phys = jnp.where(t < n_tokens, table_row[t // page], TRASH_PAGE)
+    phys = jnp.where((t >= start) & (t < n_tokens),
+                     table_row[jnp.minimum(t // page, m - 1)], TRASH_PAGE)
     off = t % page
     k_pages = k_pages.at[:, phys, off].set(k_dense.astype(k_pages.dtype))
     v_pages = v_pages.at[:, phys, off].set(v_dense.astype(v_pages.dtype))
     return k_pages, v_pages
+
+
+def copy_pages(k_pages, v_pages, src, dst):
+    """Copy-on-write fork: duplicate physical page ``src`` into ``dst``
+    across every layer ([L, P, page, kvh, hd] pools; src/dst are traced
+    scalars, so one compile serves every fork). The scheduler calls this
+    before any write lands in a page whose refcount is > 1."""
+    return (k_pages.at[:, dst].set(k_pages[:, src]),
+            v_pages.at[:, dst].set(v_pages[:, src]))

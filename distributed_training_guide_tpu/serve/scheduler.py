@@ -7,18 +7,38 @@ it at the very next step, instead of the whole batch draining before any
 admission (static batching wastes every early-finisher's slot for the
 duration of the longest request).
 
-Policy, deliberately boring and provable:
+Policy (the PagedAttention second half, Kwon et al. arXiv:2309.06180):
 
-- FIFO admission. The queue head admits when a slot is free AND the page
-  pool can grant its WORST-CASE reservation (``pages_for_tokens(prompt +
-  max_new)``); otherwise admission stops — strict order, no lookahead, so
-  a big request is never starved by small ones slipping past it.
-- Worst-case reservation at admission is the backpressure contract: a
-  running sequence already owns every page it can ever touch, so page
-  exhaustion can ONLY refuse new admissions — it can never corrupt a
-  decode in flight (no mid-flight allocation, no preemption machinery).
-- Eviction on EOS or length cap, at the iteration boundary; pages return
-  to the free list and the slot re-enters admission the same iteration.
+- FIFO admission, OPTIMISTIC: the queue head admits when a slot is free
+  AND the pool grants the pages its *current context* needs (prompt, or
+  prompt + recompute suffix) — not the old worst-case
+  ``pages_for_tokens(prompt + max_new)`` reservation that idled pages a
+  short answer never touched. Strict order, no lookahead.
+- Growth on demand: a decoding sequence takes one page whenever its next
+  token crosses a page boundary. On true exhaustion the scheduler first
+  evicts idle prefix-cache pages, then PREEMPTS the youngest sequence —
+  its pages are freed, its (request, tokens-so-far) re-enters the queue
+  head, and on re-admission the context is RECOMPUTED: the prompt
+  re-prefills (or re-shares), then the generated suffix REPLAYS through
+  the decode program itself, one discarded step per token. The replay is
+  deliberately not a prefill: the decode program writing each token's
+  k/v is the program that wrote it originally, so the rebuilt cache is
+  BITWISE the original and the continuation token-identical (a prefill
+  recompute of the suffix agrees only to ~1e-7 — enough to flip an
+  argmax). The old invariant "exhaustion can only refuse, never corrupt"
+  becomes "exhaustion can only refuse or cleanly preempt, never corrupt"
+  — the oldest sequence always wins growth, so progress is guaranteed
+  whenever one worst-case request fits the pool (validated at submit).
+- PREFIX SHARING: committed full prompt pages register in a content-keyed
+  prefix tree; a new prompt walks the tree and takes refcounted
+  references to every matching physical page instead of recomputing it
+  (system prompts amortize across every request that carries them). A
+  match may end mid-page; the partially-matched page is forked
+  COPY-ON-WRITE at admission — the first write into shared territory is
+  what triggers the copy (``kv_pages.copy_pages`` is the device copy the
+  engine runs; the fork bookkeeping is decided here).
+- Eviction on EOS or length cap, at the iteration boundary; page
+  references drop and the slot re-enters admission the same iteration.
 
 This module is pure host Python (no jax): deterministic, unit-testable,
 and the only owner of slot/page bookkeeping. The engine consumes its state
@@ -36,7 +56,7 @@ from typing import Optional
 
 import numpy as np
 
-from .kv_pages import PagePool, pages_for_tokens
+from .kv_pages import TRASH_PAGE, PagePool, pages_for_tokens
 
 
 @dataclasses.dataclass
@@ -44,8 +64,8 @@ class Request:
     """One generation request. ``temperature == 0`` is greedy; ``top_k <= 0``
     and ``top_p >= 1`` disable those filters. ``seed`` drives the slot's
     private RNG stream (sampling keys are fold_in(seed, absolute token
-    position) — deterministic per request, independent of admission order
-    and co-residents)."""
+    position) — deterministic per request, independent of admission order,
+    co-residents, AND preemption/recompute)."""
 
     prompt_ids: list
     max_new_tokens: int = 32
@@ -83,20 +103,164 @@ class RequestResult:
 @dataclasses.dataclass
 class _Slot:
     request: Request
-    pages: list
+    pages: list                     # physical pages, logical order
     generated: list
     cache_len: int                  # tokens currently IN the kv pages
     admitted_at: float
+    seq: int                        # admission order; max = youngest
+    target_len: int                 # tokens the prefill must commit
+    prefilling: bool                # True until cache_len == target_len
+    shared_len: int = 0             # tokens taken from the prefix cache
+    resumed: bool = False           # re-admission after preemption
+    # index of the token the next decode step consumes. Normal slots sit
+    # at len(generated) - 1 (the newest sample); a resumed slot starts at
+    # 0 and REPLAYS its recorded tokens through the decode program —
+    # samples along the way are discarded (they equal the recording
+    # bitwise: same program, same cache state)
+    replay_pos: int = 0
+
+    @property
+    def replaying(self) -> bool:
+        return self.replay_pos < len(self.generated) - 1
+
+
+@dataclasses.dataclass
+class _QueueEntry:
+    """Queue item: a fresh request, or a preempted sequence carrying the
+    tokens it had already generated (the recompute state)."""
+    request: Request
+    generated: list = dataclasses.field(default_factory=list)
+
+
+@dataclasses.dataclass
+class Admission:
+    """One try_admit grant, with everything the engine needs to run the
+    prefill: the prompt to (re)compute, how much of it is already
+    resident via shared pages, and the CoW fork to copy first. A resumed
+    sequence prefills its PROMPT only — the generated suffix replays
+    through the decode loop afterwards (see module docstring)."""
+    slot_idx: int
+    request: Request
+    tokens: list                    # the prompt (the prefill target)
+    shared_len: int                 # prefix tokens already in shared pages
+    fork: Optional[tuple]           # (src_page, dst_page) device copy
+    resumed: bool
+
+
+class _PrefixNode:
+    """One registered page in the prefix tree: children are keyed by the
+    NEXT page's full token content, so a chain of dict hits walks shared
+    physical pages in O(prefix) with zero hashing of the whole prompt."""
+
+    __slots__ = ("page", "tokens", "children", "parent", "last_used")
+
+    def __init__(self, page, tokens, parent):
+        self.page = page
+        self.tokens = tokens
+        self.children: dict = {}
+        self.parent = parent
+        self.last_used = 0
+
+
+class PrefixCache:
+    """Content-keyed tree of committed full prompt pages. The cache holds
+    ONE pool reference per registered page, so a page survives its
+    sequence and is reused by the next prompt that carries the same
+    prefix; eviction (leaves only, LRU) drops that reference — the page
+    returns to the free list once no slot reads it either."""
+
+    def __init__(self, pool: PagePool):
+        self.pool = pool
+        self.page_size = pool.page_size
+        self.root = _PrefixNode(None, (), None)
+        self._tick = itertools.count(1)
+        self.n_pages = 0
+
+    def match(self, tokens: list, allow_partial: bool):
+        """Longest chain of registered pages covering a PROPER prefix of
+        ``tokens`` (at least one token is always left to recompute — the
+        last position's logits must come from a live forward). Returns
+        (full_nodes, partial): ``partial`` is (node, n_tokens) when
+        ``allow_partial`` and a child page's content matches ≥ 1 of the
+        remaining tokens — the CoW candidate."""
+        page = self.page_size
+        tick = next(self._tick)
+        node, full, pos = self.root, [], 0
+        while pos + page <= len(tokens) - 1:
+            child = node.children.get(tuple(tokens[pos:pos + page]))
+            if child is None:
+                break
+            child.last_used = tick
+            full.append(child)
+            node, pos = child, pos + page
+        partial = None
+        if allow_partial and pos < len(tokens) - 1:
+            remaining = tokens[pos:]
+            best = 0
+            for child in node.children.values():
+                n = 0
+                for a, b in zip(child.tokens, remaining):
+                    if a != b:
+                        break
+                    n += 1
+                n = min(n, len(tokens) - 1 - pos)
+                if n > best:
+                    best, partial = n, (child, n)
+            if partial is not None:
+                partial[0].last_used = tick
+        return full, partial
+
+    def register(self, tokens: list, pages: list) -> None:
+        """Insert every FULL page of ``tokens`` (page i holds
+        tokens[i*page:(i+1)*page], physical id pages[i]); the cache takes
+        one pool reference per page it newly adopts. Existing nodes with
+        the same content win — duplicates are not double-registered."""
+        page = self.page_size
+        tick = next(self._tick)
+        node, pos, i = self.root, 0, 0
+        while pos + page <= len(tokens):
+            key = tuple(tokens[pos:pos + page])
+            child = node.children.get(key)
+            if child is None:
+                child = _PrefixNode(pages[i], key, node)
+                self.pool.share([pages[i]])
+                node.children[key] = child
+                self.n_pages += 1
+            child.last_used = tick
+            node, pos, i = child, pos + page, i + 1
+
+    def evict_one(self) -> bool:
+        """Drop the least-recently-used LEAF (leaves only — interior
+        evictions would orphan reachable children into leaked refs).
+        Returns False when the cache is empty."""
+        best, best_key, best_parent = None, None, None
+        stack = [self.root]
+        while stack:
+            node = stack.pop()
+            for key, child in node.children.items():
+                if child.children:
+                    stack.append(child)
+                elif best is None or child.last_used < best.last_used:
+                    best, best_key, best_parent = child, key, node
+        if best is None:
+            return False
+        del best_parent.children[best_key]
+        self.pool.free([best.page])
+        self.n_pages -= 1
+        return True
 
 
 class Scheduler:
     """Slot + page bookkeeping for the engine. All mutation goes through
-    ``submit`` / ``try_admit`` / ``record_token`` so the invariants (page
-    ownership, FIFO order, reservation-covers-lifetime) live in one place.
+    ``submit`` / ``try_admit`` / ``commit_tokens`` / ``grow_for_decode`` /
+    ``record_token`` so the invariants (page ownership, FIFO order,
+    refcount lifecycle, preemption-never-corrupts) live in one place.
     """
 
     def __init__(self, *, n_slots: int, pool: PagePool, max_len: int,
-                 max_pages_per_slot: int, clock=time.monotonic):
+                 max_pages_per_slot: int, clock=time.monotonic,
+                 prefix_cache: bool = True,
+                 allow_partial_share: bool = False):
         if n_slots < 1:
             raise ValueError(f"n_slots must be >= 1, got {n_slots}")
         self.n_slots = n_slots
@@ -106,16 +270,50 @@ class Scheduler:
         self.slots: list[Optional[_Slot]] = [None] * n_slots
         self.queue: deque = deque()
         self._ids = itertools.count()
+        self._seq = itertools.count()
         self._clock = clock
         self._submit_times: dict[int, float] = {}
-        self.stats = {"admission_blocked": 0, "admitted": 0, "finished": 0}
+        self.cache = PrefixCache(pool) if prefix_cache else None
+        self.allow_partial_share = allow_partial_share
+        self.stats = {"admission_blocked": 0, "admitted": 0, "finished": 0,
+                      "preempted": 0, "prefix_hits": 0,
+                      "prefix_tokens_shared": 0, "cow_forks": 0,
+                      "cache_evicted_pages": 0}
+
+    # ---- allocation under pressure -----------------------------------------
+    def _ensure_free(self, n: int) -> bool:
+        """Evict idle prefix-cache pages (LRU leaves) until ``n`` are free
+        or the cache is drained. False means the pool is truly out —
+        every remaining page is owned by a slot."""
+        while self.pool.n_free < n and self.cache is not None:
+            if not self.cache.evict_one():
+                break
+            self.stats["cache_evicted_pages"] += 1
+        return self.pool.n_free >= n
+
+    def _alloc(self, n: int, headroom: int = 0) -> Optional[list]:
+        """Allocate with cache pressure, keeping ``headroom`` pages free
+        after the grant (admission uses one page of lookahead per running
+        decode so a new prompt doesn't immediately force preemptions)."""
+        if not self._ensure_free(n + headroom):
+            return None
+        return self.pool.alloc(n)
+
+    def cache_pages_held(self) -> int:
+        """Pages whose only purpose right now may be prefix reuse — the
+        pool-accounting identity is ``n_free + slot-held + cache-only ==
+        capacity`` (a page can be both slot-held and cached; this counts
+        cache REFERENCES, each of which pins one ``free`` call)."""
+        return 0 if self.cache is None else self.cache.n_pages
 
     # ---- admission ---------------------------------------------------------
     def submit(self, request: Request) -> int:
         """Validate + enqueue; returns the request id. Raises on requests
         that could NEVER run (empty prompt, context past max_len, worst-case
-        pages past the whole pool) — refusing at submit keeps the FIFO head
-        from deadlocking the queue forever."""
+        pages past the whole pool — with preemption-by-recompute the pool
+        must still fit ONE worst-case request or the retry loop could never
+        terminate) — refusing at submit keeps the FIFO head from
+        deadlocking the queue forever."""
         n = len(request.prompt_ids)
         if n < 1:
             raise ValueError("empty prompt")
@@ -147,40 +345,154 @@ class Scheduler:
             raise ValueError(
                 f"request needs {pages_for_tokens(total, self.pool.page_size)}"
                 f" pages, more than the whole pool ({self.pool.capacity}) — "
-                f"it could never be admitted")
+                f"it could never run to completion even alone")
         request = dataclasses.replace(request,
                                       request_id=next(self._ids))
         self._submit_times[request.request_id] = self._clock()
-        self.queue.append(request)
+        self.queue.append(_QueueEntry(request))
         return request.request_id
 
-    def try_admit(self) -> list[tuple[int, Request]]:
-        """Admit FIFO-head requests while a slot is free and the pool grants
-        the worst-case reservation. Returns [(slot_idx, request)] — the
-        engine must prefill each and then call ``start_slot``'s bookkeeping
-        via ``record_token`` for the first sampled token."""
+    def try_admit(self) -> list[Admission]:
+        """Admit FIFO-head entries while a slot is free and the pool (after
+        prefix sharing) grants the CURRENT context's pages. Preempted
+        entries sit at the queue head and re-admit first — their context
+        includes the tokens already generated (recompute). The engine runs
+        each admission's fork copy + prefill, reporting progress through
+        ``commit_tokens``."""
         admissions = []
+        page = self.pool.page_size
         while self.queue:
             slot_idx = next((i for i, s in enumerate(self.slots)
                              if s is None), None)
             if slot_idx is None:
                 break
-            req = self.queue[0]
-            need = pages_for_tokens(
-                len(req.prompt_ids) + req.max_new_tokens,
-                self.pool.page_size)
-            pages = self.pool.alloc(need)
-            if pages is None:
-                # backpressure: head blocks (strict FIFO), decode goes on
+            entry = self.queue[0]
+            req = entry.request
+            # the prefill target is the PROMPT alone, resumed or not: a
+            # preempted sequence's generated tokens replay through the
+            # decode program after the prompt is back (bitwise recompute)
+            tokens = list(req.prompt_ids)
+            full, partial = ([], None) if self.cache is None else \
+                self.cache.match(tokens, self.allow_partial_share)
+            k_full = len(full)
+            shared_len = k_full * page + (partial[1] if partial else 0)
+            n_priv = pages_for_tokens(len(tokens), page) - k_full
+            # take the references on every matched page BEFORE allocation:
+            # _alloc's cache-eviction pressure may drop the matched nodes
+            # themselves (their cache ref could be the only one), and a
+            # share-after-evict would either crash on a dead page or hand
+            # this slot a page alloc just re-issued as its own private one
+            shared_pages = [node.page for node in full]
+            self.pool.share(shared_pages)
+            protect = [partial[0].page] if partial else []
+            if protect:              # the CoW source must survive too — the
+                self.pool.share(protect)   # engine copies it after we return
+            # headroom: every running decode may need a page within one
+            # page_size worth of steps — admitting into that margin would
+            # trade one prompt's admission for immediate preemption churn
+            priv = self._alloc(n_priv, headroom=len(self.active_indices()))
+            if protect:
+                # safe to release now: if the source node was evicted
+                # above, its page can only be re-issued to a LATER
+                # admission in this same loop, and the engine executes
+                # each admission's fork copy before any later admission's
+                # writes — the copy always reads the original bytes
+                self.pool.free(protect)
+            if priv is None:
+                # backpressure: head blocks (strict FIFO), decode goes on —
+                # release the speculative references and stay queued
+                self.pool.free(shared_pages)
                 self.stats["admission_blocked"] += 1
                 break
+            fork = None
+            if partial is not None:
+                # the first private page starts life as a CoW fork of the
+                # partially-matched shared page: the remainder prefill is
+                # about to write into its territory
+                fork = (partial[0].page, priv[0])
+                self.stats["cow_forks"] += 1
+            if shared_len:
+                self.stats["prefix_hits"] += 1
+                self.stats["prefix_tokens_shared"] += shared_len
             self.queue.popleft()
             self.slots[slot_idx] = _Slot(
-                request=req, pages=pages, generated=[],
-                cache_len=len(req.prompt_ids), admitted_at=self._clock())
+                request=req, pages=shared_pages + priv,
+                generated=list(entry.generated), cache_len=shared_len,
+                admitted_at=self._clock(), seq=next(self._seq),
+                target_len=len(tokens), prefilling=True,
+                shared_len=shared_len, resumed=bool(entry.generated),
+                replay_pos=0)
             self.stats["admitted"] += 1
-            admissions.append((slot_idx, req))
+            admissions.append(Admission(
+                slot_idx=slot_idx, request=req, tokens=tokens,
+                shared_len=shared_len, fork=fork,
+                resumed=bool(entry.generated)))
         return admissions
+
+    # ---- prefill progress --------------------------------------------------
+    def commit_tokens(self, slot_idx: int, n: int) -> None:
+        """The engine committed ``n`` more context tokens into the slot's
+        pages (one prefill chunk, or the whole bucket). When the target is
+        reached the slot joins the decode batch and its full prompt pages
+        register in the prefix cache."""
+        slot = self.slots[slot_idx]
+        assert slot is not None and slot.prefilling, \
+            f"commit_tokens on non-prefilling slot {slot_idx}"
+        slot.cache_len += n
+        assert slot.cache_len <= slot.target_len, \
+            f"prefill overran its target on slot {slot_idx}"
+        if slot.cache_len == slot.target_len:
+            slot.prefilling = False
+            if self.cache is not None:
+                n_prompt = len(slot.request.prompt_ids)
+                n_full = n_prompt // self.pool.page_size
+                self.cache.register(list(slot.request.prompt_ids[:n_full
+                                         * self.pool.page_size]),
+                                    slot.pages[:n_full])
+
+    # ---- growth + preemption ----------------------------------------------
+    def preempt(self, slot_idx: int) -> None:
+        """Cleanly un-admit a sequence: its pages' references drop, its
+        (request, generated-so-far) re-enters the queue HEAD, and the next
+        admission recomputes the context — no token it already produced is
+        lost or changed (position-keyed sampling), no running sequence is
+        ever corrupted."""
+        slot = self.slots[slot_idx]
+        assert slot is not None, f"preempting idle slot {slot_idx}"
+        self.pool.free(slot.pages)
+        self.slots[slot_idx] = None
+        self.queue.appendleft(_QueueEntry(slot.request,
+                                          list(slot.generated)))
+        self.stats["preempted"] += 1
+
+    def grow_for_decode(self) -> tuple[int, int]:
+        """Before a decode step: every decoding slot must own the page its
+        next write lands in. Oldest slots grow first; on exhaustion the
+        YOUNGEST live sequence is preempted (possibly the grower itself,
+        when it is the youngest left) and its pages fund the others.
+        Returns (pages_grown, preempted)."""
+        grown = preempted = 0
+        order = sorted((i for i, s in enumerate(self.slots)
+                        if s is not None and not s.prefilling),
+                       key=lambda i: self.slots[i].seq)
+        for slot_idx in order:
+            slot = self.slots[slot_idx]
+            if slot is None:        # preempted as a victim earlier in loop
+                continue
+            while slot.cache_len // self.pool.page_size >= len(slot.pages):
+                pages = self._alloc(1)
+                if pages is not None:
+                    slot.pages.extend(pages)
+                    grown += 1
+                    continue
+                victim = max((i for i, s in enumerate(self.slots)
+                              if s is not None),
+                             key=lambda i: self.slots[i].seq)
+                self.preempt(victim)
+                preempted += 1
+                if victim == slot_idx:
+                    break           # the grower itself was youngest
+        return grown, preempted
 
     # ---- decode bookkeeping ------------------------------------------------
     def record_token(self, slot_idx: int, token: int, *,
@@ -188,13 +500,20 @@ class Scheduler:
         """Append one sampled token. ``from_decode=True`` means a decode
         step just wrote the PREVIOUS token's k/v into the cache (cache_len
         advances); the first token (sampled off prefill logits) doesn't.
-        Returns the RequestResult if the sequence just finished (slot freed
-        and pages returned), else None."""
+        During a post-preemption REPLAY the sample is discarded instead of
+        appended — the decode step ran only to rewrite a recorded token's
+        k/v, and its output equals that recording bitwise. Returns the
+        RequestResult if the sequence just finished (slot freed and page
+        references dropped), else None."""
         slot = self.slots[slot_idx]
         assert slot is not None, f"record_token on idle slot {slot_idx}"
         if from_decode:
             slot.cache_len += 1
+        if slot.replaying:
+            slot.replay_pos += 1
+            return None
         slot.generated.append(int(token))
+        slot.replay_pos = len(slot.generated) - 1
         req = slot.request
         finished = None
         if req.eos_id is not None and token == req.eos_id:
@@ -214,26 +533,36 @@ class Scheduler:
 
     # ---- engine-facing state views ----------------------------------------
     def active_indices(self) -> list[int]:
-        return [i for i, s in enumerate(self.slots) if s is not None]
+        """Slots in the decode batch (prefill complete)."""
+        return [i for i, s in enumerate(self.slots)
+                if s is not None and not s.prefilling]
+
+    def prefilling_indices(self) -> list[int]:
+        """Slots still streaming prefill chunks, admission order."""
+        return sorted((i for i, s in enumerate(self.slots)
+                       if s is not None and s.prefilling),
+                      key=lambda i: self.slots[i].seq)
 
     @property
     def has_work(self) -> bool:
         return bool(self.queue) or any(s is not None for s in self.slots)
 
     def table_row(self, slot_idx: int) -> np.ndarray:
-        """The slot's [max_pages] block table (0 = trash beyond the
-        reservation — the causal mask keeps those positions out of any
-        attend)."""
+        """The slot's [max_pages] block table (0 = trash beyond the owned
+        pages — the causal mask keeps those positions out of any attend,
+        and ``TRASH_PAGE`` never appears among the owned pages)."""
         row = np.zeros(self.max_pages, np.int32)
         slot = self.slots[slot_idx]
         if slot is not None:
+            assert TRASH_PAGE not in slot.pages
             row[:len(slot.pages)] = slot.pages
         return row
 
     def decode_arrays(self) -> dict:
-        """Flat numpy views of the active set, shaped for the ONE compiled
-        decode step: idle slots carry token 0 / length 0 / zero table rows,
-        i.e. their lane computes into the trash page and is discarded."""
+        """Flat numpy views of the decoding set, shaped for the ONE
+        compiled decode step: idle and still-prefilling slots carry token
+        0 / length 0 / zero table rows, i.e. their lane computes into the
+        trash page and is discarded."""
         s = self.n_slots
         out = {
             "tokens": np.zeros(s, np.int32),
@@ -246,10 +575,12 @@ class Scheduler:
             "actives": np.zeros(s, bool),
         }
         for i, slot in enumerate(self.slots):
-            if slot is None:
+            if slot is None or slot.prefilling:
                 continue
             req = slot.request
-            out["tokens"][i] = slot.generated[-1]
+            # normally the newest sample; during replay, the next recorded
+            # token whose k/v needs rewriting
+            out["tokens"][i] = slot.generated[slot.replay_pos]
             out["lengths"][i] = slot.cache_len
             out["tables"][i] = self.table_row(i)
             out["seeds"][i] = req.seed

@@ -282,6 +282,19 @@ def run_preflight(trainer, *, global_batch: int, seq_length: int,
     pages_per_slot = pages_for_tokens(seq_length, page_size)
     per_page = kv_page_bytes(cfg, page_size=page_size)
     per_slot = per_page * pages_per_slot
+    # per-generated-token decode traffic: the flash-decode kernel
+    # (ops/paged_decode.py) READS the live context's pages through the
+    # block table and writes only the [S, Hq, D] output — O(context)
+    # bytes. The old gather path materialized the full [M*page] logical
+    # view per step: read the pool, WRITE the view, read it back in the
+    # attend — ~3x the kernel's traffic, plus a context-sized transient.
+    kernel_read = per_slot
+    gather_traffic = 3 * per_slot
+    # prefix sharing: a P-token shared system prompt is resident ONCE; at
+    # n slots it amortizes (n-1) x its pages (512 tokens as the nominal
+    # system-prompt size, clamped to the context)
+    shared_tokens = min(512, seq_length)
+    shared_bytes = per_page * (shared_tokens // page_size)
     report["serve_kv"] = {
         "page_size": page_size,
         "pages_per_slot_at_seq": pages_per_slot,
@@ -292,6 +305,10 @@ def run_preflight(trainer, *, global_batch: int, seq_length: int,
         # the ratio is what the paged pool saves at this seq_length
         "dense_bytes_per_slot": kv_page_bytes(
             cfg, page_size=1, n_pages=cfg.max_position_embeddings),
+        "decode_read_bytes_per_token_flash": kernel_read,
+        "decode_traffic_bytes_per_token_gather": gather_traffic,
+        "shared_prefix_tokens_nominal": shared_tokens,
+        "shared_prefix_bytes_amortized_per_extra_slot": shared_bytes,
     }
     LOGGER.info(
         f"serve KV pricing: {per_page / 2**10:.1f} KiB/page "
@@ -299,7 +316,11 @@ def run_preflight(trainer, *, global_batch: int, seq_length: int,
         f"slot at context {seq_length} ({pages_per_slot} pages; a dense "
         f"max_position cache would hold "
         f"{report['serve_kv']['dense_bytes_per_slot'] / 2**20:.2f} MiB "
-        f"per slot)")
+        f"per slot); decode reads {kernel_read / 2**20:.2f} MiB/token "
+        f"through the flash-decode kernel (the gather view moved "
+        f"~{gather_traffic / 2**20:.2f} MiB/token); a {shared_tokens}-token "
+        f"shared prefix amortizes {shared_bytes / 2**20:.2f} MiB per "
+        f"additional co-resident slot")
 
     if target_device is None and jax.default_backend() != "tpu":
         target_device = "v5p"  # the 405B recipe's stated target pod

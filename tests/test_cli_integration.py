@@ -393,6 +393,17 @@ def test_preflight_budget_and_lowering(eight_devices):
     # the dense column pays the full position table per slot
     assert sk["dense_bytes_per_slot"] == (
         sk["bytes_per_page"] // 16 * dcfg.max_position_embeddings)
+    # decode traffic: the flash kernel reads the live context once per
+    # token; the gather view moved ~3x that (read pool + write view +
+    # read view). Prefix sharing amortizes the nominal system prompt's
+    # full pages per extra co-resident slot (clamped to the context).
+    assert sk["decode_read_bytes_per_token_flash"] == \
+        sk["bytes_per_slot_at_seq"]
+    assert sk["decode_traffic_bytes_per_token_gather"] == \
+        3 * sk["bytes_per_slot_at_seq"]
+    assert sk["shared_prefix_tokens_nominal"] == 64          # min(512, seq)
+    assert sk["shared_prefix_bytes_amortized_per_extra_slot"] == \
+        4 * sk["bytes_per_page"]
 
     # MoE configs get the dispatch-transient pricing (dense-vs-ragged bytes)
     moe_t = Trainer(bundle=get_model("moe-debug", dtype=jnp.float32),

@@ -46,6 +46,43 @@ def test_pool_free_validates():
         pool.free([99])
 
 
+def test_pool_refcount_lifecycle():
+    """share/free reference counting: a page re-enters the free list at
+    the LAST release exactly, sharing a dead page is refused, and a batch
+    releasing more references than exist fails without mutating."""
+    pool = PagePool(n_pages=6, page_size=4)
+    [p] = pool.alloc(1)
+    assert pool.refcount(p) == 1
+    pool.share([p])
+    pool.share([p])
+    assert pool.refcount(p) == 3
+    pool.free([p])
+    pool.free([p])
+    assert pool.refcount(p) == 1 and pool.n_free == 4   # still held
+    pool.free([p])
+    assert pool.refcount(p) == 0 and pool.n_free == 5   # last release
+    with pytest.raises(ValueError, match="double free"):
+        pool.free([p])
+    with pytest.raises(ValueError, match="unallocated"):
+        pool.share([p])
+    # duplicate ids past the live count fail BEFORE any mutation
+    [q] = pool.alloc(1)
+    with pytest.raises(ValueError, match="double free"):
+        pool.free([q, q])
+    assert pool.refcount(q) == 1
+
+
+def test_pool_free_list_is_lifo_with_set_membership():
+    """The satellite fix: membership checks moved to a set, but reissue
+    order stays LIFO (recently freed pages come back first, keeping the
+    hot working set compact)."""
+    pool = PagePool(n_pages=10, page_size=4)
+    a = pool.alloc(4)
+    pool.free(a)
+    assert pool.alloc(4) == a                   # LIFO reissue
+    assert pool._free_set == set(pool._free)    # set mirrors the list
+
+
 def test_pages_for_tokens_rounds_up():
     assert pages_for_tokens(1, 16) == 1
     assert pages_for_tokens(16, 16) == 1
@@ -138,6 +175,92 @@ def test_paged_attend_idle_slot_writes_to_trash():
                                   np.asarray(v_pages[1:]))
     np.testing.assert_array_equal(np.asarray(nkp[TRASH_PAGE, 0]),
                                   np.ones((h, d), np.float32))
+
+
+def test_paged_attend_multi_token_chunk_matches_contiguous():
+    """The chunked-prefill contract: T new tokens scatter at positions
+    lengths..lengths+T-1 and attend over history + themselves; the padded
+    tail (past n_valid) scatters to the trash page only."""
+    page, n_pages, hkv, hq, d = 4, 12, 2, 4, 8
+    m, t, hist = 4, 6, 5                     # 5 cached tokens, 6-token chunk
+    rng = np.random.default_rng(7)
+    tables = np.asarray([[3, 7, 2, 9]], np.int32)
+    ctx = rng.standard_normal((hist + t, hkv, d)).astype(np.float32)
+    vctx = rng.standard_normal((hist + t, hkv, d)).astype(np.float32)
+    k_pages = np.zeros((n_pages, page, hkv, d), np.float32)
+    v_pages = np.zeros((n_pages, page, hkv, d), np.float32)
+    for j in range(hist):
+        k_pages[tables[0, j // page], j % page] = ctx[j]
+        v_pages[tables[0, j // page], j % page] = vctx[j]
+
+    q = rng.standard_normal((1, t, hq, d)).astype(np.float32)
+    real = 4                                  # final-chunk padding: 2 pad
+    out, (nkp, nvp) = jax.jit(paged_attend, static_argnames=())(
+        q, ctx[None, hist:], vctx[None, hist:], jnp.asarray(k_pages),
+        jnp.asarray(v_pages), jnp.asarray(tables),
+        jnp.asarray([hist], jnp.int32), n_valid=jnp.asarray([real]))
+    nkp = np.asarray(nkp)
+
+    # real chunk rows equal attention over the contiguous history + chunk
+    kv_pos = jnp.arange(hist + t)[None]
+    ref = multihead_attention(
+        q, jnp.asarray(ctx)[None], jnp.asarray(vctx)[None], causal=True,
+        positions=jnp.asarray([[hist + j for j in range(t)]]),
+        kv_positions=kv_pos, impl="xla", standard_layout=False)
+    np.testing.assert_allclose(np.asarray(out)[0, :real],
+                               np.asarray(ref)[0, :real],
+                               rtol=1e-5, atol=1e-5)
+    # real tokens landed at their logical (page, offset)
+    for j in range(real):
+        pos = hist + j
+        np.testing.assert_array_equal(
+            nkp[tables[0, pos // page], pos % page], ctx[pos])
+    # pad tokens went to the trash page; the slot's own next positions are
+    # untouched (still zero)
+    for j in range(real, t):
+        pos = hist + j
+        assert not nkp[tables[0, pos // page], pos % page].any()
+
+
+def test_copy_pages_forks_one_physical_page():
+    """The CoW device copy: src duplicated into dst across all layers,
+    everything else bitwise untouched."""
+    from distributed_training_guide_tpu.serve.kv_pages import copy_pages
+
+    rng = np.random.default_rng(8)
+    kp = rng.standard_normal((2, 6, 4, 2, 8)).astype(np.float32)
+    vp = rng.standard_normal((2, 6, 4, 2, 8)).astype(np.float32)
+    nkp, nvp = jax.jit(copy_pages)(jnp.asarray(kp), jnp.asarray(vp),
+                                   jnp.asarray(3), jnp.asarray(5))
+    nkp, nvp = np.asarray(nkp), np.asarray(nvp)
+    np.testing.assert_array_equal(nkp[:, 5], kp[:, 3])
+    np.testing.assert_array_equal(nvp[:, 5], vp[:, 3])
+    others = [0, 1, 2, 3, 4]
+    np.testing.assert_array_equal(nkp[:, others], kp[:, others])
+    np.testing.assert_array_equal(nvp[:, others], vp[:, others])
+
+
+def test_commit_prefill_skips_shared_prefix_start():
+    """``start`` routes already-resident (shared) positions to the trash
+    page — a bucketed prefill over a shared prefix recomputes but never
+    rewrites pages other sequences read through."""
+    layers, page, n_pages, h, d = 2, 4, 8, 2, 4
+    rng = np.random.default_rng(9)
+    marker = rng.standard_normal((layers, page, h, d)).astype(np.float32)
+    k_pages = np.zeros((layers, n_pages, page, h, d), np.float32)
+    k_pages[:, 5] = marker                    # the shared page's content
+    v_pages = np.zeros_like(k_pages)
+    k_dense = rng.standard_normal((layers, 8, h, d)).astype(np.float32)
+    v_dense = rng.standard_normal((layers, 8, h, d)).astype(np.float32)
+    table_row = jnp.asarray([5, 3, 0, 0], jnp.int32)
+
+    nkp, _ = jax.jit(commit_prefill)(
+        jnp.asarray(k_pages), jnp.asarray(v_pages), jnp.asarray(k_dense),
+        jnp.asarray(v_dense), table_row, jnp.asarray(6), jnp.asarray(4))
+    nkp = np.asarray(nkp)
+    np.testing.assert_array_equal(nkp[:, 5], marker)        # untouched
+    for t in (4, 5):                                        # committed
+        np.testing.assert_array_equal(nkp[:, 3, t % page], k_dense[:, t])
 
 
 def test_commit_prefill_routes_pad_tail_to_trash():

@@ -474,3 +474,28 @@ def test_pp_with_loss_chunks(golden, eight_devices):
         state, m = t.step_fn(state, batch)
         losses.append(float(m["loss"]))
     np.testing.assert_allclose(losses, golden[0], rtol=2e-4)
+
+
+def test_pp_rejects_per_layer_windows_pinned_contract(eight_devices):
+    """The documented pp x layer_windows contract (09-pipeline-parallel
+    README "Known limits"): traced per-layer window schedules (Gemma-2's
+    alternating pattern) are NOT plumbed through the pipeline's manual
+    region — construction must fail loudly, naming the limitation and the
+    supported plans, BEFORE any compile. A UNIFORM sliding window has no
+    traced per-layer column and stays accepted under pp."""
+    lw_bundle = get_model("llama-debug", dtype=jnp.float32,
+                          layer_windows=(16, 0))
+    with pytest.raises(ValueError,
+                       match="layer_windows.*pipeline|pipeline.*layer_win"):
+        Trainer(bundle=lw_bundle, optimizer=adamw_cosine(1e-3),
+                plan=make_plan("pp", make_mesh(pp=2)), donate=False,
+                pp_microbatches=2)
+    # same config on a cp plan (the composing case) constructs fine
+    Trainer(bundle=lw_bundle, optimizer=adamw_cosine(1e-3),
+            plan=make_plan("ddp", make_mesh(cp=2)), donate=False)
+    # uniform window under pp: accepted (no per-layer column involved)
+    sw_bundle = get_model("llama-debug", dtype=jnp.float32,
+                          sliding_window=16)
+    Trainer(bundle=sw_bundle, optimizer=adamw_cosine(1e-3),
+            plan=make_plan("pp", make_mesh(pp=2)), donate=False,
+            pp_microbatches=2)
