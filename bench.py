@@ -175,8 +175,9 @@ def _save_last_good(final: dict) -> dict | None:
                    ("model", "seq", "global_batch", "step_ms", "remat",
                     "remat_policy", "optimizer", "param_dtype", "precision",
                     "loss_chunks", "fence_every", "offload_opt_state",
-                    "sliding_window", "n_chips", "device",
-                    "steps_timed", "tokens_per_s_per_chip")
+                    "sliding_window", "overlap_schedule",
+                    "xla_scheduler_flags", "xla_flags_env", "n_chips",
+                    "device", "steps_timed", "tokens_per_s_per_chip")
                    if k in detail},
     }
     try:
@@ -258,13 +259,17 @@ def run_rung(rung: dict) -> None:
     else:
         plan = make_plan("single", make_mesh(devices=devices[:1]))
 
+    from distributed_training_guide_tpu.ops.overlap import (
+        RECOMMENDED_XLA_FLAGS)
+
     make_opt = OPTIMIZERS[rung.get("optimizer", "adamw")]
     trainer = Trainer(bundle=bundle, optimizer=make_opt(3e-4), plan=plan,
                       remat=remat, remat_policy=rung.get("remat_policy", "all"),
                       attn_impl=rung.get("attn_impl", "auto"),
                       loss_chunks=rung.get("loss_chunks", 0),
                       offload_opt_state=rung.get("offload_opt_state", False),
-                      precision=rung.get("precision", "fp32"))
+                      precision=rung.get("precision", "fp32"),
+                      overlap_schedule=rung.get("overlap", False))
     state = trainer.init_state(0)
 
     global_batch = batch * plan.data_parallel_size
@@ -324,6 +329,14 @@ def run_rung(rung: dict) -> None:
                    if attn_kv < seq else {}),
                 **({"moe_dispatch": rung["moe_dispatch"]}
                    if rung.get("moe_dispatch") else {}),
+                # the overlap rungs record their scheduler config: a
+                # measured number without the XLA flags it ran under is
+                # not reproducible evidence (the latency-hiding scheduler
+                # is what turns the explicit collectives into async pairs)
+                **({"overlap_schedule": True,
+                    "xla_scheduler_flags": " ".join(RECOMMENDED_XLA_FLAGS),
+                    "xla_flags_env": os.environ.get("XLA_FLAGS", "")}
+                   if rung.get("overlap") else {}),
                 "loss": round(loss, 4),
                 "steps_timed": steps_timed,
             },
@@ -652,6 +665,23 @@ SWEEP_QUEUE = [
     dict(name="moe1b_ragged_adafactor_b8", model="moe-1b-8e", batch=8,
          seq=2048, remat=True, remat_policy="attn", optimizer="adafactor",
          moe_dispatch="ragged"),
+    # --- latency-hiding schedule A/B (ops/overlap.py --overlap-schedule:
+    # unrolled explicit fsdp all-gather prefetch + per-layer grad
+    # reduce-scatter, ring EP exchange, fused hidden->loss kernel). Queued
+    # ahead of the fence entries per the one-new-variable policy: overlap
+    # is the ONLY variable vs its control, measured in the same window so
+    # pool drift can't masquerade as a schedule win. detail records the
+    # XLA latency-hiding-scheduler flags the schedule relies on — on a
+    # multi-chip fsdp mesh set XLA_FLAGS from detail.xla_scheduler_flags.
+    dict(name="fsdp_overlap_b8", model="llama-650m", batch=8, seq=2048,
+         remat=True, remat_policy="attn", overlap=True),
+    dict(name="fsdp_base_b8_ab", model="llama-650m", batch=8, seq=2048,
+         remat=True, remat_policy="attn"),
+    # ragged MoE + ring EP double-buffer vs its same-shape non-overlap
+    # sibling (moe1b_ragged_adafactor_b8 above) — overlap the only delta
+    dict(name="moe1b_ragged_overlap_adafactor_b8", model="moe-1b-8e",
+         batch=8, seq=2048, remat=True, remat_policy="attn",
+         optimizer="adafactor", moe_dispatch="ragged", overlap=True),
     # LAST on purpose: fence_every=4 dispatches 4 steps ahead, the exact
     # pattern this pool's documented failure mode punishes — its first
     # attempt (2026-07-31 03:50) stalled and the pool went down with it.

@@ -494,6 +494,7 @@ def apply(
     attn_impl: str = "auto",
     activation_sharding: Optional[Any] = None,
     return_hidden: bool = False,
+    layer_schedule=None,
 ) -> jnp.ndarray:
     """Forward pass -> logits [B, S, V] in float32 (or the final-normed
     hidden states [B, S, E] when ``return_hidden``, for chunked losses).
@@ -503,6 +504,11 @@ def apply(
     ``06-tensor-parallel/train_llm.py:210-212``.
     ``activation_sharding`` optionally constrains the inter-block residual
     stream (e.g. P('dp', 'tp', None) for sequence parallelism).
+    ``layer_schedule`` (ops/overlap.py, --overlap-schedule): replaces the
+    layer ``lax.scan`` with the explicit latency-hiding schedule — unrolled
+    layers, manual per-layer fsdp all-gather/reduce-scatter, per-cell remat
+    owned by the schedule (the ``remat``/``remat_policy`` args were baked in
+    at schedule build).
     """
     standard_layout = positions is None
     if positions is None:
@@ -516,6 +522,11 @@ def apply(
                     standard_layout=standard_layout)
 
     wins = _layer_window_column(config)
+    if layer_schedule is not None:
+        x = layer_schedule(block, x, params["layers"], wins)
+        if return_hidden:
+            return final_hidden(config, params, x)
+        return lm_head_logits(config, params, x)
     if wins is not None:
         # per-layer sliding-window pattern (Gemma-2 alternates sliding /
         # full): the window rides the scan as a traced per-layer scalar;

@@ -54,6 +54,7 @@ from jax.ad_checkpoint import checkpoint_name
 
 from . import llama
 from .llama import _rmsnorm, attention_sublayer
+from ..ops.collectives import ppermute as _ppermute
 from ..ops.collectives import psum as _psum
 from ..ops.collectives import psum_scatter as _psum_scatter
 from ..ops.grouped_matmul import grouped_matmul
@@ -442,9 +443,32 @@ def _moe_ffn(config: MoELlamaConfig, x: jnp.ndarray, moe: dict,
     return y.reshape(b, s, d), aux, dropped_frac
 
 
+def _local_groups_compute(x_sorted: jnp.ndarray, sizes: jnp.ndarray, gate,
+                          up, down, e0, e_local: int, cdt) -> jnp.ndarray:
+    """Grouped-GEMM the ``e_local`` experts starting at (traced) expert
+    ``e0`` over their contiguous run of a group-sorted row buffer; rows
+    outside those groups come back zero. The run starts at the sum of
+    earlier group sizes — a worst-case-static window is sliced from a
+    zero-padded copy (the tail past the local groups is garbage the
+    grouped-matmul contract zeroes out). Shared by the bulk (all-gather)
+    and ring (double-buffered) EP bodies."""
+    m, d = x_sorted.shape
+    ex = sizes.shape[0]
+    local_sizes = jax.lax.dynamic_slice(sizes, (e0,), (e_local,))
+    start = jnp.sum(jnp.where(jnp.arange(ex) < e0, sizes, 0))
+    x_pad = jnp.concatenate([x_sorted, jnp.zeros_like(x_sorted)], axis=0)
+    x_local = jax.lax.dynamic_slice(x_pad, (start, 0), (m, d))
+    out_local = _ragged_expert_compute(x_local, gate, up, down,
+                                       local_sizes, cdt)
+    out_pad = jnp.zeros((2 * m, d), out_local.dtype)
+    out_pad = jax.lax.dynamic_update_slice(out_pad, out_local, (start, 0))
+    return out_pad[:m]  # zeros outside this shard's groups
+
+
 def make_ragged_ep_dispatch(mesh, config: MoELlamaConfig, *,
                             data_axes=("dp", "fsdp", "ep"), ep_axis="ep",
-                            embed_axis: Optional[str] = None):
+                            embed_axis: Optional[str] = None,
+                            overlap: bool = False):
     """Sharded dropless dispatch: a shard_map over the data axes that
     exchanges *sorted expert groups* instead of the dense path's [E, C, D]
     capacity buffer.
@@ -476,7 +500,21 @@ def make_ragged_ep_dispatch(mesh, config: MoELlamaConfig, *,
     ``embed_axis``: mesh axis sharding the weights' embed dim (ep_fsdp
     plans pass "fsdp"); the body all-gathers that dim before compute and the
     transpose reduce-scatters the weight cotangent — exactly FSDP semantics,
-    hand-spelled because the region is manual.
+    hand-spelled because the region is manual. (This stays true under
+    ``--overlap-schedule``: expert weights are excluded from the layer
+    schedule's gathers — feeding one partial-manual region's output into
+    another trips the jax 0.4.37 partitioner.)
+
+    ``overlap=True`` (the latency-hiding schedule, ops/overlap.py) swaps the
+    bulk all-gather + global sort for a DOUBLE-BUFFERED RING: token blocks
+    rotate around ``ep`` one hop per step, each visiting block is sorted and
+    run through this member's experts while the ppermute bringing hop j+1's
+    block is already in flight, and each partial output ppermutes straight
+    back to its owner (the return hop of step j rides behind step j+1's
+    compute). Same O(T*D) wire bytes as the bulk form, same math (per-row
+    expert results are sort-granularity independent; owners sum the ep
+    partials), but every transfer has compute to hide behind — and peak
+    transients drop from O(ep*t_loc) sorted rows to O(t_loc) per hop.
     """
     from jax.sharding import PartitionSpec as P
 
@@ -500,49 +538,58 @@ def make_ragged_ep_dispatch(mesh, config: MoELlamaConfig, *,
     gu_spec = P(ep_axis if ep > 1 else None, embed_axis, None)
     down_spec = P(ep_axis if ep > 1 else None, None, embed_axis)
 
+    def _member_partial(xt_blk, idx_blk, probs_blk, gate, up, down):
+        """This member's experts applied to one block of rows -> the block's
+        partial combine [t_blk, D] (zeros for rows routed elsewhere)."""
+        e0 = jax.lax.axis_index(ep_axis) * e_local
+        order, sizes, x_sorted, weight_flat = _ragged_sort(
+            xt_blk, idx_blk, probs_blk, ex, k, cdt)
+        out_sorted = _local_groups_compute(x_sorted, sizes, gate, up, down,
+                                           e0, e_local, cdt)
+        return _ragged_combine(out_sorted, order, weight_flat, k,
+                               xt_blk.shape[0], cdt)
+
     def body(xt, topk_idx, topk_probs, gate, up, down):
         if embed_axis is not None:
             gate = jax.lax.all_gather(gate, embed_axis, axis=1, tiled=True)
             up = jax.lax.all_gather(up, embed_axis, axis=1, tiled=True)
             down = jax.lax.all_gather(down, embed_axis, axis=2, tiled=True)
-        if ep > 1:  # pull the whole (dp, fsdp) row's tokens + routing in
-            xt = jax.lax.all_gather(xt, ep_axis, axis=0, tiled=True)
-            topk_idx = jax.lax.all_gather(topk_idx, ep_axis, axis=0,
-                                          tiled=True)
-            topk_probs = jax.lax.all_gather(topk_probs, ep_axis, axis=0,
-                                            tiled=True)
-        t_row, d = xt.shape
-        m = k * t_row
-        order, sizes, x_sorted, weight_flat = _ragged_sort(
-            xt, topk_idx, topk_probs, ex, k, cdt)
-        if ep > 1:
-            # this shard's experts occupy a contiguous run of the sorted
-            # buffer starting at the sum of earlier groups; slice a worst-
-            # case-static [m, D] window from a zero-padded copy (the tail
-            # past the local groups is garbage the grouped-matmul contract
-            # zeroes out)
-            e0 = jax.lax.axis_index(ep_axis) * e_local
-            local_sizes = jax.lax.dynamic_slice(sizes, (e0,), (e_local,))
-            start = jnp.sum(jnp.where(jnp.arange(ex) < e0, sizes, 0))
-            x_pad = jnp.concatenate([x_sorted, jnp.zeros_like(x_sorted)],
-                                    axis=0)
-            x_local = jax.lax.dynamic_slice(x_pad, (start, 0), (m, d))
-            out_local = _ragged_expert_compute(x_local, gate, up, down,
-                                               local_sizes, cdt)
-            out_pad = jnp.zeros((2 * m, d), out_local.dtype)
-            out_pad = jax.lax.dynamic_update_slice(out_pad, out_local,
-                                                   (start, 0))
-            out_sorted = out_pad[:m]  # zeros outside this shard's groups
-        else:
+        if ep == 1:
             # no expert axis: every shard owns all experts and just runs
             # its own tokens — purely local, no collectives at all
+            order, sizes, x_sorted, weight_flat = _ragged_sort(
+                xt, topk_idx, topk_probs, ex, k, cdt)
             out_sorted = _ragged_expert_compute(x_sorted, gate, up, down,
                                                 sizes, cdt)
-        y = _ragged_combine(out_sorted, order, weight_flat, k, t_row, cdt)
-        if ep == 1:
-            return y
-        # partial per shard (only its experts' contributions): reduce-
-        # scatter sums them and lands each token back on its home shard
+            return _ragged_combine(out_sorted, order, weight_flat, k,
+                                   xt.shape[0], cdt)
+        if overlap:
+            # double-buffered ring: blocks of rows rotate +1 per hop; while
+            # hop j's block computes, the ppermute bringing hop j+1's block
+            # is in flight, and hop j's partial output permutes straight
+            # back to its owner behind hop j+1's compute
+            fwd_perm = [(i, (i + 1) % ep) for i in range(ep)]
+            blk = (xt, topk_idx, topk_probs)
+            acc = jnp.zeros_like(xt, dtype=cdt)
+            for j in range(ep):
+                nxt = (jax.tree.map(
+                    lambda a: _ppermute(a, ep_axis, perm=fwd_perm), blk)
+                    if j + 1 < ep else None)
+                y_blk = _member_partial(*blk, gate, up, down)
+                if j:  # return the visiting block's partial to its owner
+                    back = [(i, (i - j) % ep) for i in range(ep)]
+                    y_blk = _ppermute(y_blk, ep_axis, perm=back)
+                acc = acc + y_blk
+                blk = nxt
+            return acc
+        # bulk form: pull the whole (dp, fsdp) row's tokens + routing in,
+        # sort once globally, compute the local experts' contiguous window,
+        # reduce-scatter the partials back to each token's home shard
+        xt = jax.lax.all_gather(xt, ep_axis, axis=0, tiled=True)
+        topk_idx = jax.lax.all_gather(topk_idx, ep_axis, axis=0, tiled=True)
+        topk_probs = jax.lax.all_gather(topk_probs, ep_axis, axis=0,
+                                        tiled=True)
+        y = _member_partial(xt, topk_idx, topk_probs, gate, up, down)
         return _psum_scatter(y, ep_axis)
 
     sm = jax.shard_map(body, mesh=mesh, axis_names=manual, check_vma=False,
@@ -584,6 +631,7 @@ def apply_with_aux(
     return_metrics: bool = False,
     return_hidden: bool = False,
     moe_ep=None,
+    layer_schedule=None,
 ):
     """Forward -> (logits [B,S,V] fp32, mean router aux loss[, metrics]).
 
@@ -594,7 +642,9 @@ def apply_with_aux(
     logits for the final-normed hidden states [B, S, E] (chunked-loss path —
     pair with ``output_weights``). ``moe_ep``: expert-parallel ragged
     dispatch callable (``make_ragged_ep_dispatch``), threaded to every
-    layer's routed FFN."""
+    layer's routed FFN. ``layer_schedule`` (ops/overlap.py): replaces the
+    layer scan with the explicit latency-hiding schedule, which owns remat
+    per cell (``remat``/``remat_policy`` are then unused here)."""
     standard_layout = positions is None
     if positions is None:
         positions = jnp.arange(input_ids.shape[1])[None, :]
@@ -606,27 +656,40 @@ def apply_with_aux(
                     standard_layout=standard_layout, moe_ep=moe_ep)
 
     wins = llama._layer_window_column(config)
-
-    def scan_body(carry, xs):
-        if wins is not None:   # per-layer window column rides the scan
-            layer_params, w = xs
-            new_carry = block(carry, layer_params, window_override=w)
-        else:
-            new_carry = block(carry, xs)
-        if activation_sharding is not None:
-            new_carry = (jax.lax.with_sharding_constraint(new_carry[0],
-                                                          activation_sharding),
-                         *new_carry[1:])
-        return new_carry, None
-
-    if remat:
-        policy = remat_policy or jax.checkpoint_policies.nothing_saveable
-        scan_body = jax.checkpoint(scan_body, policy=policy, prevent_cse=False)
-
     zero = jnp.zeros((), jnp.float32)
-    scan_xs = (params["layers"] if wins is None
-               else (params["layers"], wins))
-    (x, aux, dropped), _ = jax.lax.scan(scan_body, (x, zero, zero), scan_xs)
+
+    if layer_schedule is not None:
+        def sched_block(carry, layer_params, window_override=None):
+            new_carry = block(carry, layer_params,
+                              window_override=window_override)
+            if activation_sharding is not None:
+                new_carry = (jax.lax.with_sharding_constraint(
+                    new_carry[0], activation_sharding), *new_carry[1:])
+            return new_carry
+
+        x, aux, dropped = layer_schedule(sched_block, (x, zero, zero),
+                                         params["layers"], wins)
+    else:
+        def scan_body(carry, xs):
+            if wins is not None:   # per-layer window column rides the scan
+                layer_params, w = xs
+                new_carry = block(carry, layer_params, window_override=w)
+            else:
+                new_carry = block(carry, xs)
+            if activation_sharding is not None:
+                new_carry = (jax.lax.with_sharding_constraint(
+                    new_carry[0], activation_sharding), *new_carry[1:])
+            return new_carry, None
+
+        if remat:
+            policy = remat_policy or jax.checkpoint_policies.nothing_saveable
+            scan_body = jax.checkpoint(scan_body, policy=policy,
+                                       prevent_cse=False)
+
+        scan_xs = (params["layers"] if wins is None
+                   else (params["layers"], wins))
+        (x, aux, dropped), _ = jax.lax.scan(scan_body, (x, zero, zero),
+                                            scan_xs)
 
     out = (llama.final_hidden(config, params, x) if return_hidden
            else llama.lm_head_logits(config, params, x))

@@ -1,30 +1,60 @@
 """Manual-collective helpers shared by shard_map regions.
 
-``psum``: like ``jax.lax.psum`` but upcasting sub-fp32 floats to fp32 on
-non-TPU backends — jaxlib 0.9's CPU runtime aborts on a bf16 all-reduce
-(hlo_instruction.cc CHECK "Invalid binary instruction opcode copy"), which
-would otherwise kill the virtual-mesh test suite. On TPU the native bf16
-all-reduce is used (half the ICI bytes).
+All helpers carry the same environment guard: jaxlib's non-TPU runtimes
+abort on sub-fp32 collectives (observed on this container's CPU backend as
+a hlo_instruction.cc CHECK "Invalid binary instruction opcode copy" on a
+bf16 all-reduce), which would otherwise kill the virtual-mesh test suite.
+``sub_fp32_guard`` factors that upcast-around-the-collective into one
+decorator: off-TPU, bf16/fp16 operands are widened to fp32 for the
+collective and narrowed back; on TPU the native low-precision collective
+runs (half the ICI bytes). The guard is exact for data-movement collectives
+(all-gather / ppermute) and changes only the reduction arithmetic width for
+psum / psum_scatter — fp32 accumulation off-TPU, never worse than native.
 """
 from __future__ import annotations
+
+import functools
 
 import jax
 import jax.numpy as jnp
 
 
+def sub_fp32_guard(collective):
+    """Decorate a collective ``f(x, axis, **kw)`` with the non-TPU sub-fp32
+    upcast: run the wrapped op in fp32 and cast back when ``x`` is bf16/fp16
+    and the backend is not TPU."""
+
+    @functools.wraps(collective)
+    def guarded(x: jnp.ndarray, axis, **kw):
+        if (jax.default_backend() != "tpu"
+                and x.dtype in (jnp.bfloat16, jnp.float16)):
+            return collective(x.astype(jnp.float32), axis, **kw).astype(x.dtype)
+        return collective(x, axis, **kw)
+
+    return guarded
+
+
+@sub_fp32_guard
 def psum(x: jnp.ndarray, axis) -> jnp.ndarray:
-    if jax.default_backend() != "tpu" and x.dtype in (jnp.bfloat16, jnp.float16):
-        return jax.lax.psum(x.astype(jnp.float32), axis).astype(x.dtype)
     return jax.lax.psum(x, axis)
 
 
+@sub_fp32_guard
 def psum_scatter(x: jnp.ndarray, axis, *, scatter_dimension: int = 0) -> jnp.ndarray:
-    """``jax.lax.psum_scatter(tiled=True)`` with the same sub-fp32 upcast
-    guard as ``psum`` (the reduction arithmetic hits the identical CPU
-    runtime abort); on TPU the native low-precision reduce-scatter runs."""
-    if jax.default_backend() != "tpu" and x.dtype in (jnp.bfloat16, jnp.float16):
-        return jax.lax.psum_scatter(
-            x.astype(jnp.float32), axis, scatter_dimension=scatter_dimension,
-            tiled=True).astype(x.dtype)
+    """``jax.lax.psum_scatter(tiled=True)`` under the shared guard."""
     return jax.lax.psum_scatter(x, axis, scatter_dimension=scatter_dimension,
                                 tiled=True)
+
+
+@sub_fp32_guard
+def all_gather(x: jnp.ndarray, axis, *, dim: int = 0) -> jnp.ndarray:
+    """Tiled all-gather along ``dim`` (the latency-hiding schedules'
+    parameter prefetch primitive, ops/overlap.py)."""
+    return jax.lax.all_gather(x, axis, axis=dim, tiled=True)
+
+
+@sub_fp32_guard
+def ppermute(x: jnp.ndarray, axis, *, perm) -> jnp.ndarray:
+    """``jax.lax.ppermute`` under the shared guard (the double-buffered EP
+    ring's hop primitive, models/moe.py)."""
+    return jax.lax.ppermute(x, axis, perm=perm)

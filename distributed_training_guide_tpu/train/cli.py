@@ -172,6 +172,19 @@ def get_parser() -> argparse.ArgumentParser:
                              "kernel, O(S*W) attention). Overrides the "
                              "model config; hf: checkpoints with "
                              "sliding_window set enable this automatically")
+    parser.add_argument("--overlap-schedule", action="store_true",
+                        help="latency-hiding schedules (ops/overlap.py): "
+                             "unroll the layer loop with explicit per-layer "
+                             "fsdp all-gather prefetch + grad reduce-scatter "
+                             "collectives the scheduler can slide across "
+                             "layer compute, double-buffer the ragged EP "
+                             "exchange as a ppermute ring, and fuse the "
+                             "chunked/vocab-parallel loss into one "
+                             "hidden->loss kernel (no [B*S,V] fp32 logits). "
+                             "Parity-tested vs the default GSPMD program; "
+                             "pair with the XLA latency-hiding-scheduler "
+                             "flags (performance-tuning README) on TPU. "
+                             "Rejected under pp/cp plans")
     parser.add_argument("--precision-policy", default="fp32",
                         metavar="POLICY",
                         help="storage-precision policy (train/precision.py): "
@@ -311,6 +324,7 @@ def run_training(args, plan_factory: Callable, *, extra_log: Optional[dict] = No
         offload_params=offload_params,
         pp_microbatches=pp_microbatches,
         precision=getattr(args, "precision_policy", "fp32"),
+        overlap_schedule=getattr(args, "overlap_schedule", False),
     )
     from .guards import GuardMonitor
 

@@ -16,7 +16,8 @@ and covers the WHOLE strategy space beyond the reference's engine:
 ``tensor_parallel``, ``pipeline_parallel`` (+ ``pp_microbatches``),
 ``context_parallel`` (+ ``context_impl``: "ring"/"ulysses"),
 ``expert_parallel``, ``moe_dispatch`` ("dense" capacity buffers / "ragged"
-dropless sorted dispatch, MoE models only), ``attn_impl``, ``loss_chunks``, and
+dropless sorted dispatch, MoE models only), ``attn_impl``, ``loss_chunks``, ``overlap_schedule`` (latency-hiding
+comm/compute schedules, ops/overlap.py), and
 ``activation_checkpointing`` as a bool or
 ``{"enabled": true, "policy": "attn"}`` (a REMAT_POLICIES key). Storage
 precision is a named policy (``train/precision.py``): spell it
@@ -260,6 +261,10 @@ class TrainingEngine:
             loss_chunks=config.get("loss_chunks", 0),
             pp_microbatches=config.get("pp_microbatches"),
             precision=precision,
+            # latency-hiding schedules (ops/overlap.py): explicit fsdp
+            # all-gather prefetch / per-layer grad reduce-scatter, ring EP
+            # exchange, fused hidden->loss kernel. Opt-in, default off
+            overlap_schedule=config.get("overlap_schedule", False),
             # both spellings: our top-level key, and DeepSpeed's nested
             # zero_optimization.offload_optimizer/offload_param — there a
             # bool, or a dict whose device decides ({"device": "none"} is

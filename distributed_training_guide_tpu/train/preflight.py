@@ -13,8 +13,10 @@ before a single chip is reserved.
 It also prints a per-collective ICI comm model + roofline
 (``comm_roofline``): ring-collective bytes per chip per step for the plan's
 fsdp all-gathers / grad reduce-scatters / megatron tp all-reduces / dp grad
-all-reduce, divided by the target chip's ICI bandwidth, against the step's
-compute time at peak — the scaling-book first-order answer to "is the
+all-reduce / MoE EP exchange / vocab-parallel loss psums, divided by the
+target chip's ICI bandwidth, against the step's compute time at peak —
+with an exposed-vs-overlapped split so the latency-hiding schedules'
+(ops/overlap.py) win is priced before launch — the scaling-book first-order answer to "is the
 fsdp=32 x tp=8 405B plan compute-bound on a v5p pod". The collective KINDS
 in the model are cross-checked against the compiled HLO at small scale by
 ``tests/test_405b_recipe.py``.
@@ -64,6 +66,7 @@ def comm_roofline(trainer, *, global_batch: int, seq_length: int,
     fsdp = mesh.get("fsdp", 1)
     tp = mesh.get("tp", 1)
     dp = mesh.get("dp", 1)
+    ep = mesh.get("ep", 1)
     n_chips = trainer.plan.mesh.devices.size
 
     e = cfg.hidden_size
@@ -91,6 +94,16 @@ def comm_roofline(trainer, *, global_batch: int, seq_length: int,
     def ar(n, k):
         return 2 * (k - 1) / k * n if k > 1 else 0.0
 
+    # MoE EP exchange (ragged dispatch, models/moe.py): per MoE layer the
+    # token rows [t_loc, D] bf16 cross ep once out (gather/ring) and once
+    # back (reduce-scatter/return ppermute); forward AND backward transpose
+    ep_exchange = (4 * n_layers * ag_rs(act_bytes, ep)
+                   if n_experts > 1 else 0.0)
+    # vocab-parallel loss psums ([b_loc, S] fp32 rows: max-gather, sumexp,
+    # picked — fwd + the bwd dh reduce), counted when the plan shards vocab
+    # on tp (the fused hidden->loss kernel's collectives)
+    loss_bytes = rows_local * seq_length * 4
+    loss_psum = 4 * ar(loss_bytes, tp) if tp > 1 else 0.0
     table = {
         # fwd all-gather + bwd re-gather of every weight over fsdp
         "fsdp_allgather_weights": 2 * ag_rs(weight_bytes, fsdp),
@@ -100,6 +113,10 @@ def comm_roofline(trainer, *, global_batch: int, seq_length: int,
         "tp_allreduce_activations": 4 * n_layers * ar(act_bytes, tp),
         # pure-dp grad all-reduce of the (fsdp x tp)-sharded grads
         "dp_allreduce_grads": ar(weight_bytes * 2 / max(fsdp, 1), dp),
+        # MoE expert-parallel token exchange (0 for dense models / ep=1)
+        "ep_exchange": ep_exchange,
+        # vocab-parallel loss psums (0 unless vocab shards on tp)
+        "loss_psum": loss_psum,
     }
     comm_bytes = sum(table.values())
 
@@ -119,6 +136,28 @@ def comm_roofline(trainer, *, global_batch: int, seq_length: int,
         vocab_size=cfg.vocab_size, attn_kv_len=attn_kv)
     t_comp = (flops_per_token * global_batch * seq_length) / (peak * n_chips)
     t_comm = comm_bytes / ici
+
+    # exposed-vs-overlapped pricing for the latency-hiding schedules
+    # (ops/overlap.py): with --overlap-schedule, the per-layer weight
+    # all-gather/reduce-scatter and the EP exchange are issued with layer
+    # compute to hide behind, so only their overflow past t_compute is
+    # exposed; everything else (tp activation all-reduces sit on the
+    # critical path between matmuls, loss psums at the end, dp bulk
+    # reduce without a schedule) stays serial. Without the flag the whole
+    # comm budget is priced exposed — the overlap win is therefore a
+    # REPORTED number before any TPU time is spent
+    overlap_on = bool(getattr(trainer, "overlap_schedule", False))
+    schedulable = (table["fsdp_allgather_weights"]
+                   + table["fsdp_reducescatter_grads"]
+                   + table["ep_exchange"])
+    if overlap_on:
+        exposed_bytes = comm_bytes - schedulable
+        t_exposed = (exposed_bytes / ici
+                     + max(0.0, schedulable / ici - t_comp))
+        overlapped_bytes = comm_bytes - exposed_bytes
+    else:
+        exposed_bytes, overlapped_bytes = comm_bytes, 0.0
+        t_exposed = t_comm
     report = {
         "attn_kv_len": attn_kv,   # mean keys/query: < seq_length iff banded
         "per_collective_bytes_per_chip": {k: int(v) for k, v in table.items()},
@@ -132,6 +171,15 @@ def comm_roofline(trainer, *, global_batch: int, seq_length: int,
         # excluded): overlapped = comm hides behind compute; serial = none
         "mfu_ceiling_overlapped": t_comp / max(t_comp, t_comm) if t_comp else 0.0,
         "mfu_ceiling_serial": t_comp / (t_comp + t_comm) if t_comp else 0.0,
+        "overlap_schedule": overlap_on,
+        "overlappable_bytes_per_chip": int(schedulable),
+        "exposed_bytes_per_chip": int(exposed_bytes),
+        "overlapped_bytes_per_chip": int(overlapped_bytes),
+        "t_exposed_s": t_exposed,
+        # the ceiling THIS configuration is priced at: serial comm exposed,
+        # scheduled comm hidden up to t_compute
+        "mfu_ceiling_scheduled": (t_comp / (t_comp + t_exposed)
+                                  if t_comp else 0.0),
     }
     if not assume_overlap:
         report["mfu_ceiling_overlapped"] = report["mfu_ceiling_serial"]
@@ -340,5 +388,14 @@ def run_preflight(trainer, *, global_batch: int, seq_length: int,
         f"{comm['t_compute_s'] * 1e3:.1f} ms -> MFU ceiling "
         f"{comm['mfu_ceiling_overlapped']:.1%} overlapped / "
         f"{comm['mfu_ceiling_serial']:.1%} serial{banded}")
+    LOGGER.info(
+        f"overlap schedule {'ON' if comm['overlap_schedule'] else 'off'}: "
+        f"{comm['overlappable_bytes_per_chip'] * mib:.0f} MiB/chip "
+        f"schedulable (param all-gather + grad reduce-scatter + EP "
+        f"exchange), {comm['exposed_bytes_per_chip'] * mib:.0f} MiB "
+        f"exposed -> t_exposed {comm['t_exposed_s'] * 1e3:.1f} ms, "
+        f"scheduled MFU ceiling {comm['mfu_ceiling_scheduled']:.1%}"
+        + ("" if comm['overlap_schedule'] else
+           " (enable --overlap-schedule to hide the schedulable bytes)"))
     del lowered
     return report

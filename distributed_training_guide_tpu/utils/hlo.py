@@ -1,0 +1,218 @@
+"""HLO inspection helpers: collective schedules and tensor-shape pins.
+
+Tests in this repo pin two kinds of compiled-program properties:
+
+- *shape pins* — a tensor of a given dtype/shape must (not) exist in the
+  lowered or compiled text ("the fused loss never materializes [B*S, V]
+  fp32 logits", "no device holds the full-E expert stack"). Lowered
+  StableHLO spells avals ``tensor<8x16xf32>``; compiled HLO spells them
+  ``f32[8,16]``. ``has_aval`` matches both so a pin survives the
+  lowered/compiled choice.
+- *schedule pins* — the latency-hiding schedules (ops/overlap.py) are only
+  real if their collectives can overlap compute: on TPU the compiled module
+  shows async ``all-gather-start``/``all-gather-done`` pairs with compute
+  scheduled between them; everywhere, the collectives must sit in the FLAT
+  entry program, not trapped inside a ``while`` body (a ``lax.scan`` over
+  layers structurally cannot issue layer i+1's gather during layer i —
+  that is exactly what the schedules replace).
+
+Shared by tests/test_overlap.py, test_moe.py, test_serve.py,
+test_paged_decode.py, test_405b_recipe.py.
+"""
+from __future__ import annotations
+
+import dataclasses
+import re
+from typing import Iterable, Optional, Sequence
+
+COLLECTIVE_KINDS = ("all-gather", "reduce-scatter", "all-reduce",
+                    "collective-permute", "all-to-all")
+
+# ops that count as "compute" when asserting an async pair spans work
+COMPUTE_OPS = ("fusion", "dot", "convolution", "custom-call")
+
+
+@dataclasses.dataclass(frozen=True)
+class CollectiveOp:
+    kind: str           # e.g. "all-gather"
+    name: str           # e.g. "%all-gather-start.3"
+    computation: str    # enclosing HLO computation name
+    line: int           # line index into the module text
+    is_start: bool
+    is_done: bool
+
+
+_COMPUTATION_RE = re.compile(  # params may be tuple-typed (nested parens)
+    r"^\s*(?:ENTRY\s+)?(%[\w.\-]+)\s*(?:\(.*\))?\s*->.*\{\s*$")
+# the result type may be tuple-shaped with spaces — async collective
+# -start ops always are on TPU: "%ag-start = (f32[8], f32[32]) all-gather-start(..."
+_OP_RE = re.compile(
+    r"^\s*(?:ROOT\s+)?(%[\w.\-]+)\s*=\s*(?:\([^)]*\)|\S+)\s+([\w\-]+)\(")
+
+
+def _iter_ops(text: str):
+    """Yield (op_name, op_kind, computation, line_no, line_text) over an HLO
+    module's text (compiled ``as_text()`` form)."""
+    comp = ""
+    for i, line in enumerate(text.splitlines()):
+        m = _COMPUTATION_RE.match(line)
+        if m:
+            comp = m.group(1)
+            continue
+        m = _OP_RE.match(line)
+        if m:
+            yield m.group(1), m.group(2), comp, i, line
+
+
+def find_collectives(text: str, kinds: Sequence[str] = COLLECTIVE_KINDS
+                     ) -> list[CollectiveOp]:
+    """Every collective op in the module, with its enclosing computation."""
+    out = []
+    for name, op, comp, line, _ in _iter_ops(text):
+        base = op
+        is_start = op.endswith("-start")
+        is_done = op.endswith("-done")
+        if is_start or is_done:
+            base = op.rsplit("-", 1)[0]
+        if base in kinds:
+            out.append(CollectiveOp(kind=base, name=name, computation=comp,
+                                    line=line, is_start=is_start,
+                                    is_done=is_done))
+    return out
+
+
+_CALLEE_RE = re.compile(
+    r"(?:calls|to_apply|body|condition|branch_computations)="
+    r"\{?(%[\w.\-]+(?:,\s*%[\w.\-]+)*)\}?")
+
+
+def _call_graph(text: str) -> dict[str, set[str]]:
+    """computation -> computations its ops reference (fusions, loop bodies,
+    reducers, conditionals)."""
+    graph: dict[str, set[str]] = {}
+    comp = ""
+    for line in text.splitlines():
+        m = _COMPUTATION_RE.match(line)
+        if m:
+            comp = m.group(1)
+            graph.setdefault(comp, set())
+            continue
+        for m in _CALLEE_RE.finditer(line):
+            graph.setdefault(comp, set()).update(
+                c.strip() for c in m.group(1).split(","))
+    return graph
+
+
+def while_body_computations(text: str) -> set[str]:
+    """Computations reachable from any ``while`` op's body/condition —
+    TRANSITIVELY, because XLA outlines collectives into helper computations
+    (fusions, parallel thunks) called from the loop body."""
+    graph = _call_graph(text)
+    roots = set()
+    for m in re.finditer(r"=[^\n]*?\swhile\([^\n]*?"
+                         r"condition=(%[\w.\-]+)[^\n]*?body=(%[\w.\-]+)",
+                         text):
+        roots.update(m.groups())
+    seen = set()
+    stack = list(roots)
+    while stack:
+        c = stack.pop()
+        if c in seen:
+            continue
+        seen.add(c)
+        stack.extend(graph.get(c, ()))
+    return seen
+
+
+def collectives_outside_loops(text: str,
+                              kinds: Sequence[str] = COLLECTIVE_KINDS
+                              ) -> list[CollectiveOp]:
+    """Collectives NOT (transitively) inside a while body — the ones a
+    latency-hiding scheduler is free to slide across layer boundaries. A
+    scan-over-layers program reports its per-layer collectives as inside
+    the loop; the unrolled overlap schedule reports them all free."""
+    loops = while_body_computations(text)
+    return [c for c in find_collectives(text, kinds)
+            if c.computation not in loops]
+
+
+def async_collective_pairs(text: str,
+                           kinds: Sequence[str] = COLLECTIVE_KINDS
+                           ) -> list[tuple[CollectiveOp, CollectiveOp]]:
+    """(start, done) pairs, matched by the done op referencing the start op
+    by name (the HLO async-pair contract). Sync spellings yield no pairs —
+    CPU lowers collectives synchronously, TPU's latency-hiding scheduler
+    emits the async form."""
+    cols = find_collectives(text, kinds)
+    starts = {c.name: c for c in cols if c.is_start}
+    pairs = []
+    lines = text.splitlines()
+    for done in cols:
+        if not done.is_done:
+            continue
+        # the done op references its start by name somewhere in its operand
+        # list (which may carry a spaced tuple type — don't try to parse the
+        # grammar, just scan the references; [0] is the done's own name)
+        refs = re.findall(r"%[\w.\-]+", lines[done.line])
+        start = next((starts[r] for r in refs[1:] if r in starts), None)
+        if start is None:  # fall back: same kind, same computation, before it
+            cands = [s for s in starts.values()
+                     if s.kind == done.kind and s.computation == done.computation
+                     and s.line < done.line]
+            start = max(cands, key=lambda s: s.line) if cands else None
+        if start is not None:
+            pairs.append((start, done))
+    return pairs
+
+
+def assert_async_pairs_span_compute(text: str, *, min_pairs: int = 1,
+                                    kinds: Sequence[str] = COLLECTIVE_KINDS,
+                                    compute_ops: Sequence[str] = COMPUTE_OPS
+                                    ) -> int:
+    """Assert >= ``min_pairs`` async collective pairs exist and at least one
+    of them brackets compute (an op from ``compute_ops`` scheduled between
+    start and done) — the literal "collective in flight while the chip
+    works" property. Returns the number of compute-spanning pairs."""
+    pairs = async_collective_pairs(text, kinds)
+    assert len(pairs) >= min_pairs, (
+        f"expected >= {min_pairs} async collective pairs, found {len(pairs)}")
+    lines = text.splitlines()
+    spanning = 0
+    for start, done in pairs:
+        if start.computation != done.computation:
+            continue
+        for i in range(start.line + 1, done.line):
+            m = _OP_RE.match(lines[i])
+            if m and m.group(2) in compute_ops:
+                spanning += 1
+                break
+    assert spanning >= 1, "no async collective pair spans any compute op"
+    return spanning
+
+
+# ---------------------------------------------------------------------------
+# tensor-shape pins
+# ---------------------------------------------------------------------------
+
+def aval_patterns(dtype: str, shape: Iterable[int]) -> tuple[str, str]:
+    """The two textual spellings of an aval: compiled HLO ``f32[8,16]`` and
+    lowered StableHLO ``tensor<8x16xf32>``."""
+    dims = [str(int(d)) for d in shape]
+    return (f"{dtype}[{','.join(dims)}]",
+            f"tensor<{'x'.join(dims)}x{dtype}>")
+
+
+def has_aval(text: str, dtype: str, shape: Iterable[int]) -> bool:
+    """True if a tensor of exactly this dtype/shape appears in the module
+    text (either spelling)."""
+    return any(p in text for p in aval_patterns(dtype, shape))
+
+
+def has_shape_run(text: str, shape: Iterable[int]) -> bool:
+    """True if some tensor's dims contain this CONTIGUOUS run (any dtype,
+    any position) — for pins of the form "no [.., E, kT, ..] buffer of any
+    width". Dim runs are boundary-delimited so 8192 can't match inside
+    18192."""
+    dims = [str(int(d)) for d in shape]
+    return bool(re.search(r"[\[,]" + ",".join(dims) + r"[,\]]", text)
+                or re.search(r"[<x]" + "x".join(dims) + r"[x>]", text))

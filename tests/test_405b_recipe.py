@@ -20,6 +20,7 @@ import pytest
 
 from distributed_training_guide_tpu.checkpoint import abstract_train_state
 from distributed_training_guide_tpu.models import get_model
+from distributed_training_guide_tpu.utils import hlo as hlo_util
 from distributed_training_guide_tpu.parallel import make_mesh, make_plan
 from distributed_training_guide_tpu.train import Trainer, adamw_cosine
 
@@ -316,8 +317,10 @@ def test_comm_model_kinds_match_compiled_hlo(eight_devices):
     batch = {k: jax.ShapeDtypeStruct((8, 64), np.int32, sharding=sh)
              for k, sh in trainer.batch_shardings().items()}
     hlo = trainer.step_fn.lower(state, batch).compile().as_text()
-    assert "all-gather" in hlo, "fsdp weight all-gather missing from HLO"
-    assert "all-reduce" in hlo, "tp/dp all-reduce missing from HLO"
+    assert hlo_util.find_collectives(hlo, kinds=("all-gather",)), \
+        "fsdp weight all-gather missing from HLO"
+    assert hlo_util.find_collectives(hlo, kinds=("all-reduce",)), \
+        "tp/dp all-reduce missing from HLO"
 
     # grad-reduction guard on an fsdp-ONLY plan (no tp axis -> no megatron
     # all-reduces to mask the check): the fsdp grad reduction must appear,
@@ -332,7 +335,8 @@ def test_comm_model_kinds_match_compiled_hlo(eight_devices):
     batch_f = {k: jax.ShapeDtypeStruct((8, 64), np.int32, sharding=sh)
                for k, sh in t_f.batch_shardings().items()}
     hlo_f = t_f.step_fn.lower(state_f, batch_f).compile().as_text()
-    assert ("reduce-scatter" in hlo_f) or ("all-reduce" in hlo_f), (
+    assert hlo_util.find_collectives(
+        hlo_f, kinds=("reduce-scatter", "all-reduce")), (
         "fsdp grad reduction missing from HLO in every spelling")
 
 

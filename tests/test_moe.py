@@ -8,6 +8,7 @@ from distributed_training_guide_tpu.models import get_model
 from distributed_training_guide_tpu.ops import causal_lm_loss
 from distributed_training_guide_tpu.parallel import make_mesh, make_plan
 from distributed_training_guide_tpu.train import Trainer, adamw_cosine
+from distributed_training_guide_tpu.utils import hlo as hlo_util
 
 
 def test_moe_forward_and_grads():
@@ -134,12 +135,13 @@ def test_ep_dispatch_stays_local(eight_devices):
     # [1, C, D] INPUT buffer is no longer asserted: the gather-only
     # dispatch fuses it into the expert einsum, so it never exists as a
     # standalone tensor — that fusion is the point of the formulation.)
-    assert f"f32[1,{C},{F}]" in hlo, "no ep-local expert activation in HLO"
+    assert hlo_util.has_aval(hlo, "f32", (1, C, F)), \
+        "no ep-local expert activation in HLO"
     # ...and no device ever materializes the full-E dispatch/activation
     # buffers or the full expert-weight stacks (params, grads, or moments)
-    for full in (f"f32[{E},{C},{D}]", f"f32[{E},{C},{F}]",
-                 f"f32[{L},{E},{D},{F}]", f"f32[{L},{E},{F},{D}]"):
-        assert full not in hlo, f"full-E tensor {full} in compiled HLO"
+    for full in ((E, C, D), (E, C, F), (L, E, D, F), (L, E, F, D)):
+        assert not hlo_util.has_aval(hlo, "f32", full), \
+            f"full-E tensor f32{list(full)} in compiled HLO"
 
 
 # ---------------------------------------------------------------------------
@@ -258,10 +260,11 @@ def test_ep_ragged_keeps_expert_stacks_local(eight_devices):
                   cfg.num_layers)
     # local (E/ep = 1) expert weight shards are what the device holds (the
     # per-layer slice fuses into the scan body, so assert the stacked form)
-    assert f"f32[{L},1,{D},{F}]" in hlo, "no ep-local expert stack in HLO"
-    for full in (f"f32[{L},{E},{D},{F}]", f"f32[{L},{E},{F},{D}]",
-                 f"f32[{E},{D},{F}]", f"f32[{E},{F},{D}]"):
-        assert full not in hlo, f"full-E tensor {full} in compiled HLO"
+    assert hlo_util.has_aval(hlo, "f32", (L, 1, D, F)), \
+        "no ep-local expert stack in HLO"
+    for full in ((L, E, D, F), (L, E, F, D), (E, D, F), (E, F, D)):
+        assert not hlo_util.has_aval(hlo, "f32", full), \
+            f"full-E tensor f32{list(full)} in compiled HLO"
 
 
 @pytest.mark.grouped
@@ -282,10 +285,12 @@ def test_decode_no_drop_transients_scale_with_tokens():
         params, ids, cache).as_text()
     kT = cfg.experts_per_token * T
     E, D, F = cfg.num_experts, cfg.hidden_size, cfg.intermediate_size
-    assert f"{kT}x{D}" in txt, "ragged [kT, D] sorted buffer missing"
-    for dense_shape in (f"{E}x{kT}x{D}", f"{E}x{kT}x{F}", f"{kT}x{E}x"):
-        assert dense_shape not in txt, (
-            f"O(E*k*t) dispatch transient {dense_shape} in decode lowering")
+    assert hlo_util.has_shape_run(txt, (kT, D)), \
+        "ragged [kT, D] sorted buffer missing"
+    for dense_shape in ((E, kT, D), (E, kT, F), (kT, E)):
+        assert not hlo_util.has_shape_run(txt, dense_shape), (
+            f"O(E*k*t) dispatch transient {list(dense_shape)} in decode "
+            f"lowering")
 
 
 @pytest.mark.grouped

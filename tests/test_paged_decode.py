@@ -10,6 +10,7 @@ import numpy as np
 import pytest
 
 from distributed_training_guide_tpu.ops.attention import multihead_attention
+from distributed_training_guide_tpu.utils import hlo as hlo_util
 from distributed_training_guide_tpu.ops.paged_decode import (
     paged_decode_eligible, paged_flash_decode)
 from distributed_training_guide_tpu.serve.kv_pages import paged_attend
@@ -200,8 +201,9 @@ def test_engine_flash_decode_tokens_and_hlo_pin():
             jnp.asarray(arr["tables"]), jnp.asarray(arr["seeds"]),
             jnp.asarray(arr["temps"]), jnp.asarray(arr["top_ks"]),
             jnp.asarray(arr["top_ps"]), jnp.asarray(arr["actives"]))
-        view = (f"<{eng.n_slots}x{eng.max_pages * eng.page_size}x"
-                f"{cfg.num_kv_heads}x{cfg.head_size}x")
-        assert (view in lowered.as_text()) == expect_view, (
+        view = (eng.n_slots, eng.max_pages * eng.page_size,
+                cfg.num_kv_heads, cfg.head_size)
+        assert (hlo_util.has_shape_run(lowered.as_text(), view)
+                == expect_view), (
             f"{impl}: gathered-view tensor "
             f"{'missing' if expect_view else 'present'} in the decode HLO")
