@@ -456,7 +456,7 @@ def run_flash_check() -> None:
     _emit(results)
 
 
-def run_decode_check() -> None:
+def run_decode_check(only: str = None) -> None:
     """Serving rungs: decode tokens/sec through the continuous-batching
     paged-KV engine (serve/) on llama-debug — the inference trajectory
     recorded next to the training MFU rungs.
@@ -469,6 +469,20 @@ def run_decode_check() -> None:
     - mixed_chunked: one 192-token prompt admitted while 4 decodes are
       resident, prefill_chunk=32 — records the resident decodes' max
       iteration gap, the number chunked prefill exists to bound.
+    - decode_sharded_tp2 (queued sweep rung): the slots8 workload on a
+      tp=2 mesh with the KV pool sharded on the kv-head axis
+      (serve/sharding.py) — needs >= 2 devices.
+    - disagg_prefill192_decode4 (queued sweep rung): the mixed workload
+      through the DISAGGREGATED pair (serve/disagg.py). One host thread
+      drives both engines serially, so the iteration gap still CONTAINS
+      the chunk forward while the prompt prefills — what this rung
+      isolates vs mixed_chunked is the split's overhead (handoff, two
+      schedulers, the decode engine's own occupancy/TTFT) and the
+      zero-copy handoff counters; removing the interference itself
+      needs concurrent executors (the multi-host seam, future work).
+
+    ``only``: comma-separated rung names (sweep-queue children select the
+    new rungs explicitly; the default ladder set keeps its PR-6 cost).
     """
     _configure_jax_cache()
     import jax
@@ -480,11 +494,13 @@ def run_decode_check() -> None:
     from distributed_training_guide_tpu.serve.engine import ServeEngine
     from distributed_training_guide_tpu.serve.scheduler import Request
 
+    rungs = (set(only.split(",")) if only
+             else {"slots", "prefix_shared8", "mixed_chunked"})
     bundle = get_model("llama-debug", dtype=jnp.float32)
     params = bundle.init(bundle.config, jax.random.key(0))
     out = {"metric": "decode_tput", "model": "llama-debug",
            "unit": "tokens_per_s", "value": 0.0}
-    for n_slots in (1, 8):
+    for n_slots in (1, 8) if "slots" in rungs else ():
         engine = ServeEngine(bundle, params, n_slots=n_slots, page_size=16,
                              max_len=128)
         # compile outside the timed window, then zero the step counters so
@@ -501,62 +517,135 @@ def run_decode_check() -> None:
         out["value"] = stats["tokens_per_s"]   # headline: the last (8-slot)
         _emit({**out, "partial": True})        # survives a stall mid-check
 
-    # prefix-shared rung: 8 slots, common 192-token prefix
-    prefix = [3 + (i % 200) for i in range(192)]
-    engine = ServeEngine(bundle, params, n_slots=8, page_size=16,
-                         max_len=256, prefill_chunk=64)
-    generate_many(engine, [Request(prompt_ids=prefix + [7],
-                                   max_new_tokens=4)])   # warm + register
-    engine.decode_steps = engine.decode_tokens = 0
-    reqs = [Request(prompt_ids=prefix + [10 + i], max_new_tokens=32,
-                    seed=i) for i in range(8)]
-    pool = engine.scheduler.pool
-    for r in reqs:
-        engine.submit(r)
-    results, peak = [], 0
-    t0 = time.perf_counter()
-    while engine.has_work:
-        results.extend(engine.step())
-        # peak sampled DURING co-residency — end-state would only show
-        # the cache-held pages after every slot has drained
-        peak = max(peak, pool.capacity - pool.n_free)
-    stats = throughput_stats(results, time.perf_counter() - t0, engine)
-    out["prefix_shared8"] = {
-        **stats,
-        "prefix_hits": engine.scheduler.stats["prefix_hits"],
-        "prefix_tokens_shared":
-            engine.scheduler.stats["prefix_tokens_shared"],
-        "resident_pages_peak": peak,
-        "unshared_pages_equivalent": 8 * (-(-(len(prefix) + 1 + 32) // 16)),
-    }
-    _emit({**out, "partial": True})
+    if "prefix_shared8" in rungs:
+        # prefix-shared rung: 8 slots, common 192-token prefix
+        prefix = [3 + (i % 200) for i in range(192)]
+        engine = ServeEngine(bundle, params, n_slots=8, page_size=16,
+                             max_len=256, prefill_chunk=64)
+        generate_many(engine, [Request(prompt_ids=prefix + [7],
+                                       max_new_tokens=4)])  # warm+register
+        engine.decode_steps = engine.decode_tokens = 0
+        reqs = [Request(prompt_ids=prefix + [10 + i], max_new_tokens=32,
+                        seed=i) for i in range(8)]
+        pool = engine.scheduler.pool
+        for r in reqs:
+            engine.submit(r)
+        results, peak = [], 0
+        t0 = time.perf_counter()
+        while engine.has_work:
+            results.extend(engine.step())
+            # peak sampled DURING co-residency — end-state would only show
+            # the cache-held pages after every slot has drained
+            peak = max(peak, pool.capacity - pool.n_free)
+        stats = throughput_stats(results, time.perf_counter() - t0, engine)
+        out["prefix_shared8"] = {
+            **stats,
+            "prefix_hits": engine.scheduler.stats["prefix_hits"],
+            "prefix_tokens_shared":
+                engine.scheduler.stats["prefix_tokens_shared"],
+            "resident_pages_peak": peak,
+            "unshared_pages_equivalent":
+                8 * (-(-(len(prefix) + 1 + 32) // 16)),
+        }
+        _emit({**out, "partial": True})
 
-    # mixed rung: long prefill chunked against resident decodes — the
-    # per-iteration decode gap is the latency chunking bounds
-    engine = ServeEngine(bundle, params, n_slots=5, page_size=16,
-                         max_len=256, prefill_chunk=32)
-    generate_many(engine, [Request(prompt_ids=[3, 17], max_new_tokens=4)])
-    residents = [Request(prompt_ids=[5 + i, 6], max_new_tokens=96, seed=i)
-                 for i in range(4)]
-    for r in residents:
-        engine.submit(r)
-    engine.step()
-    long_req = Request(prompt_ids=[3 + (i % 200) for i in range(192)],
-                       max_new_tokens=8, seed=99)
-    engine.submit(long_req)
-    gaps, t_prev = [], time.perf_counter()
-    while engine.has_work:
+    if "mixed_chunked" in rungs:
+        # mixed rung: long prefill chunked against resident decodes — the
+        # per-iteration decode gap is the latency chunking bounds
+        engine = ServeEngine(bundle, params, n_slots=5, page_size=16,
+                             max_len=256, prefill_chunk=32)
+        generate_many(engine, [Request(prompt_ids=[3, 17],
+                                       max_new_tokens=4)])
+        residents = [Request(prompt_ids=[5 + i, 6], max_new_tokens=96,
+                             seed=i) for i in range(4)]
+        for r in residents:
+            engine.submit(r)
         engine.step()
-        now = time.perf_counter()
-        gaps.append(now - t_prev)
-        t_prev = now
-    gaps.sort()
-    out["mixed_chunked"] = {
-        "prefill_chunk": 32,
-        "iterations": len(gaps),
-        "iter_ms_p50": round(1000 * gaps[len(gaps) // 2], 2),
-        "iter_ms_max": round(1000 * gaps[-1], 2),
-    }
+        long_req = Request(prompt_ids=[3 + (i % 200) for i in range(192)],
+                           max_new_tokens=8, seed=99)
+        engine.submit(long_req)
+        gaps, t_prev = [], time.perf_counter()
+        while engine.has_work:
+            engine.step()
+            now = time.perf_counter()
+            gaps.append(now - t_prev)
+            t_prev = now
+        gaps.sort()
+        out["mixed_chunked"] = {
+            "prefill_chunk": 32,
+            "iterations": len(gaps),
+            "iter_ms_p50": round(1000 * gaps[len(gaps) // 2], 2),
+            "iter_ms_max": round(1000 * gaps[-1], 2),
+        }
+
+    if "decode_sharded_tp2" in rungs:
+        # the slots8 workload with the KV pool SHARDED on the kv-head
+        # axis over a tp=2 mesh (serve/sharding.py): params + pool split,
+        # attend shard_map'd per chip — vs the replicated-pool slots8
+        # history this isolates the sharded-pool variable
+        if len(jax.devices()) < 2:
+            out["decode_sharded_tp2"] = {"skipped": "needs >= 2 devices"}
+        else:
+            from distributed_training_guide_tpu.parallel import (make_mesh,
+                                                                 make_plan)
+
+            plan = make_plan("tp", make_mesh(tp=2,
+                                             devices=jax.devices()[:2]))
+            engine = ServeEngine(bundle, params, n_slots=8, page_size=16,
+                                 max_len=128, plan=plan, shard_kv=True)
+            generate_many(engine, [Request(prompt_ids=[3, 17, 42],
+                                           max_new_tokens=4)])
+            engine.decode_steps = engine.decode_tokens = 0
+            reqs = [Request(prompt_ids=[3 + i, 17, 42], max_new_tokens=64,
+                            seed=i) for i in range(8)]
+            t0 = time.perf_counter()
+            results = generate_many(engine, reqs)
+            stats = throughput_stats(results, time.perf_counter() - t0,
+                                     engine)
+            out["decode_sharded_tp2"] = {**stats,
+                                         **engine.kv_report()}
+            out["value"] = stats["tokens_per_s"]
+        _emit({**out, "partial": True})
+
+    if "disagg_prefill192_decode4" in rungs:
+        # the mixed workload through the DISAGGREGATED pair (serial
+        # facade — see the docstring: this prices the split's overhead
+        # and the handoff, not interference removal)
+        from distributed_training_guide_tpu.serve.disagg import DisaggEngine
+
+        engine = DisaggEngine(bundle, params, n_slots=4, n_prefill_slots=1,
+                              page_size=16, max_len=256, prefill_chunk=32)
+        generate_many(engine, [Request(prompt_ids=[3, 17],
+                                       max_new_tokens=4)])
+        residents = [Request(prompt_ids=[5 + i, 6], max_new_tokens=96,
+                             seed=i) for i in range(4)]
+        for r in residents:
+            engine.submit(r)
+        engine.step()
+        long_req = Request(prompt_ids=[3 + (i % 200) for i in range(192)],
+                           max_new_tokens=8, seed=99)
+        engine.submit(long_req)
+        results, gaps, t_prev = [], [], time.perf_counter()
+        t0 = t_prev
+        while engine.has_work:
+            results.extend(engine.step())
+            now = time.perf_counter()
+            gaps.append(now - t_prev)
+            t_prev = now
+        gaps.sort()
+        stats = throughput_stats(results, time.perf_counter() - t0, engine)
+        long_res = [r for r in results
+                    if r.prompt_ids == long_req.prompt_ids][0]
+        out["disagg_prefill192_decode4"] = {
+            **stats,
+            "prefill_chunk": 32,
+            "iterations": len(gaps),
+            "iter_ms_p50": round(1000 * gaps[len(gaps) // 2], 2),
+            "iter_ms_max": round(1000 * gaps[-1], 2),
+            "long_prompt_ttft_s": round(long_res.ttft_s, 4),
+            **{f"handoff_{k}": v for k, v in engine.handoff.stats.items()},
+        }
+        out["value"] = stats["tokens_per_s"]
     _emit(out)
 
 
@@ -682,6 +771,21 @@ SWEEP_QUEUE = [
     dict(name="moe1b_ragged_overlap_adafactor_b8", model="moe-1b-8e",
          batch=8, seq=2048, remat=True, remat_policy="attn",
          optimizer="adafactor", moe_dispatch="ragged", overlap=True),
+    # --- distributed serving plane (serve/ PR 9; queued ahead of the
+    # fence entries per the one-new-variable policy — TPU pool still
+    # down, recorded queued). decode_sharded_tp2 = the slots8 decode
+    # workload with the KV pool kv-head-sharded over tp=2 (its control is
+    # the replicated-pool slots8 history in every healthy window);
+    # disagg_prefill192_decode4 = the mixed_chunked workload through the
+    # disaggregated prefill/decode pair (its control is mixed_chunked;
+    # disaggregation the new variable, MINUS one decode slot — the pair
+    # runs 4+1 where the monolith ran 5). NOTE the facade is one serial
+    # host thread, so this prices the split's overhead + the zero-copy
+    # handoff, not prefill-interference removal (that needs concurrent
+    # executors — the multi-host seam).
+    dict(name="decode_sharded_tp2", decode_rungs="decode_sharded_tp2"),
+    dict(name="disagg_prefill192_decode4",
+         decode_rungs="disagg_prefill192_decode4"),
     # LAST on purpose: fence_every=4 dispatches 4 steps ahead, the exact
     # pattern this pool's documented failure mode punishes — its first
     # attempt (2026-07-31 03:50) stalled and the pool went down with it.
@@ -904,9 +1008,17 @@ def run_sweep(watchdog: int) -> None:
                 time.sleep(min(300, max(1, deadline - time.time())))
             if time.time() >= deadline:
                 return
-            spec = {k: v for k, v in exp.items() if k != "name"}
-            spec.setdefault("steps", 10)
-            spec.setdefault("warmup", 2)
+            # serving rungs dispatch the decode-check child instead of a
+            # training rung; their result metric is decode_tput
+            metric = "decode_tput" if exp.get("decode_rungs") else "mfu"
+            if exp.get("decode_rungs"):
+                child_args = ["--check-decode",
+                              "--decode-rungs", exp["decode_rungs"]]
+            else:
+                spec = {k: v for k, v in exp.items() if k != "name"}
+                spec.setdefault("steps", 10)
+                spec.setdefault("warmup", 2)
+                child_args = ["--rung", json.dumps(spec)]
             # clamp to the remaining watchdog window (the ladder path does
             # the same): a child launched near the deadline must not overrun
             # it by its full 700s — an external kill at the deadline would
@@ -914,9 +1026,10 @@ def run_sweep(watchdog: int) -> None:
             budget = min(700, deadline - time.time())
             if budget < 90:
                 return
-            lines, kind = _run_child(["--rung", json.dumps(spec)], budget=budget)
+            lines, kind = _run_child(child_args, budget=budget)
             if kind == "pool_exhausted" and not any(
-                    r.get("metric") == "mfu" and r["value"] > 0 for r in lines):
+                    r.get("metric") == metric and r["value"] > 0
+                    for r in lines):
                 # transient pool-capacity rejection (NOT device OOM, NOT a
                 # crash): the tiny --probe child can pass while a full rung's
                 # allocation is refused, so the pool_up() gate never engages.
@@ -937,7 +1050,7 @@ def run_sweep(watchdog: int) -> None:
                 continue
             attempt += 1
             results = [r for r in lines
-                       if r.get("metric") == "mfu" and r["value"] > 0]
+                       if r.get("metric") == metric and r["value"] > 0]
             best = results[-1] if results else None
             _append_sweep_log(
                 {"name": exp["name"], "attempt": attempt, "kind": kind,
@@ -945,7 +1058,8 @@ def run_sweep(watchdog: int) -> None:
                  "utc": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
                  "result": best})
             if best is not None and not best.get("partial"):
-                _save_last_good(best)
+                if metric == "mfu":   # last-good cache is the MFU headline
+                    _save_last_good(best)
                 break   # complete result: next experiment
             if kind == "ok":
                 break   # clean exit without a number: don't burn a retry
@@ -1059,6 +1173,7 @@ def main() -> None:
     parser.add_argument("--probe", action="store_true", help=argparse.SUPPRESS)
     parser.add_argument("--check-flash", action="store_true", help=argparse.SUPPRESS)
     parser.add_argument("--check-decode", action="store_true", help=argparse.SUPPRESS)
+    parser.add_argument("--decode-rungs", default=None, help=argparse.SUPPRESS)
     args = parser.parse_args()
     if args.remat is False and args.remat_policy:
         parser.error("--no-remat contradicts --remat-policy "
@@ -1071,7 +1186,7 @@ def main() -> None:
     if args.check_flash:
         return run_flash_check()
     if args.check_decode:
-        return run_decode_check()
+        return run_decode_check(args.decode_rungs)
     if args.sweep:
         return run_sweep(args.watchdog)
 

@@ -25,6 +25,14 @@ garbage) are cut by the causal mask exactly as in the gather path.
 ``interpret=True`` runs the kernel on CPU — the tier-1 parity grid in
 ``tests/test_paged_decode.py`` pins it against the XLA gather path at
 1e-5 across GQA/window/scale/softcap and shuffled physical layouts.
+
+Under the SHARDED page pool (``serve/sharding.py``) this kernel runs
+inside a full-manual shard_map with a per-chip pool slice: GSPMD cannot
+partition a ``pallas_call``, so the manual region is what takes the
+kernel from "replicated over a replicated pool" to "each chip reads its
+own kvh/tp heads' pages". Nothing here changes — the grid's kv-head axis
+is just smaller (possibly 1) and block tables/lengths arrive replicated;
+the GQA group count is per-KV-head and therefore shard-invariant.
 """
 from __future__ import annotations
 
@@ -138,6 +146,13 @@ def paged_flash_decode(
     s, hq, d = q.shape
     _, page, hkv, _ = k_pages.shape
     m = tables.shape[1]
+    if hkv < 1 or hq % hkv:
+        # a silent floor-division here would drop query heads (the
+        # reshape below masks it for some shapes); seen when a sharded
+        # caller splits q and the pool on mismatched axes
+        raise ValueError(
+            f"query heads ({hq}) must be a positive multiple of kv heads "
+            f"({hkv}); mismatched head sharding?")
     groups = hq // hkv
     if scale is None:
         scale = 1.0 / (d ** 0.5)

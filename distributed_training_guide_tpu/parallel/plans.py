@@ -160,6 +160,14 @@ class ShardingPlan:
     def data_parallel_size(self) -> int:
         return int(np.prod([self.mesh.shape[a] for a in self.data_axes]))
 
+    def active_axes(self) -> tuple:
+        """Mesh axes with size > 1. The serve-side sharded page pool
+        (serve/sharding.py) keys its full-manual-region validation on
+        this: its rules table mirrors the ``kv``->tp mapping above, and
+        the pool is only shardable when tp is the sole active axis."""
+        return tuple(a for a in self.mesh.axis_names
+                     if int(self.mesh.shape[a]) > 1)
+
     # ---- activations -------------------------------------------------------
     def activation_sharding(self) -> Optional[NamedSharding]:
         """Residual-stream constraint [B, S, E] between blocks.

@@ -1,33 +1,48 @@
-"""Serving runtime: continuous-batching decode engine + paged KV cache.
+"""Serving runtime: continuous-batching decode engine + paged KV cache,
+grown into a distributed serving plane.
 
 The training side of this repo ends at checkpoints; this package is the
 inference side — iteration-level (Orca) scheduling over a block-table
 paged (vLLM/PagedAttention) KV cache with a Pallas flash-decode kernel
 (``ops/paged_decode.py``), refcounted copy-on-write prefix sharing,
-optimistic admission with preemption-by-recompute, and Sarathi-style
-chunked prefill — reusing each model family's ``init_cache``/``prefill``/
-``paged_decode_step`` layouts and the training sharding plans. See
-related-topics/serving/README.md for the chapter.
+optimistic admission with preemption-by-recompute, Sarathi-style chunked
+prefill, a MESH-SHARDED page pool (``serve/sharding.py`` — pages split on
+the kv-head axis under tp, attend shard_map'd over per-chip slices),
+DISAGGREGATED prefill/decode engines connected by a refcounted page
+handoff (``serve/disagg.py``, DistServe), and a STREAMING request layer
+(``serve/api.py`` — per-token SSE, deadlines, priorities, structured
+refusals, lock-free metrics). See related-topics/serving/README.md.
 
     from distributed_training_guide_tpu.serve import (
-        Request, ServeEngine, generate_many)
+        Request, ServeEngine, DisaggEngine, generate_many)
 """
-from .engine import ServeEngine
+from .engine import ModelPrograms, ServeEngine
 from .kv_pages import PagePool, kv_page_bytes, pages_for_tokens
-from .scheduler import PrefixCache, Request, RequestResult, Scheduler
+from .scheduler import (PrefixCache, RefusalError, Request, RequestResult,
+                        Scheduler)
 
 __all__ = [
-    "PagePool", "PrefixCache", "Request", "RequestResult", "Scheduler",
-    "ServeEngine", "generate_many", "kv_page_bytes", "pages_for_tokens",
-    "serve_http",
+    "DisaggEngine", "ModelPrograms", "PagePool", "PrefixCache",
+    "RefusalError", "Request", "RequestResult", "Scheduler", "ServeEngine",
+    "generate_many", "kv_page_bytes", "match_partition_rules",
+    "pages_for_tokens", "serve_http",
 ]
 
 
 def __getattr__(name):
-    # generate_many / serve_http live in api.py, which imports http.server;
+    # generate_many / serve_http live in api.py (imports http.server),
+    # DisaggEngine in disagg.py, match_partition_rules in sharding.py;
     # keep the package import light for library users
     if name in ("generate_many", "serve_http", "throughput_stats"):
         from . import api
 
         return getattr(api, name)
+    if name == "DisaggEngine":
+        from .disagg import DisaggEngine
+
+        return DisaggEngine
+    if name == "match_partition_rules":
+        from .sharding import match_partition_rules
+
+        return match_partition_rules
     raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -57,6 +57,29 @@ def main(argv=None) -> None:
                         help="decode attend: the Pallas block-table kernel "
                         "('flash', TPU), the gather reference ('xla'), or "
                         "platform auto-dispatch")
+    parser.add_argument("--disagg", action="store_true",
+                        help="disaggregated serving: separate prefill and "
+                        "decode engines connected by a KV-page handoff "
+                        "(DistServe) instead of the monolithic engine")
+    parser.add_argument("--prefill-slots", type=int, default=1,
+                        help="concurrent prefill slots of the --disagg "
+                        "prefill engine")
+    parser.add_argument("--tp", type=int, default=1,
+                        help="tensor-parallel mesh size for serving "
+                        "(params shard as in training)")
+    parser.add_argument("--shard-kv", action="store_true",
+                        help="shard the KV page pool on the kv-head axis "
+                        "over the --tp mesh (per-chip pool slices; "
+                        "requires --tp > 1)")
+    parser.add_argument("--max-queue", type=int, default=None,
+                        help="admission queue bound; submits past it "
+                        "refuse with 429 backpressure")
+    parser.add_argument("--priority", type=int, default=0,
+                        help="priority of the offline requests (higher "
+                        "admits first)")
+    parser.add_argument("--deadline-s", type=float, default=None,
+                        help="per-request deadline in seconds from submit "
+                        "(expired requests evict cleanly)")
     parser.add_argument("--pretrained", default=None, metavar="DIR",
                         help="converted checkpoint dir (models/hf_convert); "
                         "random init otherwise")
@@ -96,12 +119,27 @@ def main(argv=None) -> None:
     else:
         params = bundle.init(bundle.config, jax.random.key(args.seed))
 
-    engine = ServeEngine(bundle, params, n_slots=args.n_slots,
-                         page_size=args.page_size, n_pages=args.n_pages,
-                         max_len=args.max_len,
-                         prefill_chunk=args.prefill_chunk,
-                         prefix_cache=not args.no_prefix_cache,
-                         attend_impl=args.attend_impl)
+    plan = None
+    if args.tp > 1:
+        from ..parallel import make_mesh, make_plan
+
+        plan = make_plan("tp", make_mesh(tp=args.tp,
+                                         devices=jax.devices()[:args.tp]))
+    elif args.shard_kv:
+        raise SystemExit("--shard-kv needs a tp mesh: pass --tp > 1")
+    common = dict(n_slots=args.n_slots, page_size=args.page_size,
+                  n_pages=args.n_pages, max_len=args.max_len,
+                  prefill_chunk=args.prefill_chunk,
+                  prefix_cache=not args.no_prefix_cache,
+                  attend_impl=args.attend_impl, plan=plan,
+                  shard_kv=args.shard_kv, max_queue=args.max_queue)
+    if args.disagg:
+        from .disagg import DisaggEngine
+
+        engine = DisaggEngine(bundle, params,
+                              n_prefill_slots=args.prefill_slots, **common)
+    else:
+        engine = ServeEngine(bundle, params, **common)
     report = engine.kv_report()
     print(json.dumps({"kv_report": report}))
 
@@ -130,7 +168,8 @@ def main(argv=None) -> None:
     requests = [Request(prompt_ids=p, max_new_tokens=args.steps,
                         temperature=args.temperature, top_k=args.top_k,
                         top_p=args.top_p, seed=args.seed + i,
-                        eos_id=args.eos_id)
+                        eos_id=args.eos_id, priority=args.priority,
+                        deadline_s=args.deadline_s)
                 for i, p in enumerate(prompts)]
     t0 = time.perf_counter()
     results = generate_many(engine, requests)

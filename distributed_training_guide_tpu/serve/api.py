@@ -1,6 +1,7 @@
 """Serving front-ends over the engine: an offline batch API and a minimal
-stdlib HTTP endpoint. Both emit per-request latency and aggregate
-tokens/sec (the numbers bench.py's ``decode_tput`` rung records).
+stdlib HTTP endpoint with per-token streaming. Both emit per-request
+latency + TTFT/ITL and aggregate tokens/sec (the numbers bench.py's
+``decode_tput`` rung records).
 
 ``generate_many`` is synchronous continuous batching: all requests enter
 the scheduler queue up front and the engine iterates until the queue
@@ -9,26 +10,48 @@ granularity (an early finisher's slot is re-admitted mid-flight).
 
 ``serve_http`` is ONLINE continuous batching: a single background engine
 thread owns all device work and loops over ``engine.step()``; HTTP handler
-threads only enqueue requests and wait on a per-request event. Concurrent
-clients therefore genuinely co-batch — two requests in flight share decode
-steps, which is the throughput story of iteration-level scheduling.
+threads only enqueue requests and wait on a per-request event (or, with
+``"stream": true``, on a per-request token queue). Concurrent clients
+therefore genuinely co-batch — two requests in flight share decode steps,
+which is the throughput story of iteration-level scheduling.
+
+The streaming response is SSE over chunked transfer-encoding: one
+``data: {"token_id": ...}`` event per generated token AS the engine
+produces it (tapped from ``engine.partial_tokens()`` after every
+iteration), closed by a ``data: {"done": true, ...}`` event carrying the
+full result + latency/TTFT metrics. The first token therefore reaches the
+client while generation is still running — TTFT < total latency is the
+pinned property, and the per-request ``deadline_s`` / ``priority`` fields
+are honored by the scheduler underneath (an expired request's stream ends
+with ``finish_reason: "deadline"``).
+
+Refusals are structured end to end: the scheduler's RefusalError maps to
+HTTP 429 (backpressure — full queue) or 400 (a request that could never
+run), and the body carries the machine-readable ``reason`` plus the
+current ``queue_depth`` instead of an opaque status; ``/healthz`` serves
+the engine's lock-free ``stats()`` snapshot, so it answers even while a
+decode iteration holds the engine thread.
+
+Works unchanged over the monolithic :class:`~.engine.ServeEngine` and the
+disaggregated :class:`~.disagg.DisaggEngine` — both implement the same
+``submit / step / has_work / partial_tokens / stats`` surface.
 """
 from __future__ import annotations
 
 import json
 import logging
+import queue as queue_mod
 import threading
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 
-from .engine import ServeEngine
-from .scheduler import Request, RequestResult
+from .scheduler import RefusalError, Request, RequestResult
 
 LOGGER = logging.getLogger(__name__)
 
 
-def generate_many(engine: ServeEngine, requests: list[Request],
+def generate_many(engine, requests: list[Request],
                   max_iterations: Optional[int] = None) -> list[RequestResult]:
     """Run a batch of requests to completion; results in submit order.
 
@@ -53,10 +76,12 @@ def generate_many(engine: ServeEngine, requests: list[Request],
 
 
 def throughput_stats(results: list[RequestResult],
-                     wall_s: float, engine: ServeEngine) -> dict:
+                     wall_s: float, engine) -> dict:
     """Aggregate serving metrics for a completed batch."""
     gen = sum(len(r.generated_ids) for r in results)
     lat = sorted(r.latency_s for r in results)
+    ttft = sorted(r.ttft_s for r in results if r.first_token_at)
+    es = engine.stats()
     return {
         "n_requests": len(results),
         "generated_tokens": gen,
@@ -66,27 +91,30 @@ def throughput_stats(results: list[RequestResult],
         # slot occupancy of the decode program: 1.0 = every lane of every
         # step carried a live request (continuous batching's win over
         # static batching shows up here)
-        "decode_occupancy": round(
-            engine.decode_tokens / (engine.decode_steps * engine.n_slots), 3)
-        if engine.decode_steps else 0.0,
+        "decode_occupancy": es["decode_occupancy"],
         "latency_s_p50": round(lat[len(lat) // 2], 4) if lat else 0.0,
         "latency_s_max": round(lat[-1], 4) if lat else 0.0,
-        "admission_blocked": engine.scheduler.stats["admission_blocked"],
+        "ttft_s_p50": round(ttft[len(ttft) // 2], 4) if ttft else 0.0,
+        "admission_blocked": es["admission_blocked"],
         # PagedAttention second-half counters: recompute preemptions,
         # prefix-cache reuse, and copy-on-write forks (serve/scheduler.py)
-        "preempted": engine.scheduler.stats["preempted"],
-        "prefix_hits": engine.scheduler.stats["prefix_hits"],
-        "prefix_tokens_shared":
-            engine.scheduler.stats["prefix_tokens_shared"],
-        "cow_forks": engine.scheduler.stats["cow_forks"],
+        "preempted": es["preempted"],
+        "prefix_hits": es["prefix_hits"],
+        "prefix_tokens_shared": es["prefix_tokens_shared"],
+        "cow_forks": es["cow_forks"],
+        "deadline_expired": es["deadline_expired"],
+        "refused": es["refused"],
     }
 
 
 class _EngineWorker(threading.Thread):
     """The single thread that touches the device. Handlers enqueue via
-    ``submit`` (engine + futures under one lock) and wait on an event."""
+    ``submit`` (engine + futures under one lock) and wait on an event —
+    or, for streaming requests, consume a per-request token queue the
+    run loop feeds from ``engine.partial_tokens()`` after every
+    iteration."""
 
-    def __init__(self, engine: ServeEngine):
+    def __init__(self, engine):
         super().__init__(daemon=True, name="serve-engine")
         self.engine = engine
         self.lock = threading.Lock()
@@ -95,16 +123,43 @@ class _EngineWorker(threading.Thread):
         self.dead: Optional[BaseException] = None
         self._stop = False
 
-    def submit(self, request: Request) -> dict:
+    def submit(self, request: Request, stream: bool = False) -> dict:
         fut = {"event": threading.Event(), "result": None, "error": None,
-               "submitted": time.monotonic()}
+               "submitted": time.monotonic(), "stream": stream,
+               "queue": queue_mod.SimpleQueue() if stream else None,
+               "sent": 0}
         with self.lock:
             if self.dead is not None:
                 raise RuntimeError(f"engine thread died: {self.dead!r}")
-            rid = self.engine.submit(request)   # raises -> handler reports 400
+            rid = self.engine.submit(request)  # raises -> handler 400/429
             self.futures[rid] = fut
         self.wakeup.set()
         return fut
+
+    def _fail_all(self, exc: BaseException) -> None:
+        self.dead = exc
+        for fut in self.futures.values():
+            fut["error"] = exc
+            if fut["stream"]:
+                fut["queue"].put(("error", exc))
+            fut["event"].set()
+        self.futures.clear()
+
+    def _push_tokens(self) -> None:
+        """Feed per-token deltas to streaming waiters. Dedup is by count:
+        ``partial_tokens`` lists only grow (replay rewrites k/v, not
+        tokens), so slicing past ``sent`` is exact across preemption.
+        Pay-for-use: the tap (which copies every live slot's token list)
+        is skipped entirely while no streaming request is in flight."""
+        if not any(f["stream"] for f in self.futures.values()):
+            return
+        for rid, toks in self.engine.partial_tokens().items():
+            fut = self.futures.get(rid)
+            if fut is None or not fut["stream"]:
+                continue
+            for tok in toks[fut["sent"]:]:
+                fut["queue"].put(("token", int(tok)))
+            fut["sent"] = max(fut["sent"], len(toks))
 
     def run(self) -> None:
         while not self._stop:
@@ -112,10 +167,17 @@ class _EngineWorker(threading.Thread):
                 with self.lock:
                     busy = self.engine.has_work
                     finished = self.engine.step() if busy else []
+                    if busy:
+                        self._push_tokens()
                     for res in finished:
                         fut = self.futures.pop(res.request_id, None)
                         if fut is not None:
                             fut["result"] = res
+                            if fut["stream"]:
+                                for tok in \
+                                        res.generated_ids[fut["sent"]:]:
+                                    fut["queue"].put(("token", int(tok)))
+                                fut["queue"].put(("done", res))
                             fut["event"].set()
             except Exception as exc:
                 # an engine error must fail every waiter LOUDLY — a silent
@@ -123,11 +185,7 @@ class _EngineWorker(threading.Thread):
                 # /healthz kept answering ok
                 LOGGER.exception("serve engine thread died")
                 with self.lock:
-                    self.dead = exc
-                    for fut in self.futures.values():
-                        fut["error"] = exc
-                        fut["event"].set()
-                    self.futures.clear()
+                    self._fail_all(exc)
                 return
             if not busy:
                 self.wakeup.wait(timeout=0.05)
@@ -137,31 +195,48 @@ class _EngineWorker(threading.Thread):
         # otherwise hang (with its client) past server.shutdown()
         with self.lock:
             if self.futures:
-                exc = RuntimeError("server shutting down")
-                self.dead = exc
-                for fut in self.futures.values():
-                    fut["error"] = exc
-                    fut["event"].set()
-                self.futures.clear()
+                self._fail_all(RuntimeError("server shutting down"))
 
     def stop(self) -> None:
         self._stop = True
         self.wakeup.set()
 
+    def stats(self) -> dict:
+        """Worker + engine snapshot WITHOUT the engine lock: the run loop
+        holds that lock for a whole iteration, and /healthz must answer
+        while a decode iteration is in flight. Every field is a host-side
+        read (atomic enough under the GIL for a health probe)."""
+        return {
+            "ok": self.dead is None,
+            **({"error": repr(self.dead)} if self.dead is not None else {}),
+            "pending_requests": len(self.futures),
+            **self.engine.stats(),
+        }
 
-def serve_http(engine: ServeEngine, host: str = "127.0.0.1", port: int = 8000,
+
+def serve_http(engine, host: str = "127.0.0.1", port: int = 8000,
                tokenizer=None):
     """Start the HTTP endpoint; returns (server, worker) — call
     ``server.shutdown()`` + ``worker.stop()`` to tear down.
 
     POST /generate  {"prompt_ids": [...]} or {"prompt": "..."} (needs a
                     tokenizer), plus optional max_new_tokens / temperature /
-                    top_k / top_p / seed / eos_id
-    GET  /healthz   liveness + queue depth
+                    top_k / top_p / seed / eos_id / priority / deadline_s.
+                    With ``"stream": true`` the response is SSE over
+                    chunked transfer-encoding: one ``data:`` event per
+                    token as it is generated, then a final ``done`` event
+                    with the full result + latency/TTFT metrics.
+    GET  /healthz   liveness + the engine's full lock-free metrics
+                    snapshot (queue depth, pool occupancy, prefix-cache
+                    hit rate, TTFT/ITL, refusals by reason)
     """
     worker = _EngineWorker(engine)
 
     class Handler(BaseHTTPRequestHandler):
+        # HTTP/1.1 for chunked transfer-encoding (the streaming path);
+        # non-streaming replies keep explicit Content-Length framing
+        protocol_version = "HTTP/1.1"
+
         def log_message(self, fmt, *args):  # route to logging, not stderr
             LOGGER.debug("http: " + fmt, *args)
 
@@ -173,21 +248,33 @@ def serve_http(engine: ServeEngine, host: str = "127.0.0.1", port: int = 8000,
             self.end_headers()
             self.wfile.write(body)
 
+        def _chunk(self, data: bytes) -> None:
+            self.wfile.write(f"{len(data):X}\r\n".encode() + data + b"\r\n")
+
+        def _sse(self, payload: dict) -> None:
+            self._chunk(b"data: " + json.dumps(payload).encode() + b"\n\n")
+
         def do_GET(self):
             if self.path != "/healthz":
                 return self._reply(404, {"error": "unknown path"})
-            with worker.lock:
-                payload = {
-                    "ok": worker.dead is None,
-                    **({"error": repr(worker.dead)}
-                       if worker.dead is not None else {}),
-                    "queued": len(engine.scheduler.queue),
-                    "active_slots": len(engine.scheduler.active_indices()),
-                    "n_slots": engine.n_slots,
-                    "pages_free": engine.scheduler.pool.n_free,
-                    "decode_steps": engine.decode_steps,
-                }
-            self._reply(200, payload)
+            # deliberately NOT under worker.lock: the engine thread holds
+            # it for a full iteration, and a health probe that blocks on
+            # in-flight device work defeats its purpose
+            self._reply(200, worker.stats())
+
+        def _result_payload(self, res: RequestResult) -> dict:
+            payload = {
+                "token_ids": res.token_ids,
+                "generated_ids": res.generated_ids,
+                "finish_reason": res.finish_reason,
+                "latency_s": round(res.latency_s, 4),
+                "queue_s": round(res.queue_s, 4),
+                "ttft_s": round(res.ttft_s, 4),
+                "itl_s": round(res.itl_s, 6),
+            }
+            if tokenizer is not None:
+                payload["text"] = tokenizer.decode(res.token_ids)
+            return payload
 
         def do_POST(self):
             if self.path != "/generate":
@@ -204,6 +291,7 @@ def serve_http(engine: ServeEngine, host: str = "127.0.0.1", port: int = 8000,
                     prompt_ids = tokenizer(body["prompt"])["input_ids"]
                     if prompt_ids and isinstance(prompt_ids[0], list):
                         prompt_ids = prompt_ids[0]
+                stream = bool(body.get("stream", False))
                 req = Request(
                     prompt_ids=[int(t) for t in (prompt_ids or [])],
                     max_new_tokens=int(body.get("max_new_tokens", 32)),
@@ -212,32 +300,58 @@ def serve_http(engine: ServeEngine, host: str = "127.0.0.1", port: int = 8000,
                     top_p=float(body.get("top_p", 1.0)),
                     seed=int(body.get("seed", 0)),
                     eos_id=(int(body["eos_id"])
-                            if body.get("eos_id") is not None else None))
-                fut = worker.submit(req)
+                            if body.get("eos_id") is not None else None),
+                    priority=int(body.get("priority", 0)),
+                    deadline_s=(float(body["deadline_s"])
+                                if body.get("deadline_s") is not None
+                                else None))
+                fut = worker.submit(req, stream=stream)
+            except RefusalError as exc:
+                # the scheduler's refusal verbatim: machine-readable
+                # reason + current load, not an opaque status code
+                return self._reply(exc.http_status, {
+                    "error": str(exc), "reason": exc.reason, **exc.detail})
             except (ValueError, KeyError, json.JSONDecodeError) as exc:
                 return self._reply(400, {"error": str(exc)})
             except RuntimeError as exc:     # engine thread already dead
                 return self._reply(503, {"error": str(exc)})
+            if stream:
+                return self._stream_response(fut)
             fut["event"].wait()
             if fut["error"] is not None:
                 return self._reply(500, {"error": repr(fut["error"])})
-            res: RequestResult = fut["result"]
-            payload = {
-                "token_ids": res.token_ids,
-                "generated_ids": res.generated_ids,
-                "finish_reason": res.finish_reason,
-                "latency_s": round(res.latency_s, 4),
-                "queue_s": round(res.queue_s, 4),
-            }
-            if tokenizer is not None:
-                payload["text"] = tokenizer.decode(res.token_ids)
-            self._reply(200, payload)
+            self._reply(200, self._result_payload(fut["result"]))
+
+        def _stream_response(self, fut: dict) -> None:
+            """SSE over chunked transfer-encoding, one event per token.
+            The headers go out immediately — the client owns a live
+            stream while the engine is still decoding (TTFT << total
+            latency, the pinned property)."""
+            self.send_response(200)
+            self.send_header("Content-Type", "text/event-stream")
+            self.send_header("Cache-Control", "no-store")
+            self.send_header("Transfer-Encoding", "chunked")
+            self.end_headers()
+            index = 0
+            while True:
+                kind, item = fut["queue"].get()
+                if kind == "token":
+                    self._sse({"token_id": item, "index": index})
+                    index += 1
+                elif kind == "done":
+                    self._sse({"done": True,
+                               **self._result_payload(item)})
+                    break
+                else:           # error
+                    self._sse({"error": repr(item)})
+                    break
+            self._chunk(b"")    # terminating zero-length chunk
+            self.close_connection = True
 
     server = ThreadingHTTPServer((host, port), Handler)
     worker.start()
     threading.Thread(target=server.serve_forever, daemon=True,
                      name="serve-http").start()
     LOGGER.info(f"serving on http://{host}:{server.server_address[1]} "
-                f"(n_slots={engine.n_slots}, "
-                f"pool={engine.scheduler.pool.n_pages} pages)")
+                f"(n_slots={engine.n_slots})")
     return server, worker

@@ -1,0 +1,459 @@
+"""Disaggregated serving: a prefill engine and a decode engine connected
+by a KV-page handoff (DistServe, Zhong et al. arXiv:2401.09670).
+
+The monolithic :class:`~.engine.ServeEngine` co-schedules prefill work
+inside its decode iteration: even chunked, a 32k-token prompt spends
+``ceil(32k / chunk)`` iterations adding one chunk-forward of latency to
+every co-resident decode step, and an un-chunked bucket prefill stalls
+the whole batch for the prompt's full length. Prefill and decode also
+want DIFFERENT compiled programs and batching policies — prefill is
+compute-bound (big matmuls, batch for throughput), decode is
+bandwidth-bound (one token per slot, batch for occupancy) — which is
+DistServe's case for splitting them into separate engines entirely.
+
+Here the split is two engines over ONE refcounted page pool:
+
+- :class:`PrefillEngine`: its own scheduler (admission, prefix cache,
+  CoW) and its own compiled programs (bucketed prefill or the chunk
+  program). It never runs a decode step. When a prompt's pages are fully
+  committed it samples the first token and emits a :class:`Handoff`.
+- :class:`PageHandoff`: the transfer protocol. SAME-HOST (this
+  implementation) the two engines address one physical pool, so
+  transferring a sequence is a refcount/ownership move — the handoff
+  record carries the page ids and the receiving scheduler adopts the
+  SAME physical pages: zero page copies, zero bytes moved (pinned by
+  test). The protocol object is deliberately the seam for multi-host
+  disaggregation: a cross-host transfer would serialize the pages'
+  contents (``bytes_per_sequence`` prices it) and re-allocate at the
+  receiver; everything else — both engines, both schedulers — is
+  already written against the handoff, not against shared memory.
+- :class:`DecodeEngine`: its own scheduler over the fixed decode slots
+  and the ONE compiled decode program. It admits from the handoff queue
+  (priority order), never from raw prompts. On pool exhaustion it
+  preempts exactly as the monolith does — but the preempted sequence
+  routes BACK to the prefill engine's queue (it needs its prompt
+  recomputed), then returns through the handoff carrying its generated
+  tokens and replays them through the decode program (bitwise cache
+  recompute, see serve/scheduler.py).
+
+Both engines share one :class:`~.engine.ModelPrograms` (one params
+layout, one jit cache) and compose with the sharded page pool
+(``shard_kv=True`` — the handoff moves page ids, which are
+shard-agnostic). The scheduler invariant is unchanged and property-pinned
+across the pair: refuse or cleanly preempt, never corrupt.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..models.registry import ModelBundle
+from .engine import (LatencyMeter, ModelPrograms, advance_prefill_chunks,
+                     build_kv_report, default_prefill_buckets,
+                     derived_pool_metrics, drop_stale_pending,
+                     resolve_context_bounds, run_bucket_prefill, run_fork,
+                     validate_prefill_buckets)
+from .kv_pages import PagePool
+from .scheduler import Admission, Request, RequestResult, Scheduler
+
+
+@dataclasses.dataclass
+class Handoff:
+    """One sequence crossing the prefill->decode boundary: the request,
+    the committed pages (ownership moves WITH the record — the prefill
+    scheduler released them without freeing), and the generation state
+    ([first token], or the full recorded suffix of a preempted sequence
+    about to replay)."""
+    request: Request
+    pages: list
+    cache_len: int                  # committed tokens (= len(prompt))
+    generated: list
+    submitted_at: float
+    admitted_at: float
+    first_token_at: float = 0.0
+    resumed: bool = False
+
+
+class PageHandoff:
+    """Same-host page handoff: a queue of :class:`Handoff` records whose
+    page references are IN TRANSIT — released by the prefill scheduler,
+    not yet adopted by the decode scheduler, still holding their pool
+    refcounts (the property tests count in-transit records as holders).
+
+    ``stats``: ``transfers`` / ``pages_transferred`` / ``tokens_transferred``
+    count the traffic; ``bytes_copied`` is the page payload MOVED, which
+    same-host is identically 0 — the refcount transfer never touches page
+    contents. A multi-host implementation would override ``transfer``/
+    ``take`` to move ``bytes_per_sequence(config, ...)`` of k/v payload
+    and re-allocate at the receiver; the engines are written against this
+    interface only.
+    """
+
+    def __init__(self, pool: PagePool):
+        self.pool = pool
+        self.pending: list[Handoff] = []
+        self.stats = {"transfers": 0, "pages_transferred": 0,
+                      "tokens_transferred": 0, "bytes_copied": 0}
+
+    def transfer(self, handoff: Handoff) -> None:
+        """Accept a sequence from the prefill side. Same-host: ownership
+        of the (already-held) page references moves to the pending queue
+        — no copy, no refcount churn, no device work."""
+        self.pending.append(handoff)
+        self.stats["transfers"] += 1
+        self.stats["pages_transferred"] += len(handoff.pages)
+        self.stats["tokens_transferred"] += handoff.cache_len
+
+    def take(self) -> Optional[Handoff]:
+        """Next sequence for the decode side, priority order (FIFO within
+        a class — mirrors admission)."""
+        if not self.pending:
+            return None
+        best = max(range(len(self.pending)),
+                   key=lambda i: (self.pending[i].request.priority, -i))
+        return self.pending.pop(best)
+
+    def __len__(self) -> int:
+        return len(self.pending)
+
+
+class PrefillEngine:
+    """The prefill half: admission + prefix sharing + (bucketed |
+    chunked) prompt computation, emitting Handoffs. Owns its scheduler;
+    shares the ModelPrograms jit cache and the device page pool with the
+    decode half."""
+
+    def __init__(self, programs: ModelPrograms, pages: dict,
+                 sched: Scheduler, handoff: PageHandoff, *,
+                 prefill_chunk: Optional[int], prefill_buckets: tuple):
+        self.programs = programs
+        self.pages = pages              # SHARED dict (key assignment only)
+        self.sched = sched
+        self.handoff = handoff
+        self.prefill_chunk = prefill_chunk
+        self.prefill_buckets = prefill_buckets
+        self._pending: dict[int, Admission] = {}
+
+    def _finish_prefill(self, adm: Admission, logit) \
+            -> Optional[RequestResult]:
+        """The slot's pages are fully committed: sample the first token
+        (unless this is a preempted sequence replaying — its tokens
+        already exist), then either finish outright (eos / max_new==1) or
+        release the slot into a Handoff. Page references move with the
+        handoff — the scheduler's release_slot explicitly does NOT free
+        them."""
+        sched = self.sched
+        if not adm.resumed:
+            t0 = self.programs.sample_one(logit, adm.request,
+                                          len(adm.tokens))
+            res = sched.record_token(adm.slot_idx, int(t0),
+                                     from_decode=False)
+            if res is not None:            # finished on the first token
+                return res
+        slot, submitted_at = sched.release_slot(adm.slot_idx)
+        self.handoff.transfer(Handoff(
+            request=slot.request, pages=list(slot.pages),
+            cache_len=slot.cache_len, generated=list(slot.generated),
+            submitted_at=submitted_at, admitted_at=slot.admitted_at,
+            first_token_at=slot.first_token_at, resumed=adm.resumed))
+        return None
+
+    def step(self) -> list[RequestResult]:
+        finished = []
+        expired = self.sched.expire_deadlines()
+        if expired:
+            drop_stale_pending(self.sched, self._pending)
+            finished.extend(expired)
+        for adm in self.sched.try_admit():
+            if adm.fork is not None:
+                run_fork(self.programs, self.pages, adm)
+            if self.prefill_chunk is None:
+                logit = run_bucket_prefill(self.programs, self.pages,
+                                           self.sched, adm,
+                                           self.prefill_buckets)
+                res = self._finish_prefill(adm, logit)
+                if res is not None:
+                    finished.append(res)
+            else:
+                self._pending[adm.slot_idx] = adm
+        if self._pending:
+            # the shared chunk-budget loop (engine.py): here the only
+            # thing one chunk can delay is OTHER PREFILLS — resident
+            # decodes live in the other engine's scheduler
+            finished.extend(advance_prefill_chunks(
+                self.programs, self.pages, self.sched, self._pending,
+                self.prefill_chunk, self._finish_prefill))
+        return finished
+
+
+class DecodeEngine:
+    """The decode half: a fixed ``[n_slots]`` batch fed exclusively from
+    the handoff queue, running the ONE compiled decode program. Keeps the
+    monolith's device-resident steady state (tokens/lengths live on
+    device between scheduler events). Preempted sequences are returned to
+    the caller for re-prefill — this engine cannot recompute a prompt."""
+
+    def __init__(self, programs: ModelPrograms, pages: dict,
+                 sched: Scheduler, handoff: PageHandoff):
+        self.programs = programs
+        self.pages = pages
+        self.sched = sched
+        self.handoff = handoff
+        self._dev: Optional[dict] = None
+        self.decode_steps = 0
+        self.decode_tokens = 0
+
+    def _seat_handoffs(self) -> None:
+        while self.handoff.pending and None in self.sched.slots:
+            h = self.handoff.take()
+            self.sched.adopt(
+                request=h.request, pages=h.pages, cache_len=h.cache_len,
+                generated=h.generated, submitted_at=h.submitted_at,
+                admitted_at=h.admitted_at, first_token_at=h.first_token_at,
+                resumed=h.resumed)
+            self._dev = None
+
+    def step(self) -> tuple[list[RequestResult], list]:
+        """One decode iteration. Returns (finished, preempted_entries) —
+        preempted entries (request + generated suffix) must be requeued
+        on the prefill side by the caller."""
+        finished = []
+        sched = self.sched
+        expired = sched.expire_deadlines()
+        if expired:
+            self._dev = None
+            finished.extend(expired)
+        self._seat_handoffs()
+        grown, preempted = sched.grow_for_decode()
+        if grown or preempted:
+            self._dev = None
+        # a preempted sequence lands in THIS scheduler's queue, but only
+        # the prefill engine can recompute its prompt — hand the entries
+        # back for requeue-at-head over there (with their submit times)
+        entries = []
+        while sched.queue:
+            entry = sched.queue.pop(0)
+            t_submit = sched._submit_times.pop(entry.request.request_id)
+            entries.append((entry, t_submit))
+
+        active = sched.active_indices()
+        if active:
+            if self._dev is None:
+                self._dev = {k: jnp.asarray(v)
+                             for k, v in sched.decode_arrays().items()}
+            d = self._dev
+            nxt, new_len, self.pages["k"], self.pages["v"] = \
+                self.programs._decode_fn(
+                    self.programs.params, self.pages["k"], self.pages["v"],
+                    d["tokens"], d["lengths"], d["tables"], d["seeds"],
+                    d["temps"], d["top_ks"], d["top_ps"], d["actives"])
+            d["tokens"], d["lengths"] = nxt, new_len
+            nxt_host = np.asarray(nxt)
+            self.decode_steps += 1
+            self.decode_tokens += len(active)
+            for slot_idx in active:
+                res = sched.record_token(slot_idx, int(nxt_host[slot_idx]),
+                                         from_decode=True)
+                if res is not None:
+                    finished.append(res)
+                    self._dev = None
+        return finished, entries
+
+
+class DisaggEngine:
+    """The disaggregated pair behind the monolith's driving surface
+    (``submit`` / ``step`` / ``has_work`` / ``stats`` /
+    ``partial_tokens``), so ``serve/api.py`` — offline batch, HTTP,
+    streaming — runs over it unchanged.
+
+    ``n_slots`` is the DECODE batch (the latency-critical side);
+    ``n_prefill_slots`` bounds concurrently-prefilling prompts. The
+    default pool holds full residency for decode slots plus prefill
+    slots; size ``n_pages`` below that to engage backpressure/preemption
+    exactly as in the monolith.
+    """
+
+    def __init__(self, bundle: ModelBundle, params, *, n_slots: int = 8,
+                 n_prefill_slots: int = 1, page_size: int = 16,
+                 n_pages: Optional[int] = None,
+                 max_len: Optional[int] = None,
+                 prefill_buckets: Optional[tuple] = None, plan=None,
+                 prefill_chunk: Optional[int] = None,
+                 prefix_cache: bool = True, attend_impl: str = "auto",
+                 shard_kv: bool = False, max_queue: Optional[int] = None):
+        if n_prefill_slots < 1:
+            raise ValueError(f"n_prefill_slots must be >= 1, got "
+                             f"{n_prefill_slots}")
+        if prefill_chunk is not None and prefill_chunk < 1:
+            raise ValueError(f"prefill_chunk must be >= 1, got "
+                             f"{prefill_chunk}")
+        self.programs = ModelPrograms(bundle, params, plan=plan,
+                                      shard_kv=shard_kv,
+                                      attend_impl=attend_impl)
+        self.bundle, self.config = bundle, bundle.config
+        max_len, self.max_model_len, self.max_pages = \
+            resolve_context_bounds(self.config, max_len, page_size)
+        self.page_size = page_size
+        self.n_slots = n_slots
+        self.n_prefill_slots = n_prefill_slots
+        if n_pages is None:
+            n_pages = 1 + (n_slots + n_prefill_slots) * self.max_pages
+        self.pool = PagePool(n_pages, page_size)
+        self.handoff = PageHandoff(self.pool)
+        self.prefill_chunk = prefill_chunk
+        if prefill_buckets is None:
+            prefill_buckets = default_prefill_buckets(self.max_pages,
+                                                      page_size)
+        prefill_buckets = validate_prefill_buckets(
+            prefill_buckets, max_pages=self.max_pages, page_size=page_size,
+            max_model_len=self.max_model_len)
+        self.pages = self.programs.init_device_pages(n_pages, page_size)
+
+        prefill_sched = Scheduler(
+            n_slots=n_prefill_slots, pool=self.pool,
+            max_len=self.max_model_len, max_pages_per_slot=self.max_pages,
+            prefix_cache=prefix_cache, max_queue=max_queue,
+            allow_partial_share=prefill_chunk is not None,
+            # admission headroom must count the DECODE side's running
+            # slots (this scheduler never decodes): without it, admission
+            # would eat the last free pages out from under growing
+            # decodes and trade every admission for preemption churn
+            # (late-bound closure — decode_sched is created just below)
+            admission_headroom=lambda: len(decode_sched.active_indices()))
+        # the decode scheduler shares the prefill side's PrefixCache
+        # object (or runs cache-less): growth under pressure must be able
+        # to evict idle cached pages before preempting a live sequence
+        decode_sched = Scheduler(
+            n_slots=n_slots, pool=self.pool, max_len=self.max_model_len,
+            max_pages_per_slot=self.max_pages,
+            prefix_cache=prefill_sched.cache
+            if prefill_sched.cache is not None else False)
+        self.prefill = PrefillEngine(
+            self.programs, self.pages, prefill_sched, self.handoff,
+            prefill_chunk=prefill_chunk, prefill_buckets=prefill_buckets)
+        self.decode = DecodeEngine(self.programs, self.pages, decode_sched,
+                                   self.handoff)
+        self._lat = LatencyMeter()
+
+    # ---- the ServeEngine driving surface -----------------------------------
+    def submit(self, request: Request) -> int:
+        try:
+            self.programs.check_prompt(request)
+        except ValueError as exc:
+            self.prefill.sched.refuse("bad_prompt", str(exc))
+        return self.prefill.sched.submit(request)
+
+    @property
+    def has_work(self) -> bool:
+        return (self.prefill.sched.has_work or self.decode.sched.has_work
+                or bool(self.handoff.pending))
+
+    @property
+    def decode_steps(self) -> int:
+        return self.decode.decode_steps
+
+    @property
+    def decode_tokens(self) -> int:
+        return self.decode.decode_tokens
+
+    @property
+    def scheduler(self):
+        """The admission-side scheduler (queue depth, refusal stats) —
+        what generic front-end code means by "the" scheduler."""
+        return self.prefill.sched
+
+    def _expire_in_transit(self) -> list[RequestResult]:
+        """Deadline expiry for sequences sitting IN the handoff queue —
+        neither scheduler owns them, so the facade evicts (frees pages,
+        returns partial tokens) at the same iteration boundary."""
+        now = self.prefill.sched._clock()
+        results = []
+        for h in [h for h in self.handoff.pending
+                  if h.request.deadline_s is not None
+                  and now - h.submitted_at > h.request.deadline_s]:
+            self.handoff.pending.remove(h)
+            self.pool.free(h.pages)
+            self.prefill.sched.stats["deadline_expired"] += 1
+            results.append(RequestResult(
+                request_id=h.request.request_id,
+                prompt_ids=list(h.request.prompt_ids),
+                generated_ids=list(h.generated), finish_reason="deadline",
+                submitted_at=h.submitted_at, admitted_at=h.admitted_at,
+                finished_at=now, first_token_at=h.first_token_at))
+        return results
+
+    def step(self) -> list[RequestResult]:
+        """One iteration of the PAIR: prefill engine advances prompts
+        (admissions + chunks, emitting handoffs), the facade expires
+        in-transit deadlines, the decode engine seats handoffs and runs
+        one batched decode. Preempted sequences route back to the prefill
+        queue head with their generated suffix (recompute + replay)."""
+        finished = self.prefill.step()
+        finished.extend(self._expire_in_transit())
+        decoded, preempted = self.decode.step()
+        finished.extend(decoded)
+        # requeue preempted entries at the head of their priority class on
+        # the prefill side, oldest-preempted last so relative order holds
+        for entry, t_submit in reversed(preempted):
+            self.prefill.sched._submit_times[entry.request.request_id] = \
+                t_submit
+            self.prefill.sched._queue_insert(entry, front=True)
+        self._lat.note(finished)
+        return finished
+
+    # ---- metrics -----------------------------------------------------------
+    def partial_tokens(self) -> dict:
+        """The streaming tap across the whole plane: prefill slots (the
+        first token exists before handoff), in-transit handoffs, and
+        decode slots."""
+        out = {}
+        for sched in (self.prefill.sched, self.decode.sched):
+            for slot in sched.slots:
+                if slot is not None and slot.generated:
+                    out[slot.request.request_id] = list(slot.generated)
+        for h in self.handoff.pending:
+            if h.generated:
+                out[h.request.request_id] = list(h.generated)
+        return out
+
+    def stats(self) -> dict:
+        """Host-side snapshot (no device, no lock — see
+        ServeEngine.stats). Admission/prefix/refusal counters come from
+        the prefill scheduler, decode occupancy from the decode engine,
+        and the handoff adds its transfer counters."""
+        p, d = self.prefill.sched, self.decode.sched
+        s = {k: (dict(v) if isinstance(v, dict) else v)
+             for k, v in p.stats.items()}
+        # counters that genuinely occur on BOTH sides are summed;
+        # admission counters stay prefill-side (the decode scheduler's
+        # adopt() is a handoff, not a new admission)
+        for k in ("preempted", "deadline_expired", "cache_evicted_pages",
+                  "finished"):
+            s[k] = p.stats[k] + d.stats[k]
+        return {
+            **s,
+            "queued": len(p.queue),
+            "handoff_pending": len(self.handoff),
+            "prefilling_slots": len(p.prefilling_indices()),
+            "active_slots": len(d.active_indices()),
+            "n_prefill_slots": self.n_prefill_slots,
+            **derived_pool_metrics(
+                pool=self.pool, cached_pages=p.cache_pages_held(),
+                n_slots=self.n_slots,
+                decode_steps=self.decode.decode_steps,
+                decode_tokens=self.decode.decode_tokens,
+                admitted=p.stats.get("admitted", 0),
+                prefix_hits=s.get("prefix_hits", 0), lat=self._lat),
+            **{f"handoff_{k}": v for k, v in self.handoff.stats.items()},
+        }
+
+    def kv_report(self) -> dict:
+        return build_kv_report(
+            self.programs, page_size=self.page_size, pool=self.pool,
+            cached_pages=self.prefill.sched.cache_pages_held(),
+            n_slots=self.n_slots, max_pages=self.max_pages,
+            pool_bytes=int(self.pages["k"].nbytes
+                           + self.pages["v"].nbytes))

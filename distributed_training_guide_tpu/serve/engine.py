@@ -34,10 +34,16 @@ preemption-identity tests in tests/test_serve.py.
 
 Sharded weights ride the existing ``parallel/plans.py`` meshes: pass
 ``plan=`` (tp / fsdp / single) and params are device_put to the plan's
-param shardings while KV pages and per-step host arrays stay replicated —
-GSPMD partitions the decode matmuls exactly as it does the training
-forward. (Pages sharded over dp is future work; replicated is always
-correct.)
+param shardings. The KV page pool is replicated by default;
+``shard_kv=True`` (tp meshes) splits it on the kv-head axis under the
+``serve/sharding.py`` rules table and runs the attend — flash kernel
+included — shard_map'd over per-chip pool slices, so no chip ever holds
+the full-kv-head pool (ROADMAP item 2; HLO-pinned in tests).
+
+The compiled programs live in :class:`ModelPrograms`, shared between this
+monolithic engine and the disaggregated prefill/decode pair in
+``serve/disagg.py`` (separate engines, same program cache, one page
+pool).
 """
 from __future__ import annotations
 
@@ -48,8 +54,8 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..models.registry import ModelBundle, family_module
-from .kv_pages import (PagePool, commit_prefill, copy_pages, init_pages,
-                       kv_page_bytes, make_attend, pages_for_tokens)
+from .kv_pages import (commit_prefill, copy_pages, init_pages, kv_page_bytes,
+                       make_attend, PagePool, pages_for_tokens)
 from .scheduler import Admission, Request, RequestResult, Scheduler
 
 
@@ -83,34 +89,238 @@ def _sample_tokens(logits, seeds, positions, temps, top_ks, top_ps):
     return jnp.where(temps > 0, sampled, greedy).astype(jnp.int32)
 
 
-class ServeEngine:
-    """Multi-request generation over a model family's KV-cache decode.
+def resolve_context_bounds(config, max_len: Optional[int],
+                           page_size: int) -> tuple:
+    """(capacity max_len, request-validation max_model_len, max_pages)
+    for one engine — single-sourced so the monolith and the
+    disaggregated facade can never disagree on sizing policy.
 
-    Drive it either through ``serve/api.py`` (``generate_many`` /
-    ``serve_http``) or directly: ``submit(Request(...))`` then ``step()``
-    in a loop — each ``step`` is one scheduler iteration (grow/preempt +
-    admit + prefill work + one batched decode) and returns whatever
-    finished.
+    Bounded default: the full position table of a big preset (131k for
+    llama3) would size BOTH the default full-residency pool and the xla
+    path's gather transient to the dense worst case this package exists
+    to remove — long contexts are opt-in via max_len=. max_len is
+    CAPACITY (page-granular); requests validate against min(capacity,
+    position table) so a rounded-up capacity can't push gpt2 past its
+    learned positions."""
+    max_pos = getattr(config, "max_position_embeddings", None)
+    if max_len is None:
+        max_len = min(max_pos, 2048) if max_pos else 2048
+    max_model_len = min(max_len, max_pos) if max_pos else max_len
+    return max_len, max_model_len, pages_for_tokens(max_len, page_size)
 
-    ``prefix_cache`` (default on): committed prompt pages register in a
-    content-keyed cache so identical prefixes share physical pages across
-    requests (refcounted, copy-on-write). ``prefill_chunk=N`` streams
-    prompts through the paged path N tokens per iteration instead of one
-    bucketed prefill (long prompts stop stalling resident decodes; also
-    unlocks mid-page prefix reuse). ``attend_impl`` picks the decode
-    attend: "auto" (flash kernel on TPU, gather elsewhere), "flash",
-    "xla". Caveat: under a multi-device ``plan=``, GSPMD cannot partition
-    the Mosaic kernel — it runs replicated per device (correct; the
-    sharded-page-pool design that makes it efficient is ROADMAP item 2),
-    so sharded engines should keep "auto"/"xla" until then.
+
+def derived_pool_metrics(*, pool: PagePool, cached_pages: int, n_slots: int,
+                         decode_steps: int, decode_tokens: int,
+                         admitted: int, prefix_hits: int,
+                         lat: "LatencyMeter") -> dict:
+    """The derived stats() tail both engines expose (api.py's
+    throughput_stats and /healthz index these keys on either)."""
+    held = pool.capacity - pool.n_free
+    return {
+        "n_slots": n_slots,
+        "pages_capacity": pool.capacity,
+        "pages_free": pool.n_free,
+        "pages_held": held,
+        "pages_cached": cached_pages,
+        "pool_occupancy": (round(held / pool.capacity, 3)
+                           if pool.capacity else 0.0),
+        "prefix_hit_rate": (round(prefix_hits / admitted, 3)
+                            if admitted else 0.0),
+        "decode_steps": decode_steps,
+        "decode_tokens": decode_tokens,
+        "decode_occupancy": (round(
+            decode_tokens / (decode_steps * n_slots), 3)
+            if decode_steps else 0.0),
+        "ttft_s_avg": lat.ttft_avg(),
+        "itl_s_avg": lat.itl_avg(),
+    }
+
+
+def default_prefill_buckets(max_pages: int, page_size: int) -> tuple:
+    """Power-of-two prompt buckets up to the per-slot page capacity."""
+    cap = max_pages * page_size
+    b, buckets = page_size, []
+    while b < cap:
+        buckets.append(b)
+        b *= 2
+    buckets.append(cap)
+    return tuple(buckets)
+
+
+def validate_prefill_buckets(buckets: tuple, *, max_pages: int,
+                             page_size: int, max_model_len: int) -> tuple:
+    """Buckets must cover every admissible prompt and stay inside the
+    page capacity (``commit_prefill`` indexes table_row[t // page]) — an
+    unservable bucket config fails at construction, not after a request
+    has been admitted and holds a slot + pages."""
+    buckets = tuple(sorted(buckets))
+    cap = max_pages * page_size
+    if buckets[-1] < min(max_model_len - 1, cap):
+        raise ValueError(
+            f"prefill_buckets {buckets} cannot cover the largest "
+            f"admissible prompt ({min(max_model_len - 1, cap)} tokens)")
+    if buckets[-1] > cap:
+        raise ValueError(
+            f"prefill bucket {buckets[-1]} exceeds the per-slot page "
+            f"capacity {cap}")
+    return buckets
+
+
+class LatencyMeter:
+    """Running TTFT / inter-token-latency averages over finished
+    requests (host-side counters feeding stats())."""
+
+    def __init__(self):
+        self.ttft_sum = self.itl_sum = 0.0
+        self.ttft_n = self.itl_n = 0
+
+    def note(self, finished: list) -> None:
+        for res in finished:
+            if res.first_token_at:
+                self.ttft_sum += res.ttft_s
+                self.ttft_n += 1
+                if len(res.generated_ids) > 1:
+                    self.itl_sum += res.itl_s
+                    self.itl_n += 1
+
+    def ttft_avg(self) -> float:
+        return round(self.ttft_sum / self.ttft_n, 4) if self.ttft_n else 0.0
+
+    def itl_avg(self) -> float:
+        return round(self.itl_sum / self.itl_n, 6) if self.itl_n else 0.0
+
+
+def run_fork(programs: "ModelPrograms", pages: dict, adm: Admission) -> None:
+    """Device side of the CoW bookkeeping: the remainder prefill is about
+    to write into the partially-shared page, so its content is copied
+    into the slot's private replacement first. Mutates ``pages`` in
+    place (the dict is the engine-shared handle)."""
+    src, dst = adm.fork
+    pages["k"], pages["v"] = programs._copy_fn(
+        pages["k"], pages["v"],
+        jnp.asarray(src, jnp.int32), jnp.asarray(dst, jnp.int32))
+
+
+def run_bucket_prefill(programs: "ModelPrograms", pages: dict,
+                       sched: Scheduler, adm: Admission, buckets: tuple):
+    """Whole-context prefill through the family's bucketed program +
+    commit; the commit scatter skips the shared prefix (those pages are
+    other sequences' territory) and the pad tail. Returns the real last
+    token's logits row (the first-sample input). Shared verbatim by the
+    monolithic engine and the disaggregated prefill engine — prefill
+    semantics must never fork between them."""
+    tokens = adm.tokens
+    n = len(tokens)
+    bucket = next((b for b in buckets if b >= n), None)
+    if bucket is None:
+        raise ValueError(f"prompt length {n} exceeds the largest prefill "
+                         f"bucket {buckets[-1]}")
+    ids = np.zeros((1, bucket), np.int32)
+    ids[0, :n] = tokens
+    logit, kd, vd = programs.prefill_for(bucket)(
+        programs.params, jnp.asarray(ids), jnp.asarray(n - 1))
+    table_row = jnp.asarray(sched.table_row(adm.slot_idx))
+    pages["k"], pages["v"] = programs._commit_fn(
+        pages["k"], pages["v"], kd, vd, table_row,
+        jnp.asarray(n), jnp.asarray(adm.shared_len))
+    sched.commit_tokens(adm.slot_idx, n - adm.shared_len)
+    return logit
+
+
+def advance_prefill_chunks(programs: "ModelPrograms", pages: dict,
+                           sched: Scheduler, pending: dict, chunk: int,
+                           on_complete) -> list:
+    """Run up to ``chunk`` prompt tokens through the chunk program,
+    oldest prefilling slot first — the per-iteration budget that bounds
+    how much prompt work one iteration can absorb. ``on_complete(adm,
+    logit)`` fires when a slot's final chunk lands (the engines differ
+    there: the monolith samples the first token into the decode batch,
+    the disaggregated prefill engine emits a Handoff); a non-None return
+    is a finished RequestResult. Single-sourced so budget discipline —
+    charged at the padded PROGRAM cost, not real tokens (the PR-6 review
+    fix) — cannot fork between the engines."""
+    finished = []
+    budget = chunk
+    for slot_idx in sched.prefilling_indices():
+        if budget <= 0:
+            break
+        adm = pending.get(slot_idx)
+        if adm is None:        # pre-chunking admission (mode switch)
+            continue
+        slot = sched.slots[slot_idx]
+        start = slot.cache_len
+        real = min(chunk, slot.target_len - start)
+        # budget is charged at the PROGRAM cost (the chunk is padded to
+        # `chunk` whatever `real` is) — charging real tokens would let N
+        # slots with short final chunks run N full-width forwards in one
+        # iteration, exactly the latency spike the budget bounds
+        budget -= chunk
+        ids = np.zeros((1, chunk), np.int32)
+        ids[0, :real] = adm.tokens[start:start + real]
+        logit, pages["k"], pages["v"] = programs.chunk_for(chunk)(
+            programs.params, pages["k"], pages["v"],
+            jnp.asarray(ids), jnp.asarray([start], jnp.int32),
+            jnp.asarray(sched.table_row(slot_idx)[None]),
+            jnp.asarray(real - 1, jnp.int32),
+            jnp.asarray([real], jnp.int32))
+        sched.commit_tokens(slot_idx, real)
+        if not sched.slots[slot_idx].prefilling:   # final chunk landed
+            pending.pop(slot_idx)
+            res = on_complete(adm, logit)
+            if res is not None:
+                finished.append(res)
+    return finished
+
+
+def drop_stale_pending(sched: Scheduler, pending: dict) -> None:
+    """Preemption or deadline expiry may have evicted a mid-prefill
+    slot; its chunk state must go with it (a preempted slot will be
+    re-admitted from the queue)."""
+    for idx in list(pending):
+        slot = sched.slots[idx]
+        adm = pending[idx]
+        if (slot is None
+                or slot.request.request_id != adm.request.request_id):
+            del pending[idx]
+
+
+def build_kv_report(programs: "ModelPrograms", *, page_size: int,
+                    pool: PagePool, cached_pages: int, n_slots: int,
+                    max_pages: int, pool_bytes: int) -> dict:
+    """The preflight-style byte table for one engine's pool."""
+    per_page = kv_page_bytes(programs.config, page_size=page_size)
+    shards = (int(programs.mesh.shape["tp"]) if programs.shard_kv else 1)
+    return {
+        "page_size": page_size,
+        "n_pages": pool.n_pages,
+        "pages_free": pool.n_free,
+        "pages_cached": cached_pages,
+        "bytes_per_page": per_page,
+        "kv_shards": shards,
+        "bytes_per_page_per_chip": per_page // shards,
+        "pool_bytes": pool_bytes,
+        "dense_equivalent_bytes": kv_page_bytes(
+            programs.config, page_size=page_size,
+            n_pages=n_slots * max_pages),
+    }
+
+
+class ModelPrograms:
+    """The compiled-program cache for one (model, params, sharding)
+    triple: the batched decode step, per-bucket prefill programs, the
+    chunk program, commit/copy scatters, and the batch-1 sampler. Owned
+    by a :class:`ServeEngine`, or SHARED between the disaggregated
+    prefill/decode pair (``serve/disagg.py``) — both engines then reuse
+    one params layout and one jit cache.
+
+    ``shard_kv=True`` is the distributed-pool mode: params follow the
+    plan as usual, and every pool-touching program runs its pool work
+    inside a full-manual shard_map with per-chip kv-head slices
+    (``serve/sharding.py``).
     """
 
-    def __init__(self, bundle: ModelBundle, params, *, n_slots: int = 8,
-                 page_size: int = 16, n_pages: Optional[int] = None,
-                 max_len: Optional[int] = None,
-                 prefill_buckets: Optional[tuple] = None, plan=None,
-                 prefill_chunk: Optional[int] = None,
-                 prefix_cache: bool = True, attend_impl: str = "auto"):
+    def __init__(self, bundle: ModelBundle, params, *, plan=None,
+                 shard_kv: bool = False, attend_impl: str = "auto"):
         self.bundle = bundle
         self.config = bundle.config
         self.mod = family_module(bundle.family)
@@ -122,63 +332,27 @@ class ServeEngine:
             raise ValueError(f"attend_impl must be 'auto', 'flash' or "
                              f"'xla', got {attend_impl!r}")
         self.attend_impl = attend_impl
-        if prefill_chunk is not None and prefill_chunk < 1:
-            raise ValueError(f"prefill_chunk must be >= 1, got "
-                             f"{prefill_chunk}")
-        self.prefill_chunk = prefill_chunk
-        max_pos = getattr(self.config, "max_position_embeddings", None)
-        if max_len is None:
-            # bounded default: the full position table of a big preset
-            # (131k for llama3) would size BOTH the default full-residency
-            # pool (n_slots x max_pages pages) and the xla path's gather
-            # transient to the dense worst case this module exists to
-            # remove — long contexts are opt-in via max_len=
-            max_len = min(max_pos, 2048) if max_pos else 2048
-        # max_len is CAPACITY (page-granular); requests are validated
-        # against min(capacity, position table) so a rounded-up capacity
-        # can't push gpt2 past its learned positions
-        self.max_model_len = min(max_len, max_pos) if max_pos else max_len
-        self.page_size = page_size
-        self.max_pages = pages_for_tokens(max_len, page_size)
-        self.n_slots = n_slots
-        if n_pages is None:
-            # default: full residency + the trash page — backpressure /
-            # preemption only engage when the caller sizes the pool below
-            n_pages = 1 + n_slots * self.max_pages
-        pool = PagePool(n_pages, page_size)
-        self.scheduler = Scheduler(
-            n_slots=n_slots, pool=pool, max_len=self.max_model_len,
-            max_pages_per_slot=self.max_pages, prefix_cache=prefix_cache,
-            # mid-page prefix reuse needs the chunked path: a bucketed
-            # prefill recomputes from position 0 anyway, so only aligned
-            # (full-page) sharing pays for itself there
-            allow_partial_share=prefill_chunk is not None)
-        if prefill_buckets is None:
-            cap = self.max_pages * page_size
-            b, buckets = page_size, []
-            while b < cap:
-                buckets.append(b)
-                b *= 2
-            buckets.append(cap)
-            prefill_buckets = tuple(buckets)
-        self.prefill_buckets = tuple(sorted(prefill_buckets))
-        # buckets must cover every admissible prompt (Scheduler.submit
-        # accepts up to max_model_len - 1 prompt tokens) and stay inside the
-        # page capacity (commit_prefill indexes table_row[t // page]) — an
-        # unservable bucket config fails HERE, not after a request has been
-        # admitted and holds a slot + pages
-        cap = self.max_pages * page_size
-        if self.prefill_buckets[-1] < min(self.max_model_len - 1, cap):
-            raise ValueError(
-                f"prefill_buckets {self.prefill_buckets} cannot cover the "
-                f"largest admissible prompt "
-                f"({min(self.max_model_len - 1, cap)} tokens)")
-        if self.prefill_buckets[-1] > cap:
-            raise ValueError(
-                f"prefill bucket {self.prefill_buckets[-1]} exceeds the "
-                f"per-slot page capacity {cap}")
-
         self.plan = plan
+        self.shard_kv = bool(shard_kv)
+        self.mesh = plan.mesh if plan is not None else None
+        self._kv_sharding = None
+        self._repl = None
+        if self.shard_kv:
+            from .sharding import (make_sharded_commit, make_sharded_copy,
+                                   serve_kv_shardings, validate_kv_shard)
+
+            validate_kv_shard(plan, self.config)
+            # the rules-table pattern: pool sharding comes from the serve
+            # regex -> PartitionSpec table, not an ad-hoc spec here
+            probe = {"pages": {"k": np.zeros((2, 2, 2, 2, 2)),
+                               "v": np.zeros((2, 2, 2, 2, 2))}}
+            self._kv_sharding = serve_kv_shardings(
+                self.mesh, probe)["pages"]["k"]
+            self._repl = plan.replicated()
+            commit_impl = make_sharded_commit(self.mesh)
+            copy_impl = make_sharded_copy(self.mesh)
+        else:
+            commit_impl, copy_impl = commit_prefill, copy_pages
         if plan is not None:
             shapes = jax.tree.map(
                 lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype), params)
@@ -186,33 +360,57 @@ class ServeEngine:
                 bundle.param_logical_axes(self.config), shapes)
             params = jax.device_put(params, shardings)
         self.params = params
-        self.pages = init_pages(self.config, n_pages, page_size)
-        if plan is not None:
-            self.pages = jax.device_put(self.pages, plan.replicated())
 
+        kv_out = ((self._kv_sharding, self._kv_sharding)
+                  if self.shard_kv else None)
         self._prefill_fns = {}
         self._chunk_fns = {}
         # one jit wrapper; each prefill bucket's [L, Pb, ...] shape gets its
         # own cached executable automatically
-        self._commit_fn = jax.jit(commit_prefill, donate_argnums=(0, 1))
-        self._copy_fn = jax.jit(copy_pages, donate_argnums=(0, 1))
-        self._decode_fn = jax.jit(self._decode, donate_argnums=(1, 2))
+        self._commit_fn = jax.jit(commit_impl, donate_argnums=(0, 1),
+                                  **({"out_shardings": kv_out}
+                                     if kv_out else {}))
+        self._copy_fn = jax.jit(copy_impl, donate_argnums=(0, 1),
+                                **({"out_shardings": kv_out}
+                                   if kv_out else {}))
+        self._decode_fn = jax.jit(
+            self._decode, donate_argnums=(1, 2),
+            **({"out_shardings": (self._repl, self._repl,
+                                  self._kv_sharding, self._kv_sharding)}
+               if self.shard_kv else {}))
         self._sample_one = jax.jit(
             lambda logit, seed, pos, t, tk, tp: _sample_tokens(
                 logit[None], seed[None], pos[None], t[None], tk[None],
                 tp[None])[0])
-        # chunked-prefill state per slot + the device-resident steady
-        # decode arrays (None = rebuild from the scheduler next decode)
-        self._pending: dict[int, Admission] = {}
-        self._dev: Optional[dict] = None
-        # decode throughput counters (api.py metrics)
-        self.decode_steps = 0
-        self.decode_tokens = 0
+
+    # ---- state placement ---------------------------------------------------
+    def init_device_pages(self, n_pages: int, page_size: int) -> dict:
+        """Zeroed pools placed per the serve sharding rules (kv-head
+        split under shard_kv, replicated under a plain plan)."""
+        pages = init_pages(self.config, n_pages, page_size)
+        if self.shard_kv:
+            return jax.device_put(pages, {"k": self._kv_sharding,
+                                          "v": self._kv_sharding})
+        if self.plan is not None:
+            return jax.device_put(pages, self.plan.replicated())
+        return pages
+
+    def make_attend(self, tables, lengths, *, impl: Optional[str] = None,
+                    n_valid=None):
+        """The per-layer attend callback — shard_map'd per-chip pool
+        slices under shard_kv, the plain callback otherwise."""
+        impl = self.attend_impl if impl is None else impl
+        if self.shard_kv:
+            from .sharding import make_sharded_attend
+
+            return make_sharded_attend(self.mesh, tables, lengths,
+                                       impl=impl, n_valid=n_valid)
+        return make_attend(tables, lengths, impl=impl, n_valid=n_valid)
 
     # ---- compiled programs -------------------------------------------------
     def _decode(self, params, kp, vp, tokens, lengths, tables, seeds, temps,
                 top_ks, top_ps, actives):
-        attend = make_attend(tables, lengths, impl=self.attend_impl)
+        attend = self.make_attend(tables, lengths)
         logits, cache = self.mod.paged_decode_step(
             self.config, params, tokens[:, None], lengths,
             {"k": kp, "v": vp}, attend)
@@ -224,7 +422,7 @@ class ServeEngine:
         return nxt, jnp.where(actives, lengths + 1, lengths), \
             cache["k"], cache["v"]
 
-    def _prefill_for(self, bucket: int):
+    def prefill_for(self, bucket: int):
         if bucket not in self._prefill_fns:
             def fn(params, ids, last_pos):
                 cache = self.mod.init_cache(self.config, 1, bucket)
@@ -235,7 +433,7 @@ class ServeEngine:
             self._prefill_fns[bucket] = jax.jit(fn)
         return self._prefill_fns[bucket]
 
-    def _chunk_for(self, t: int):
+    def chunk_for(self, t: int):
         """The ONE chunk-prefill program: [1, t] tokens run the paged
         decode path (gather impl — a chunk is compute-bound and needs the
         multi-token attend), writing their k/v into the slot's pages at
@@ -244,27 +442,139 @@ class ServeEngine:
         page; ``last_index`` picks the real last token's logits."""
         if t not in self._chunk_fns:
             def fn(params, kp, vp, ids, start, table, last_index, n_valid):
-                attend = make_attend(table, start, impl="xla",
-                                     n_valid=n_valid)
+                attend = self.make_attend(table, start, impl="xla",
+                                          n_valid=n_valid)
                 logits, cache = self.mod.paged_decode_step(
                     self.config, params, ids, start, {"k": kp, "v": vp},
                     attend, last_index=last_index)
                 return logits[0], cache["k"], cache["v"]
 
-            self._chunk_fns[t] = jax.jit(fn, donate_argnums=(1, 2))
+            kv_out = ((self._repl, self._kv_sharding, self._kv_sharding)
+                      if self.shard_kv else None)
+            self._chunk_fns[t] = jax.jit(
+                fn, donate_argnums=(1, 2),
+                **({"out_shardings": kv_out} if kv_out else {}))
         return self._chunk_fns[t]
 
-    # ---- serving loop ------------------------------------------------------
-    def submit(self, request: Request) -> int:
-        # range-check ids here (the scheduler is model-agnostic): under jit
-        # the embedding gather CLAMPS out-of-range ids, so an unchecked
-        # prompt would return garbage generations with a 200 instead of
-        # being refused
+    def sample_one(self, logit, request: Request, position: int):
+        """Batch-1 sample off prefill logits (the request's first token)."""
+        return self._sample_one(
+            logit.astype(jnp.float32), jnp.asarray(request.seed, jnp.int32),
+            jnp.asarray(position, jnp.int32),
+            jnp.asarray(request.temperature, jnp.float32),
+            jnp.asarray(request.top_k, jnp.int32),
+            jnp.asarray(request.top_p, jnp.float32))
+
+    def check_prompt(self, request: Request) -> None:
+        """Range-check prompt ids (the scheduler is model-agnostic): under
+        jit the embedding gather CLAMPS out-of-range ids, so an unchecked
+        prompt would return garbage generations with a 200 instead of
+        being refused."""
         v = self.config.vocab_size
         bad = [t for t in request.prompt_ids if not 0 <= int(t) < v]
         if bad:
             raise ValueError(
                 f"prompt ids {bad[:5]} out of range for vocab_size {v}")
+
+
+class ServeEngine:
+    """Multi-request generation over a model family's KV-cache decode.
+
+    Drive it either through ``serve/api.py`` (``generate_many`` /
+    ``serve_http``) or directly: ``submit(Request(...))`` then ``step()``
+    in a loop — each ``step`` is one scheduler iteration (deadline expiry
+    + grow/preempt + admit + prefill work + one batched decode) and
+    returns whatever finished.
+
+    ``prefix_cache`` (default on): committed prompt pages register in a
+    content-keyed cache so identical prefixes share physical pages across
+    requests (refcounted, copy-on-write). ``prefill_chunk=N`` streams
+    prompts through the paged path N tokens per iteration instead of one
+    bucketed prefill (long prompts stop stalling resident decodes; also
+    unlocks mid-page prefix reuse). ``attend_impl`` picks the decode
+    attend: "auto" (flash kernel on TPU, gather elsewhere), "flash",
+    "xla". ``max_queue`` bounds the admission queue — submits past it
+    refuse with a 429-class RefusalError (backpressure the HTTP layer
+    forwards verbatim).
+
+    Under a multi-device ``plan=``, params shard as in training while the
+    page pool stays replicated; ``shard_kv=True`` additionally splits the
+    pool on the kv-head axis and runs the attend (flash kernel included)
+    shard_map'd with per-chip pool slices — the distributed-pool mode
+    (tp-only meshes; see serve/sharding.py).
+    """
+
+    def __init__(self, bundle: ModelBundle, params, *, n_slots: int = 8,
+                 page_size: int = 16, n_pages: Optional[int] = None,
+                 max_len: Optional[int] = None,
+                 prefill_buckets: Optional[tuple] = None, plan=None,
+                 prefill_chunk: Optional[int] = None,
+                 prefix_cache: bool = True, attend_impl: str = "auto",
+                 shard_kv: bool = False, max_queue: Optional[int] = None,
+                 programs: Optional[ModelPrograms] = None):
+        self.programs = programs if programs is not None else ModelPrograms(
+            bundle, params, plan=plan, shard_kv=shard_kv,
+            attend_impl=attend_impl)
+        self.bundle = self.programs.bundle
+        self.config = self.programs.config
+        self.mod = self.programs.mod
+        self.plan = self.programs.plan
+        self.attend_impl = self.programs.attend_impl
+        if prefill_chunk is not None and prefill_chunk < 1:
+            raise ValueError(f"prefill_chunk must be >= 1, got "
+                             f"{prefill_chunk}")
+        self.prefill_chunk = prefill_chunk
+        max_len, self.max_model_len, self.max_pages = \
+            resolve_context_bounds(self.config, max_len, page_size)
+        self.page_size = page_size
+        self.n_slots = n_slots
+        if n_pages is None:
+            # default: full residency + the trash page — backpressure /
+            # preemption only engage when the caller sizes the pool below
+            n_pages = 1 + n_slots * self.max_pages
+        pool = PagePool(n_pages, page_size)
+        self.scheduler = Scheduler(
+            n_slots=n_slots, pool=pool, max_len=self.max_model_len,
+            max_pages_per_slot=self.max_pages, prefix_cache=prefix_cache,
+            max_queue=max_queue,
+            # mid-page prefix reuse needs the chunked path: a bucketed
+            # prefill recomputes from position 0 anyway, so only aligned
+            # (full-page) sharing pays for itself there
+            allow_partial_share=prefill_chunk is not None)
+        if prefill_buckets is None:
+            prefill_buckets = default_prefill_buckets(self.max_pages,
+                                                      page_size)
+        self.prefill_buckets = validate_prefill_buckets(
+            prefill_buckets, max_pages=self.max_pages, page_size=page_size,
+            max_model_len=self.max_model_len)
+
+        self.pages = self.programs.init_device_pages(n_pages, page_size)
+
+        # chunked-prefill state per slot + the device-resident steady
+        # decode arrays (None = rebuild from the scheduler next decode)
+        self._pending: dict[int, Admission] = {}
+        self._dev: Optional[dict] = None
+        # decode throughput + latency counters (api.py metrics; all
+        # host-side — see stats())
+        self.decode_steps = 0
+        self.decode_tokens = 0
+        self._lat = LatencyMeter()
+
+    # ---- delegation (kept public: tests/bench lower these directly) --------
+    @property
+    def params(self):
+        return self.programs.params
+
+    @property
+    def _decode_fn(self):
+        return self.programs._decode_fn
+
+    # ---- serving loop ------------------------------------------------------
+    def submit(self, request: Request) -> int:
+        try:
+            self.programs.check_prompt(request)
+        except ValueError as exc:
+            self.scheduler.refuse("bad_prompt", str(exc))
         return self.scheduler.submit(request)
 
     @property
@@ -273,131 +583,59 @@ class ServeEngine:
 
     def kv_cache_bytes(self) -> int:
         """Resident KV bytes — scales with the page pool, NOT with
-        n_slots x max_len (the memory pin in tests/test_serve.py)."""
+        n_slots x max_len (the memory pin in tests/test_serve.py).
+        Global bytes: under shard_kv each chip holds 1/tp of this."""
         return int(self.pages["k"].nbytes + self.pages["v"].nbytes)
-
-    def _bucket_for(self, n: int) -> int:
-        for b in self.prefill_buckets:
-            if b >= n:
-                return b
-        raise ValueError(f"prompt length {n} exceeds the largest prefill "
-                         f"bucket {self.prefill_buckets[-1]}")
-
-    def _run_fork(self, adm: Admission) -> None:
-        """Device side of the CoW bookkeeping: the remainder prefill is
-        about to write into the partially-shared page, so its content is
-        copied into the slot's private replacement first."""
-        src, dst = adm.fork
-        self.pages["k"], self.pages["v"] = self._copy_fn(
-            self.pages["k"], self.pages["v"],
-            jnp.asarray(src, jnp.int32), jnp.asarray(dst, jnp.int32))
 
     def _sample_first(self, adm: Admission, logit) -> Optional[RequestResult]:
         """First token off the prefill logits (skipped for preempted
         sequences — their next token was generated before preemption)."""
-        req = adm.request
-        n = len(adm.tokens)
-        t0 = self._sample_one(
-            logit.astype(jnp.float32), jnp.asarray(req.seed, jnp.int32),
-            jnp.asarray(n, jnp.int32),
-            jnp.asarray(req.temperature, jnp.float32),
-            jnp.asarray(req.top_k, jnp.int32),
-            jnp.asarray(req.top_p, jnp.float32))
+        t0 = self.programs.sample_one(logit, adm.request, len(adm.tokens))
         return self.scheduler.record_token(adm.slot_idx, int(t0),
                                            from_decode=False)
 
-    def _admit_bucket(self, adm: Admission) -> Optional[RequestResult]:
-        """Whole-context prefill through the family's bucketed program;
-        the commit scatter skips the shared prefix (those pages are other
-        sequences' territory) and the pad tail."""
-        tokens = adm.tokens
-        n = len(tokens)
-        bucket = self._bucket_for(n)
-        ids = np.zeros((1, bucket), np.int32)
-        ids[0, :n] = tokens
-        logit, kd, vd = self._prefill_for(bucket)(
-            self.params, jnp.asarray(ids), jnp.asarray(n - 1))
-        table_row = jnp.asarray(self.scheduler.table_row(adm.slot_idx))
-        self.pages["k"], self.pages["v"] = self._commit_fn(
-            self.pages["k"], self.pages["v"], kd, vd, table_row,
-            jnp.asarray(n), jnp.asarray(adm.shared_len))
-        self.scheduler.commit_tokens(adm.slot_idx, n - adm.shared_len)
+    def _on_prefill_complete(self, adm: Admission,
+                             logit) -> Optional[RequestResult]:
+        """The slot's pages are fully committed: it joins the decode
+        batch (device arrays rebuild) with its first token sampled —
+        unless it is a resumed sequence, whose tokens already exist."""
+        self._dev = None
         if adm.resumed:
             return None
         return self._sample_first(adm, logit)
 
-    def _advance_prefill(self) -> list[RequestResult]:
-        """Run up to ``prefill_chunk`` prompt tokens through the chunk
-        program, oldest prefilling slot first — the per-iteration budget
-        that bounds how much a long prompt can delay the co-resident
-        decode step that follows."""
-        finished = []
-        sched = self.scheduler
-        t = self.prefill_chunk
-        budget = t
-        for slot_idx in sched.prefilling_indices():
-            if budget <= 0:
-                break
-            adm = self._pending.get(slot_idx)
-            if adm is None:        # pre-chunking admission (mode switch)
-                continue
-            slot = sched.slots[slot_idx]
-            start = slot.cache_len
-            real = min(t, slot.target_len - start)
-            # budget is charged at the PROGRAM cost (the chunk is padded
-            # to t whatever `real` is) — charging real tokens would let N
-            # slots with short final chunks run N full-width forwards in
-            # one iteration, exactly the latency spike the budget bounds
-            budget -= t
-            ids = np.zeros((1, t), np.int32)
-            ids[0, :real] = adm.tokens[start:start + real]
-            logit, self.pages["k"], self.pages["v"] = self._chunk_for(t)(
-                self.params, self.pages["k"], self.pages["v"],
-                jnp.asarray(ids), jnp.asarray([start], jnp.int32),
-                jnp.asarray(sched.table_row(slot_idx)[None]),
-                jnp.asarray(real - 1, jnp.int32),
-                jnp.asarray([real], jnp.int32))
-            sched.commit_tokens(slot_idx, real)
-            if not sched.slots[slot_idx].prefilling:   # final chunk landed
-                self._pending.pop(slot_idx)
-                self._dev = None   # the slot joins the decode batch
-                if not adm.resumed:
-                    res = self._sample_first(adm, logit)
-                    if res is not None:
-                        finished.append(res)
-        return finished
-
-    def _drop_stale_pending(self) -> None:
-        """Preemption may have evicted a mid-prefill slot; its chunk state
-        must go with it (the slot will be re-admitted from the queue)."""
-        for idx in list(self._pending):
-            slot = self.scheduler.slots[idx]
-            adm = self._pending[idx]
-            if (slot is None
-                    or slot.request.request_id != adm.request.request_id):
-                del self._pending[idx]
-
     def step(self) -> list[RequestResult]:
-        """One scheduler iteration: grow running decodes (preempting the
-        youngest on true exhaustion), admit whatever now fits (sharing
-        cached prefixes), advance prefill work (whole-bucket, or one
+        """One scheduler iteration: expire deadlines (clean eviction at
+        the boundary), grow running decodes (preempting the cheapest on
+        true exhaustion), admit whatever now fits (sharing cached
+        prefixes), advance prefill work (whole-bucket, or one
         chunk-budget's worth), then ONE batched decode over the decoding
         slots. Returns finished requests."""
         finished = []
         sched = self.scheduler
+        expired = sched.expire_deadlines()
+        if expired:
+            self._dev = None
+            drop_stale_pending(sched, self._pending)
+            finished.extend(expired)
         admissions = sched.try_admit()
         for adm in admissions:
             self._dev = None
             if adm.fork is not None:
-                self._run_fork(adm)
+                run_fork(self.programs, self.pages, adm)
             if self.prefill_chunk is None:
-                res = self._admit_bucket(adm)
+                logit = run_bucket_prefill(self.programs, self.pages,
+                                           sched, adm,
+                                           self.prefill_buckets)
+                res = self._on_prefill_complete(adm, logit)
                 if res is not None:        # eos/length on the first token
                     finished.append(res)
             else:
                 self._pending[adm.slot_idx] = adm
         if self._pending:
-            finished.extend(self._advance_prefill())
+            finished.extend(advance_prefill_chunks(
+                self.programs, self.pages, sched, self._pending,
+                self.prefill_chunk, self._on_prefill_complete))
 
         # growth runs LAST before the decode so every slot in the batch —
         # including one admitted or chunk-completed this very iteration
@@ -407,7 +645,7 @@ class ServeEngine:
         if grown or preempted:
             self._dev = None
             if preempted:
-                self._drop_stale_pending()
+                drop_stale_pending(sched, self._pending)
 
         active = sched.active_indices()
         if active:
@@ -429,20 +667,50 @@ class ServeEngine:
                 if res is not None:
                     finished.append(res)
                     self._dev = None       # the slot left the batch
+        self._lat.note(finished)
         return finished
+
+    # ---- metrics (host-side only — safe from any thread) -------------------
+    def partial_tokens(self) -> dict:
+        """request_id -> tokens generated so far, for every LIVE sequence
+        — the streaming layer's tap. Pure host bookkeeping (the tokens
+        were already read back for EOS checks), so the HTTP worker can
+        push per-token deltas without extra device traffic. Dedup by
+        count on the consumer side: the list only ever grows (a
+        post-preemption replay rewrites k/v, not tokens)."""
+        out = {}
+        for slot in self.scheduler.slots:
+            if slot is not None and slot.generated:
+                out[slot.request.request_id] = list(slot.generated)
+        return out
+
+    def stats(self) -> dict:
+        """Metrics snapshot WITHOUT acquiring the device or any lock:
+        every value is host-side Python the scheduler/engine already
+        maintains, so ``/healthz`` answers mid-decode-iteration (reads
+        are individually atomic under the GIL; the snapshot is
+        best-effort consistent, which is what a health probe wants)."""
+        sched = self.scheduler
+        s = {k: (dict(v) if isinstance(v, dict) else v)
+             for k, v in sched.stats.items()}
+        return {
+            **s,
+            "queued": len(sched.queue),
+            "active_slots": len(sched.active_indices()),
+            "prefilling_slots": len(sched.prefilling_indices()),
+            **derived_pool_metrics(
+                pool=sched.pool, cached_pages=sched.cache_pages_held(),
+                n_slots=self.n_slots, decode_steps=self.decode_steps,
+                decode_tokens=self.decode_tokens,
+                admitted=s.get("admitted", 0),
+                prefix_hits=s.get("prefix_hits", 0), lat=self._lat),
+        }
 
     def kv_report(self) -> dict:
         """The preflight-style byte table for this engine's pool."""
-        pool = self.scheduler.pool
-        return {
-            "page_size": self.page_size,
-            "n_pages": pool.n_pages,
-            "pages_free": pool.n_free,
-            "pages_cached": self.scheduler.cache_pages_held(),
-            "bytes_per_page": kv_page_bytes(self.config,
-                                            page_size=self.page_size),
-            "pool_bytes": self.kv_cache_bytes(),
-            "dense_equivalent_bytes": kv_page_bytes(
-                self.config, page_size=self.page_size,
-                n_pages=self.n_slots * self.max_pages),
-        }
+        return build_kv_report(
+            self.programs, page_size=self.page_size,
+            pool=self.scheduler.pool,
+            cached_pages=self.scheduler.cache_pages_held(),
+            n_slots=self.n_slots, max_pages=self.max_pages,
+            pool_bytes=self.kv_cache_bytes())

@@ -9,11 +9,24 @@ duration of the longest request).
 
 Policy (the PagedAttention second half, Kwon et al. arXiv:2309.06180):
 
-- FIFO admission, OPTIMISTIC: the queue head admits when a slot is free
-  AND the pool grants the pages its *current context* needs (prompt, or
-  prompt + recompute suffix) — not the old worst-case
-  ``pages_for_tokens(prompt + max_new)`` reservation that idled pages a
-  short answer never touched. Strict order, no lookahead.
+- PRIORITY-then-FIFO admission, OPTIMISTIC: the queue is ordered by
+  request priority (higher admits first), FIFO within a class; the head
+  admits when a slot is free AND the pool grants the pages its *current
+  context* needs (prompt, or prompt + recompute suffix) — not the old
+  worst-case ``pages_for_tokens(prompt + max_new)`` reservation that
+  idled pages a short answer never touched. Strict order within the
+  priority ordering, no lookahead.
+- DEADLINES: a request may carry ``deadline_s`` (seconds from submit).
+  ``expire_deadlines`` runs at every iteration boundary: an expired
+  queued entry is removed, an expired RUNNING sequence is evicted
+  CLEANLY (pages freed, partial tokens returned, finish_reason
+  "deadline") — expiry is an orderly eviction through the same
+  bookkeeping as EOS, never a mid-iteration abort.
+- REFUSALS are structured: everything submit rejects raises
+  :class:`RefusalError` carrying a machine-readable ``reason`` +
+  suggested HTTP status + the current queue depth, and
+  ``stats["refused"]`` counts refusals by reason (the HTTP layer
+  returns the body verbatim instead of an opaque status).
 - Growth on demand: a decoding sequence takes one page whenever its next
   token crosses a page boundary. On true exhaustion the scheduler first
   evicts idle prefix-cache pages, then PREEMPTS the youngest sequence —
@@ -51,12 +64,26 @@ from __future__ import annotations
 import dataclasses
 import itertools
 import time
-from collections import deque
 from typing import Optional
 
 import numpy as np
 
 from .kv_pages import TRASH_PAGE, PagePool, pages_for_tokens
+
+
+class RefusalError(ValueError):
+    """A structured scheduler refusal: ``reason`` is a stable
+    machine-readable slug (counted in ``stats['refused']``),
+    ``http_status`` the suggested mapping (429 for backpressure, 400 for
+    a request that could never run), ``detail`` whatever load context the
+    client should see (always includes ``queue_depth``)."""
+
+    def __init__(self, reason: str, message: str, *, http_status: int = 400,
+                 detail: Optional[dict] = None):
+        super().__init__(message)
+        self.reason = reason
+        self.http_status = http_status
+        self.detail = dict(detail or {})
 
 
 @dataclasses.dataclass
@@ -65,7 +92,10 @@ class Request:
     and ``top_p >= 1`` disable those filters. ``seed`` drives the slot's
     private RNG stream (sampling keys are fold_in(seed, absolute token
     position) — deterministic per request, independent of admission order,
-    co-residents, AND preemption/recompute)."""
+    co-residents, AND preemption/recompute). ``priority`` orders admission
+    (higher first, FIFO within a class); ``deadline_s`` (seconds from
+    submit) evicts the request cleanly at the first iteration boundary
+    past the deadline, queued or running."""
 
     prompt_ids: list
     max_new_tokens: int = 32
@@ -74,6 +104,8 @@ class Request:
     top_p: float = 1.0
     seed: int = 0
     eos_id: Optional[int] = None
+    priority: int = 0
+    deadline_s: Optional[float] = None
     request_id: Optional[int] = None  # assigned at submit
 
 
@@ -82,10 +114,11 @@ class RequestResult:
     request_id: int
     prompt_ids: list
     generated_ids: list
-    finish_reason: str              # "eos" | "length"
+    finish_reason: str              # "eos" | "length" | "deadline"
     submitted_at: float
     admitted_at: float
     finished_at: float
+    first_token_at: float = 0.0     # 0.0 = no token ever produced
 
     @property
     def token_ids(self) -> list:
@@ -98,6 +131,20 @@ class RequestResult:
     @property
     def queue_s(self) -> float:
         return self.admitted_at - self.submitted_at
+
+    @property
+    def ttft_s(self) -> float:
+        """Time to first token (the streaming layer's headline metric)."""
+        return (self.first_token_at - self.submitted_at
+                if self.first_token_at else 0.0)
+
+    @property
+    def itl_s(self) -> float:
+        """Mean inter-token latency over tokens after the first."""
+        n = len(self.generated_ids)
+        if n < 2 or not self.first_token_at:
+            return 0.0
+        return (self.finished_at - self.first_token_at) / (n - 1)
 
 
 @dataclasses.dataclass
@@ -112,6 +159,7 @@ class _Slot:
     prefilling: bool                # True until cache_len == target_len
     shared_len: int = 0             # tokens taken from the prefix cache
     resumed: bool = False           # re-admission after preemption
+    first_token_at: float = 0.0     # survives preemption via _QueueEntry
     # index of the token the next decode step consumes. Normal slots sit
     # at len(generated) - 1 (the newest sample); a resumed slot starts at
     # 0 and REPLAYS its recorded tokens through the decode program —
@@ -130,6 +178,7 @@ class _QueueEntry:
     tokens it had already generated (the recompute state)."""
     request: Request
     generated: list = dataclasses.field(default_factory=list)
+    first_token_at: float = 0.0
 
 
 @dataclasses.dataclass
@@ -260,25 +309,68 @@ class Scheduler:
     def __init__(self, *, n_slots: int, pool: PagePool, max_len: int,
                  max_pages_per_slot: int, clock=time.monotonic,
                  prefix_cache: bool = True,
-                 allow_partial_share: bool = False):
+                 allow_partial_share: bool = False,
+                 max_queue: Optional[int] = None,
+                 admission_headroom=None):
         if n_slots < 1:
             raise ValueError(f"n_slots must be >= 1, got {n_slots}")
+        if max_queue is not None and max_queue < 1:
+            raise ValueError(f"max_queue must be >= 1, got {max_queue}")
         self.n_slots = n_slots
         self.pool = pool
         self.max_len = max_len
         self.max_pages = max_pages_per_slot
+        self.max_queue = max_queue
         self.slots: list[Optional[_Slot]] = [None] * n_slots
-        self.queue: deque = deque()
+        # priority-ordered (higher first, FIFO within a class); index 0 is
+        # the admission head. Plain list: depths are human-scale and the
+        # ordered insert keeps every existing head/pop call site simple.
+        self.queue: list[_QueueEntry] = []
         self._ids = itertools.count()
         self._seq = itertools.count()
         self._clock = clock
         self._submit_times: dict[int, float] = {}
-        self.cache = PrefixCache(pool) if prefix_cache else None
+        # prefix_cache may be a PrefixCache INSTANCE: the disaggregated
+        # decode scheduler shares the prefill side's cache so its
+        # growth-under-pressure can evict idle cached pages too (it never
+        # registers or matches — admission lives on the prefill side)
+        self.cache = (prefix_cache if isinstance(prefix_cache, PrefixCache)
+                      else (PrefixCache(pool) if prefix_cache else None))
         self.allow_partial_share = allow_partial_share
+        # extra admission headroom beyond THIS scheduler's running decodes
+        # — the disaggregated prefill scheduler has no decoding slots of
+        # its own, so its engine threads the DECODE side's count through
+        # this hook (admitting into that margin trades one admission for
+        # immediate preemption churn over there)
+        self._headroom_fn = admission_headroom
         self.stats = {"admission_blocked": 0, "admitted": 0, "finished": 0,
                       "preempted": 0, "prefix_hits": 0,
                       "prefix_tokens_shared": 0, "cow_forks": 0,
-                      "cache_evicted_pages": 0}
+                      "cache_evicted_pages": 0, "deadline_expired": 0,
+                      "refused": {}}
+
+    # ---- refusals / queue order --------------------------------------------
+    def refuse(self, reason: str, message: str, *, http_status: int = 400,
+               **detail):
+        """Count + raise a structured refusal (see RefusalError)."""
+        self.stats["refused"][reason] = \
+            self.stats["refused"].get(reason, 0) + 1
+        raise RefusalError(reason, message, http_status=http_status,
+                           detail={"queue_depth": len(self.queue), **detail})
+
+    def _queue_insert(self, entry: _QueueEntry, *, front: bool = False) -> None:
+        """Ordered insert: after every entry of >= priority (submit — FIFO
+        within the class), or before every entry of <= priority (``front``
+        — a preempted sequence re-enters at the head of its class, but
+        never ahead of strictly higher-priority work)."""
+        p = entry.request.priority
+        if front:
+            i = next((i for i, e in enumerate(self.queue)
+                      if e.request.priority <= p), len(self.queue))
+        else:
+            i = next((i for i, e in enumerate(self.queue)
+                      if e.request.priority < p), len(self.queue))
+        self.queue.insert(i, entry)
 
     # ---- allocation under pressure -----------------------------------------
     def _ensure_free(self, n: int) -> bool:
@@ -308,56 +400,72 @@ class Scheduler:
 
     # ---- admission ---------------------------------------------------------
     def submit(self, request: Request) -> int:
-        """Validate + enqueue; returns the request id. Raises on requests
+        """Validate + enqueue; returns the request id. Refuses requests
         that could NEVER run (empty prompt, context past max_len, worst-case
         pages past the whole pool — with preemption-by-recompute the pool
         must still fit ONE worst-case request or the retry loop could never
-        terminate) — refusing at submit keeps the FIFO head from
-        deadlocking the queue forever."""
+        terminate) with a 400-class RefusalError, and refuses on a full
+        queue (``max_queue`` backpressure) with a 429-class one — refusing
+        at submit keeps the queue head from deadlocking forever, and the
+        structured reason keeps the client from guessing why."""
         n = len(request.prompt_ids)
         if n < 1:
-            raise ValueError("empty prompt")
+            self.refuse("empty_prompt", "empty prompt")
         if request.max_new_tokens < 1:
-            raise ValueError(
-                f"max_new_tokens must be >= 1, got {request.max_new_tokens}")
+            self.refuse("bad_params",
+                        f"max_new_tokens must be >= 1, got "
+                        f"{request.max_new_tokens}")
         if not 0.0 <= request.temperature:
-            raise ValueError(f"temperature must be >= 0, got "
-                             f"{request.temperature}")
+            self.refuse("bad_params", f"temperature must be >= 0, got "
+                        f"{request.temperature}")
         if not 0.0 < request.top_p <= 1.0:
-            raise ValueError(f"top_p must be in (0, 1], got {request.top_p}")
+            self.refuse("bad_params",
+                        f"top_p must be in (0, 1], got {request.top_p}")
         if not 0 <= request.seed < 2 ** 31:
             # the engine carries seeds as int32 arrays; refusing here beats
             # an OverflowError mid-flight with the slot already admitted
-            raise ValueError(
-                f"seed must fit int32 (0 <= seed < 2**31), got {request.seed}")
+            self.refuse("bad_params",
+                        f"seed must fit int32 (0 <= seed < 2**31), got "
+                        f"{request.seed}")
         if not -(2 ** 31) <= request.top_k < 2 ** 31:
             # same int32 path as seed (decode_arrays): an unchecked top_k
             # would overflow AFTER admission and kill the engine thread
             # (top_k <= 0 stays a valid "disabled")
-            raise ValueError(
-                f"top_k must fit int32, got {request.top_k}")
+            self.refuse("bad_params", f"top_k must fit int32, got "
+                        f"{request.top_k}")
+        if request.deadline_s is not None and request.deadline_s <= 0:
+            self.refuse("bad_params", f"deadline_s must be > 0, got "
+                        f"{request.deadline_s}")
         total = n + request.max_new_tokens
         if total > self.max_len:
-            raise ValueError(
+            self.refuse(
+                "context_too_long",
                 f"prompt ({n}) + max_new_tokens ({request.max_new_tokens}) "
                 f"= {total} exceeds the engine's max_len ({self.max_len})")
         if pages_for_tokens(total, self.pool.page_size) > self.pool.capacity:
-            raise ValueError(
+            self.refuse(
+                "exceeds_pool",
                 f"request needs {pages_for_tokens(total, self.pool.page_size)}"
                 f" pages, more than the whole pool ({self.pool.capacity}) — "
                 f"it could never run to completion even alone")
+        if self.max_queue is not None and len(self.queue) >= self.max_queue:
+            self.refuse(
+                "queue_full",
+                f"admission queue is full ({len(self.queue)} >= "
+                f"{self.max_queue}); retry later", http_status=429)
         request = dataclasses.replace(request,
                                       request_id=next(self._ids))
         self._submit_times[request.request_id] = self._clock()
-        self.queue.append(_QueueEntry(request))
+        self._queue_insert(_QueueEntry(request))
         return request.request_id
 
     def try_admit(self) -> list[Admission]:
-        """Admit FIFO-head entries while a slot is free and the pool (after
-        prefix sharing) grants the CURRENT context's pages. Preempted
-        entries sit at the queue head and re-admit first — their context
-        includes the tokens already generated (recompute). The engine runs
-        each admission's fork copy + prefill, reporting progress through
+        """Admit queue-head entries (priority order, FIFO within a class)
+        while a slot is free and the pool (after prefix sharing) grants the
+        CURRENT context's pages. Preempted entries sit at the head of
+        their priority class and re-admit first — their context includes
+        the tokens already generated (recompute). The engine runs each
+        admission's fork copy + prefill, reporting progress through
         ``commit_tokens``."""
         admissions = []
         page = self.pool.page_size
@@ -390,7 +498,10 @@ class Scheduler:
             # headroom: every running decode may need a page within one
             # page_size worth of steps — admitting into that margin would
             # trade one prompt's admission for immediate preemption churn
-            priv = self._alloc(n_priv, headroom=len(self.active_indices()))
+            # (decodes running in a sibling scheduler count via the hook)
+            headroom = len(self.active_indices()) + (
+                self._headroom_fn() if self._headroom_fn else 0)
+            priv = self._alloc(n_priv, headroom=headroom)
             if protect:
                 # safe to release now: if the source node was evicted
                 # above, its page can only be re-issued to a LATER
@@ -414,14 +525,14 @@ class Scheduler:
             if shared_len:
                 self.stats["prefix_hits"] += 1
                 self.stats["prefix_tokens_shared"] += shared_len
-            self.queue.popleft()
+            self.queue.pop(0)
             self.slots[slot_idx] = _Slot(
                 request=req, pages=shared_pages + priv,
                 generated=list(entry.generated), cache_len=shared_len,
                 admitted_at=self._clock(), seq=next(self._seq),
                 target_len=len(tokens), prefilling=True,
                 shared_len=shared_len, resumed=bool(entry.generated),
-                replay_pos=0)
+                replay_pos=0, first_token_at=entry.first_token_at)
             self.stats["admitted"] += 1
             admissions.append(Admission(
                 slot_idx=slot_idx, request=req, tokens=tokens,
@@ -453,24 +564,24 @@ class Scheduler:
     # ---- growth + preemption ----------------------------------------------
     def preempt(self, slot_idx: int) -> None:
         """Cleanly un-admit a sequence: its pages' references drop, its
-        (request, generated-so-far) re-enters the queue HEAD, and the next
-        admission recomputes the context — no token it already produced is
-        lost or changed (position-keyed sampling), no running sequence is
-        ever corrupted."""
+        (request, generated-so-far) re-enters at the HEAD of its priority
+        class, and the next admission recomputes the context — no token it
+        already produced is lost or changed (position-keyed sampling), no
+        running sequence is ever corrupted."""
         slot = self.slots[slot_idx]
         assert slot is not None, f"preempting idle slot {slot_idx}"
         self.pool.free(slot.pages)
         self.slots[slot_idx] = None
-        self.queue.appendleft(_QueueEntry(slot.request,
-                                          list(slot.generated)))
+        self._queue_insert(_QueueEntry(slot.request, list(slot.generated),
+                                       slot.first_token_at), front=True)
         self.stats["preempted"] += 1
 
     def grow_for_decode(self) -> tuple[int, int]:
         """Before a decode step: every decoding slot must own the page its
         next write lands in. Oldest slots grow first; on exhaustion the
-        YOUNGEST live sequence is preempted (possibly the grower itself,
-        when it is the youngest left) and its pages fund the others.
-        Returns (pages_grown, preempted)."""
+        LOWEST-PRIORITY live sequence is preempted, youngest first within
+        a class (possibly the grower itself, when nothing cheaper is left)
+        and its pages fund the others. Returns (pages_grown, preempted)."""
         grown = preempted = 0
         order = sorted((i for i, s in enumerate(self.slots)
                         if s is not None and not s.prefilling),
@@ -487,11 +598,12 @@ class Scheduler:
                     continue
                 victim = max((i for i, s in enumerate(self.slots)
                               if s is not None),
-                             key=lambda i: self.slots[i].seq)
+                             key=lambda i: (-self.slots[i].request.priority,
+                                            self.slots[i].seq))
                 self.preempt(victim)
                 preempted += 1
                 if victim == slot_idx:
-                    break           # the grower itself was youngest
+                    break           # the grower itself was the victim
         return grown, preempted
 
     # ---- decode bookkeeping ------------------------------------------------
@@ -514,6 +626,8 @@ class Scheduler:
             return None
         slot.generated.append(int(token))
         slot.replay_pos = len(slot.generated) - 1
+        if not slot.first_token_at:
+            slot.first_token_at = self._clock()
         req = slot.request
         finished = None
         if req.eos_id is not None and token == req.eos_id:
@@ -529,7 +643,87 @@ class Scheduler:
             request_id=req.request_id, prompt_ids=list(req.prompt_ids),
             generated_ids=list(slot.generated), finish_reason=finished,
             submitted_at=self._submit_times.pop(req.request_id),
-            admitted_at=slot.admitted_at, finished_at=self._clock())
+            admitted_at=slot.admitted_at, finished_at=self._clock(),
+            first_token_at=slot.first_token_at)
+
+    # ---- deadlines ---------------------------------------------------------
+    def _deadline_result(self, req: Request, generated: list,
+                         admitted_at: float, first_token_at: float,
+                         now: float) -> RequestResult:
+        self.stats["deadline_expired"] += 1
+        return RequestResult(
+            request_id=req.request_id, prompt_ids=list(req.prompt_ids),
+            generated_ids=list(generated), finish_reason="deadline",
+            submitted_at=self._submit_times.pop(req.request_id),
+            admitted_at=admitted_at, finished_at=now,
+            first_token_at=first_token_at)
+
+    def expire_deadlines(self, now: Optional[float] = None) \
+            -> list[RequestResult]:
+        """Evict everything past its deadline — queued entries leave the
+        queue, RUNNING sequences (prefilling or decoding) are evicted
+        through the same clean path as EOS: pages freed, tokens produced
+        so far returned, finish_reason "deadline". Called by the engine at
+        every iteration boundary — expiry is always an orderly eviction,
+        never a mid-iteration abort (the invariant all scheduling shares:
+        refuse or cleanly evict/preempt, never corrupt)."""
+        now = self._clock() if now is None else now
+
+        def expired(req: Request) -> bool:
+            return (req.deadline_s is not None
+                    and now - self._submit_times[req.request_id]
+                    > req.deadline_s)
+
+        results = []
+        for entry in [e for e in self.queue if expired(e.request)]:
+            self.queue.remove(entry)
+            results.append(self._deadline_result(
+                entry.request, entry.generated, now, entry.first_token_at,
+                now))
+        for i, slot in enumerate(self.slots):
+            if slot is not None and expired(slot.request):
+                self.pool.free(slot.pages)
+                self.slots[i] = None
+                results.append(self._deadline_result(
+                    slot.request, slot.generated, slot.admitted_at,
+                    slot.first_token_at, now))
+        return results
+
+    # ---- page handoff (disaggregated serving seam) -------------------------
+    def release_slot(self, slot_idx: int) -> tuple[_Slot, float]:
+        """Remove a prefill-complete slot WITHOUT freeing its pages:
+        ownership of the page references moves with the returned slot
+        record (serve/disagg.py wraps it in a Handoff — same-host transfer
+        is exactly this refcount move, zero page copies). Returns
+        (slot, submitted_at)."""
+        slot = self.slots[slot_idx]
+        assert slot is not None and not slot.prefilling, \
+            f"release_slot on idle/prefilling slot {slot_idx}"
+        self.slots[slot_idx] = None
+        return slot, self._submit_times.pop(slot.request.request_id)
+
+    def adopt(self, *, request: Request, pages: list, cache_len: int,
+              generated: list, submitted_at: float, admitted_at: float,
+              first_token_at: float = 0.0, resumed: bool = False) \
+            -> Optional[int]:
+        """Seat a handed-off sequence (pages already committed elsewhere —
+        the prefill engine) into a free slot, taking over its page
+        references. Returns the slot index, or None when no slot is free.
+        A resumed sequence replays its recorded tokens through the decode
+        program (see the module docstring) before continuing."""
+        slot_idx = next((i for i, s in enumerate(self.slots) if s is None),
+                        None)
+        if slot_idx is None:
+            return None
+        self._submit_times[request.request_id] = submitted_at
+        self.slots[slot_idx] = _Slot(
+            request=request, pages=list(pages), generated=list(generated),
+            cache_len=cache_len, admitted_at=admitted_at,
+            seq=next(self._seq), target_len=cache_len, prefilling=False,
+            shared_len=0, resumed=resumed, replay_pos=0,
+            first_token_at=first_token_at)
+        self.stats["admitted"] += 1
+        return slot_idx
 
     # ---- engine-facing state views ----------------------------------------
     def active_indices(self) -> list[int]:

@@ -1,0 +1,197 @@
+"""Serve-side sharding: the page pool partitioned over the mesh.
+
+Training shards parameters through ``parallel/plans.py``'s logical-axis
+rules; serving state (KV page pools, block tables, lengths, sampling
+knobs) has no logical-axis annotations — it is a handful of engine-owned
+arrays with stable names. The mechanism here is therefore the
+``match_partition_rules`` pattern (regex over tree paths -> PartitionSpec,
+the standard JAX-LLM idiom): one rules table says where every piece of
+serve state lives on the mesh, and everything not matched fails loudly
+instead of silently replicating.
+
+The layout itself mirrors the attention plans in ``parallel/plans.py``:
+under tp the q/k/v projections shard on (kv-)heads, so the page pool
+``[L, n_pages, page, kvh, hd]`` splits on the SAME kv-head axis — each
+chip holds ``kvh/tp`` heads' worth of every page, block tables and
+lengths are replicated (they are tiny int32 bookkeeping), and attention
+is embarrassingly parallel over heads. The attend (scatter new k/v +
+paged flash-decode kernel / gather reference) runs under a FULL-MANUAL
+``shard_map``: each chip scatters into and reads from its own pool slice,
+no collective appears inside the region, and the only cross-chip traffic
+of a decode step is what GSPMD inserts around it anyway (the out
+projection's row-parallel psum and the vocab-sharded sampling psums).
+Full-manual (every mesh axis) rather than partial-auto because jax
+0.4.37's partitioner rejects programs mixing manual subgroups of
+different shapes (the ops/overlap.py finding) — which also means the
+serve mesh must have tp as its only non-trivial axis
+(``validate_kv_shard``).
+
+The Mosaic kernel is the forcing function: GSPMD cannot partition a
+``pallas_call``, so without the manual region a sharded engine ran the
+kernel replicated with a replicated pool. With it, the kernel body is
+unchanged — a per-chip pool slice is just a smaller pool.
+"""
+from __future__ import annotations
+
+import re
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from .kv_pages import commit_prefill, copy_pages, num_kv_heads, paged_attend
+
+# Regex -> PartitionSpec over serve-state tree paths. The pool splits on
+# the kv-head axis (dim 3 of [L, n_pages, page, kvh, hd]); every host-side
+# bookkeeping array the compiled programs consume is replicated. An
+# unmatched leaf is an error by design (silent replication of a pool-sized
+# tensor is the exact failure class this table exists to prevent).
+SERVE_KV_RULES = (
+    (r"pages/(k|v)$", P(None, None, None, "tp", None)),
+    (r"(tables|table_row)$", P()),
+    (r"(lengths|tokens|seeds|actives|n_valid)$", P()),
+    (r"(temps|top_ks|top_ps)$", P()),
+)
+
+# specs for the shard_map'd regions: activations [S, T, H, D] split on
+# heads, ONE layer's pool [P, page, kvh, hd] split on kv-heads, dense
+# prefill caches [L, Pb, kvh, hd] split on kv-heads
+_HEADS = P(None, None, "tp", None)
+_POOL = P(None, None, "tp", None)
+_POOL_L = P(None, None, None, "tp", None)
+_DENSE_L = P(None, None, "tp", None)
+
+
+def match_partition_rules(rules, tree):
+    """PartitionSpec pytree for ``tree``: each leaf's '/'-joined path is
+    matched against ``rules`` (ordered (regex, spec) pairs, first hit
+    wins); scalar/size-1 leaves replicate, anything unmatched raises."""
+
+    def name_of(path) -> str:
+        parts = []
+        for p in path:
+            if hasattr(p, "key"):
+                parts.append(str(p.key))
+            elif hasattr(p, "idx"):
+                parts.append(str(p.idx))
+            else:
+                parts.append(str(p))
+        return "/".join(parts)
+
+    def spec_for(path, leaf):
+        name = name_of(path)
+        shape = np.shape(leaf)
+        if len(shape) == 0 or int(np.prod(shape)) == 1:
+            return P()
+        for rule, spec in rules:
+            if re.search(rule, name):
+                return spec
+        raise ValueError(f"no serve partition rule matches leaf {name!r} "
+                         f"(shape {shape})")
+
+    return jax.tree_util.tree_map_with_path(spec_for, tree)
+
+
+def serve_kv_shardings(mesh: Mesh, tree):
+    """NamedSharding pytree for serve state under ``SERVE_KV_RULES``."""
+    return jax.tree.map(lambda spec: NamedSharding(mesh, spec),
+                        match_partition_rules(SERVE_KV_RULES, tree),
+                        is_leaf=lambda x: isinstance(x, P))
+
+
+def validate_kv_shard(plan, config) -> None:
+    """The sharded-pool contract: tp is the mesh's only non-trivial axis
+    (the attend region is full-manual — see module docstring) and tp
+    divides both head counts so every chip owns whole (kv-)heads."""
+    if plan is None:
+        raise ValueError("shard_kv=True needs a plan= with a tp mesh "
+                         "(parallel.make_plan('tp', make_mesh(tp=N)))")
+    mesh = plan.mesh
+    tp = int(mesh.shape["tp"])
+    if tp < 2:
+        raise ValueError(f"shard_kv=True needs mesh tp > 1, got tp={tp}")
+    extra = [a for a in plan.active_axes() if a != "tp"]
+    if extra:
+        raise ValueError(
+            f"shard_kv supports tp-only meshes (the attend region is "
+            f"full-manual over every axis); axes {extra} have size > 1")
+    kvh, hq = num_kv_heads(config), config.num_heads
+    if kvh % tp or hq % tp:
+        raise ValueError(
+            f"kv pool shards on the kv-head axis: num_kv_heads ({kvh}) and "
+            f"num_heads ({hq}) must both divide by tp ({tp})")
+
+
+def _manual(mesh: Mesh):
+    return set(mesh.axis_names)
+
+
+def make_sharded_attend(mesh: Mesh, tables, lengths, *, impl: str = "auto",
+                        n_valid=None):
+    """The shard_map'd twin of ``kv_pages.make_attend``: per-chip pool
+    slices and head groups, replicated tables/lengths, no collective in
+    the region (head-parallel attention needs none — the psums of a
+    sharded decode step live in GSPMD's out-projection/sampling land).
+    ``window`` may be a traced per-layer value (Gemma-2 schedules); it
+    then rides as an explicit replicated operand — shard_map must not
+    close over tracers."""
+
+    def attend(q, k_new, v_new, k_pages, v_pages, *, window=None,
+               scale=None, softcap=None):
+        operands = [q, k_new, v_new, k_pages, v_pages, tables, lengths]
+        in_specs = [_HEADS, _HEADS, _HEADS, _POOL, _POOL, P(), P()]
+        if n_valid is not None:
+            operands.append(n_valid)
+            in_specs.append(P())
+        dyn_window = window is not None and not isinstance(window, int)
+        if dyn_window:
+            operands.append(window)
+            in_specs.append(P())
+
+        def body(q, kn, vn, kp, vp, tab, lens, *rest):
+            rest = list(rest)
+            nv = rest.pop(0) if n_valid is not None else None
+            w = rest.pop(0) if dyn_window else window
+            return paged_attend(q, kn, vn, kp, vp, tab, lens, window=w,
+                                scale=scale, softcap=softcap, impl=impl,
+                                n_valid=nv)
+
+        sm = jax.shard_map(body, mesh=mesh, in_specs=tuple(in_specs),
+                           out_specs=(_HEADS, (_POOL, _POOL)),
+                           axis_names=_manual(mesh), check_vma=False)
+        return sm(*operands)
+
+    return attend
+
+
+def make_sharded_commit(mesh: Mesh):
+    """shard_map'd ``commit_prefill``: the dense prefill cache arrives
+    split on its kv-head dim and each chip scatters its slice into its
+    pool slice — the full-kv-head pool never materializes on any chip."""
+
+    def commit(k_pages, v_pages, k_dense, v_dense, table_row, n_tokens,
+               start):
+        sm = jax.shard_map(
+            commit_prefill, mesh=mesh,
+            in_specs=(_POOL_L, _POOL_L, _DENSE_L, _DENSE_L, P(), P(), P()),
+            out_specs=(_POOL_L, _POOL_L),
+            axis_names=_manual(mesh), check_vma=False)
+        return sm(k_pages, v_pages, k_dense, v_dense, table_row, n_tokens,
+                  start)
+
+    return commit
+
+
+def make_sharded_copy(mesh: Mesh):
+    """shard_map'd ``copy_pages`` (CoW fork): each chip copies its slice
+    of the source page — page ids are replicated scalars."""
+
+    def copy(k_pages, v_pages, src, dst):
+        sm = jax.shard_map(
+            copy_pages, mesh=mesh,
+            in_specs=(_POOL_L, _POOL_L, P(), P()),
+            out_specs=(_POOL_L, _POOL_L),
+            axis_names=_manual(mesh), check_vma=False)
+        return sm(k_pages, v_pages, src, dst)
+
+    return copy

@@ -324,12 +324,23 @@ def run_preflight(trainer, *, global_batch: int, seq_length: int,
     # "does the step fit"; this row answers the follow-on "how many
     # concurrent requests fit next to the weights when the checkpoint
     # serves" before anyone sizes a pool by trial and error.
-    from ..serve.kv_pages import kv_page_bytes, pages_for_tokens
+    from ..serve.kv_pages import kv_page_bytes, num_kv_heads, \
+        pages_for_tokens
 
     page_size = 16
     pages_per_slot = pages_for_tokens(seq_length, page_size)
     per_page = kv_page_bytes(cfg, page_size=page_size)
     per_slot = per_page * pages_per_slot
+    # sharded pool (serve/sharding.py): under tp the pool splits on the
+    # kv-head axis, so each chip holds per_page / tp — the number that
+    # actually bounds co-resident requests on a tp-serving mesh. Priced
+    # off THIS plan's tp under EXACTLY validate_kv_shard's contract
+    # (tp-only mesh, tp divides both head counts) — a per-chip figure
+    # the engine would refuse to build must never reach the report.
+    tp = int(trainer.plan.mesh.shape["tp"])
+    kv_shards = tp if (
+        tp > 1 and all(a == "tp" for a in trainer.plan.active_axes())
+        and num_kv_heads(cfg) % tp == 0 and cfg.num_heads % tp == 0) else 1
     # per-generated-token decode traffic: the flash-decode kernel
     # (ops/paged_decode.py) READS the live context's pages through the
     # block table and writes only the [S, Hq, D] output — O(context)
@@ -357,6 +368,16 @@ def run_preflight(trainer, *, global_batch: int, seq_length: int,
         "decode_traffic_bytes_per_token_gather": gather_traffic,
         "shared_prefix_tokens_nominal": shared_tokens,
         "shared_prefix_bytes_amortized_per_extra_slot": shared_bytes,
+        # sharded-pool column: the per-CHIP page/slot bytes next to the
+        # replicated cost above (equal when kv_shards == 1)
+        "kv_shards": kv_shards,
+        "bytes_per_page_per_chip": per_page // kv_shards,
+        "bytes_per_slot_per_chip_at_seq": per_slot // kv_shards,
+        # disaggregated handoff (serve/disagg.py): same-host transfer is
+        # a refcount move — 0 bytes; a cross-host transfer would move the
+        # sequence's committed k/v payload (the per-slot bytes above)
+        "handoff_bytes_same_host": 0,
+        "handoff_bytes_cross_host_at_seq": per_slot,
     }
     LOGGER.info(
         f"serve KV pricing: {per_page / 2**10:.1f} KiB/page "
@@ -364,11 +385,16 @@ def run_preflight(trainer, *, global_batch: int, seq_length: int,
         f"slot at context {seq_length} ({pages_per_slot} pages; a dense "
         f"max_position cache would hold "
         f"{report['serve_kv']['dense_bytes_per_slot'] / 2**20:.2f} MiB "
-        f"per slot); decode reads {kernel_read / 2**20:.2f} MiB/token "
+        f"per slot"
+        + (f"; kv-head-sharded pool: {per_slot / kv_shards / 2**20:.2f} "
+           f"MiB per chip at tp={kv_shards}" if kv_shards > 1 else "")
+        + f"); decode reads {kernel_read / 2**20:.2f} MiB/token "
         f"through the flash-decode kernel (the gather view moved "
         f"~{gather_traffic / 2**20:.2f} MiB/token); a {shared_tokens}-token "
         f"shared prefix amortizes {shared_bytes / 2**20:.2f} MiB per "
-        f"additional co-resident slot")
+        f"additional co-resident slot; prefill->decode handoff moves 0 B "
+        f"same-host (refcount transfer), {per_slot / 2**20:.2f} MiB "
+        f"cross-host at this context")
 
     if target_device is None and jax.default_backend() != "tpu":
         target_device = "v5p"  # the 405B recipe's stated target pod

@@ -404,6 +404,24 @@ def test_preflight_budget_and_lowering(eight_devices):
     assert sk["shared_prefix_tokens_nominal"] == 64          # min(512, seq)
     assert sk["shared_prefix_bytes_amortized_per_extra_slot"] == \
         4 * sk["bytes_per_page"]
+    # fsdp mesh: tp=1, pool replicated — per-chip column equals the full
+    # one; handoff is 0 B same-host, per-slot payload cross-host
+    assert sk["kv_shards"] == 1
+    assert sk["bytes_per_page_per_chip"] == sk["bytes_per_page"]
+    assert sk["handoff_bytes_same_host"] == 0
+    assert sk["handoff_bytes_cross_host_at_seq"] == \
+        sk["bytes_per_slot_at_seq"]
+
+    # tp mesh: the sharded pool (serve/sharding.py kv-head split) halves
+    # the per-CHIP page/slot bytes at tp=2 (llama-debug: 2 kv heads)
+    tp_t = Trainer(bundle=bundle, optimizer=adamw_cosine(1e-3),
+                   plan=make_plan("tp", make_mesh(
+                       tp=2, devices=eight_devices[:2])), donate=False)
+    tp_sk = run_preflight(tp_t, global_batch=2, seq_length=64)["serve_kv"]
+    assert tp_sk["kv_shards"] == 2
+    assert tp_sk["bytes_per_page_per_chip"] == sk["bytes_per_page"] // 2
+    assert tp_sk["bytes_per_slot_per_chip_at_seq"] == \
+        sk["bytes_per_slot_at_seq"] // 2
 
     # MoE configs get the dispatch-transient pricing (dense-vs-ragged bytes)
     moe_t = Trainer(bundle=get_model("moe-debug", dtype=jnp.float32),

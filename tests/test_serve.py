@@ -494,6 +494,159 @@ def test_scheduler_random_trace_invariants(llama):
         assert by_id[rid].token_ids == ref.token_ids
 
 
+# ---- property traces over the grown surface (PR 9) -------------------------
+# The scheduler invariant — refuse or cleanly preempt/evict, never corrupt
+# — must survive every extension: streaming taps, deadlines, priorities,
+# the sharded pool, and the disaggregated handoff. Random traces assert
+# after EVERY iteration that (a) each page's refcount equals its holder
+# count, (b) free + held + cache-only pages balance to capacity, (c) the
+# trash page never enters a live table, and at the end that every
+# completion is token-identical to batch-1 (deadline evictions: a strict
+# prefix).
+
+
+def _pool_invariants(pool, holder_maps):
+    """holder_maps: iterables of {page: n_refs}. Assert refcount==holders
+    and the capacity identity."""
+    held: dict = {}
+    for m in holder_maps:
+        for p, n in m.items():
+            held[p] = held.get(p, 0) + n
+    for p, n in held.items():
+        assert pool.refcount(p) == n, \
+            f"page {p}: {n} holders but refcount {pool.refcount(p)}"
+        assert p not in pool._free_set
+    assert pool.n_free + len(held) == pool.capacity
+
+
+def _slot_holders(sched, page_size):
+    held: dict = {}
+    for slot in sched.slots:
+        if slot is None:
+            continue
+        assert 0 not in slot.pages, "trash page in a live table"
+        assert len(set(slot.pages)) == len(slot.pages)
+        assert slot.cache_len <= len(slot.pages) * page_size
+        for p in slot.pages:
+            held[p] = held.get(p, 0) + 1
+    return held
+
+
+def _check_completions(bundle, params, done, submitted, *, max_len):
+    """Every finished request equals batch-1; deadline evictions must be
+    a strict prefix of the batch-1 generation (clean, never garbage).
+    The reference runs with the deadline STRIPPED — it is the
+    deadline-free baseline, and a cold-compile reference engine could
+    otherwise itself expire a 'racing' deadline and corrupt the oracle."""
+    import dataclasses
+
+    ref_eng = _ref_engine(bundle, params, page_size=4, max_len=max_len)
+    by_id = {r.request_id: r for r in done}
+    for rid, req in submitted:
+        res = by_id[rid]
+        baseline = dataclasses.replace(_fresh(req), deadline_s=None)
+        ref = generate_many(ref_eng, [baseline])[0]
+        if res.finish_reason == "deadline":
+            n = len(res.generated_ids)
+            assert res.generated_ids == ref.generated_ids[:n], \
+                f"seed={req.seed}: deadline eviction returned garbage"
+        else:
+            assert res.token_ids == ref.token_ids, \
+                f"seed={req.seed} diverged"
+
+
+def _random_request(rng, n_submitted):
+    n_prompt = int(rng.integers(1, 10))
+    dl = rng.random()
+    return Request(
+        prompt_ids=[int(rng.integers(3, 500)) for _ in range(n_prompt)],
+        max_new_tokens=int(rng.integers(4, 17 - n_prompt)),
+        temperature=float(rng.choice([0.0, 0.9])),
+        priority=int(rng.integers(0, 3)),
+        # a third guaranteed-expired, a third racing, a third unbounded
+        deadline_s=(1e-6 if dl < 0.33 else
+                    float(rng.uniform(0.01, 0.1)) if dl < 0.66 else None),
+        seed=n_submitted)
+
+
+@pytest.mark.stream
+def test_random_trace_stream_deadline_priority_sharded(llama,
+                                                       eight_devices):
+    """The grown monolith under pressure AND the sharded pool: random
+    submits with priorities + deadlines, the streaming tap read every
+    iteration (its prefixes must match the final tokens), pool
+    invariants after every step, completions vs batch-1."""
+    from distributed_training_guide_tpu.parallel import make_mesh, make_plan
+
+    bundle, params = llama
+    plan = make_plan("tp", make_mesh(tp=2, devices=eight_devices[:2]))
+    rng = np.random.default_rng(7)
+    eng = ServeEngine(bundle, params, n_slots=3, page_size=4, max_len=16,
+                      n_pages=8, prefill_chunk=4, plan=plan, shard_kv=True)
+    sched, pool = eng.scheduler, eng.scheduler.pool
+    done, submitted, streamed = [], [], {}
+    for it in range(250):
+        if rng.random() < 0.3 and len(submitted) < 14:
+            req = _random_request(rng, len(submitted))
+            submitted.append((eng.submit(req), req))
+        done.extend(eng.step())
+        for rid, toks in eng.partial_tokens().items():
+            prev = streamed.get(rid, [])
+            assert toks[:len(prev)] == prev, "stream rewrote history"
+            streamed[rid] = toks
+        _pool_invariants(pool, [_slot_holders(sched, eng.page_size),
+                                _cache_page_refs(sched)])
+        if len(done) == len(submitted) and not eng.has_work and it > 80:
+            break
+    done.extend(_drain(eng))
+    assert len(done) == len(submitted)
+    assert sched.stats["deadline_expired"] > 0
+    _check_completions(bundle, params, done, submitted, max_len=16)
+    # streamed prefixes of completed requests match their final tokens
+    by_id = {r.request_id: r for r in done}
+    for rid, toks in streamed.items():
+        assert by_id[rid].generated_ids[:len(toks)] == toks
+
+
+@pytest.mark.disagg
+def test_random_trace_disagg_handoff(llama):
+    """The disaggregated pair under pressure: the same trace with the
+    handoff in the holder accounting — a page in transit (released by
+    the prefill scheduler, not yet adopted) is still exactly one
+    reference. Preempt-requeue-replay must keep token identity."""
+    bundle, params = llama
+    rng = np.random.default_rng(11)
+    from distributed_training_guide_tpu.serve.disagg import DisaggEngine
+
+    eng = DisaggEngine(bundle, params, n_slots=3, n_prefill_slots=2,
+                       page_size=4, max_len=16, n_pages=9,
+                       prefill_chunk=4)
+    done, submitted = [], []
+    for it in range(400):
+        if rng.random() < 0.3 and len(submitted) < 16:
+            req = _random_request(rng, len(submitted))
+            submitted.append((eng.submit(req), req))
+        done.extend(eng.step())
+        transit: dict = {}
+        for h in eng.handoff.pending:
+            assert 0 not in h.pages
+            for p in h.pages:
+                transit[p] = transit.get(p, 0) + 1
+        _pool_invariants(eng.pool, [
+            _slot_holders(eng.prefill.sched, eng.page_size),
+            _slot_holders(eng.decode.sched, eng.page_size),
+            transit, _cache_page_refs(eng.prefill.sched)])
+        if len(done) == len(submitted) and not eng.has_work and it > 100:
+            break
+    done.extend(_drain(eng))
+    assert len(done) == len(submitted)
+    stats = eng.stats()
+    assert stats["deadline_expired"] > 0
+    assert stats["handoff_transfers"] > 0
+    assert stats["handoff_bytes_copied"] == 0
+    _check_completions(bundle, params, done, submitted, max_len=16)
+
+
 # ---- chunked prefill --------------------------------------------------------
 
 def test_chunked_prefill_interleaves_with_resident_decode(llama):

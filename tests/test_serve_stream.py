@@ -1,0 +1,355 @@
+"""Streaming request layer (serve/api.py + scheduler deadlines and
+priorities): per-token SSE delivery with TTFT < total latency (the
+acceptance pin), clean deadline eviction at iteration boundaries,
+priority-ordered admission, structured refusal bodies (429/400 with
+reason + queue depth), and the lock-free /healthz metrics snapshot.
+"""
+import dataclasses
+import http.client
+import json
+import threading
+import time
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from distributed_training_guide_tpu.models import get_model
+from distributed_training_guide_tpu.serve import (RefusalError, Request,
+                                                  ServeEngine)
+from distributed_training_guide_tpu.serve.api import generate_many, serve_http
+
+pytestmark = [pytest.mark.serve, pytest.mark.stream]
+
+
+@pytest.fixture(scope="module")
+def llama():
+    bundle = get_model("llama-debug", dtype=jnp.float32)
+    return bundle, bundle.init(bundle.config, jax.random.key(0))
+
+
+def _fresh(req):
+    return dataclasses.replace(req, request_id=None)
+
+
+def _read_sse_events(resp):
+    """Read SSE events (with client-side arrival timestamps) until the
+    stream closes; http.client decodes the chunked framing."""
+    events = []
+    buf = b""
+    while True:
+        line = resp.readline()
+        if not line:
+            break
+        buf += line
+        if buf.endswith(b"\n\n"):
+            for part in buf.strip().split(b"\n"):
+                if part.startswith(b"data: "):
+                    events.append((time.monotonic(),
+                                   json.loads(part[len(b"data: "):])))
+            buf = b""
+    return events
+
+
+# ---- streaming --------------------------------------------------------------
+
+def test_partial_tokens_mid_generation(llama):
+    """The engine-level half of the TTFT pin, deterministically: after
+    the prefill iteration the first token is already visible through
+    ``partial_tokens`` while the request is still generating."""
+    bundle, params = llama
+    eng = ServeEngine(bundle, params, n_slots=1, page_size=4, max_len=32)
+    rid = eng.submit(Request(prompt_ids=[3, 17, 42], max_new_tokens=8))
+    eng.step()                       # admission + prefill + first sample
+    assert eng.has_work, "request must still be generating"
+    partial = eng.partial_tokens()
+    assert rid in partial and len(partial[rid]) >= 1
+    full = []
+    while eng.has_work:
+        full.extend(eng.step())
+    assert full[0].generated_ids[:len(partial[rid])] == partial[rid], \
+        "streamed prefix must be exactly the final tokens' prefix"
+
+
+def test_streaming_sse_first_token_before_completion(llama):
+    """The acceptance pin: the streaming endpoint delivers one SSE event
+    per token, the FIRST of them strictly before the stream completes,
+    the server-side TTFT strictly below total latency, and the streamed
+    ids equal the non-streaming (batch-1) generation."""
+    bundle, params = llama
+    eng = ServeEngine(bundle, params, n_slots=2, page_size=4, max_len=64)
+    server, worker = serve_http(eng, port=0)
+    port = server.server_address[1]
+    try:
+        conn = http.client.HTTPConnection("127.0.0.1", port, timeout=120)
+        conn.request("POST", "/generate", json.dumps(
+            {"prompt_ids": [3, 17, 42], "max_new_tokens": 16,
+             "stream": True}))
+        resp = conn.getresponse()
+        assert resp.status == 200
+        assert resp.getheader("Content-Type") == "text/event-stream"
+        events = _read_sse_events(resp)
+        conn.close()
+
+        token_events = [(t, e) for t, e in events if "token_id" in e]
+        done_events = [(t, e) for t, e in events if e.get("done")]
+        assert len(token_events) == 16
+        assert len(done_events) == 1
+        t_done, done = done_events[0]
+        t_first = token_events[0][0]
+        assert t_first < t_done, \
+            "first token event must arrive before the stream completes"
+        assert 0 < done["ttft_s"] < done["latency_s"], \
+            f"TTFT {done['ttft_s']} must undercut latency " \
+            f"{done['latency_s']}"
+        assert [e["token_id"] for _, e in token_events] \
+            == done["generated_ids"]
+        # and the streamed generation is the same math as offline batch-1
+        offline = generate_many(
+            ServeEngine(bundle, params, n_slots=1, page_size=4,
+                        max_len=64),
+            [Request(prompt_ids=[3, 17, 42], max_new_tokens=16)])
+        assert done["token_ids"] == offline[0].token_ids
+    finally:
+        server.shutdown()
+        worker.stop()
+
+
+def test_result_carries_ttft_and_itl(llama):
+    """Every RequestResult prices the streaming metrics, streamed or
+    not: 0 < ttft_s < latency_s and a finite mean inter-token gap."""
+    bundle, params = llama
+    eng = ServeEngine(bundle, params, n_slots=1, page_size=4, max_len=32)
+    res = generate_many(eng, [Request(prompt_ids=[3, 17],
+                                      max_new_tokens=6)])[0]
+    assert 0 < res.ttft_s < res.latency_s
+    assert 0 < res.itl_s < res.latency_s
+    stats = eng.stats()
+    assert stats["ttft_s_avg"] > 0 and stats["itl_s_avg"] > 0
+
+
+# ---- deadlines --------------------------------------------------------------
+
+def test_deadline_expires_cleanly_at_iteration_boundary(llama):
+    """A running request past its deadline is evicted CLEANLY: partial
+    tokens returned with finish_reason 'deadline' (a strict prefix of
+    its batch-1 generation), pages freed, and a co-resident request is
+    untouched."""
+    bundle, params = llama
+    full = generate_many(
+        ServeEngine(bundle, params, n_slots=1, page_size=4, max_len=64),
+        [Request(prompt_ids=[3, 17, 42], max_new_tokens=24)])[0]
+
+    eng = ServeEngine(bundle, params, n_slots=2, page_size=4, max_len=64)
+    rid_dead = eng.submit(Request(prompt_ids=[3, 17, 42],
+                                  max_new_tokens=24, deadline_s=1e-6))
+    rid_live = eng.submit(Request(prompt_ids=[5, 6], max_new_tokens=6))
+    done = {}
+    it = 0
+    while eng.has_work:
+        for r in eng.step():
+            done[r.request_id] = r
+        it += 1
+        assert it < 500
+    dead = done[rid_dead]
+    assert dead.finish_reason == "deadline"
+    assert len(dead.generated_ids) < 24
+    n = len(dead.generated_ids)
+    assert dead.generated_ids == full.generated_ids[:n], \
+        "deadline eviction must return a clean prefix, never garbage"
+    assert done[rid_live].finish_reason == "length"
+    assert done[rid_live].token_ids == generate_many(
+        ServeEngine(bundle, params, n_slots=1, page_size=4, max_len=64),
+        [Request(prompt_ids=[5, 6], max_new_tokens=6)])[0].token_ids
+    assert eng.stats()["deadline_expired"] == 1
+    pool = eng.scheduler.pool
+    assert pool.n_free + eng.scheduler.cache_pages_held() == pool.capacity
+
+
+def test_queued_deadline_expires_without_admission():
+    """A QUEUED entry past its deadline leaves the queue at the boundary
+    without ever taking a slot or a page — scheduler-level, fake clock."""
+    from distributed_training_guide_tpu.serve import PagePool, Scheduler
+
+    now = [0.0]
+    sched = Scheduler(n_slots=1, pool=PagePool(8, 4), max_len=16,
+                      max_pages_per_slot=4, clock=lambda: now[0])
+    sched.submit(Request(prompt_ids=[1, 2], max_new_tokens=4,
+                         deadline_s=5.0))
+    now[0] = 6.0
+    results = sched.expire_deadlines()
+    assert len(results) == 1
+    assert results[0].finish_reason == "deadline"
+    assert results[0].generated_ids == []
+    assert not sched.queue and sched.pool.n_free == sched.pool.capacity
+
+
+# ---- priorities -------------------------------------------------------------
+
+def test_priority_orders_admission_fifo_within_class(llama):
+    """With one slot busy, a later high-priority submit overtakes earlier
+    low-priority ones; equal priorities stay FIFO. (Admission order is
+    observed through admitted_at.)"""
+    bundle, params = llama
+    eng = ServeEngine(bundle, params, n_slots=1, page_size=4, max_len=32)
+    running = eng.submit(Request(prompt_ids=[9, 9], max_new_tokens=8))
+    eng.step()                      # occupy the only slot
+    low_a = eng.submit(Request(prompt_ids=[3], max_new_tokens=2))
+    low_b = eng.submit(Request(prompt_ids=[4], max_new_tokens=2))
+    high = eng.submit(Request(prompt_ids=[5], max_new_tokens=2,
+                              priority=5))
+    done = {}
+    it = 0
+    while eng.has_work:
+        for r in eng.step():
+            done[r.request_id] = r
+        it += 1
+        assert it < 500
+    assert done[high].admitted_at < done[low_a].admitted_at \
+        < done[low_b].admitted_at
+    assert done[running].finish_reason == "length"
+
+
+def test_preemption_victim_is_lowest_priority_youngest():
+    """Scheduler-level: growth under exhaustion preempts the lowest
+    priority first (youngest within a class), never the high-priority
+    grower."""
+    from distributed_training_guide_tpu.serve import PagePool, Scheduler
+
+    pool = PagePool(7, 4)           # 6 usable
+    sched = Scheduler(n_slots=3, pool=pool, max_len=32,
+                      max_pages_per_slot=8, prefix_cache=False)
+    for seed, prio in ((0, 5), (1, 0), (2, 0)):
+        sched.submit(Request(prompt_ids=[seed + 1] * 7, max_new_tokens=8,
+                             priority=prio))
+    adms = sched.try_admit()
+    assert len(adms) == 3           # 2 pages each = 6 pages, pool full
+    for adm in adms:
+        sched.commit_tokens(adm.slot_idx, 7)
+    # every slot's 8th token crosses into page 3: growth must preempt —
+    # the victim must be a priority-0 sequence (youngest first), never
+    # the priority-5 one, which must survive with its grown page
+    for slot in sched.slots:
+        slot.cache_len = 8
+    sched.grow_for_decode()
+    live = [s for s in sched.slots if s is not None]
+    assert any(s.request.priority == 5 for s in live), \
+        "the high-priority sequence must survive growth pressure"
+    assert sched.stats["preempted"] >= 1
+    assert sched.queue and \
+        all(e.request.priority == 0 for e in sched.queue), \
+        "every preempted entry must be a priority-0 sequence"
+
+
+# ---- refusals ---------------------------------------------------------------
+
+def test_refusal_bodies_carry_reason_and_queue_depth(llama):
+    """HTTP refusals are structured: 429 for backpressure (queue_full)
+    and 400 for impossible requests, with machine-readable reason +
+    queue depth in the body; stats count refusals by reason."""
+    bundle, params = llama
+    eng = ServeEngine(bundle, params, n_slots=1, page_size=4, max_len=16,
+                      max_queue=2)
+    server, worker = serve_http(eng, port=0)
+    port = server.server_address[1]
+    try:
+        conn = http.client.HTTPConnection("127.0.0.1", port, timeout=60)
+
+        def post(payload):
+            conn.request("POST", "/generate", json.dumps(payload))
+            resp = conn.getresponse()
+            return resp.status, json.loads(resp.read())
+
+        status, body = post({"prompt_ids": [], "max_new_tokens": 2})
+        assert status == 400 and body["reason"] == "empty_prompt"
+        assert "queue_depth" in body
+        status, body = post({"prompt_ids": [3] * 20,
+                             "max_new_tokens": 20})
+        assert status == 400 and body["reason"] == "context_too_long"
+
+        # 8 near-simultaneous clients against max_queue=2 and one slot:
+        # admission drains at most one per iteration, so a burst must
+        # split into served 200s and 429 backpressure refusals
+        outcomes = []
+        outcomes_lock = threading.Lock()
+
+        def client(seed):
+            c = http.client.HTTPConnection("127.0.0.1", port, timeout=120)
+            c.request("POST", "/generate", json.dumps(
+                {"prompt_ids": [3 + seed, 17], "max_new_tokens": 12,
+                 "seed": seed}))
+            resp = c.getresponse()
+            body = json.loads(resp.read())
+            with outcomes_lock:
+                outcomes.append((resp.status, body))
+            c.close()
+
+        threads = [threading.Thread(target=client, args=(i,))
+                   for i in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=120)
+        conn.close()
+        served = [b for s, b in outcomes if s == 200]
+        refused_429 = [b for s, b in outcomes if s == 429]
+        assert len(served) + len(refused_429) == 8
+        assert served, "some burst requests must be served"
+        assert refused_429, "bounded queue never produced a 429"
+        for body in refused_429:
+            assert body["reason"] == "queue_full"
+            assert body["queue_depth"] >= 2
+        refused = eng.stats()["refused"]
+        assert refused["empty_prompt"] == 1
+        assert refused["context_too_long"] == 1
+        assert refused["queue_full"] == len(refused_429)
+    finally:
+        server.shutdown()
+        worker.stop()
+
+
+def test_refusal_error_surface(llama):
+    """Library-level: RefusalError carries reason/status/detail, and the
+    engine's vocab check routes through it."""
+    bundle, params = llama
+    eng = ServeEngine(bundle, params, n_slots=1, page_size=4, max_len=16,
+                      max_queue=1)
+    with pytest.raises(RefusalError) as exc_info:
+        eng.submit(Request(prompt_ids=[bundle.config.vocab_size]))
+    assert exc_info.value.reason == "bad_prompt"
+    assert exc_info.value.http_status == 400
+    eng.submit(Request(prompt_ids=[3], max_new_tokens=2))
+    with pytest.raises(RefusalError) as exc_info:
+        eng.submit(Request(prompt_ids=[4], max_new_tokens=2))
+    assert exc_info.value.reason == "queue_full"
+    assert exc_info.value.http_status == 429
+    assert exc_info.value.detail["queue_depth"] == 1
+
+
+# ---- lock-free health -------------------------------------------------------
+
+def test_healthz_answers_while_engine_lock_is_held(llama):
+    """/healthz must not block on the engine lock (the run loop holds it
+    for a whole decode iteration): hold the lock from the test and
+    require a timely, complete health response."""
+    bundle, params = llama
+    eng = ServeEngine(bundle, params, n_slots=2, page_size=4, max_len=16)
+    server, worker = serve_http(eng, port=0)
+    port = server.server_address[1]
+    try:
+        with worker.lock:      # simulate an in-flight decode iteration
+            conn = http.client.HTTPConnection("127.0.0.1", port, timeout=5)
+            t0 = time.monotonic()
+            conn.request("GET", "/healthz")
+            health = json.loads(conn.getresponse().read())
+            elapsed = time.monotonic() - t0
+            conn.close()
+        assert elapsed < 5.0
+        assert health["ok"] is True
+        # the full stats snapshot rides the probe
+        for key in ("queued", "pool_occupancy", "prefix_hit_rate",
+                    "pages_free", "ttft_s_avg", "refused"):
+            assert key in health
+    finally:
+        server.shutdown()
+        worker.stop()
