@@ -480,6 +480,14 @@ def run_decode_check(only: str = None) -> None:
       schedulers, the decode engine's own occupancy/TTFT) and the
       zero-copy handoff counters; removing the interference itself
       needs concurrent executors (the multi-host seam, future work).
+    - spec_ngram8 / spec_draft8: speculative decoding (serve/spec.py) on
+      a lookup-friendly prompt (repeated block; its greedy continuation
+      cycles), 8 slots, k=8, with the spec-off CONTROL measured on the
+      identical workload inside the rung — speedup, acceptance rate,
+      and tokens-per-iteration in detail. On CPU the win is fewer
+      iterations (per-iteration fixed cost amortizes over the accepted
+      run); the TPU rungs (queued) add the weight-read amortization the
+      feature exists for.
 
     ``only``: comma-separated rung names (sweep-queue children select the
     new rungs explicitly; the default ladder set keeps its PR-6 cost).
@@ -606,6 +614,59 @@ def run_decode_check(only: str = None) -> None:
                                          **engine.kv_report()}
             out["value"] = stats["tokens_per_s"]
         _emit({**out, "partial": True})
+
+    if "spec_ngram8" in rungs or "spec_draft8" in rungs:
+        # speculative decoding rungs (serve/spec.py): 8 slots over a
+        # repeated-block prompt whose greedy continuation cycles — the
+        # prompt-lookup best case ("lookup-friendly"). The spec-off
+        # CONTROL runs the identical workload inside the rung, so the
+        # recorded speedup isolates the one new variable (the drafter);
+        # acceptance rate and tokens-per-iteration land in detail.
+        from distributed_training_guide_tpu.serve.spec import (
+            DraftModelDrafter, new_spec_counters)
+
+        block = [7, 11, 13, 17, 19, 23, 29, 31]
+        prompt = (block * 12)[:96]
+
+        def spec_workload(engine):
+            # warm with the WORKLOAD's shape: the same prefill bucket and
+            # a cycling continuation long enough that the drafter actually
+            # drafts — empty-draft iterations fall back to the plain
+            # program, so a trivial warm-up would leave the verify
+            # program's first touch inside the timed window
+            generate_many(engine, [Request(prompt_ids=prompt + [39],
+                                           max_new_tokens=16)])
+            engine.decode_steps = engine.decode_tokens = 0
+            engine.spec.update(new_spec_counters())
+            reqs = [Request(prompt_ids=prompt + [40 + i],
+                            max_new_tokens=96, seed=i) for i in range(8)]
+            t0 = time.perf_counter()
+            results = generate_many(engine, reqs)
+            return throughput_stats(results, time.perf_counter() - t0,
+                                    engine)
+
+        base = spec_workload(ServeEngine(bundle, params, n_slots=8,
+                                         page_size=16, max_len=256))
+        for name in ("spec_ngram8", "spec_draft8"):
+            if name not in rungs:
+                continue
+            speculate = ("ngram" if name == "spec_ngram8"
+                         else DraftModelDrafter(bundle, params, n_slots=8,
+                                                max_len=256, k=8,
+                                                page_size=16))
+            eng = ServeEngine(bundle, params, n_slots=8, page_size=16,
+                              max_len=256, speculate=speculate, spec_k=8)
+            stats = spec_workload(eng)
+            out[name] = {
+                **stats,
+                "spec_k": 8,
+                "spec_off_tokens_per_s": base["tokens_per_s"],
+                "speedup_vs_spec_off": (
+                    round(stats["tokens_per_s"] / base["tokens_per_s"], 3)
+                    if base["tokens_per_s"] else 0.0),
+            }
+            out["value"] = stats["tokens_per_s"]
+            _emit({**out, "partial": True})
 
     if "disagg_prefill192_decode4" in rungs:
         # the mixed workload through the DISAGGREGATED pair (serial
@@ -786,6 +847,16 @@ SWEEP_QUEUE = [
     dict(name="decode_sharded_tp2", decode_rungs="decode_sharded_tp2"),
     dict(name="disagg_prefill192_decode4",
          decode_rungs="disagg_prefill192_decode4"),
+    # --- speculative decoding (serve/spec.py, PR 10; one new variable
+    # each: the drafter — both rungs run the identical lookup-friendly
+    # workload whose spec-off control is measured inside the rung).
+    # spec_ngram8 = prompt-lookup drafting, 8 slots, k=8; spec_draft8 =
+    # the self-draft-model drafter on the same workload (prices the
+    # drafter's own k sequential forwards per iteration against the
+    # verify amortization — on CPU the draft loop is the bottleneck,
+    # on TPU the weight-read amortization is the point).
+    dict(name="spec_ngram8", decode_rungs="spec_ngram8"),
+    dict(name="spec_draft8", decode_rungs="spec_draft8"),
     # LAST on purpose: fence_every=4 dispatches 4 steps ahead, the exact
     # pattern this pool's documented failure mode punishes — its first
     # attempt (2026-07-31 03:50) stalled and the pool went down with it.

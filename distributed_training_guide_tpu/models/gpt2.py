@@ -346,12 +346,14 @@ def decode_step(config: GPT2Config, params: dict, token_ids: jnp.ndarray,
 
 def paged_decode_step(config: GPT2Config, params: dict,
                       token_ids: jnp.ndarray, positions: jnp.ndarray,
-                      cache: dict, attend, last_index=None):
+                      cache: dict, attend, last_index=None,
+                      all_logits=False):
     """Paged multi-request decode/chunk step (llama.paged_decode_step
     contract): ``token_ids`` [S, T] starting at per-slot ``positions``
     [S] index the learned position table at embed time; ``attend`` owns
     the page scatter + block-table attend; ``last_index`` selects the
-    logits row for a padded chunk. The block wiring is ``_cached_block``
+    logits row for a padded chunk, ``all_logits=True`` keeps every row
+    (speculative verification). The block wiring is ``_cached_block``
     — the same body the contiguous decode runs."""
     from .llama import paged_logits_at, paged_positions
 
@@ -370,7 +372,8 @@ def paged_decode_step(config: GPT2Config, params: dict,
 
     x, (ks, vs) = jax.lax.scan(body, x, (params["layers"],
                                          cache["k"], cache["v"]))
-    return (paged_logits_at(lm_head_logits, config, params, x, last_index),
+    return (paged_logits_at(lm_head_logits, config, params, x, last_index,
+                            all_logits),
             {"k": ks, "v": vs})
 
 

@@ -680,11 +680,19 @@ def paged_positions(token_ids: jnp.ndarray,
     return positions[:, None] + jnp.arange(t, dtype=positions.dtype)[None, :]
 
 
-def paged_logits_at(lm_head, config, params, x, last_index):
+def paged_logits_at(lm_head, config, params, x, last_index,
+                    all_logits=False):
     """Slice the hidden states at the position whose logits the caller
     wants BEFORE the head projection (same rationale as ``prefill``: never
     project a whole chunk to [S, T, V] fp32 to keep one row). ``None``
-    keeps the decode contract — the last position."""
+    keeps the decode contract — the last position. ``all_logits=True``
+    keeps EVERY position ([S, T, V]): the speculative-decoding
+    verification forward (serve/engine.py ``verify_for``) needs one
+    target distribution per drafted token — T there is the speculation
+    depth k+1, not a prompt length, so the full projection is the point,
+    not a waste."""
+    if all_logits:
+        return lm_head(config, params, x)
     x_last = (x[:, -1:] if last_index is None
               else jax.lax.dynamic_slice_in_dim(x, last_index, 1, axis=1))
     return lm_head(config, params, x_last)[:, 0]
@@ -692,7 +700,8 @@ def paged_logits_at(lm_head, config, params, x, last_index):
 
 def paged_decode_step(config: LlamaConfig, params: dict,
                       token_ids: jnp.ndarray, positions: jnp.ndarray,
-                      cache: dict, attend, last_index=None):
+                      cache: dict, attend, last_index=None,
+                      all_logits=False):
     """One step over a PAGED multi-request cache (serve/engine.py):
     ``token_ids`` [S, T] are each slot's next T tokens starting at
     PER-SLOT position ``positions`` [S] (the contiguous-cache
@@ -700,12 +709,16 @@ def paged_decode_step(config: LlamaConfig, params: dict,
     for continuous batching). T == 1 is the batched decode step; T > 1 is
     a chunked-prefill call (S == 1 in practice) whose queries attend over
     the committed history AND the chunk itself — ``last_index`` (traced)
-    then selects the real last token's logits out of a padded chunk.
-    ``cache`` holds the page pools ``{"k","v"}: [L, n_pages, page, kvh,
-    hd]`` and ``attend(q, k, v, kp, vp, *, window, scale, softcap)``
-    (built by serve/kv_pages.py) scatters the new k/v into the layer's
-    pages and attends each slot over its own block table. Returns
-    (logits [S, V], updated cache)."""
+    then selects the real last token's logits out of a padded chunk —
+    or a speculative-decoding VERIFICATION step (S slots, T = k+1
+    candidates each), which instead passes ``all_logits=True`` for the
+    [S, T, V] logits at every position (one target distribution per
+    drafted token). ``cache`` holds the page pools ``{"k","v"}:
+    [L, n_pages, page, kvh, hd]`` and ``attend(q, k, v, kp, vp, *,
+    window, scale, softcap)`` (built by serve/kv_pages.py) scatters the
+    new k/v into the layer's pages and attends each slot over its own
+    block table. Returns (logits [S, V] — or [S, T, V] under
+    ``all_logits`` — and the updated cache)."""
     pos2d = paged_positions(token_ids, positions)
     x = embed_tokens(config, params, token_ids, pos2d)
 
@@ -727,7 +740,8 @@ def paged_decode_step(config: LlamaConfig, params: dict,
         return x, (nkp, nvp)
 
     x, (ks, vs) = _scan_kv_layers(body, x, params, cache, wins)
-    return (paged_logits_at(lm_head_logits, config, params, x, last_index),
+    return (paged_logits_at(lm_head_logits, config, params, x, last_index,
+                            all_logits),
             {"k": ks, "v": vs})
 
 

@@ -791,12 +791,15 @@ def decode_step(config: MoELlamaConfig, params: dict, token_ids: jnp.ndarray,
 
 def paged_decode_step(config: MoELlamaConfig, params: dict,
                       token_ids: jnp.ndarray, positions: jnp.ndarray,
-                      cache: dict, attend, last_index=None):
+                      cache: dict, attend, last_index=None,
+                      all_logits=False):
     """Paged multi-request decode/chunk step (llama.paged_decode_step
     contract): the routed FFN runs drop-free (ragged backend) on the
     [S, T] tokens — per-token routing is independent of the co-resident
     slots, so continuous batching cannot perturb a request's expert
-    choices."""
+    choices (and a speculative verification chunk cannot perturb the
+    tokens it verifies). ``all_logits=True`` keeps every position's
+    logits (speculative verification)."""
     pos2d = llama.paged_positions(token_ids, positions)
     x = embed_tokens(config, params, token_ids, pos2d)
 
@@ -821,7 +824,7 @@ def paged_decode_step(config: MoELlamaConfig, params: dict,
 
     x, (ks, vs) = llama._scan_kv_layers(body, x, params, cache, wins)
     return (llama.paged_logits_at(lm_head_logits, config, params, x,
-                                  last_index),
+                                  last_index, all_logits),
             {"k": ks, "v": vs})
 
 

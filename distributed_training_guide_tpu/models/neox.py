@@ -418,10 +418,12 @@ def decode_step(config: NeoXConfig, params: dict, token_ids: jnp.ndarray,
 
 def paged_decode_step(config: NeoXConfig, params: dict,
                       token_ids: jnp.ndarray, positions: jnp.ndarray,
-                      cache: dict, attend, last_index=None):
+                      cache: dict, attend, last_index=None,
+                      all_logits=False):
     """Paged multi-request decode/chunk step (llama.paged_decode_step
     contract) through ``_cached_block`` — the same parallel-/sequential-
-    residual body the contiguous decode runs."""
+    residual body the contiguous decode runs. ``all_logits=True`` keeps
+    every position's logits (speculative verification)."""
     from .llama import paged_logits_at, paged_positions
 
     pos2d = paged_positions(token_ids, positions)
@@ -439,7 +441,8 @@ def paged_decode_step(config: NeoXConfig, params: dict,
 
     x, (ks, vs) = jax.lax.scan(body, x, (params["layers"],
                                          cache["k"], cache["v"]))
-    return (paged_logits_at(lm_head_logits, config, params, x, last_index),
+    return (paged_logits_at(lm_head_logits, config, params, x, last_index,
+                            all_logits),
             {"k": ks, "v": vs})
 
 

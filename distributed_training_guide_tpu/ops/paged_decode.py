@@ -26,6 +26,16 @@ garbage) are cut by the causal mask exactly as in the gather path.
 ``tests/test_paged_decode.py`` pins it against the XLA gather path at
 1e-5 across GQA/window/scale/softcap and shuffled physical layouts.
 
+Scope: this kernel is the SINGLE-token decode specialist (block_q == 1).
+The serve plane's multi-token paged calls — chunked prefill and the
+speculative-decoding verification forward (``serve/engine.py``
+``verify_for``, T = k+1 candidates per slot) — run the XLA gather form
+of ``serve/kv_pages.paged_attend``: they are compute-bound (T query rows
+amortize the context read), so the kernel's O(live pages) read advantage
+matters much less there. Extending the grid to block_q = T for a fused
+verify step is the natural follow-up once the TPU pool drains the queued
+``spec_*`` rungs.
+
 Under the SHARDED page pool (``serve/sharding.py``) this kernel runs
 inside a full-manual shard_map with a per-chip pool slice: GSPMD cannot
 partition a ``pallas_call``, so the manual region is what takes the

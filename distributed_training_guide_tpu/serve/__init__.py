@@ -9,9 +9,13 @@ optimistic admission with preemption-by-recompute, Sarathi-style chunked
 prefill, a MESH-SHARDED page pool (``serve/sharding.py`` — pages split on
 the kv-head axis under tp, attend shard_map'd over per-chip slices),
 DISAGGREGATED prefill/decode engines connected by a refcounted page
-handoff (``serve/disagg.py``, DistServe), and a STREAMING request layer
+handoff (``serve/disagg.py``, DistServe), a STREAMING request layer
 (``serve/api.py`` — per-token SSE, deadlines, priorities, structured
-refusals, lock-free metrics). See related-topics/serving/README.md.
+refusals, lock-free metrics), and SPECULATIVE DECODING
+(``serve/spec.py`` — n-gram prompt-lookup and draft-model drafting with
+exact-acceptance multi-token verification: spec-on output is
+token-identical to spec-off at any temperature). See
+related-topics/serving/README.md.
 
     from distributed_training_guide_tpu.serve import (
         Request, ServeEngine, DisaggEngine, generate_many)
@@ -22,17 +26,19 @@ from .scheduler import (PrefixCache, RefusalError, Request, RequestResult,
                         Scheduler)
 
 __all__ = [
-    "DisaggEngine", "ModelPrograms", "PagePool", "PrefixCache",
-    "RefusalError", "Request", "RequestResult", "Scheduler", "ServeEngine",
-    "generate_many", "kv_page_bytes", "match_partition_rules",
-    "pages_for_tokens", "serve_http",
+    "DisaggEngine", "Drafter", "DraftModelDrafter", "ModelPrograms",
+    "NgramDrafter", "PagePool", "PrefixCache", "RefusalError", "Request",
+    "RequestResult", "Scheduler", "ServeEngine", "generate_many",
+    "kv_page_bytes", "match_partition_rules", "pages_for_tokens",
+    "serve_http",
 ]
 
 
 def __getattr__(name):
     # generate_many / serve_http live in api.py (imports http.server),
-    # DisaggEngine in disagg.py, match_partition_rules in sharding.py;
-    # keep the package import light for library users
+    # DisaggEngine in disagg.py, match_partition_rules in sharding.py,
+    # the spec drafters in spec.py; keep the package import light for
+    # library users
     if name in ("generate_many", "serve_http", "throughput_stats"):
         from . import api
 
@@ -41,6 +47,10 @@ def __getattr__(name):
         from .disagg import DisaggEngine
 
         return DisaggEngine
+    if name in ("Drafter", "DraftModelDrafter", "NgramDrafter"):
+        from . import spec
+
+        return getattr(spec, name)
     if name == "match_partition_rules":
         from .sharding import match_partition_rules
 

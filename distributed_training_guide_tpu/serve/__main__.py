@@ -57,6 +57,22 @@ def main(argv=None) -> None:
                         help="decode attend: the Pallas block-table kernel "
                         "('flash', TPU), the gather reference ('xla'), or "
                         "platform auto-dispatch")
+    parser.add_argument("--speculate", default="off",
+                        choices=("off", "ngram", "draft"),
+                        help="speculative decoding: 'ngram' is the "
+                        "model-free prompt-lookup drafter, 'draft' runs "
+                        "a co-resident --draft-model; verification is "
+                        "exact — spec-on output is token-identical to "
+                        "spec-off at any temperature")
+    parser.add_argument("--spec-k", type=int, default=4,
+                        help="speculation depth: candidate tokens drafted "
+                        "per slot per iteration")
+    parser.add_argument("--draft-model", default=None, metavar="NAME",
+                        help="model zoo name for --speculate draft (a "
+                        "debug-size family; loads --draft-pretrained or "
+                        "random-inits, which only demos the machinery)")
+    parser.add_argument("--draft-pretrained", default=None, metavar="DIR",
+                        help="converted checkpoint dir for the draft model")
     parser.add_argument("--disagg", action="store_true",
                         help="disaggregated serving: separate prefill and "
                         "decode engines connected by a KV-page handoff "
@@ -127,12 +143,44 @@ def main(argv=None) -> None:
                                          devices=jax.devices()[:args.tp]))
     elif args.shard_kv:
         raise SystemExit("--shard-kv needs a tp mesh: pass --tp > 1")
+    speculate = None
+    if args.speculate == "ngram":
+        speculate = "ngram"
+    elif args.speculate == "draft":
+        from .engine import resolve_context_bounds
+        from .spec import DraftModelDrafter
+
+        if args.draft_model is None:
+            raise SystemExit("--speculate draft needs --draft-model NAME")
+        draft_bundle = get_model(args.draft_model, dtype=jnp.float32)
+        if args.draft_pretrained:
+            from ..models.hf_convert import load_pretrained
+            from ..parallel import make_mesh, make_plan
+
+            dplan = make_plan("single",
+                              make_mesh(devices=jax.devices()[:1]))
+            dshapes = jax.eval_shape(lambda: draft_bundle.init(
+                draft_bundle.config, jax.random.key(0)))
+            dshard = dplan.param_shardings(
+                draft_bundle.param_logical_axes(draft_bundle.config),
+                dshapes)
+            draft_params = load_pretrained(draft_bundle, dshard,
+                                           args.draft_pretrained)
+        else:
+            draft_params = draft_bundle.init(draft_bundle.config,
+                                             jax.random.key(args.seed + 1))
+        target_len = resolve_context_bounds(
+            bundle.config, args.max_len, args.page_size)[0]
+        speculate = DraftModelDrafter(
+            draft_bundle, draft_params, n_slots=args.n_slots,
+            max_len=target_len, k=args.spec_k, page_size=args.page_size)
     common = dict(n_slots=args.n_slots, page_size=args.page_size,
                   n_pages=args.n_pages, max_len=args.max_len,
                   prefill_chunk=args.prefill_chunk,
                   prefix_cache=not args.no_prefix_cache,
                   attend_impl=args.attend_impl, plan=plan,
-                  shard_kv=args.shard_kv, max_queue=args.max_queue)
+                  shard_kv=args.shard_kv, max_queue=args.max_queue,
+                  speculate=speculate, spec_k=args.spec_k)
     if args.disagg:
         from .disagg import DisaggEngine
 

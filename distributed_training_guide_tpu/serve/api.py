@@ -104,6 +104,13 @@ def throughput_stats(results: list[RequestResult],
         "cow_forks": es["cow_forks"],
         "deadline_expired": es["deadline_expired"],
         "refused": es["refused"],
+        # speculative decoding (serve/spec.py): acceptance and the
+        # achieved weight-read amortization (tokens per decode iteration)
+        "spec_steps": es["spec_steps"],
+        "spec_tokens_drafted": es["spec_tokens_drafted"],
+        "spec_tokens_accepted": es["spec_tokens_accepted"],
+        "spec_acceptance_rate": es["spec_acceptance_rate"],
+        "decode_tokens_per_step": es["decode_tokens_per_step"],
     }
 
 

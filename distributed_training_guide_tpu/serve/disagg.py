@@ -39,25 +39,29 @@ Here the split is two engines over ONE refcounted page pool:
 Both engines share one :class:`~.engine.ModelPrograms` (one params
 layout, one jit cache) and compose with the sharded page pool
 (``shard_kv=True`` — the handoff moves page ids, which are
-shard-agnostic). The scheduler invariant is unchanged and property-pinned
-across the pair: refuse or cleanly preempt, never corrupt.
+shard-agnostic) and with DECODE-SIDE SPECULATION (``speculate=`` — the
+drafter and the multi-token verify program live entirely on the
+bandwidth-bound decode half, which is exactly where amortizing the
+weight read pays; prefill never sees a draft). The scheduler invariant
+is unchanged and property-pinned across the pair: refuse or cleanly
+preempt, never corrupt.
 """
 from __future__ import annotations
 
 import dataclasses
 from typing import Optional
 
-import jax.numpy as jnp
-import numpy as np
-
 from ..models.registry import ModelBundle
 from .engine import (LatencyMeter, ModelPrograms, advance_prefill_chunks,
-                     build_kv_report, default_prefill_buckets,
-                     derived_pool_metrics, drop_stale_pending,
-                     resolve_context_bounds, run_bucket_prefill, run_fork,
+                     build_kv_report, collect_partial_tokens,
+                     default_prefill_buckets, derived_pool_metrics,
+                     drop_stale_pending, resolve_context_bounds,
+                     resolve_drafter, run_bucket_prefill,
+                     run_decode_iteration, run_fork, spec_metrics,
                      validate_prefill_buckets)
-from .kv_pages import PagePool
+from .kv_pages import PagePool, kv_page_bytes
 from .scheduler import Admission, Request, RequestResult, Scheduler
+from .spec import new_spec_counters
 
 
 @dataclasses.dataclass
@@ -197,11 +201,16 @@ class DecodeEngine:
     the caller for re-prefill — this engine cannot recompute a prompt."""
 
     def __init__(self, programs: ModelPrograms, pages: dict,
-                 sched: Scheduler, handoff: PageHandoff):
+                 sched: Scheduler, handoff: PageHandoff, drafter=None):
         self.programs = programs
         self.pages = pages
         self.sched = sched
         self.handoff = handoff
+        # decode-side speculation (the disaggregation makes this natural:
+        # the drafter and verify program live entirely on the
+        # bandwidth-bound half; prefill never sees a draft)
+        self.drafter = drafter
+        self.spec = new_spec_counters()
         self._dev: Optional[dict] = None
         self.decode_steps = 0
         self.decode_tokens = 0
@@ -239,27 +248,18 @@ class DecodeEngine:
             t_submit = sched._submit_times.pop(entry.request.request_id)
             entries.append((entry, t_submit))
 
-        active = sched.active_indices()
-        if active:
-            if self._dev is None:
-                self._dev = {k: jnp.asarray(v)
-                             for k, v in sched.decode_arrays().items()}
-            d = self._dev
-            nxt, new_len, self.pages["k"], self.pages["v"] = \
-                self.programs._decode_fn(
-                    self.programs.params, self.pages["k"], self.pages["v"],
-                    d["tokens"], d["lengths"], d["tables"], d["seeds"],
-                    d["temps"], d["top_ks"], d["top_ps"], d["actives"])
-            d["tokens"], d["lengths"] = nxt, new_len
-            nxt_host = np.asarray(nxt)
+        if sched.active_indices():
+            # the spec/plain dispatch is the monolith's, verbatim
+            # (engine.run_decode_iteration — replay pauses speculation,
+            # empty-draft iterations fall back to the plain program)
+            fin, emitted, self._dev = run_decode_iteration(
+                self.programs, self.pages, sched, self.drafter, self.spec,
+                self._dev)
             self.decode_steps += 1
-            self.decode_tokens += len(active)
-            for slot_idx in active:
-                res = sched.record_token(slot_idx, int(nxt_host[slot_idx]),
-                                         from_decode=True)
-                if res is not None:
-                    finished.append(res)
-                    self._dev = None
+            self.decode_tokens += emitted
+            finished.extend(fin)
+            if fin:
+                self._dev = None       # a slot left the batch
         return finished, entries
 
 
@@ -283,13 +283,22 @@ class DisaggEngine:
                  prefill_buckets: Optional[tuple] = None, plan=None,
                  prefill_chunk: Optional[int] = None,
                  prefix_cache: bool = True, attend_impl: str = "auto",
-                 shard_kv: bool = False, max_queue: Optional[int] = None):
+                 shard_kv: bool = False, max_queue: Optional[int] = None,
+                 speculate=None, spec_k: int = 4):
         if n_prefill_slots < 1:
             raise ValueError(f"n_prefill_slots must be >= 1, got "
                              f"{n_prefill_slots}")
         if prefill_chunk is not None and prefill_chunk < 1:
             raise ValueError(f"prefill_chunk must be >= 1, got "
                              f"{prefill_chunk}")
+        drafter = resolve_drafter(speculate, spec_k=spec_k,
+                                  n_slots=n_slots)
+        if drafter is not None and attend_impl == "auto":
+            # same program-family rule as the monolith (engine.py): under
+            # speculation the single-token decode stays in the gather
+            # family the verify forward uses, or TPU flash-vs-gather
+            # 1e-5 drift could break spec-on == spec-off identity
+            attend_impl = "xla"
         self.programs = ModelPrograms(bundle, params, plan=plan,
                                       shard_kv=shard_kv,
                                       attend_impl=attend_impl)
@@ -321,8 +330,11 @@ class DisaggEngine:
             # slots (this scheduler never decodes): without it, admission
             # would eat the last free pages out from under growing
             # decodes and trade every admission for preemption churn
-            # (late-bound closure — decode_sched is created just below)
-            admission_headroom=lambda: len(decode_sched.active_indices()))
+            # (late-bound closure — decode_sched is created just below).
+            # Under decode-side speculation the margin widens to the k
+            # in-flight speculated tokens each decode can scatter.
+            admission_headroom=lambda: len(decode_sched.active_indices()),
+            spec_lookahead=drafter.k if drafter else 0)
         # the decode scheduler shares the prefill side's PrefixCache
         # object (or runs cache-less): growth under pressure must be able
         # to evict idle cached pages before preempting a live sequence
@@ -330,12 +342,13 @@ class DisaggEngine:
             n_slots=n_slots, pool=self.pool, max_len=self.max_model_len,
             max_pages_per_slot=self.max_pages,
             prefix_cache=prefill_sched.cache
-            if prefill_sched.cache is not None else False)
+            if prefill_sched.cache is not None else False,
+            spec_lookahead=drafter.k if drafter else 0)
         self.prefill = PrefillEngine(
             self.programs, self.pages, prefill_sched, self.handoff,
             prefill_chunk=prefill_chunk, prefill_buckets=prefill_buckets)
         self.decode = DecodeEngine(self.programs, self.pages, decode_sched,
-                                   self.handoff)
+                                   self.handoff, drafter=drafter)
         self._lat = LatencyMeter()
 
     # ---- the ServeEngine driving surface -----------------------------------
@@ -408,16 +421,12 @@ class DisaggEngine:
     def partial_tokens(self) -> dict:
         """The streaming tap across the whole plane: prefill slots (the
         first token exists before handoff), in-transit handoffs, and
-        decode slots."""
-        out = {}
-        for sched in (self.prefill.sched, self.decode.sched):
-            for slot in sched.slots:
-                if slot is not None and slot.generated:
-                    out[slot.request.request_id] = list(slot.generated)
-        for h in self.handoff.pending:
-            if h.generated:
-                out[h.request.request_id] = list(h.generated)
-        return out
+        decode slots — via the same single-sourced producer the monolith
+        uses (``engine.collect_partial_tokens``: grow-only lists, so the
+        SSE consumer's dedup-by-count stays exact under speculation)."""
+        return collect_partial_tokens((self.prefill.sched,
+                                       self.decode.sched),
+                                      self.handoff.pending)
 
     def stats(self) -> dict:
         """Host-side snapshot (no device, no lock — see
@@ -431,7 +440,7 @@ class DisaggEngine:
         # admission counters stay prefill-side (the decode scheduler's
         # adopt() is a handoff, not a new admission)
         for k in ("preempted", "deadline_expired", "cache_evicted_pages",
-                  "finished"):
+                  "finished", "spec_lookahead_clamped"):
             s[k] = p.stats[k] + d.stats[k]
         return {
             **s,
@@ -446,7 +455,13 @@ class DisaggEngine:
                 decode_steps=self.decode.decode_steps,
                 decode_tokens=self.decode.decode_tokens,
                 admitted=p.stats.get("admitted", 0),
-                prefix_hits=s.get("prefix_hits", 0), lat=self._lat),
+                prefix_hits=s.get("prefix_hits", 0), lat=self._lat,
+                bytes_per_page=kv_page_bytes(self.config,
+                                             page_size=self.page_size)),
+            **spec_metrics(self.decode.spec,
+                           decode_steps=self.decode.decode_steps,
+                           decode_tokens=self.decode.decode_tokens,
+                           drafter=self.decode.drafter),
             **{f"handoff_{k}": v for k, v in self.handoff.stats.items()},
         }
 

@@ -30,7 +30,12 @@ token)`` — a pure function of (request seed, position), so a request's
 tokens are identical whatever slot it lands in, whenever it is admitted,
 whoever it shares the batch with, and whether or not it was preempted and
 recomputed mid-flight. That property IS the order-invariance and
-preemption-identity tests in tests/test_serve.py.
+preemption-identity tests in tests/test_serve.py — and it is what makes
+speculative decoding's acceptance EXACT here: the verification forward
+(``verify_for`` — the same [S, T] multi-token form chunked prefill uses)
+samples the target token at every drafted position from those same keys
+and accepts a draft only when it matches, so spec-on emits literally the
+spec-off stream, k+1 tokens per weight pass at best (serve/spec.py).
 
 Sharded weights ride the existing ``parallel/plans.py`` meshes: pass
 ``plan=`` (tp / fsdp / single) and params are device_put to the plan's
@@ -57,6 +62,7 @@ from ..models.registry import ModelBundle, family_module
 from .kv_pages import (commit_prefill, copy_pages, init_pages, kv_page_bytes,
                        make_attend, PagePool, pages_for_tokens)
 from .scheduler import Admission, Request, RequestResult, Scheduler
+from .spec import Drafter, NgramDrafter, new_spec_counters
 
 
 def _sample_tokens(logits, seeds, positions, temps, top_ks, top_ps):
@@ -112,9 +118,14 @@ def resolve_context_bounds(config, max_len: Optional[int],
 def derived_pool_metrics(*, pool: PagePool, cached_pages: int, n_slots: int,
                          decode_steps: int, decode_tokens: int,
                          admitted: int, prefix_hits: int,
-                         lat: "LatencyMeter") -> dict:
+                         lat: "LatencyMeter",
+                         bytes_per_page: int = 0) -> dict:
     """The derived stats() tail both engines expose (api.py's
-    throughput_stats and /healthz index these keys on either)."""
+    throughput_stats and /healthz index these keys on either).
+    ``pages_cached_bytes`` sits next to the hit rate so cache pressure is
+    visible in bytes, not just page counts — together with the
+    scheduler's ``cache_evicted_pages`` counter a thrashing prefix cache
+    (high hit rate, high churn) no longer looks healthy on /healthz."""
     held = pool.capacity - pool.n_free
     return {
         "n_slots": n_slots,
@@ -122,6 +133,7 @@ def derived_pool_metrics(*, pool: PagePool, cached_pages: int, n_slots: int,
         "pages_free": pool.n_free,
         "pages_held": held,
         "pages_cached": cached_pages,
+        "pages_cached_bytes": cached_pages * bytes_per_page,
         "pool_occupancy": (round(held / pool.capacity, 3)
                            if pool.capacity else 0.0),
         "prefix_hit_rate": (round(prefix_hits / admitted, 3)
@@ -134,6 +146,73 @@ def derived_pool_metrics(*, pool: PagePool, cached_pages: int, n_slots: int,
         "ttft_s_avg": lat.ttft_avg(),
         "itl_s_avg": lat.itl_avg(),
     }
+
+
+def spec_metrics(spec: dict, *, decode_steps: int, decode_tokens: int,
+                 drafter: Optional[Drafter]) -> dict:
+    """The speculation tail of stats(): drafted/accepted/rejected
+    counters, the acceptance rate, and tokens-per-iteration (the
+    weight-read amortization actually achieved — spec-off it is the
+    decode occupancy in tokens, spec-on it can exceed the slot count)."""
+    drafted = spec["tokens_drafted"]
+    out = {
+        "spec_steps": spec["spec_steps"],
+        "spec_tokens_drafted": drafted,
+        "spec_tokens_accepted": spec["tokens_accepted"],
+        "spec_tokens_rejected": spec["tokens_rejected"],
+        "spec_acceptance_rate": (round(spec["tokens_accepted"] / drafted, 3)
+                                 if drafted else 0.0),
+        "decode_tokens_per_step": (round(decode_tokens / decode_steps, 3)
+                                   if decode_steps else 0.0),
+    }
+    if drafter is not None:
+        out.update(drafter.stats())
+    return out
+
+
+def resolve_drafter(speculate, *, spec_k: int,
+                    n_slots: Optional[int] = None) -> Optional[Drafter]:
+    """The engines' ``speculate=`` knob: None/"off" disables, "ngram" is
+    the built-in prompt-lookup drafter at depth ``spec_k``, and any
+    :class:`~.spec.Drafter` instance (e.g. a configured
+    ``DraftModelDrafter``) rides as-is (its own ``k`` wins). A drafter
+    that carries per-slot state (``n_slots`` attribute) must cover the
+    engine's slots — refusing here beats an IndexError deep inside
+    ``propose_many`` on the first speculative iteration."""
+    if speculate is None or speculate == "off":
+        return None
+    if speculate == "ngram":
+        return NgramDrafter(k=spec_k)
+    if isinstance(speculate, Drafter):
+        drafter_slots = getattr(speculate, "n_slots", None)
+        if (n_slots is not None and drafter_slots is not None
+                and drafter_slots < n_slots):
+            raise ValueError(
+                f"drafter covers {drafter_slots} slots but the engine "
+                f"decodes {n_slots} — build the drafter with n_slots >= "
+                f"the engine's")
+        return speculate
+    raise ValueError(f"speculate must be None, 'off', 'ngram', or a "
+                     f"Drafter instance, got {speculate!r}")
+
+
+def collect_partial_tokens(scheds, handoffs=()) -> dict:
+    """request_id -> tokens generated so far, for every LIVE sequence —
+    THE streaming tap producer, single-sourced for the monolith and the
+    disaggregated facade so the consumer contract lives in one place:
+    lists only ever GROW (a post-preemption replay rewrites k/v, not
+    tokens, and a speculative iteration appends its whole accepted run
+    at once), so api.py's dedup-by-count slicing is exact and a spec
+    iteration's accepted tokens all flush in that iteration's push."""
+    out = {}
+    for sched in scheds:
+        for slot in sched.slots:
+            if slot is not None and slot.generated:
+                out[slot.request.request_id] = list(slot.generated)
+    for h in handoffs:
+        if h.generated:
+            out[h.request.request_id] = list(h.generated)
+    return out
 
 
 def default_prefill_buckets(max_pages: int, page_size: int) -> tuple:
@@ -272,6 +351,164 @@ def advance_prefill_chunks(programs: "ModelPrograms", pages: dict,
     return finished
 
 
+def run_spec_decode(programs: "ModelPrograms", pages: dict,
+                    sched: Scheduler, drafter: Drafter, spec: dict,
+                    dev: Optional[dict]) -> tuple[list, int, dict]:
+    """One SPECULATIVE decode iteration over the decoding slots, shared
+    verbatim by the monolithic engine and the disaggregated decode
+    engine (speculation semantics must never fork between them):
+
+    1. host-side drafting — per-slot candidate streams from the drafter,
+       each clipped to the request's remaining token budget and the
+       engine's position table;
+    2. opportunistic lookahead page growth (``ensure_lookahead`` — a
+       slot that can't get its speculated positions' pages just drafts
+       less, it never preempts anyone);
+    3. ONE ``[S, k+1]`` verification forward through the paged cache
+       (``verify_for`` — the chunked-prefill multi-token form), which
+       scatters all candidate k/v and samples the TARGET token at every
+       position with the plain decode path's fold_in(seed, position)
+       keys;
+    4. exact acceptance: a draft is accepted iff it equals the target's
+       own draw, so the emitted run — accepted prefix plus the first
+       disagreeing target draw — is literally the spec-off stream.
+       Rejection rolls ``lengths`` back implicitly (``record_token``
+       only ever advances by the emitted count, and the verify program
+       returns the rolled-back lengths; the dead k/v past them is
+       overwritten by the next scatter in place — no page churn).
+
+    ``dev`` is the engine-managed device cache (None after any scheduler
+    event, exactly like the plain path's ``_dev``): lengths roll forward
+    ON DEVICE via the verify program's ``new_lengths`` output and the
+    slow-changing arrays (tables, sampling knobs, actives) stay resident,
+    so a steady spec iteration uploads only the [S, k+1] candidate ids +
+    per-slot validity and reads back only (targets, n_acc) — the PR-6
+    host-round-trip lesson, kept under speculation. The emitted tokens
+    themselves come back in that read (the host needs them anyway for
+    EOS checks and streaming).
+
+    Returns (finished results, tokens emitted, updated dev cache) — or
+    None when NO slot drafted anything this iteration: the padded
+    [S, k+1] verify forward would then pay ~(k+1)x the projection/attend
+    width to emit exactly one token per slot, so the caller runs the
+    plain single-token program instead (lookup-hostile stretches cost
+    spec-off speed, not a persistent slowdown).
+    """
+    active = sched.active_indices()
+    k = int(drafter.k)
+    t = k + 1
+    contexts, budgets = {}, {}
+    for i in active:
+        slot = sched.slots[i]
+        contexts[i] = list(slot.request.prompt_ids) + list(slot.generated)
+        budgets[i] = max(0, min(
+            k,
+            # a draft past the request's own budget could never be
+            # emitted (max emitted = remaining tokens)
+            slot.request.max_new_tokens - len(slot.generated) - 1,
+            # the verify scatter targets positions up to cache_len +
+            # n_drafts, which must stay inside the position table
+            sched.max_len - 1 - slot.cache_len))
+    proposals = drafter.propose_many(contexts, budgets)
+    if not any(proposals.get(i) and budgets[i] > 0 for i in active):
+        return None
+    ids = np.zeros((sched.n_slots, t), np.int32)
+    n_valid = np.ones(sched.n_slots, np.int32)
+    grew = False
+    for i in active:
+        slot = sched.slots[i]
+        ids[i, 0] = slot.generated[slot.replay_pos]
+        props = [int(x) for x in (proposals.get(i) or [])][:budgets[i]]
+        n_pages_before = len(slot.pages)
+        granted = sched.ensure_lookahead(i, len(props))
+        grew = grew or len(slot.pages) != n_pages_before
+        props = props[:granted]
+        ids[i, 1:1 + len(props)] = props
+        n_valid[i] = 1 + len(props)
+    if dev is None or dev.get("kind") != "spec":
+        arr = sched.decode_arrays()
+        dev = {"kind": "spec",
+               **{key: jnp.asarray(arr[key])
+                  for key in ("lengths", "tables", "seeds", "temps",
+                              "top_ks", "top_ps", "actives")}}
+    elif grew:      # lookahead growth extended a block table mid-flight
+        dev["tables"] = jnp.asarray(sched.decode_arrays()["tables"])
+    # static greedy specialization: when every active slot decodes at
+    # temperature 0 the target draw is argmax and the verify program
+    # skips the t-position sorted-space sampler entirely (exact — see
+    # verify_for); a single stochastic slot switches the whole batch to
+    # the full sampler program
+    greedy = all(sched.slots[i].request.temperature == 0.0 for i in active)
+    targets, n_acc, dev["lengths"], pages["k"], pages["v"] = \
+        programs.verify_for(t, greedy=greedy)(
+            programs.params, pages["k"], pages["v"], jnp.asarray(ids),
+            dev["lengths"], dev["tables"], dev["seeds"], dev["temps"],
+            dev["top_ks"], dev["top_ps"], dev["actives"],
+            jnp.asarray(n_valid))
+    targets = np.asarray(targets)
+    n_acc = np.asarray(n_acc)
+    finished, emitted_total = [], 0
+    for i in active:
+        n_d = int(n_valid[i]) - 1
+        acc = int(n_acc[i])
+        spec["tokens_drafted"] += n_d
+        spec["tokens_accepted"] += acc
+        spec["tokens_rejected"] += n_d - acc
+        for j in range(acc + 1):
+            emitted_total += 1
+            res = sched.record_token(i, int(targets[i, j]),
+                                     from_decode=True)
+            if res is not None:     # eos/length mid-run: the rest of the
+                finished.append(res)   # accepted tokens are dropped with
+                break                  # the slot (clean boundary)
+    spec["spec_steps"] += 1
+    return finished, emitted_total, dev
+
+
+def run_decode_iteration(programs: "ModelPrograms", pages: dict,
+                         sched: Scheduler, drafter: Optional[Drafter],
+                         spec: dict, dev: Optional[dict]) \
+        -> tuple[list, int, Optional[dict]]:
+    """ONE decode iteration over the active slots — the spec/plain
+    dispatch, single-sourced for the monolith and the disaggregated
+    decode engine (like ``run_spec_decode`` itself: neither the
+    semantics NOR the scaffolding around them may fork between the two).
+    Speculation runs when a drafter is configured, no active slot is
+    replaying (a post-preemption replay must rewrite k/v through the
+    SAME single-token program that wrote it — bitwise recompute, the
+    PR-6 finding), and at least one slot actually drafted; otherwise the
+    plain single-token program steps with its device-resident arrays.
+    The two paths keep separate device caches keyed by ``kind`` —
+    switching costs one rebuild, a scheduler-event-sized expense.
+
+    Returns (finished, tokens emitted, dev). The caller owns the
+    decode_steps/decode_tokens counters and must drop ``dev`` when a
+    finished slot leaves the batch."""
+    active = sched.active_indices()
+    if drafter is not None and not any(sched.slots[i].replaying
+                                       for i in active):
+        out = run_spec_decode(programs, pages, sched, drafter, spec, dev)
+        if out is not None:
+            return out
+    if dev is None or dev.get("kind") != "plain":
+        dev = {"kind": "plain",
+               **{key: jnp.asarray(v)
+                  for key, v in sched.decode_arrays().items()}}
+    nxt, new_len, pages["k"], pages["v"] = programs._decode_fn(
+        programs.params, pages["k"], pages["v"],
+        dev["tokens"], dev["lengths"], dev["tables"], dev["seeds"],
+        dev["temps"], dev["top_ks"], dev["top_ps"], dev["actives"])
+    dev["tokens"], dev["lengths"] = nxt, new_len
+    nxt_host = np.asarray(nxt)
+    finished = []
+    for slot_idx in active:
+        res = sched.record_token(slot_idx, int(nxt_host[slot_idx]),
+                                 from_decode=True)
+        if res is not None:
+            finished.append(res)
+    return finished, len(active), dev
+
+
 def drop_stale_pending(sched: Scheduler, pending: dict) -> None:
     """Preemption or deadline expiry may have evicted a mid-prefill
     slot; its chunk state must go with it (a preempted slot will be
@@ -365,6 +602,7 @@ class ModelPrograms:
                   if self.shard_kv else None)
         self._prefill_fns = {}
         self._chunk_fns = {}
+        self._verify_fns = {}
         # one jit wrapper; each prefill bucket's [L, Pb, ...] shape gets its
         # own cached executable automatically
         self._commit_fn = jax.jit(commit_impl, donate_argnums=(0, 1),
@@ -456,6 +694,73 @@ class ModelPrograms:
                 **({"out_shardings": kv_out} if kv_out else {}))
         return self._chunk_fns[t]
 
+    def verify_for(self, t: int, greedy: bool = False):
+        """The speculative-verification program: ``[S, t]`` tokens per
+        slot (index 0 = the slot's newest sampled token, 1.. = the
+        drafter's candidates, zero-padded; ``n_valid`` [S] routes each
+        pad tail's scatter to the trash page), ONE forward through the
+        multi-token paged path — the same ``[S, T]`` form chunked
+        prefill runs, sharded attend included — with ALL-position logits
+        and the position-keyed target sampler at every row.
+
+        Returns (targets [S, t], n_acc [S], new_lengths [S], k_pages,
+        v_pages): ``targets[s, j]`` is the token the spec-off engine
+        would sample at absolute position ``lengths[s] + 1 + j``
+        (fold_in(seed, that position) — the deterministic stream), and
+        ``n_acc[s]`` counts the leading drafts that EQUAL their target
+        draw. Acceptance is therefore exact by construction: the engine
+        emits ``targets[s, :n_acc+1]`` — always the target sampler's own
+        tokens — and the drafts only decide how many land per weight
+        pass (serve/spec.py has the full argument). ``new_lengths`` is
+        the post-acceptance rollback (``lengths + n_acc + 1`` per active
+        slot — everything past it is dead k/v the next scatter
+        overwrites), computed in-program so a steady spec iteration
+        keeps lengths ON DEVICE: the host uploads only the candidate ids
+        and reads back only (targets, n_acc).
+
+        ``greedy=True`` is a STATIC specialization the engine selects
+        when every active slot decodes at temperature 0 (a host-known
+        predicate, like the prefill buckets): the per-position draw is
+        then exactly ``argmax`` — same output, none of the sampler's
+        sorted-space top-k/top-p machinery, which is t full-vocab sorts
+        per iteration and dominates the verify cost on CPU. Mixed
+        batches (any stochastic slot) take the full sampler program."""
+        key = (t, bool(greedy))
+        if key not in self._verify_fns:
+            def fn(params, kp, vp, ids, lengths, tables, seeds, temps,
+                   top_ks, top_ps, actives, n_valid):
+                attend = self.make_attend(tables, lengths, impl="xla",
+                                          n_valid=n_valid)
+                logits, cache = self.mod.paged_decode_step(
+                    self.config, params, ids, lengths, {"k": kp, "v": vp},
+                    attend, all_logits=True)
+                if greedy:
+                    targets = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+                else:
+                    pos = lengths[:, None] + 1 + jnp.arange(t)[None, :]
+                    targets = jax.vmap(
+                        _sample_tokens,
+                        in_axes=(1, None, 1, None, None, None),
+                        out_axes=1)(logits.astype(jnp.float32), seeds, pos,
+                                    temps, top_ks, top_ps)
+                targets = jnp.where(actives[:, None], targets, 0)
+                matches = ((ids[:, 1:] == targets[:, :-1])
+                           & (jnp.arange(t - 1)[None, :]
+                              < (n_valid - 1)[:, None]))
+                n_acc = jnp.cumprod(matches.astype(jnp.int32),
+                                    axis=1).sum(axis=1)
+                new_lengths = jnp.where(actives, lengths + n_acc + 1,
+                                        lengths)
+                return targets, n_acc, new_lengths, cache["k"], cache["v"]
+
+            kv_out = ((self._repl, self._repl, self._repl,
+                       self._kv_sharding, self._kv_sharding)
+                      if self.shard_kv else None)
+            self._verify_fns[key] = jax.jit(
+                fn, donate_argnums=(1, 2),
+                **({"out_shardings": kv_out} if kv_out else {}))
+        return self._verify_fns[key]
+
     def sample_one(self, logit, request: Request, position: int):
         """Batch-1 sample off prefill logits (the request's first token)."""
         return self._sample_one(
@@ -495,7 +800,13 @@ class ServeEngine:
     attend: "auto" (flash kernel on TPU, gather elsewhere), "flash",
     "xla". ``max_queue`` bounds the admission queue — submits past it
     refuse with a 429-class RefusalError (backpressure the HTTP layer
-    forwards verbatim).
+    forwards verbatim). ``speculate`` turns on speculative decoding
+    ("ngram" for the built-in prompt-lookup drafter at depth ``spec_k``,
+    or any ``serve/spec.py`` Drafter instance): drafts verify through
+    ONE multi-token forward per iteration with exact acceptance —
+    spec-on output is token-identical to spec-off at every temperature
+    (see serve/spec.py), and acceptance/amortization counters land in
+    ``stats()``.
 
     Under a multi-device ``plan=``, params shard as in training while the
     page pool stays replicated; ``shard_kv=True`` additionally splits the
@@ -511,7 +822,24 @@ class ServeEngine:
                  prefill_chunk: Optional[int] = None,
                  prefix_cache: bool = True, attend_impl: str = "auto",
                  shard_kv: bool = False, max_queue: Optional[int] = None,
-                 programs: Optional[ModelPrograms] = None):
+                 programs: Optional[ModelPrograms] = None,
+                 speculate=None, spec_k: int = 4):
+        self.drafter = resolve_drafter(speculate, spec_k=spec_k,
+                                       n_slots=n_slots)
+        self.spec = new_spec_counters()
+        if self.drafter is not None and attend_impl == "auto":
+            # ONE program family for every emitted token under
+            # speculation: the verify forward is the multi-token GATHER
+            # form, so the single-token program (empty-draft fallback,
+            # replay) must stay in that family too — on TPU the flash
+            # kernel is parity-pinned against the gather path only to
+            # 1e-5, enough to flip a near-tie argmax and silently break
+            # the spec-on == spec-off identity this feature guarantees.
+            # An explicit attend_impl="flash" (or a pre-built programs=)
+            # is the caller's own assertion and rides unchanged; the
+            # block_q=T flash-verify kernel (queued follow-up) removes
+            # the trade.
+            attend_impl = "xla"
         self.programs = programs if programs is not None else ModelPrograms(
             bundle, params, plan=plan, shard_kv=shard_kv,
             attend_impl=attend_impl)
@@ -540,7 +868,10 @@ class ServeEngine:
             # mid-page prefix reuse needs the chunked path: a bucketed
             # prefill recomputes from position 0 anyway, so only aligned
             # (full-page) sharing pays for itself there
-            allow_partial_share=prefill_chunk is not None)
+            allow_partial_share=prefill_chunk is not None,
+            # admission headroom scales to the k in-flight speculated
+            # tokens a verify step can scatter per running decode
+            spec_lookahead=self.drafter.k if self.drafter else 0)
         if prefill_buckets is None:
             prefill_buckets = default_prefill_buckets(self.max_pages,
                                                       page_size)
@@ -647,26 +978,15 @@ class ServeEngine:
             if preempted:
                 drop_stale_pending(sched, self._pending)
 
-        active = sched.active_indices()
-        if active:
-            if self._dev is None:
-                self._dev = {k: jnp.asarray(v)
-                             for k, v in sched.decode_arrays().items()}
-            d = self._dev
-            nxt, new_len, self.pages["k"], self.pages["v"] = self._decode_fn(
-                self.params, self.pages["k"], self.pages["v"],
-                d["tokens"], d["lengths"], d["tables"], d["seeds"],
-                d["temps"], d["top_ks"], d["top_ps"], d["actives"])
-            d["tokens"], d["lengths"] = nxt, new_len
-            nxt_host = np.asarray(nxt)
+        if sched.active_indices():
+            fin, emitted, self._dev = run_decode_iteration(
+                self.programs, self.pages, sched, self.drafter, self.spec,
+                self._dev)
             self.decode_steps += 1
-            self.decode_tokens += len(active)
-            for slot_idx in active:
-                res = sched.record_token(slot_idx, int(nxt_host[slot_idx]),
-                                         from_decode=True)
-                if res is not None:
-                    finished.append(res)
-                    self._dev = None       # the slot left the batch
+            self.decode_tokens += emitted
+            finished.extend(fin)
+            if fin:
+                self._dev = None       # a slot left the batch
         self._lat.note(finished)
         return finished
 
@@ -675,14 +995,10 @@ class ServeEngine:
         """request_id -> tokens generated so far, for every LIVE sequence
         — the streaming layer's tap. Pure host bookkeeping (the tokens
         were already read back for EOS checks), so the HTTP worker can
-        push per-token deltas without extra device traffic. Dedup by
-        count on the consumer side: the list only ever grows (a
-        post-preemption replay rewrites k/v, not tokens)."""
-        out = {}
-        for slot in self.scheduler.slots:
-            if slot is not None and slot.generated:
-                out[slot.request.request_id] = list(slot.generated)
-        return out
+        push per-token deltas without extra device traffic. The consumer
+        contract (dedup-by-count; a speculative iteration's accepted run
+        flushes at once) is documented on ``collect_partial_tokens``."""
+        return collect_partial_tokens([self.scheduler])
 
     def stats(self) -> dict:
         """Metrics snapshot WITHOUT acquiring the device or any lock:
@@ -703,7 +1019,12 @@ class ServeEngine:
                 n_slots=self.n_slots, decode_steps=self.decode_steps,
                 decode_tokens=self.decode_tokens,
                 admitted=s.get("admitted", 0),
-                prefix_hits=s.get("prefix_hits", 0), lat=self._lat),
+                prefix_hits=s.get("prefix_hits", 0), lat=self._lat,
+                bytes_per_page=kv_page_bytes(self.config,
+                                             page_size=self.page_size)),
+            **spec_metrics(self.spec, decode_steps=self.decode_steps,
+                           decode_tokens=self.decode_tokens,
+                           drafter=self.drafter),
         }
 
     def kv_report(self) -> dict:

@@ -177,10 +177,18 @@ def paged_attend(q, k_new, v_new, k_pages, v_pages, tables, lengths, *,
     the trash page); lengths [S] int32 = tokens already cached per slot —
     the T new tokens land at positions ``lengths[s] + 0..T-1``. T == 1 is
     the decode step; T > 1 is a prefill chunk attending over its own
-    (already-scattered) tokens plus the cached history. ``n_valid`` [S]
-    (default T) marks how many of the T tokens are REAL — the padded tail
-    of a final chunk scatters to the trash page and its query rows are
-    ignored by the caller's logit slice.
+    (already-scattered) tokens plus the cached history, or a speculative
+    VERIFICATION step (serve/engine.py ``verify_for``: T = k+1 candidate
+    tokens per slot, all slots at once). ``n_valid`` [S] (default T)
+    marks how many of the T tokens are REAL — the padded tail of a final
+    chunk (or of a slot that drafted fewer than k candidates) scatters to
+    the trash page and its query rows are ignored by the caller.
+
+    Rejected speculation needs no cleanup here: the engine simply rolls
+    ``lengths`` back to the accepted prefix, and the NEXT call's scatter
+    overwrites the dead k/v in place — every position up to a query's own
+    is either live history or rewritten by the same call's scatter before
+    the attend, and the causal mask cuts everything past it.
 
     impl: "flash" routes single-token calls through the Pallas
     block-table kernel (``ops/paged_decode.py``) — the decode step then

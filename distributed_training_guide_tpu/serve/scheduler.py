@@ -311,7 +311,7 @@ class Scheduler:
                  prefix_cache: bool = True,
                  allow_partial_share: bool = False,
                  max_queue: Optional[int] = None,
-                 admission_headroom=None):
+                 admission_headroom=None, spec_lookahead: int = 0):
         if n_slots < 1:
             raise ValueError(f"n_slots must be >= 1, got {n_slots}")
         if max_queue is not None and max_queue < 1:
@@ -343,11 +343,19 @@ class Scheduler:
         # this hook (admitting into that margin trades one admission for
         # immediate preemption churn over there)
         self._headroom_fn = admission_headroom
+        # speculative decoding widens the per-decode admission margin: a
+        # verify step may scatter up to 1 + spec_lookahead tokens per
+        # slot, so each running decode can claim that many positions'
+        # worth of pages within one iteration instead of one token's
+        if spec_lookahead < 0:
+            raise ValueError(f"spec_lookahead must be >= 0, got "
+                             f"{spec_lookahead}")
+        self.spec_lookahead = spec_lookahead
         self.stats = {"admission_blocked": 0, "admitted": 0, "finished": 0,
                       "preempted": 0, "prefix_hits": 0,
                       "prefix_tokens_shared": 0, "cow_forks": 0,
                       "cache_evicted_pages": 0, "deadline_expired": 0,
-                      "refused": {}}
+                      "spec_lookahead_clamped": 0, "refused": {}}
 
     # ---- refusals / queue order --------------------------------------------
     def refuse(self, reason: str, message: str, *, http_status: int = 400,
@@ -498,9 +506,13 @@ class Scheduler:
             # headroom: every running decode may need a page within one
             # page_size worth of steps — admitting into that margin would
             # trade one prompt's admission for immediate preemption churn
-            # (decodes running in a sibling scheduler count via the hook)
-            headroom = len(self.active_indices()) + (
-                self._headroom_fn() if self._headroom_fn else 0)
+            # (decodes running in a sibling scheduler count via the hook).
+            # Under speculation each decode can consume 1 + spec_lookahead
+            # positions per iteration, so the margin scales to the pages
+            # that worth of tokens can claim.
+            per_decode = pages_for_tokens(1 + self.spec_lookahead, page)
+            headroom = (len(self.active_indices()) + (
+                self._headroom_fn() if self._headroom_fn else 0)) * per_decode
             priv = self._alloc(n_priv, headroom=headroom)
             if protect:
                 # safe to release now: if the source node was evicted
@@ -605,6 +617,37 @@ class Scheduler:
                 if victim == slot_idx:
                     break           # the grower itself was the victim
         return grown, preempted
+
+    def ensure_lookahead(self, slot_idx: int, extra: int) -> int:
+        """Grow a decoding slot's pages to cover ``extra`` SPECULATED
+        positions beyond its next write (the verify scatter targets
+        positions cache_len .. cache_len + extra). Opportunistic, unlike
+        ``grow_for_decode``: allocation failure (after cache-eviction
+        pressure) CLAMPS the lookahead instead of preempting — candidate
+        tokens are a throughput optimization and must never cost a live
+        sequence its pages — so the grant also keeps one page of
+        headroom per OTHER active decode (their imminent MANDATORY
+        next-write page: draining the pool for drafts here would hand
+        the next ``grow_for_decode`` a preemption spec-off never takes).
+        Returns the extra positions actually covered;
+        the engine drops the drafts past that. Rejected speculation needs
+        no un-grow: ``lengths`` rolls back and the next scatter
+        overwrites the dead k/v in place, so a granted page simply
+        arrives a few tokens early."""
+        if extra < 0:
+            raise ValueError(f"lookahead must be >= 0, got {extra}")
+        slot = self.slots[slot_idx]
+        assert slot is not None and not slot.prefilling, \
+            f"ensure_lookahead on idle/prefilling slot {slot_idx}"
+        page = self.pool.page_size
+        headroom = max(0, len(self.active_indices()) - 1)
+        while (slot.cache_len + extra) // page >= len(slot.pages):
+            got = self._alloc(1, headroom=headroom)
+            if got is None:
+                self.stats["spec_lookahead_clamped"] += 1
+                return max(len(slot.pages) * page - 1 - slot.cache_len, 0)
+            slot.pages.extend(got)
+        return extra
 
     # ---- decode bookkeeping ------------------------------------------------
     def record_token(self, slot_idx: int, token: int, *,

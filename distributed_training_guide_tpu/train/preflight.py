@@ -379,6 +379,22 @@ def run_preflight(trainer, *, global_batch: int, seq_length: int,
         "handoff_bytes_same_host": 0,
         "handoff_bytes_cross_host_at_seq": per_slot,
     }
+    # speculative decoding (serve/spec.py): decode's OTHER traffic is the
+    # weight read — every spec-off token pays the full per-chip param
+    # bytes. A verify step amortizes one weight pass over the accepted
+    # run; with per-position acceptance rate a and depth k the expected
+    # emitted tokens per pass are 1 + a + a^2 + ... + a^k (the accepted
+    # prefix is geometric), so the per-token weight bytes divide by that.
+    spec_k = 4
+    def _amortized(a: float) -> int:
+        tokens = sum(a ** j for j in range(spec_k + 1))
+        return int(params_b / tokens)
+    report["serve_kv"].update({
+        "spec_k_nominal": spec_k,
+        "weight_read_bytes_per_token_spec_off": params_b,
+        "weight_read_bytes_per_token_spec_accept_0.7": _amortized(0.7),
+        "weight_read_bytes_per_token_spec_accept_1.0": _amortized(1.0),
+    })
     LOGGER.info(
         f"serve KV pricing: {per_page / 2**10:.1f} KiB/page "
         f"({page_size} tokens) -> {per_slot / 2**20:.2f} MiB per decode "
@@ -394,7 +410,10 @@ def run_preflight(trainer, *, global_batch: int, seq_length: int,
         f"shared prefix amortizes {shared_bytes / 2**20:.2f} MiB per "
         f"additional co-resident slot; prefill->decode handoff moves 0 B "
         f"same-host (refcount transfer), {per_slot / 2**20:.2f} MiB "
-        f"cross-host at this context")
+        f"cross-host at this context; speculative decode at k={spec_k} "
+        f"amortizes the {params_b / 2**20:.0f} MiB/chip weight read to "
+        f"{_amortized(0.7) / 2**20:.0f} MiB/token at 0.7 acceptance "
+        f"({_amortized(1.0) / 2**20:.0f} at full)")
 
     if target_device is None and jax.default_backend() != "tpu":
         target_device = "v5p"  # the 405B recipe's stated target pod

@@ -48,3 +48,27 @@ def eight_devices():
     devices = jax.devices()
     assert len(devices) == 8, f"expected 8 virtual devices, got {len(devices)}"
     return devices
+
+
+def pytest_terminal_summary(terminalreporter, exitstatus, config):
+    """Tier-1 timing report (ROADMAP caveat d: the 870s budget is tight
+    even warm): the slowest test calls plus the suite's total test time
+    on every run — a creeping compile shows up as a diff in this block,
+    not as a surprise timeout three PRs later. (The stock ``--durations``
+    flag reports the same numbers but must be remembered per invocation;
+    the verify command is pinned in ROADMAP.md, so the report lives in
+    conftest where it cannot be forgotten.)"""
+    reports = []
+    for key in ("passed", "failed", "error"):
+        reports.extend(r for r in terminalreporter.stats.get(key, [])
+                       if getattr(r, "when", None) == "call")
+    if not reports:
+        return
+    total = sum(r.duration for r in reports)
+    slowest = sorted(reports, key=lambda r: r.duration, reverse=True)[:12]
+    terminalreporter.write_sep(
+        "-", f"tier-1 timing: {total:.1f}s across {len(reports)} test "
+             f"calls (budget 870s incl. setup/collection)")
+    for rep in slowest:
+        terminalreporter.write_line(
+            f"  {rep.duration:7.2f}s  {rep.nodeid}")
