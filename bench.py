@@ -488,6 +488,19 @@ def run_decode_check(only: str = None) -> None:
       iterations (per-iteration fixed cost amortizes over the accepted
       run); the TPU rungs (queued) add the weight-read amortization the
       feature exists for.
+    - kvq_int8_slots8 (queued sweep rung): the slots8 workload on an
+      int8-quantized page pool (serve/kv_pages.py kv_dtype="int8") with
+      its fp32-KV control measured in-rung — tokens/sec both ways, the
+      pool byte ratio (scales included), and the first greedy-divergence
+      position per request (the coarse quality meter). The capacity win
+      (~3x pages per pool byte) is the point; on TPU the same ratio cuts
+      the bandwidth-bound decode read.
+    - kvq_spec_accept (queued sweep rung): the spec_ngram8 workload run
+      int8-KV vs fp32-KV, recording the ACCEPTANCE-RATE delta — spec
+      acceptance is a sensitive function of KV fidelity (cache error
+      perturbs the verify logits and breaks drafted runs long before
+      evals move), so this is the serving plane's built-in quality
+      meter for quantized pages. Target: |delta| <= 0.02.
 
     ``only``: comma-separated rung names (sweep-queue children select the
     new rungs explicitly; the default ladder set keeps its PR-6 cost).
@@ -667,6 +680,91 @@ def run_decode_check(only: str = None) -> None:
             }
             out["value"] = stats["tokens_per_s"]
             _emit({**out, "partial": True})
+
+    if "kvq_int8_slots8" in rungs:
+        # int8 KV pages: the slots8 workload with the pool quantized and
+        # the fp32-KV control measured in-rung on the identical workload
+        # (one new variable — the storage dtype). The greedy divergence
+        # positions are the coarse quality meter beside kvq_spec_accept's
+        # acceptance delta; -1 means token-identical over all 64 steps.
+        def kvq_workload(engine):
+            generate_many(engine, [Request(prompt_ids=[3, 17, 42],
+                                           max_new_tokens=4)])
+            engine.decode_steps = engine.decode_tokens = 0
+            reqs = [Request(prompt_ids=[3 + i, 17, 42], max_new_tokens=64,
+                            seed=i) for i in range(8)]
+            t0 = time.perf_counter()
+            results = generate_many(engine, reqs)
+            return results, throughput_stats(
+                results, time.perf_counter() - t0, engine)
+
+        ctl_eng = ServeEngine(bundle, params, n_slots=8, page_size=16,
+                              max_len=128)
+        ctl_res, ctl = kvq_workload(ctl_eng)
+        eng = ServeEngine(bundle, params, n_slots=8, page_size=16,
+                          max_len=128, kv_dtype="int8")
+        res, stats = kvq_workload(eng)
+        div = []
+        for a, b in zip(res, ctl_res):
+            n = next((j for j, (x, y) in enumerate(
+                zip(a.generated_ids, b.generated_ids)) if x != y), -1)
+            div.append(n)
+        out["kvq_int8_slots8"] = {
+            **stats,
+            "pool_dtype": "int8",
+            "pool_bytes": eng.kv_cache_bytes(),
+            "fp32_pool_bytes": ctl_eng.kv_cache_bytes(),
+            "bytes_vs_fp32": round(
+                eng.kv_cache_bytes() / ctl_eng.kv_cache_bytes(), 4),
+            "fp32_kv_tokens_per_s": ctl["tokens_per_s"],
+            "speedup_vs_fp32_kv": (
+                round(stats["tokens_per_s"] / ctl["tokens_per_s"], 3)
+                if ctl["tokens_per_s"] else 0.0),
+            "greedy_divergence_positions": div,
+        }
+        out["value"] = stats["tokens_per_s"]
+        _emit({**out, "partial": True})
+
+    if "kvq_spec_accept" in rungs:
+        # the KV-quality meter: n-gram speculation on the lookup-friendly
+        # workload, int8 pool vs fp32 pool — acceptance rate is the
+        # sensitive function of cache fidelity (a perturbed verify logit
+        # breaks a drafted run immediately), so the delta is the rung's
+        # headline. tests/test_kv_quant.py pins |delta| <= 0.02 in CI.
+        from distributed_training_guide_tpu.serve.spec import \
+            new_spec_counters
+
+        block = [7, 11, 13, 17, 19, 23, 29, 31]
+        prompt = (block * 12)[:96]
+
+        def accept_workload(engine):
+            generate_many(engine, [Request(prompt_ids=prompt + [39],
+                                           max_new_tokens=16)])
+            engine.decode_steps = engine.decode_tokens = 0
+            engine.spec.update(new_spec_counters())
+            reqs = [Request(prompt_ids=prompt + [40 + i],
+                            max_new_tokens=96, seed=i) for i in range(8)]
+            t0 = time.perf_counter()
+            results = generate_many(engine, reqs)
+            st = throughput_stats(results, time.perf_counter() - t0, engine)
+            return st["tokens_per_s"], st["spec_acceptance_rate"]
+
+        tps32, acc32 = accept_workload(ServeEngine(
+            bundle, params, n_slots=8, page_size=16, max_len=256,
+            speculate="ngram", spec_k=8))
+        tps8, acc8 = accept_workload(ServeEngine(
+            bundle, params, n_slots=8, page_size=16, max_len=256,
+            speculate="ngram", spec_k=8, kv_dtype="int8"))
+        out["kvq_spec_accept"] = {
+            "spec_k": 8,
+            "tokens_per_s": tps8,
+            "fp32_kv_tokens_per_s": tps32,
+            "acceptance_int8": acc8,
+            "acceptance_fp32": acc32,
+            "acceptance_delta": round(acc8 - acc32, 4),
+        }
+        out["value"] = tps8
+        _emit({**out, "partial": True})
 
     if "disagg_prefill192_decode4" in rungs:
         # the mixed workload through the DISAGGREGATED pair (serial
@@ -857,6 +955,18 @@ SWEEP_QUEUE = [
     # on TPU the weight-read amortization is the point).
     dict(name="spec_ngram8", decode_rungs="spec_ngram8"),
     dict(name="spec_draft8", decode_rungs="spec_draft8"),
+    # --- quantized KV pages (serve/kv_pages.py kv_dtype="int8"; one new
+    # variable each — both rungs measure their fp32-KV control in-rung).
+    # kvq_int8_slots8 = the slots8 decode workload on the int8 pool:
+    # tput, the pool byte ratio with scales included (~0.31x at
+    # llama-debug's head_dim 16), per-request greedy divergence
+    # positions. kvq_spec_accept = the spec_ngram8 workload int8-vs-fp32
+    # recording the acceptance-rate delta — the sensitive KV-fidelity
+    # meter (gate |delta| <= 0.02, also pinned in tests). On TPU the
+    # byte ratio is also the decode-read ratio on the bandwidth-bound
+    # path — these rungs make the capacity claim honest on CPU first.
+    dict(name="kvq_int8_slots8", decode_rungs="kvq_int8_slots8"),
+    dict(name="kvq_spec_accept", decode_rungs="kvq_spec_accept"),
     # LAST on purpose: fence_every=4 dispatches 4 steps ahead, the exact
     # pattern this pool's documented failure mode punishes — its first
     # attempt (2026-07-31 03:50) stalled and the pool went down with it.

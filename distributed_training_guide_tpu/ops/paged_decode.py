@@ -22,6 +22,16 @@ this): ``window`` (static, or traced per-layer schedules riding the same
 ``softcap``. Positions past ``lengths`` (trash-page rows, stale tail
 garbage) are cut by the causal mask exactly as in the gather path.
 
+QUANTIZED pools (``serve/kv_pages.py`` ``kv_dtype="int8"``): pass the
+per-(position, kv-head) fp32 scales as ``k_scale``/``v_scale``
+``[P, page, Hkv]`` and the kernel dequantizes IN the tile loop — the
+scale blocks ride their own block-table BlockSpec, so step (s, h, m)
+DMAs physical page ``tables[s, m]``'s payload AND its scale row in the
+same prefetch-driven pattern, multiplies them in fp32 inside the
+online-softmax accumulation, and still writes only the [S, Hq, D]
+output. The decode read drops to ~1/4 of the fp32 bytes (int8 payload +
+4 B/vector scales) with no float pool ever materialized.
+
 ``interpret=True`` runs the kernel on CPU — the tier-1 parity grid in
 ``tests/test_paged_decode.py`` pins it against the XLA gather path at
 1e-5 across GQA/window/scale/softcap and shuffled physical layouts.
@@ -62,13 +72,19 @@ except Exception:  # pragma: no cover
     pltpu = None
 
 
-def _decode_kernel(lens_ref, tabs_ref, band_ref, q_ref, k_ref, v_ref, o_ref,
-                   m_scr, l_scr, acc_scr, *, scale, softcap, page,
-                   num_page_blocks):
+def _decode_kernel(lens_ref, tabs_ref, band_ref, q_ref, k_ref, v_ref, *rest,
+                   scale, softcap, page, num_page_blocks, quantized):
     """Grid (slot, kv_head, page_block); page_block innermost so the
     (m, l, acc) scratch carries the online softmax across the slot's
     pages. One query row per slot: block_q == 1 with the query offset at
-    ``lengths[slot]`` drives the shared band machinery."""
+    ``lengths[slot]`` drives the shared band machinery. Under
+    ``quantized`` two more inputs follow k/v: the page's k/v scale rows,
+    DMA'd through the same block-table index map and multiplied into the
+    int8 payload right here in the tile loop."""
+    if quantized:
+        ks_ref, vs_ref, o_ref, m_scr, l_scr, acc_scr = rest
+    else:
+        o_ref, m_scr, l_scr, acc_scr = rest
     s_idx = pl.program_id(0)
     m_idx = pl.program_id(2)
     q_pos = lens_ref[s_idx]          # the new token's position (see caller)
@@ -90,6 +106,8 @@ def _decode_kernel(lens_ref, tabs_ref, band_ref, q_ref, k_ref, v_ref, o_ref,
     def _compute():
         q = q_ref[0, 0].astype(jnp.float32)          # [G, D] (GQA group)
         k = k_ref[0, :, 0, :].astype(jnp.float32)    # [page, D]
+        if quantized:   # in-tile dequant: int8 payload x per-vector scale
+            k = k * ks_ref[0, :, 0][:, None]
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * scale
         if softcap is not None:  # Gemma-2: tanh cap BEFORE the mask
@@ -112,6 +130,8 @@ def _decode_kernel(lens_ref, tabs_ref, band_ref, q_ref, k_ref, v_ref, o_ref,
         p = jnp.where(mask, p, 0.0)
         l_new = alpha * l_prev + jnp.sum(p, axis=1, keepdims=True)
         v = v_ref[0, :, 0, :].astype(jnp.float32)    # [page, D]
+        if quantized:
+            v = v * vs_ref[0, :, 0][:, None]
         pv = jax.lax.dot_general(p, v, (((1,), (0,)), ((), ())),
                                  preferred_element_type=jnp.float32)
         acc_scr[:] = acc_scr[:] * alpha + pv
@@ -125,34 +145,49 @@ def _decode_kernel(lens_ref, tabs_ref, band_ref, q_ref, k_ref, v_ref, o_ref,
         o_ref[0, 0] = (acc_scr[:] / safe_l).astype(o_ref.dtype)
 
 
-def paged_decode_eligible(head_dim: int, page_size: int) -> bool:
+def paged_decode_eligible(head_dim: int, page_size: int,
+                          quantized: bool = False) -> bool:
     """Mosaic tile-divisibility gate for the COMPILED kernel (the interpret
-    path takes any shape): head_dim on the lane axis, page on sublanes."""
+    path takes any shape): head_dim on the lane axis, page on sublanes.
+    int8 payloads pack (32, 128) native tiles, so the quantized gate is
+    stricter on the sublane (page) axis — conservative until the TPU
+    pool drains the queued kvq rungs."""
+    if quantized:
+        return head_dim % 64 == 0 and page_size % 32 == 0
     return head_dim % 64 == 0 and page_size % 8 == 0
 
 
 def paged_flash_decode(
     q: jnp.ndarray,          # [S, Hq, D] — one query token per slot
     k_pages: jnp.ndarray,    # [P, page, Hkv, D] — ONE layer's page pool
-    v_pages: jnp.ndarray,
+    v_pages: jnp.ndarray,    # (int8 payload when k_scale/v_scale given)
     tables: jnp.ndarray,     # [S, M] int32 physical page ids (0 = trash)
     lengths: jnp.ndarray,    # [S] int32 — the query token's position; kv
                              # positions j <= lengths[s] are live
     *,
+    k_scale: Optional[jnp.ndarray] = None,   # [P, page, Hkv] fp32 — the
+    v_scale: Optional[jnp.ndarray] = None,   # quantized pool's scales
     window=None,
     scale: Optional[float] = None,
     softcap: Optional[float] = None,
     interpret: Optional[bool] = None,
 ) -> jnp.ndarray:
-    """Flash decode through the block table; returns [S, Hq, D] in q.dtype.
+    """Flash decode through the block table; returns [S, Hq, D] in q.dtype
+    (the output dtype is the QUERY's — a quantized pool still emits float
+    attention).
 
     The caller has already scattered the new token's k/v into the pages
     (``serve/kv_pages.paged_attend`` owns that write), so position
     ``lengths[s]`` is resident and the causal mask keeps everything past
     it (trash page, stale garbage) out — identical semantics to the XLA
-    gather reference, without the gathered view.
+    gather reference, without the gathered view. ``k_scale``/``v_scale``
+    (both or neither) switch on the in-kernel dequant of an int8 pool.
     """
     check_static_window(window)
+    quantized = k_scale is not None or v_scale is not None
+    if quantized and (k_scale is None or v_scale is None):
+        raise ValueError("pass both k_scale and v_scale (or neither) — a "
+                         "half-quantized pool cannot exist")
     s, hq, d = q.shape
     _, page, hkv, _ = k_pages.shape
     m = tables.shape[1]
@@ -168,33 +203,44 @@ def paged_flash_decode(
         scale = 1.0 / (d ** 0.5)
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
-    if not interpret and not paged_decode_eligible(d, page):
+    if not interpret and not paged_decode_eligible(d, page,
+                                                   quantized=quantized):
         raise ValueError(
             f"paged_flash_decode (compiled) needs head_dim % 64 == 0 and "
-            f"page_size % 8 == 0; got head_dim={d}, page_size={page} — "
-            f"use impl='xla' or adjust page_size")
+            f"page_size % {32 if quantized else 8} == 0; got head_dim={d}, "
+            f"page_size={page} — use impl='xla' or adjust page_size")
     band = _pack_band(window)     # [window|2**30, 0, 0] int32 — the same
                                   # dynamic-band contract as the training
                                   # kernels; traced per-layer windows ride it
     qr = q.reshape(s, hkv, groups, d)
 
     kernel = functools.partial(_decode_kernel, scale=scale, softcap=softcap,
-                               page=page, num_page_blocks=m)
+                               page=page, num_page_blocks=m,
+                               quantized=quantized)
+    # the point of the kernel: the kv BlockSpecs read THROUGH the block
+    # table — step (s, h, m) DMAs physical page tables[s, m]; a quantized
+    # pool's scale rows ride the SAME index map as two more operands
+    table_kv = pl.BlockSpec((1, page, 1, d),
+                            lambda s_, h, m_, lens, tabs, band_:
+                            (tabs[s_, m_], 0, h, 0))
+    table_scale = pl.BlockSpec((1, page, 1),
+                               lambda s_, h, m_, lens, tabs, band_:
+                               (tabs[s_, m_], 0, h))
+    in_specs = [
+        pl.BlockSpec((1, 1, groups, d),
+                     lambda s_, h, m_, lens, tabs, band_: (s_, h, 0, 0)),
+        table_kv,
+        table_kv,
+    ]
+    operands = [qr, k_pages, v_pages]
+    if quantized:
+        in_specs += [table_scale, table_scale]
+        operands += [k_scale.astype(jnp.float32),
+                     v_scale.astype(jnp.float32)]
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=3,          # lengths, tables, band
         grid=(s, hkv, m),
-        in_specs=[
-            pl.BlockSpec((1, 1, groups, d),
-                         lambda s_, h, m_, lens, tabs, band_: (s_, h, 0, 0)),
-            # the point of the kernel: the kv BlockSpec reads THROUGH the
-            # block table — step (s, h, m) DMAs physical page tables[s, m]
-            pl.BlockSpec((1, page, 1, d),
-                         lambda s_, h, m_, lens, tabs, band_:
-                         (tabs[s_, m_], 0, h, 0)),
-            pl.BlockSpec((1, page, 1, d),
-                         lambda s_, h, m_, lens, tabs, band_:
-                         (tabs[s_, m_], 0, h, 0)),
-        ],
+        in_specs=in_specs,
         out_specs=pl.BlockSpec((1, 1, groups, d),
                                lambda s_, h, m_, lens, tabs, band_:
                                (s_, h, 0, 0)),
@@ -209,6 +255,5 @@ def paged_flash_decode(
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((s, hkv, groups, d), q.dtype),
         interpret=interpret,
-    )(lengths.astype(jnp.int32), tables.astype(jnp.int32), band, qr,
-      k_pages, v_pages)
+    )(lengths.astype(jnp.int32), tables.astype(jnp.int32), band, *operands)
     return out.reshape(s, hq, d)

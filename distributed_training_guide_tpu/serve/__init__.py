@@ -11,10 +11,14 @@ the kv-head axis under tp, attend shard_map'd over per-chip slices),
 DISAGGREGATED prefill/decode engines connected by a refcounted page
 handoff (``serve/disagg.py``, DistServe), a STREAMING request layer
 (``serve/api.py`` — per-token SSE, deadlines, priorities, structured
-refusals, lock-free metrics), and SPECULATIVE DECODING
+refusals, lock-free metrics), SPECULATIVE DECODING
 (``serve/spec.py`` — n-gram prompt-lookup and draft-model drafting with
 exact-acceptance multi-token verification: spec-on output is
-token-identical to spec-off at any temperature). See
+token-identical to spec-off at any temperature), and QUANTIZED KV PAGES
+(``kv_dtype="int8"`` — block-wise absmax int8 payloads with
+per-(position, kv-head) fp32 scales as first-class pool state,
+dequantized in the flash kernel's tile loop: ~0.26-0.31x the fp32 pool
+bytes, spec acceptance the built-in quality meter). See
 related-topics/serving/README.md.
 
     from distributed_training_guide_tpu.serve import (

@@ -57,6 +57,18 @@ def main(argv=None) -> None:
                         help="decode attend: the Pallas block-table kernel "
                         "('flash', TPU), the gather reference ('xla'), or "
                         "platform auto-dispatch")
+    parser.add_argument("--kv-dtype", default=None,
+                        choices=("fp32", "bf16", "int8"),
+                        help="KV page pool storage (default: the model "
+                        "dtype). 'int8' stores block-wise absmax-quantized "
+                        "payloads with per-(position, kv-head) fp32 scales "
+                        "— ~3x more pages per pool byte, dequantized "
+                        "in-kernel on the decode read; the kv_report line "
+                        "prices it. Pair with --page-size 32 on TPU: the "
+                        "int8 kernel tiles need page_size %% 32 == 0 (an "
+                        "engine whose page size would demote an otherwise "
+                        "kernel-eligible model to the gather path warns at "
+                        "construction)")
     parser.add_argument("--speculate", default="off",
                         choices=("off", "ngram", "draft"),
                         help="speculative decoding: 'ngram' is the "
@@ -180,7 +192,8 @@ def main(argv=None) -> None:
                   prefix_cache=not args.no_prefix_cache,
                   attend_impl=args.attend_impl, plan=plan,
                   shard_kv=args.shard_kv, max_queue=args.max_queue,
-                  speculate=speculate, spec_k=args.spec_k)
+                  speculate=speculate, spec_k=args.spec_k,
+                  kv_dtype=args.kv_dtype)
     if args.disagg:
         from .disagg import DisaggEngine
 

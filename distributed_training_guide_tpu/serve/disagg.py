@@ -59,7 +59,8 @@ from .engine import (LatencyMeter, ModelPrograms, advance_prefill_chunks,
                      resolve_drafter, run_bucket_prefill,
                      run_decode_iteration, run_fork, spec_metrics,
                      validate_prefill_buckets)
-from .kv_pages import PagePool, kv_page_bytes
+from .kv_pages import (check_kv_page_geometry, kv_page_bytes, PagePool,
+                       pool_nbytes)
 from .scheduler import Admission, Request, RequestResult, Scheduler
 from .spec import new_spec_counters
 
@@ -284,7 +285,7 @@ class DisaggEngine:
                  prefill_chunk: Optional[int] = None,
                  prefix_cache: bool = True, attend_impl: str = "auto",
                  shard_kv: bool = False, max_queue: Optional[int] = None,
-                 speculate=None, spec_k: int = 4):
+                 speculate=None, spec_k: int = 4, kv_dtype=None):
         if n_prefill_slots < 1:
             raise ValueError(f"n_prefill_slots must be >= 1, got "
                              f"{n_prefill_slots}")
@@ -301,10 +302,18 @@ class DisaggEngine:
             attend_impl = "xla"
         self.programs = ModelPrograms(bundle, params, plan=plan,
                                       shard_kv=shard_kv,
-                                      attend_impl=attend_impl)
+                                      attend_impl=attend_impl,
+                                      kv_dtype=kv_dtype)
         self.bundle, self.config = bundle, bundle.config
+        # both halves write/read ONE pool at one storage dtype; the
+        # handoff moves page ids, so a quantized page's payload AND its
+        # scale rows transfer by refcount exactly like float pages
+        self.kv_dtype = self.programs.kv_dtype
         max_len, self.max_model_len, self.max_pages = \
             resolve_context_bounds(self.config, max_len, page_size)
+        check_kv_page_geometry(self.config, page_size=page_size,
+                               kv_dtype=self.kv_dtype,
+                               attend_impl=self.programs.attend_impl)
         self.page_size = page_size
         self.n_slots = n_slots
         self.n_prefill_slots = n_prefill_slots
@@ -457,7 +466,9 @@ class DisaggEngine:
                 admitted=p.stats.get("admitted", 0),
                 prefix_hits=s.get("prefix_hits", 0), lat=self._lat,
                 bytes_per_page=kv_page_bytes(self.config,
-                                             page_size=self.page_size)),
+                                             page_size=self.page_size,
+                                             kv_dtype=self.kv_dtype),
+                pool_dtype=self.kv_dtype),
             **spec_metrics(self.decode.spec,
                            decode_steps=self.decode.decode_steps,
                            decode_tokens=self.decode.decode_tokens,
@@ -470,5 +481,4 @@ class DisaggEngine:
             self.programs, page_size=self.page_size, pool=self.pool,
             cached_pages=self.prefill.sched.cache_pages_held(),
             n_slots=self.n_slots, max_pages=self.max_pages,
-            pool_bytes=int(self.pages["k"].nbytes
-                           + self.pages["v"].nbytes))
+            pool_bytes=pool_nbytes(self.pages))

@@ -59,8 +59,10 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..models.registry import ModelBundle, family_module
-from .kv_pages import (commit_prefill, copy_pages, init_pages, kv_page_bytes,
-                       make_attend, PagePool, pages_for_tokens)
+from ..train.precision import Quantized
+from .kv_pages import (check_kv_page_geometry, commit_prefill, copy_pages,
+                       init_pages, kv_dtype_name, kv_page_bytes, make_attend,
+                       PagePool, pages_for_tokens, pool_nbytes)
 from .scheduler import Admission, Request, RequestResult, Scheduler
 from .spec import Drafter, NgramDrafter, new_spec_counters
 
@@ -119,16 +121,22 @@ def derived_pool_metrics(*, pool: PagePool, cached_pages: int, n_slots: int,
                          decode_steps: int, decode_tokens: int,
                          admitted: int, prefix_hits: int,
                          lat: "LatencyMeter",
-                         bytes_per_page: int = 0) -> dict:
+                         bytes_per_page: int = 0,
+                         pool_dtype: str = "fp32") -> dict:
     """The derived stats() tail both engines expose (api.py's
     throughput_stats and /healthz index these keys on either).
     ``pages_cached_bytes`` sits next to the hit rate so cache pressure is
     visible in bytes, not just page counts — together with the
     scheduler's ``cache_evicted_pages`` counter a thrashing prefix cache
-    (high hit rate, high churn) no longer looks healthy on /healthz."""
+    (high hit rate, high churn) no longer looks healthy on /healthz.
+    ``pool_dtype`` + ``bytes_per_page`` surface the quantization lever in
+    bytes (scales included), so a kv_dtype="int8" capacity gain is a
+    number on /healthz, not a vibe."""
     held = pool.capacity - pool.n_free
     return {
         "n_slots": n_slots,
+        "pool_dtype": pool_dtype,
+        "bytes_per_page": bytes_per_page,
         "pages_capacity": pool.capacity,
         "pages_free": pool.n_free,
         "pages_held": held,
@@ -524,21 +532,31 @@ def drop_stale_pending(sched: Scheduler, pending: dict) -> None:
 def build_kv_report(programs: "ModelPrograms", *, page_size: int,
                     pool: PagePool, cached_pages: int, n_slots: int,
                     max_pages: int, pool_bytes: int) -> dict:
-    """The preflight-style byte table for one engine's pool."""
-    per_page = kv_page_bytes(programs.config, page_size=page_size)
+    """The preflight-style byte table for one engine's pool. Priced at
+    the pool's OWN kv_dtype (scale bytes included under int8), with the
+    fp32 per-page cost alongside so the quantization gain is a ratio the
+    reader can check against ``pool_bytes``."""
+    kv_dtype = programs.kv_dtype
+    per_page = kv_page_bytes(programs.config, page_size=page_size,
+                             kv_dtype=kv_dtype)
+    per_page_fp32 = kv_page_bytes(programs.config, page_size=page_size,
+                                  kv_dtype="fp32")
     shards = (int(programs.mesh.shape["tp"]) if programs.shard_kv else 1)
     return {
         "page_size": page_size,
+        "pool_dtype": kv_dtype,
         "n_pages": pool.n_pages,
         "pages_free": pool.n_free,
         "pages_cached": cached_pages,
         "bytes_per_page": per_page,
+        "bytes_per_page_fp32": per_page_fp32,
+        "bytes_vs_fp32": round(per_page / per_page_fp32, 4),
         "kv_shards": shards,
         "bytes_per_page_per_chip": per_page // shards,
         "pool_bytes": pool_bytes,
         "dense_equivalent_bytes": kv_page_bytes(
             programs.config, page_size=page_size,
-            n_pages=n_slots * max_pages),
+            n_pages=n_slots * max_pages, kv_dtype=kv_dtype),
     }
 
 
@@ -557,7 +575,8 @@ class ModelPrograms:
     """
 
     def __init__(self, bundle: ModelBundle, params, *, plan=None,
-                 shard_kv: bool = False, attend_impl: str = "auto"):
+                 shard_kv: bool = False, attend_impl: str = "auto",
+                 kv_dtype=None):
         self.bundle = bundle
         self.config = bundle.config
         self.mod = family_module(bundle.family)
@@ -569,6 +588,11 @@ class ModelPrograms:
             raise ValueError(f"attend_impl must be 'auto', 'flash' or "
                              f"'xla', got {attend_impl!r}")
         self.attend_impl = attend_impl
+        # the pool's storage dtype ("fp32" | "bf16" | "int8"; None inherits
+        # the model dtype). int8 pools are Quantized pytrees — every
+        # pool-touching program below threads them transparently, and the
+        # scales are first-class pool state (CoW/commit/handoff/sharding)
+        self.kv_dtype = kv_dtype_name(self.config, kv_dtype)
         self.plan = plan
         self.shard_kv = bool(shard_kv)
         self.mesh = plan.mesh if plan is not None else None
@@ -580,9 +604,14 @@ class ModelPrograms:
 
             validate_kv_shard(plan, self.config)
             # the rules-table pattern: pool sharding comes from the serve
-            # regex -> PartitionSpec table, not an ad-hoc spec here
-            probe = {"pages": {"k": np.zeros((2, 2, 2, 2, 2)),
-                               "v": np.zeros((2, 2, 2, 2, 2))}}
+            # regex -> PartitionSpec table, not an ad-hoc spec here; the
+            # probe mirrors the pool's pytree structure (payload + scales
+            # under int8) so the sharding tree matches leaf for leaf
+            leaf = np.zeros((2, 2, 2, 2, 2))
+            if self.kv_dtype == "int8":
+                leaf = Quantized(q=leaf.astype(np.int8),
+                                 scale=np.zeros((2, 2, 2, 2, 1), np.float32))
+            probe = {"pages": {"k": leaf, "v": leaf}}
             self._kv_sharding = serve_kv_shardings(
                 self.mesh, probe)["pages"]["k"]
             self._repl = plan.replicated()
@@ -625,7 +654,8 @@ class ModelPrograms:
     def init_device_pages(self, n_pages: int, page_size: int) -> dict:
         """Zeroed pools placed per the serve sharding rules (kv-head
         split under shard_kv, replicated under a plain plan)."""
-        pages = init_pages(self.config, n_pages, page_size)
+        pages = init_pages(self.config, n_pages, page_size,
+                           kv_dtype=self.kv_dtype)
         if self.shard_kv:
             return jax.device_put(pages, {"k": self._kv_sharding,
                                           "v": self._kv_sharding})
@@ -813,6 +843,18 @@ class ServeEngine:
     pool on the kv-head axis and runs the attend (flash kernel included)
     shard_map'd with per-chip pool slices — the distributed-pool mode
     (tp-only meshes; see serve/sharding.py).
+
+    ``kv_dtype`` ("fp32" | "bf16" | "int8"; default: the model dtype)
+    picks the pool's STORAGE: "int8" stores block-wise absmax-quantized
+    payloads with per-(position, kv-head) fp32 scales (serve/kv_pages.py)
+    — ~0.31x the fp32 pool bytes at head_dim 16 (0.27x at 64), so ~3x
+    more pages per pool byte and proportionally less HBM read on the
+    bandwidth-bound decode. Every write site quantizes, every read site
+    dequantizes (in-kernel on the flash path), and all scheduling
+    invariants — bitwise replay, CoW, handoff, spec-on == spec-off —
+    carry over because quantization is pure per token. Quality is a
+    measurable trade: tests/test_kv_quant.py pins the attend error bound
+    and the spec-acceptance delta vs an fp32-KV control.
     """
 
     def __init__(self, bundle: ModelBundle, params, *, n_slots: int = 8,
@@ -823,7 +865,7 @@ class ServeEngine:
                  prefix_cache: bool = True, attend_impl: str = "auto",
                  shard_kv: bool = False, max_queue: Optional[int] = None,
                  programs: Optional[ModelPrograms] = None,
-                 speculate=None, spec_k: int = 4):
+                 speculate=None, spec_k: int = 4, kv_dtype=None):
         self.drafter = resolve_drafter(speculate, spec_k=spec_k,
                                        n_slots=n_slots)
         self.spec = new_spec_counters()
@@ -842,8 +884,9 @@ class ServeEngine:
             attend_impl = "xla"
         self.programs = programs if programs is not None else ModelPrograms(
             bundle, params, plan=plan, shard_kv=shard_kv,
-            attend_impl=attend_impl)
+            attend_impl=attend_impl, kv_dtype=kv_dtype)
         self.bundle = self.programs.bundle
+        self.kv_dtype = self.programs.kv_dtype
         self.config = self.programs.config
         self.mod = self.programs.mod
         self.plan = self.programs.plan
@@ -854,6 +897,9 @@ class ServeEngine:
         self.prefill_chunk = prefill_chunk
         max_len, self.max_model_len, self.max_pages = \
             resolve_context_bounds(self.config, max_len, page_size)
+        check_kv_page_geometry(self.config, page_size=page_size,
+                               kv_dtype=self.kv_dtype,
+                               attend_impl=self.attend_impl)
         self.page_size = page_size
         self.n_slots = n_slots
         if n_pages is None:
@@ -914,9 +960,11 @@ class ServeEngine:
 
     def kv_cache_bytes(self) -> int:
         """Resident KV bytes — scales with the page pool, NOT with
-        n_slots x max_len (the memory pin in tests/test_serve.py).
-        Global bytes: under shard_kv each chip holds 1/tp of this."""
-        return int(self.pages["k"].nbytes + self.pages["v"].nbytes)
+        n_slots x max_len (the memory pin in tests/test_serve.py). Summed
+        over the pool's LEAVES (``kv_pages.pool_nbytes``), so a quantized
+        pool's fp32 scales are counted, not hidden. Global bytes: under
+        shard_kv each chip holds 1/tp of this."""
+        return pool_nbytes(self.pages)
 
     def _sample_first(self, adm: Admission, logit) -> Optional[RequestResult]:
         """First token off the prefill logits (skipped for preempted
@@ -1021,7 +1069,9 @@ class ServeEngine:
                 admitted=s.get("admitted", 0),
                 prefix_hits=s.get("prefix_hits", 0), lat=self._lat,
                 bytes_per_page=kv_page_bytes(self.config,
-                                             page_size=self.page_size)),
+                                             page_size=self.page_size,
+                                             kv_dtype=self.kv_dtype),
+                pool_dtype=self.kv_dtype),
             **spec_metrics(self.spec, decode_steps=self.decode_steps,
                            decode_tokens=self.decode_tokens,
                            drafter=self.drafter),

@@ -36,6 +36,42 @@ the kernel's interpret mode is for CI correctness, not CPU throughput).
 Multi-token calls (chunked prefill) always take the gather path — the
 kernel is the single-token decode specialist.
 
+QUANTIZED pools (``kv_dtype="int8"``): the k/v payload is stored int8
+with block-wise absmax scales (``train/precision.py``'s Dettmers
+machinery, the same code path the adam8bit optimizer state uses) —
+~4x fewer pool bytes than fp32 and ~4x fewer HBM bytes on the
+bandwidth-bound decode read. The block is one (position, kv-head) k/v
+vector — ``head_dim`` elements, one fp32 scale — so the scale tensor
+``[L, P, page, kvh, 1]`` tiles the pool exactly: scale rows ride page
+identity (CoW forks copy them, the prefix cache and the disaggregated
+handoff share/move them for free, the sharded pool splits them on the
+same kv-head axis). Deliberately NOT one scale per whole page: a
+page-granular absmax would change when a LATER token raises the page's
+absmax, forcing a requantization that mutates already-written k/v —
+which would break the engine's bitwise guarantees (preemption replay
+and speculative verification rewrite single tokens and must reproduce
+the original pool bytes exactly). Per-token blocks keep every write
+independent: ``quantize(x)`` is a pure function of that token's k/v, so
+replay/verify/chunk writes are bitwise identical however the token
+first arrived. Quantization happens at every write site (decode
+scatter, prefill commit, chunked-prefill/verify multi-token scatter);
+dequantization at every read site (the gather view, and inside the
+flash-decode kernel's tile loop — the scale rides a second block-table
+DMA operand).
+
+One consequence to know: under int8 token identity is PROGRAM-relative.
+A chunked prefill attends over already-quantized history (every chunk
+reads the pool), while a bucket prefill computes the whole prompt in
+float and quantizes once at commit — in fp32 those two paths agree to
+~1e-7 (argmax flips are a lottery the test suite never loses), but
+under int8 the difference is a genuine 1-LSB cache rounding that CAN
+flip a downstream near-tie. Every identity guarantee the engines make
+(batch-1 invariance, spec-on == spec-off, preemption replay) holds
+bitwise WITHIN one engine configuration because each token's k/v is
+rewritten by the same program that wrote it; comparing engines across
+prefill modes is a quality question (bounded by the attend error
+pinned in tests/test_kv_quant.py), not an identity one.
+
 Device-side pieces (``paged_attend``, ``commit_prefill``, ``copy_pages``)
 are pure functions of array arguments — block tables and lengths arrive
 as int32 arrays, so requests coming and going never change a traced
@@ -51,8 +87,13 @@ import jax.numpy as jnp
 
 from ..ops.attention import multihead_attention
 from ..ops.paged_decode import paged_decode_eligible, paged_flash_decode
+from ..train.precision import (Quantized, dequantize_blockwise,
+                               quantize_blockwise)
 
 TRASH_PAGE = 0  # physical page id reserved for masked/idle writes
+
+KV_DTYPES = ("fp32", "bf16", "int8")
+_KV_FLOAT = {"fp32": jnp.float32, "bf16": jnp.bfloat16}
 
 
 def pages_for_tokens(n_tokens: int, page_size: int) -> int:
@@ -65,23 +106,107 @@ def num_kv_heads(config) -> int:
     return getattr(config, "num_kv_heads", config.num_heads)
 
 
-def kv_page_bytes(config, *, page_size: int, n_pages: int = 1) -> int:
-    """Resident bytes of ``n_pages`` KV pages for this model:
-    pages x layers x 2 (k and v) x page_size x kv_heads x head_dim x
-    itemsize — the per-slot serving cost is this at
+def kv_dtype_name(config, kv_dtype=None) -> str:
+    """Normalize the engine's ``kv_dtype=`` knob: None inherits the
+    model's storage dtype (the pre-quantization behavior), otherwise one
+    of ``KV_DTYPES``. The name — not a jnp dtype — is the canonical form
+    because "int8" is payload + scales, not a single dtype."""
+    if kv_dtype is None:
+        return "bf16" if jnp.dtype(config.dtype) == jnp.bfloat16 else "fp32"
+    name = str(kv_dtype).lower()
+    alias = {"float32": "fp32", "bfloat16": "bf16"}
+    name = alias.get(name, name)
+    if name not in KV_DTYPES:
+        raise ValueError(f"kv_dtype must be one of {KV_DTYPES}, got "
+                         f"{kv_dtype!r}")
+    return name
+
+
+def quantize_kv(x: jax.Array) -> Quantized:
+    """Block-wise absmax int8 of one or more k/v vectors: the block is
+    the trailing ``head_dim`` axis, so each (position, kv-head) vector
+    quantizes independently with one fp32 scale (``scale`` keeps a
+    trailing size-1 block axis — the ``train/precision.py`` container
+    contract). Pure per token, which is what keeps replay/verify writes
+    bitwise reproducible (module docstring)."""
+    return quantize_blockwise(x, block_size=x.shape[-1])
+
+
+def dequantize_kv(qt: Quantized, dtype=jnp.float32) -> jax.Array:
+    return dequantize_blockwise(qt, dtype=dtype)
+
+
+def pool_nbytes(pages: dict) -> int:
+    """Resident bytes of a pools dict, summed over LEAVES — the one place
+    that knows a quantized pool's fp32 scales count too (consumed by the
+    monolith's ``kv_cache_bytes`` and the disagg facade's report, so the
+    two can never diverge on what 'pool bytes' means)."""
+    return int(sum(x.nbytes for x in jax.tree.leaves(pages)))
+
+
+def check_kv_page_geometry(config, *, page_size: int, kv_dtype,
+                           attend_impl: str) -> None:
+    """Warn at ENGINE CONSTRUCTION when the chosen (kv_dtype, page_size)
+    cannot take the compiled flash-decode kernel on TPU: int8 payloads
+    pack stricter Mosaic tiles (page_size % 32), so the default
+    page_size=16 pool would silently fall back to the gather program
+    under ``attend_impl='auto'`` — paying ~3x the kernel's decode
+    traffic and contradicting the in-kernel-dequant pitch. Only fires
+    when int8 REGRESSES eligibility — a shape the fp32 kernel also
+    couldn't tile (debug models' head_dim 16) never had the flash path
+    to lose, and stays silent. Off-TPU nothing changes (the gather path
+    is the CPU default regardless), but the warning fires anywhere so
+    the misconfiguration is caught in CI, not on the pod."""
+    if kv_dtype_name(config, kv_dtype) != "int8" or attend_impl == "xla":
+        return
+    if (paged_decode_eligible(config.head_size, page_size)
+            and not paged_decode_eligible(config.head_size, page_size,
+                                          quantized=True)):
+        import warnings
+
+        warnings.warn(
+            f"kv_dtype='int8' with page_size={page_size} (head_dim "
+            f"{config.head_size}) is not eligible for the compiled "
+            f"flash-decode kernel (int8 Mosaic tiles need page_size % 32 "
+            f"== 0 and head_dim % 64 == 0): on TPU the decode will run "
+            f"the gather path at ~3x the kernel's HBM traffic. Use "
+            f"page_size=32 to keep the in-kernel dequant.",
+            stacklevel=3)
+
+
+def kv_page_bytes(config, *, page_size: int, n_pages: int = 1,
+                  kv_dtype=None) -> int:
+    """Resident bytes of ``n_pages`` KV pages for this model at
+    ``kv_dtype`` (None = the model's storage dtype): pages x layers x 2
+    (k and v) x page_size x kv_heads x (head_dim x payload-itemsize
+    [+ 4 B fp32 scale per vector under int8 — the scales are pool state
+    and are priced, not hidden]) — the per-slot serving cost is this at
     ``n_pages = pages_for_tokens(context)`` (train/preflight.py reports
     that table)."""
-    itemsize = jnp.dtype(config.dtype).itemsize
+    name = kv_dtype_name(config, kv_dtype)
+    per_vector = (config.head_size + 4 if name == "int8"
+                  else config.head_size * jnp.dtype(_KV_FLOAT[name]).itemsize)
     return (n_pages * config.num_layers * 2 * page_size
-            * num_kv_heads(config) * config.head_size * itemsize)
+            * num_kv_heads(config) * per_vector)
 
 
-def init_pages(config, n_pages: int, page_size: int) -> dict:
-    """Zeroed page pools {"k","v"}: [L, n_pages, page_size, kvh, hd]."""
+def init_pages(config, n_pages: int, page_size: int, kv_dtype=None) -> dict:
+    """Zeroed page pools {"k","v"}: [L, n_pages, page_size, kvh, hd]
+    arrays, or :class:`Quantized` (int8 payload of that shape + fp32
+    scales [L, n_pages, page_size, kvh, 1]) under ``kv_dtype="int8"``.
+    Zero scales dequantize to the same zero pool the float form starts
+    with."""
+    name = kv_dtype_name(config, kv_dtype)
     shape = (config.num_layers, n_pages, page_size, num_kv_heads(config),
              config.head_size)
-    return {"k": jnp.zeros(shape, config.dtype),
-            "v": jnp.zeros(shape, config.dtype)}
+    if name == "int8":
+        def pool():
+            return Quantized(q=jnp.zeros(shape, jnp.int8),
+                             scale=jnp.zeros(shape[:-1] + (1,), jnp.float32))
+
+        return {"k": pool(), "v": pool()}
+    return {"k": jnp.zeros(shape, _KV_FLOAT[name]),
+            "v": jnp.zeros(shape, _KV_FLOAT[name])}
 
 
 class PagePool:
@@ -122,6 +247,22 @@ class PagePool:
     def refcount(self, page: int) -> int:
         return self._refs[page]
 
+    def describe(self, page: int) -> str:
+        """One-line holder context for a page id — refcount, free-list
+        membership, and the pool's pressure — so a validation error from
+        a thousand-iteration chaos trace localizes itself instead of
+        printing a bare id."""
+        if not 0 <= page < self.n_pages:
+            state = f"out of range (valid ids {TRASH_PAGE + 1}.."\
+                    f"{self.n_pages - 1})"
+        elif page == TRASH_PAGE:
+            state = "the reserved trash page"
+        else:
+            state = (f"refcount {self._refs[page]}, "
+                     + ("free-listed" if page in self._free_set else "held"))
+        return (f"page {page}: {state}; pool {self.n_free}/{self.capacity} "
+                f"free")
+
     def alloc(self, n: int) -> Optional[list[int]]:
         """``n`` pages at refcount 1 each, or None (never a partial
         grant)."""
@@ -142,7 +283,8 @@ class PagePool:
         """Take one additional reference on each (already-live) page."""
         for p in pages:
             if not (TRASH_PAGE < p < self.n_pages) or self._refs[p] < 1:
-                raise ValueError(f"sharing unallocated page id {p}")
+                raise ValueError(f"sharing unallocated page id {p} "
+                                 f"({self.describe(p)})")
         for p in pages:
             self._refs[p] += 1
 
@@ -154,10 +296,13 @@ class PagePool:
         releases: dict[int, int] = {}
         for p in pages:
             if not (TRASH_PAGE < p < self.n_pages):
-                raise ValueError(f"freeing invalid page id {p}")
+                raise ValueError(f"freeing invalid page id {p} "
+                                 f"({self.describe(p)})")
             releases[p] = releases.get(p, 0) + 1
             if p in self._free_set or releases[p] > self._refs[p]:
-                raise ValueError(f"double free of page {p}")
+                raise ValueError(
+                    f"double free of page {p} ({self.describe(p)}; this "
+                    f"batch releases it {releases[p]}x)")
         for p in pages:
             self._refs[p] -= 1
             if self._refs[p] == 0:
@@ -208,8 +353,9 @@ def paged_attend(q, k_new, v_new, k_pages, v_pages, tables, lengths, *,
 
     Returns (attn [S, T, Hq, D], (k_pages, v_pages) updated).
     """
+    quantized = isinstance(k_pages, Quantized)
     s, t = q.shape[0], q.shape[1]
-    page = k_pages.shape[1]
+    page = (k_pages.q if quantized else k_pages).shape[1]
     m = tables.shape[1]
     slot = jnp.arange(s)
     t_idx = lengths[:, None] + jnp.arange(t)[None, :]          # [S, T]
@@ -221,12 +367,23 @@ def paged_attend(q, k_new, v_new, k_pages, v_pages, tables, lengths, *,
         phys = jnp.where(t_idx < (lengths + n_valid)[:, None], phys,
                          TRASH_PAGE)
     off = t_idx % page
-    k_pages = k_pages.at[phys, off].set(k_new.astype(k_pages.dtype))
-    v_pages = v_pages.at[phys, off].set(v_new.astype(v_pages.dtype))
+    if quantized:
+        # quantize-at-write: each new token's [Hkv, D] vector becomes int8
+        # payload + one fp32 scale, scattered to the SAME (page, offset) —
+        # the scale is pool state with page identity, nothing more
+        kq, vq = quantize_kv(k_new), quantize_kv(v_new)
+        k_pages = Quantized(q=k_pages.q.at[phys, off].set(kq.q),
+                            scale=k_pages.scale.at[phys, off].set(kq.scale))
+        v_pages = Quantized(q=v_pages.q.at[phys, off].set(vq.q),
+                            scale=v_pages.scale.at[phys, off].set(vq.scale))
+    else:
+        k_pages = k_pages.at[phys, off].set(k_new.astype(k_pages.dtype))
+        v_pages = v_pages.at[phys, off].set(v_new.astype(v_pages.dtype))
 
     if impl == "auto":
         impl = ("flash" if (t == 1 and jax.default_backend() == "tpu"
-                            and paged_decode_eligible(q.shape[-1], page))
+                            and paged_decode_eligible(q.shape[-1], page,
+                                                      quantized=quantized))
                 else "xla")
     if impl == "flash":
         if t != 1:
@@ -234,13 +391,28 @@ def paged_attend(q, k_new, v_new, k_pages, v_pages, tables, lengths, *,
                 f"impl='flash' is the single-token decode kernel; chunked "
                 f"prefill (T={t}) runs the gather path — use impl='auto' "
                 f"or 'xla'")
-        attn = paged_flash_decode(q[:, 0], k_pages, v_pages, tables,
-                                  lengths, window=window, scale=scale,
-                                  softcap=softcap)[:, None]
+        if quantized:
+            attn = paged_flash_decode(
+                q[:, 0], k_pages.q, v_pages.q, tables, lengths,
+                k_scale=k_pages.scale[..., 0], v_scale=v_pages.scale[..., 0],
+                window=window, scale=scale, softcap=softcap)[:, None]
+        else:
+            attn = paged_flash_decode(q[:, 0], k_pages, v_pages, tables,
+                                      lengths, window=window, scale=scale,
+                                      softcap=softcap)[:, None]
         return attn, (k_pages, v_pages)
 
-    kg = k_pages[tables]                          # [S, M, page, Hkv, D]
-    vg = v_pages[tables]
+    if quantized:
+        # gather payload AND scales through the table, dequantize the
+        # gathered view (context-sized transient, same as the float
+        # gather) — the POOL itself never materializes in float
+        kg = dequantize_kv(Quantized(q=k_pages.q[tables],
+                                     scale=k_pages.scale[tables]), q.dtype)
+        vg = dequantize_kv(Quantized(q=v_pages.q[tables],
+                                     scale=v_pages.scale[tables]), q.dtype)
+    else:
+        kg = k_pages[tables]                      # [S, M, page, Hkv, D]
+        vg = v_pages[tables]
     tot = kg.shape[1] * page
     kg = kg.reshape(s, tot, *kg.shape[3:])
     vg = vg.reshape(s, tot, *vg.shape[3:])
@@ -279,15 +451,27 @@ def commit_prefill(k_pages, v_pages, k_dense, v_dense, table_row, n_tokens,
     scheduler, this scatter simply never touches shared territory).
     Returns the updated pools.
     """
+    quantized = isinstance(k_pages, Quantized)
     pb = k_dense.shape[1]
-    page = k_pages.shape[2]
+    page = (k_pages.q if quantized else k_pages).shape[2]
     m = table_row.shape[0]
     t = jnp.arange(pb)
     phys = jnp.where((t >= start) & (t < n_tokens),
                      table_row[jnp.minimum(t // page, m - 1)], TRASH_PAGE)
     off = t % page
-    k_pages = k_pages.at[:, phys, off].set(k_dense.astype(k_pages.dtype))
-    v_pages = v_pages.at[:, phys, off].set(v_dense.astype(v_pages.dtype))
+    if quantized:
+        # same quantize-at-write grain as the decode scatter: one scale
+        # per (position, kv-head) vector of the dense prefill output
+        kq, vq = quantize_kv(k_dense), quantize_kv(v_dense)
+        k_pages = Quantized(
+            q=k_pages.q.at[:, phys, off].set(kq.q),
+            scale=k_pages.scale.at[:, phys, off].set(kq.scale))
+        v_pages = Quantized(
+            q=v_pages.q.at[:, phys, off].set(vq.q),
+            scale=v_pages.scale.at[:, phys, off].set(vq.scale))
+    else:
+        k_pages = k_pages.at[:, phys, off].set(k_dense.astype(k_pages.dtype))
+        v_pages = v_pages.at[:, phys, off].set(v_dense.astype(v_pages.dtype))
     return k_pages, v_pages
 
 
@@ -295,6 +479,12 @@ def copy_pages(k_pages, v_pages, src, dst):
     """Copy-on-write fork: duplicate physical page ``src`` into ``dst``
     across every layer ([L, P, page, kvh, hd] pools; src/dst are traced
     scalars, so one compile serves every fork). The scheduler calls this
-    before any write lands in a page whose refcount is > 1."""
-    return (k_pages.at[:, dst].set(k_pages[:, src]),
-            v_pages.at[:, dst].set(v_pages[:, src]))
+    before any write lands in a page whose refcount is > 1. Tree-generic
+    over the pool leaves, so a quantized pool's scales fork WITH their
+    payload — a dst page whose scales still described the old content
+    would dequantize garbage."""
+
+    def fork(a):
+        return a.at[:, dst].set(a[:, src])
+
+    return jax.tree.map(fork, k_pages), jax.tree.map(fork, v_pages)

@@ -46,8 +46,14 @@ from .kv_pages import commit_prefill, copy_pages, num_kv_heads, paged_attend
 # bookkeeping array the compiled programs consume is replicated. An
 # unmatched leaf is an error by design (silent replication of a pool-sized
 # tensor is the exact failure class this table exists to prevent).
+# A QUANTIZED pool (serve/kv_pages.py kv_dtype="int8") is a Quantized
+# NamedTuple per pool: int8 payload [L, P, page, kvh, hd] plus fp32 scales
+# [L, P, page, kvh, 1] — BOTH split on the same kv-head axis (each chip's
+# heads dequantize with each chip's scales, so the manual attend/commit/
+# copy regions stay collective-free; the per-(position, head) scale grain
+# is what makes that possible — a cross-head block would need a gather).
 SERVE_KV_RULES = (
-    (r"pages/(k|v)$", P(None, None, None, "tp", None)),
+    (r"pages/(k|v)(/(q|scale))?$", P(None, None, None, "tp", None)),
     (r"(tables|table_row)$", P()),
     (r"(lengths|tokens|seeds|actives|n_valid)$", P()),
     (r"(temps|top_ks|top_ps)$", P()),
@@ -74,6 +80,8 @@ def match_partition_rules(rules, tree):
                 parts.append(str(p.key))
             elif hasattr(p, "idx"):
                 parts.append(str(p.idx))
+            elif hasattr(p, "name"):       # NamedTuple fields (GetAttrKey):
+                parts.append(str(p.name))  # the Quantized pool's q/scale
             else:
                 parts.append(str(p))
         return "/".join(parts)

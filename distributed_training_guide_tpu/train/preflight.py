@@ -324,7 +324,7 @@ def run_preflight(trainer, *, global_batch: int, seq_length: int,
     # "does the step fit"; this row answers the follow-on "how many
     # concurrent requests fit next to the weights when the checkpoint
     # serves" before anyone sizes a pool by trial and error.
-    from ..serve.kv_pages import kv_page_bytes, num_kv_heads, \
+    from ..serve.kv_pages import KV_DTYPES, kv_page_bytes, num_kv_heads, \
         pages_for_tokens
 
     page_size = 16
@@ -379,6 +379,19 @@ def run_preflight(trainer, *, global_batch: int, seq_length: int,
         "handoff_bytes_same_host": 0,
         "handoff_bytes_cross_host_at_seq": per_slot,
     }
+    # kv_dtype column (serve/kv_pages.py): every per-page/per-slot figure
+    # above parameterizes on the pool's storage dtype — int8 rows INCLUDE
+    # the per-(position, kv-head) fp32 scales (payload bytes alone would
+    # overstate the win). The same ratio applies to the decode read, the
+    # cross-host handoff payload, and the slots-per-HBM-byte capacity.
+    by_dtype = {name: kv_page_bytes(cfg, page_size=page_size, kv_dtype=name)
+                for name in KV_DTYPES}
+    report["serve_kv"].update({
+        "bytes_per_page_by_kv_dtype": by_dtype,
+        "bytes_per_slot_by_kv_dtype": {
+            name: b * pages_per_slot for name, b in by_dtype.items()},
+        "int8_bytes_vs_fp32": round(by_dtype["int8"] / by_dtype["fp32"], 4),
+    })
     # speculative decoding (serve/spec.py): decode's OTHER traffic is the
     # weight read — every spec-off token pays the full per-chip param
     # bytes. A verify step amortizes one weight pass over the accepted
@@ -404,7 +417,11 @@ def run_preflight(trainer, *, global_batch: int, seq_length: int,
         f"per slot"
         + (f"; kv-head-sharded pool: {per_slot / kv_shards / 2**20:.2f} "
            f"MiB per chip at tp={kv_shards}" if kv_shards > 1 else "")
-        + f"); decode reads {kernel_read / 2**20:.2f} MiB/token "
+        + f"); int8 KV pages (kv_dtype='int8', scales included) cut a page "
+        f"to {by_dtype['int8'] / 2**10:.1f} KiB — "
+        f"{by_dtype['int8'] / by_dtype['fp32']:.2f}x of fp32, the same "
+        f"factor on decode reads and the cross-host handoff payload"
+        f"; decode reads {kernel_read / 2**20:.2f} MiB/token "
         f"through the flash-decode kernel (the gather view moved "
         f"~{gather_traffic / 2**20:.2f} MiB/token); a {shared_tokens}-token "
         f"shared prefix amortizes {shared_bytes / 2**20:.2f} MiB per "

@@ -411,6 +411,19 @@ def test_preflight_budget_and_lowering(eight_devices):
     assert sk["handoff_bytes_same_host"] == 0
     assert sk["handoff_bytes_cross_host_at_seq"] == \
         sk["bytes_per_slot_at_seq"]
+    # kv_dtype rows (quantized KV pages, serve/kv_pages.py): the int8
+    # figure INCLUDES the per-(position, kv-head) fp32 scales — payload
+    # bytes alone would overstate the capacity win
+    by = sk["bytes_per_page_by_kv_dtype"]
+    model_dtype = ("bf16" if jnp.dtype(dcfg.dtype) == jnp.bfloat16
+                   else "fp32")
+    assert by[model_dtype] == sk["bytes_per_page"]   # headline row = model
+    assert by["fp32"] == (dcfg.num_layers * 2 * 16 * dcfg.num_kv_heads
+                          * dcfg.head_size * 4)
+    assert by["int8"] == (dcfg.num_layers * 2 * 16 * dcfg.num_kv_heads
+                          * (dcfg.head_size + 4))
+    assert sk["bytes_per_slot_by_kv_dtype"]["int8"] == 4 * by["int8"]
+    assert sk["int8_bytes_vs_fp32"] <= 0.55
 
     # tp mesh: the sharded pool (serve/sharding.py kv-head split) halves
     # the per-CHIP page/slot bytes at tp=2 (llama-debug: 2 kv heads)

@@ -46,6 +46,29 @@ def test_pool_free_validates():
         pool.free([99])
 
 
+def test_pool_errors_carry_holder_context():
+    """The localization satellite: validation errors name the page's
+    refcount / free-list state and the pool's pressure — a bare id out
+    of a thousand-iteration chaos trace was needlessly slow to chase."""
+    pool = PagePool(n_pages=6, page_size=4)
+    [p] = pool.alloc(1)
+    pool.free([p])
+    with pytest.raises(ValueError,
+                       match=rf"page {p}: refcount 0, free-listed"):
+        pool.free([p])
+    with pytest.raises(ValueError, match=r"pool \d+/5 free"):
+        pool.share([p])
+    with pytest.raises(ValueError, match="out of range"):
+        pool.free([99])
+    with pytest.raises(ValueError, match="trash page"):
+        pool.free([TRASH_PAGE])
+    # a batch with duplicates reports how often the batch releases it
+    [q] = pool.alloc(1)
+    with pytest.raises(ValueError, match="releases it 2x"):
+        pool.free([q, q])
+    assert pool.refcount(q) == 1              # validated before mutation
+
+
 def test_pool_refcount_lifecycle():
     """share/free reference counting: a page re-enters the free list at
     the LAST release exactly, sharing a dead page is refused, and a batch

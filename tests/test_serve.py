@@ -441,17 +441,24 @@ def _cache_page_refs(sched) -> dict:
     return refs
 
 
-def test_scheduler_random_trace_invariants(llama):
+@pytest.mark.parametrize(
+    "kv_dtype", [None, pytest.param("int8", marks=pytest.mark.kvquant)],
+    ids=["fp32", "int8"])
+def test_scheduler_random_trace_invariants(llama, kv_dtype):
     """Property-style trace over refcounted CoW pages: random
     submit/step events on a tight pool with chunked prefill, asserting
     after EVERY iteration that (a) page refcounts equal the number of
     holders (slots + cache nodes), (b) the trash page never enters a live
     table, (c) free + held pages balance to capacity, and (d) every
-    completed request is token-identical to its batch-1 run."""
+    completed request is token-identical to its batch-1 run. Re-run with
+    the int8-quantized pool (the kvquant satellite): the allocator never
+    sees dtypes, but the DEVICE side does — preempt/replay/CoW/commit all
+    rewrite quantized bytes + scales, and the batch-1 oracle (itself
+    int8) pins that those rewrites are bitwise."""
     bundle, params = llama
     rng = np.random.default_rng(42)
     eng = ServeEngine(bundle, params, n_slots=3, page_size=4, max_len=16,
-                      n_pages=7, prefill_chunk=4)
+                      n_pages=7, prefill_chunk=4, kv_dtype=kv_dtype)
     sched, pool = eng.scheduler, eng.scheduler.pool
     done, submitted = [], []
     for it in range(400):
@@ -488,7 +495,17 @@ def test_scheduler_random_trace_invariants(llama):
     assert len(done) == len(submitted)
     assert sched.stats["preempted"] > 0        # the trace hit real pressure
     by_id = {r.request_id: r for r in done}
-    ref_eng = _ref_engine(bundle, params, page_size=4, max_len=16)
+    # the int8 oracle must share the PREFILL MODE: chunked prefill
+    # attends over already-quantized history while a bucket prefill
+    # computes the whole prompt in float and quantizes once at commit —
+    # under fp32 the two agree to ~1e-7 (never flips this trace), under
+    # int8 that difference is a 1-LSB cache rounding that can. Token
+    # identity is program-relative, and the scheduling-invariance claim
+    # is engine-config-relative — so the reference runs the same chunk
+    # program (see serve/kv_pages.py docstring).
+    ref_eng = _ref_engine(bundle, params, page_size=4, max_len=16,
+                          kv_dtype=kv_dtype,
+                          prefill_chunk=4 if kv_dtype == "int8" else None)
     for rid, req in submitted:
         ref = generate_many(ref_eng, [_fresh(req)])[0]
         assert by_id[rid].token_ids == ref.token_ids
