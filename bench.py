@@ -501,6 +501,17 @@ def run_decode_check(only: str = None) -> None:
       perturbs the verify logits and breaks drafted runs long before
       evals move), so this is the serving plane's built-in quality
       meter for quantized pages. Target: |delta| <= 0.02.
+    - router_fleet2 (queued sweep rung): 16 requests in two shared-
+      prefix groups over a 2-replica fleet behind the router
+      (serve/router.py) vs one identical single engine in-rung — prices
+      the routing layer + affinity hit rate (one host thread steps both
+      replicas serially, so this is NOT a parallel-host speedup claim).
+    - handoff_crossproc (queued sweep rung): the disaggregated pair on
+      transport='cross_host' (every handoff ships the real serialized
+      k/v payload through the socket protocol) vs the same-host 0-byte
+      control in-rung, plus a raw wire microbench across a REAL process
+      boundary (subprocess echo endpoint, payload sha256 must match,
+      MiB/s recorded).
 
     ``only``: comma-separated rung names (sweep-queue children select the
     new rungs explicitly; the default ladder set keeps its PR-6 cost).
@@ -805,6 +816,143 @@ def run_decode_check(only: str = None) -> None:
             **{f"handoff_{k}": v for k, v in engine.handoff.stats.items()},
         }
         out["value"] = stats["tokens_per_s"]
+
+    if "router_fleet2" in rungs:
+        # the fleet rung: 2 ServeEngine replicas (4 slots each, shared
+        # compiled programs) behind the router, 16 requests in two
+        # 64-token shared-prefix groups — affinity should land each
+        # group on one replica where its PrefixCache pages are. The
+        # CONTROL is one identical single engine on the same workload
+        # in-rung (the router + second replica are the only new
+        # variables); one host thread steps both replicas serially, so
+        # this prices the routing layer's overhead + the affinity hit
+        # rate, not parallel-host speedup (that's the multi-host rung).
+        import dataclasses
+
+        from distributed_training_guide_tpu.serve.router import local_fleet
+
+        pre_a = [3 + (i % 200) for i in range(64)]
+        pre_b = [7 + (i % 190) for i in range(64)]
+        reqs = [Request(prompt_ids=(pre_a if i % 2 else pre_b) + [10 + i],
+                        max_new_tokens=32, seed=i) for i in range(16)]
+
+        def fleet_workload(eng):
+            generate_many(eng, [Request(prompt_ids=pre_a + [7],
+                                        max_new_tokens=4),
+                                Request(prompt_ids=pre_b + [9],
+                                        max_new_tokens=4)])   # warm+register
+            t0 = time.perf_counter()
+            results = generate_many(
+                eng, [dataclasses.replace(r, request_id=None)
+                      for r in reqs], max_iterations=5000)
+            return throughput_stats(results, time.perf_counter() - t0, eng)
+
+        ctl_eng = ServeEngine(bundle, params, n_slots=4, page_size=16,
+                              max_len=128, prefill_chunk=32)
+        ctl = fleet_workload(ctl_eng)
+        router = local_fleet(bundle, params, 2, n_slots=4, page_size=16,
+                             max_len=128, prefill_chunk=32)
+        stats = fleet_workload(router)
+        rs = router.stats()
+        out["router_fleet2"] = {
+            **stats,
+            "control_single_engine": {
+                "tokens_per_s": ctl["tokens_per_s"],
+                "prefix_hits": ctl["prefix_hits"]},
+            "speedup_vs_single": round(
+                stats["tokens_per_s"] / max(ctl["tokens_per_s"], 1e-9), 3),
+            "affinity_routed": rs["affinity_routed"],
+            "spillovers": rs["spillovers"],
+            "prefix_hits_fleet": rs["prefix_hits"],
+            "live_replicas": rs["live_replicas"],
+        }
+        out["value"] = stats["tokens_per_s"]
+        _emit({**out, "partial": True})
+
+    if "handoff_crossproc" in rungs:
+        # the cross-host handoff rung, two legs: (a) the disagg pair on
+        # transport='cross_host' — every prefill->decode transfer moves
+        # the real serialized k/v payload through the socket protocol —
+        # with the same-host (0-byte refcount move) pair as the in-rung
+        # control, transport the only variable; (b) a raw wire
+        # microbench across a REAL process boundary: a subprocess echo
+        # endpoint (python -m ...serve.transport --echo) receives the
+        # same per-sequence frames over TCP and returns a payload
+        # digest, pinning cross-process bitwise integrity + MB/s.
+        import socket as socket_mod
+
+        import numpy as np
+
+        from distributed_training_guide_tpu.serve.disagg import DisaggEngine
+        from distributed_training_guide_tpu.serve import transport as twire
+
+        def disagg_workload(eng):
+            generate_many(eng, [Request(prompt_ids=[3, 17],
+                                        max_new_tokens=4)])
+            reqs = [Request(prompt_ids=[3 + (j % 200)
+                                        for j in range(64)] + [10 + i],
+                            max_new_tokens=32, seed=i) for i in range(8)]
+            t0 = time.perf_counter()
+            results = generate_many(eng, reqs, max_iterations=5000)
+            stats = throughput_stats(results, time.perf_counter() - t0, eng)
+            return stats, eng.stats()
+
+        ctl_stats, ctl_es = disagg_workload(DisaggEngine(
+            bundle, params, n_slots=4, n_prefill_slots=1, page_size=16,
+            max_len=128, prefill_chunk=32))
+        ch_eng = DisaggEngine(bundle, params, n_slots=4, n_prefill_slots=1,
+                              page_size=16, max_len=128, prefill_chunk=32,
+                              transport="cross_host")
+        ch_stats, ch_es = disagg_workload(ch_eng)
+
+        # leg (b): ship one real sequence payload N times cross-process
+        payload = twire.gather_payload(
+            ch_eng.pages, list(range(1, min(5, ch_eng.pool.n_pages))))
+        n_frames, digest = 32, hashlib.sha256()
+        proc = subprocess.Popen(
+            [sys.executable, "-m",
+             "distributed_training_guide_tpu.serve.transport",
+             "--echo", "--expect", str(n_frames)],
+            stdout=subprocess.PIPE, text=True,
+            env={**os.environ, "JAX_PLATFORMS": "cpu"})
+        port = json.loads(proc.stdout.readline())["port"]
+        sock = socket_mod.create_connection(("127.0.0.1", port))
+        sender = twire.HandoffSender(sock, ack_timeout_s=10.0)
+        wire_bytes = 0
+        t0 = time.perf_counter()
+        for i in range(n_frames):
+            frame = twire.encode_frame(i, {"seq": i}, payload)
+            assert sender.send(frame, i) == "delivered"
+            wire_bytes += len(frame)
+        wall = time.perf_counter() - t0
+        # close OUR end first: the echo server waits for the peer's EOF
+        # before printing its digest and exiting (reading its stdout
+        # while still holding the socket open would deadlock into the
+        # server's join timeout)
+        sock.close()
+        for _ in range(n_frames):
+            for name in twire.pool_leaf_names(ch_eng.pages):
+                digest.update(np.ascontiguousarray(payload[name]).tobytes())
+        echo = json.loads(proc.stdout.readlines()[-1])
+        proc.wait(timeout=30)
+        ch_eng.close()
+        out["handoff_crossproc"] = {
+            **ch_stats,
+            "control_same_host": {
+                "tokens_per_s": ctl_stats["tokens_per_s"],
+                "handoff_bytes_copied": ctl_es["handoff_bytes_copied"]},
+            "tokens_per_s_vs_same_host": round(
+                ch_stats["tokens_per_s"]
+                / max(ctl_stats["tokens_per_s"], 1e-9), 3),
+            "handoff_bytes_copied": ch_es["handoff_bytes_copied"],
+            "handoff_delivered": ch_es["handoff_delivered"],
+            "crossproc_frames": echo["frames"],
+            "crossproc_digest_match":
+                echo["sha256"] == digest.hexdigest(),
+            "crossproc_wire_mib_s": round(
+                wire_bytes / 2**20 / max(wall, 1e-9), 2),
+        }
+        out["value"] = ch_stats["tokens_per_s"]
     _emit(out)
 
 
@@ -967,6 +1115,15 @@ SWEEP_QUEUE = [
     # path — these rungs make the capacity claim honest on CPU first.
     dict(name="kvq_int8_slots8", decode_rungs="kvq_int8_slots8"),
     dict(name="kvq_spec_accept", decode_rungs="kvq_spec_accept"),
+    # router_fleet2 = 2 replicas behind serve/router.py on a shared-
+    # prefix workload; the in-rung control is ONE identical engine, so
+    # the router layer (+ second replica's schedulers) is the only new
+    # variable. handoff_crossproc = disagg on transport='cross_host'
+    # (real serialized payload over the socket protocol) with the
+    # same-host 0-byte pair as the in-rung control — transport the only
+    # variable — plus the cross-process wire digest/MiB/s leg.
+    dict(name="router_fleet2", decode_rungs="router_fleet2"),
+    dict(name="handoff_crossproc", decode_rungs="handoff_crossproc"),
     # LAST on purpose: fence_every=4 dispatches 4 steps ahead, the exact
     # pattern this pool's documented failure mode punishes — its first
     # attempt (2026-07-31 03:50) stalled and the pool went down with it.

@@ -18,7 +18,12 @@ token-identical to spec-off at any temperature), and QUANTIZED KV PAGES
 (``kv_dtype="int8"`` — block-wise absmax int8 payloads with
 per-(position, kv-head) fp32 scales as first-class pool state,
 dequantized in the flash kernel's tile loop: ~0.26-0.31x the fp32 pool
-bytes, spec acceptance the built-in quality meter). See
+bytes, spec acceptance the built-in quality meter), and the
+FAULT-TOLERANT MULTI-HOST FABRIC (``serve/router.py`` — prefix-affinity
++ least-loaded routing over N replicas with heartbeat fencing and
+bitwise resubmission replay; ``serve/transport.py`` — the cross-host
+branch of the page handoff: serialized k/v payloads over a CRC-framed
+ack/commit wire whose only failure outcome is drop-free-requeue). See
 related-topics/serving/README.md.
 
     from distributed_training_guide_tpu.serve import (
@@ -31,18 +36,19 @@ from .scheduler import (PrefixCache, RefusalError, Request, RequestResult,
 
 __all__ = [
     "DisaggEngine", "Drafter", "DraftModelDrafter", "ModelPrograms",
-    "NgramDrafter", "PagePool", "PrefixCache", "RefusalError", "Request",
-    "RequestResult", "Scheduler", "ServeEngine", "generate_many",
-    "kv_page_bytes", "match_partition_rules", "pages_for_tokens",
+    "NgramDrafter", "PagePool", "PrefixCache", "RefusalError", "Replica",
+    "Request", "RequestResult", "Router", "Scheduler", "ServeEngine",
+    "generate_many", "kv_page_bytes", "local_fleet",
+    "match_partition_rules", "pages_for_tokens", "prefix_affinity_key",
     "serve_http",
 ]
 
 
 def __getattr__(name):
     # generate_many / serve_http live in api.py (imports http.server),
-    # DisaggEngine in disagg.py, match_partition_rules in sharding.py,
-    # the spec drafters in spec.py; keep the package import light for
-    # library users
+    # DisaggEngine in disagg.py, the fleet router in router.py,
+    # match_partition_rules in sharding.py, the spec drafters in
+    # spec.py; keep the package import light for library users
     if name in ("generate_many", "serve_http", "throughput_stats"):
         from . import api
 
@@ -51,6 +57,10 @@ def __getattr__(name):
         from .disagg import DisaggEngine
 
         return DisaggEngine
+    if name in ("Replica", "Router", "local_fleet", "prefix_affinity_key"):
+        from . import router
+
+        return getattr(router, name)
     if name in ("Drafter", "DraftModelDrafter", "NgramDrafter"):
         from . import spec
 

@@ -14,6 +14,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import threading
 import time
 
 
@@ -92,6 +93,19 @@ def main(argv=None) -> None:
     parser.add_argument("--prefill-slots", type=int, default=1,
                         help="concurrent prefill slots of the --disagg "
                         "prefill engine")
+    parser.add_argument("--transport", default="same_host",
+                        choices=("same_host", "cross_host"),
+                        help="--disagg handoff transport: 'same_host' "
+                        "moves refcounts over one pool (0 bytes); "
+                        "'cross_host' runs the multi-host branch — two "
+                        "pools, the sequence's serialized k/v payload "
+                        "over the crash-safe serve/transport.py wire")
+    parser.add_argument("--replicas", type=int, default=1,
+                        help="front N engine replicas with the fleet "
+                        "router (serve/router.py): prefix-affinity + "
+                        "least-loaded routing, heartbeat fencing, "
+                        "resubmission replay; replicas share one "
+                        "compiled-program cache")
     parser.add_argument("--tp", type=int, default=1,
                         help="tensor-parallel mesh size for serving "
                         "(params shard as in training)")
@@ -194,24 +208,49 @@ def main(argv=None) -> None:
                   shard_kv=args.shard_kv, max_queue=args.max_queue,
                   speculate=speculate, spec_k=args.spec_k,
                   kv_dtype=args.kv_dtype)
-    if args.disagg:
+    if args.replicas > 1 and args.disagg:
+        raise SystemExit("--replicas fronts ServeEngine replicas; combine "
+                         "with --disagg per replica is future work")
+    if args.replicas > 1:
+        from .router import local_fleet
+
+        engine = local_fleet(bundle, params, args.replicas, **common)
+        report = {"replicas": args.replicas,
+                  **engine.replicas["r0"].engine.kv_report()}
+    elif args.disagg:
         from .disagg import DisaggEngine
 
         engine = DisaggEngine(bundle, params,
-                              n_prefill_slots=args.prefill_slots, **common)
+                              n_prefill_slots=args.prefill_slots,
+                              transport=args.transport, **common)
+        report = engine.kv_report()
     else:
         engine = ServeEngine(bundle, params, **common)
-    report = engine.kv_report()
+        report = engine.kv_report()
     print(json.dumps({"kv_report": report}))
 
     if args.http_port is not None:
+        import signal
+
         server, worker = serve_http(engine, port=args.http_port,
                                     tokenizer=tokenizer)
         print(json.dumps({"serving": f"http://127.0.0.1:{args.http_port}",
-                          "endpoints": ["/generate", "/healthz"]}))
+                          "endpoints": ["/generate", "/healthz", "/readyz"]}))
+        stop = threading.Event()
+
+        def on_sigterm(signum, frame):
+            stop.set()
+
+        signal.signal(signal.SIGTERM, on_sigterm)
         try:
-            while True:
-                time.sleep(3600)
+            while not stop.wait(timeout=1.0):
+                pass
+            # graceful drain: refuse new work (clients see structured
+            # 503 + Retry-After), finish everything in flight, THEN exit
+            # — a SIGTERM'd replica loses no accepted request
+            print(json.dumps({"draining": True}))
+            worker.stop(drain=True)
+            server.shutdown()
         except KeyboardInterrupt:
             server.shutdown()
             worker.stop()

@@ -129,6 +129,11 @@ class _EngineWorker(threading.Thread):
         self.futures: dict[int, dict] = {}
         self.dead: Optional[BaseException] = None
         self._stop = False
+        # the loop's heartbeat: stamped every pass, read lock-free by
+        # /readyz — a wedged iteration (stuck device op) leaves it stale
+        # while /healthz keeps answering, which is exactly the
+        # liveness-vs-readiness split
+        self.last_loop_at = time.monotonic()
 
     def submit(self, request: Request, stream: bool = False) -> dict:
         fut = {"event": threading.Event(), "result": None, "error": None,
@@ -170,6 +175,7 @@ class _EngineWorker(threading.Thread):
 
     def run(self) -> None:
         while not self._stop:
+            self.last_loop_at = time.monotonic()
             try:
                 with self.lock:
                     busy = self.engine.has_work
@@ -204,7 +210,24 @@ class _EngineWorker(threading.Thread):
             if self.futures:
                 self._fail_all(RuntimeError("server shutting down"))
 
-    def stop(self) -> None:
+    def stop(self, drain: bool = False, timeout_s: float = 30.0) -> None:
+        """Stop the engine thread. ``drain=True`` is the graceful half
+        (SIGTERM): the engine stops ADMITTING (refusing new submits with
+        a structured 503) but keeps stepping until every in-flight
+        future has its result — clients connected before the signal get
+        answers, not reset connections — bounded by ``timeout_s``;
+        whatever is still pending after the bound fails loudly through
+        the existing clean-stop path."""
+        if drain and self.dead is None:
+            drain_fn = getattr(self.engine, "drain", None)
+            if drain_fn is not None:
+                drain_fn()
+            deadline = time.monotonic() + timeout_s
+            while time.monotonic() < deadline:
+                with self.lock:
+                    if not self.futures:
+                        break
+                time.sleep(0.01)
         self._stop = True
         self.wakeup.set()
 
@@ -217,6 +240,7 @@ class _EngineWorker(threading.Thread):
             "ok": self.dead is None,
             **({"error": repr(self.dead)} if self.dead is not None else {}),
             "pending_requests": len(self.futures),
+            "loop_age_s": round(time.monotonic() - self.last_loop_at, 4),
             **self.engine.stats(),
         }
 
@@ -233,9 +257,22 @@ def serve_http(engine, host: str = "127.0.0.1", port: int = 8000,
                     chunked transfer-encoding: one ``data:`` event per
                     token as it is generated, then a final ``done`` event
                     with the full result + latency/TTFT metrics.
-    GET  /healthz   liveness + the engine's full lock-free metrics
+    GET  /healthz   LIVENESS + the engine's full lock-free metrics
                     snapshot (queue depth, pool occupancy, prefix-cache
                     hit rate, TTFT/ITL, refusals by reason)
+    GET  /readyz    READINESS: 200 only when a router should send
+                    traffic here — not draining, queue depth and pool
+                    headroom inside their watermarks, engine loop
+                    heartbeat fresh (serve/router.py ``readiness``);
+                    503 with the failing reasons otherwise
+
+    429/503 refusals carry a ``Retry-After`` header derived from queue
+    depth and decode occupancy (the scheduler's ``retry_after_hint``).
+    ``worker.stop(drain=True)`` is the graceful SIGTERM half: refuse new
+    work, finish everything in flight, then exit.
+
+    Works over a single engine or a :class:`~.router.Router` fleet —
+    both implement the same driving surface.
     """
     worker = _EngineWorker(engine)
 
@@ -247,11 +284,14 @@ def serve_http(engine, host: str = "127.0.0.1", port: int = 8000,
         def log_message(self, fmt, *args):  # route to logging, not stderr
             LOGGER.debug("http: " + fmt, *args)
 
-        def _reply(self, code: int, payload: dict) -> None:
+        def _reply(self, code: int, payload: dict,
+                   headers: Optional[dict] = None) -> None:
             body = json.dumps(payload).encode()
             self.send_response(code)
             self.send_header("Content-Type", "application/json")
             self.send_header("Content-Length", str(len(body)))
+            for name, value in (headers or {}).items():
+                self.send_header(name, value)
             self.end_headers()
             self.wfile.write(body)
 
@@ -262,12 +302,28 @@ def serve_http(engine, host: str = "127.0.0.1", port: int = 8000,
             self._chunk(b"data: " + json.dumps(payload).encode() + b"\n\n")
 
         def do_GET(self):
-            if self.path != "/healthz":
-                return self._reply(404, {"error": "unknown path"})
-            # deliberately NOT under worker.lock: the engine thread holds
-            # it for a full iteration, and a health probe that blocks on
-            # in-flight device work defeats its purpose
-            self._reply(200, worker.stats())
+            if self.path == "/healthz":
+                # LIVENESS: "is the process up and the engine thread not
+                # dead" — deliberately NOT under worker.lock: the engine
+                # thread holds it for a full iteration, and a health
+                # probe that blocks on in-flight device work defeats its
+                # purpose
+                return self._reply(200, worker.stats())
+            if self.path == "/readyz":
+                # READINESS: "should a router send traffic here" — the
+                # same lock-free snapshot run through the fleet's gates
+                # (serve/router.py readiness): draining, queue depth,
+                # pool headroom, and the engine LOOP's heartbeat age
+                # (a wedged-but-alive iteration answers /healthz fine
+                # and must fail here)
+                from .router import readiness
+
+                stats = worker.stats()
+                ready, reasons = readiness(
+                    stats, loop_age_s=stats.get("loop_age_s"))
+                return self._reply(200 if ready else 503,
+                                   {"ready": ready, "reasons": reasons})
+            return self._reply(404, {"error": "unknown path"})
 
         def _result_payload(self, res: RequestResult) -> dict:
             payload = {
@@ -315,9 +371,18 @@ def serve_http(engine, host: str = "127.0.0.1", port: int = 8000,
                 fut = worker.submit(req, stream=stream)
             except RefusalError as exc:
                 # the scheduler's refusal verbatim: machine-readable
-                # reason + current load, not an opaque status code
+                # reason + current load, not an opaque status code. A
+                # backpressure refusal additionally carries the
+                # load-derived retry hint as a real Retry-After header
+                # (integer seconds per RFC 9110 — the precise float
+                # rides in the JSON body; router spillover uses that)
+                headers = None
+                if exc.retry_after_s is not None:
+                    headers = {"Retry-After":
+                               str(max(1, int(-(-exc.retry_after_s // 1))))}
                 return self._reply(exc.http_status, {
-                    "error": str(exc), "reason": exc.reason, **exc.detail})
+                    "error": str(exc), "reason": exc.reason, **exc.detail},
+                    headers)
             except (ValueError, KeyError, json.JSONDecodeError) as exc:
                 return self._reply(400, {"error": str(exc)})
             except RuntimeError as exc:     # engine thread already dead

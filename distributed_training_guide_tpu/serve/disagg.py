@@ -17,16 +17,21 @@ Here the split is two engines over ONE refcounted page pool:
   CoW) and its own compiled programs (bucketed prefill or the chunk
   program). It never runs a decode step. When a prompt's pages are fully
   committed it samples the first token and emits a :class:`Handoff`.
-- :class:`PageHandoff`: the transfer protocol. SAME-HOST (this
-  implementation) the two engines address one physical pool, so
-  transferring a sequence is a refcount/ownership move — the handoff
-  record carries the page ids and the receiving scheduler adopts the
-  SAME physical pages: zero page copies, zero bytes moved (pinned by
-  test). The protocol object is deliberately the seam for multi-host
-  disaggregation: a cross-host transfer would serialize the pages'
-  contents (``bytes_per_sequence`` prices it) and re-allocate at the
-  receiver; everything else — both engines, both schedulers — is
-  already written against the handoff, not against shared memory.
+- :class:`PageHandoff`: the transfer protocol, in two implementations
+  behind one interface. SAME-HOST the two engines address one physical
+  pool, so transferring a sequence is a refcount/ownership move — the
+  handoff record carries the page ids and the receiving scheduler adopts
+  the SAME physical pages: zero page copies, zero bytes moved (pinned by
+  test). CROSS-HOST (:class:`CrossHostPageHandoff`,
+  ``transport="cross_host"``) the engines own separate pools and the
+  transfer moves the sequence's real serialized k/v payload — int8
+  scale rows included — through ``serve/transport.py``'s CRC-framed
+  ack/commit wire, re-allocating at the receiver; a crash or timeout
+  mid-flight resolves ONLY to "payload dropped, sender pages freed,
+  request requeued at the prefill queue's head". Both engines and both
+  schedulers are written against the handoff interface, not against
+  shared memory — which is exactly what made the second implementation
+  a drop-in.
 - :class:`DecodeEngine`: its own scheduler over the fixed decode slots
   and the ONE compiled decode program. It admits from the handoff queue
   (priority order), never from raw prompts. On pool exhaustion it
@@ -49,6 +54,8 @@ preempt, never corrupt.
 from __future__ import annotations
 
 import dataclasses
+import itertools
+import queue as queue_mod
 from typing import Optional
 
 from ..models.registry import ModelBundle
@@ -60,9 +67,12 @@ from .engine import (LatencyMeter, ModelPrograms, advance_prefill_chunks,
                      run_decode_iteration, run_fork, spec_metrics,
                      validate_prefill_buckets)
 from .kv_pages import (check_kv_page_geometry, kv_page_bytes, PagePool,
-                       pool_nbytes)
+                       pages_for_tokens, pool_nbytes)
 from .scheduler import Admission, Request, RequestResult, Scheduler
 from .spec import new_spec_counters
+from .transport import encode_frame, gather_payload, scatter_payload
+
+TRANSPORTS = ("same_host", "cross_host")
 
 
 @dataclasses.dataclass
@@ -80,6 +90,11 @@ class Handoff:
     admitted_at: float
     first_token_at: float = 0.0
     resumed: bool = False
+    # cross-host only: the received-but-not-yet-seated k/v payload (host
+    # arrays, no pool pages until the decode side takes the record) and
+    # the wire transfer id it arrived under
+    payload: Optional[dict] = None
+    xfer_id: Optional[int] = None
 
 
 class PageHandoff:
@@ -100,17 +115,23 @@ class PageHandoff:
     def __init__(self, pool: PagePool):
         self.pool = pool
         self.pending: list[Handoff] = []
-        self.stats = {"transfers": 0, "pages_transferred": 0,
-                      "tokens_transferred": 0, "bytes_copied": 0}
+        self.stats = {"transfers": 0, "delivered": 0, "pages_transferred": 0,
+                      "tokens_transferred": 0, "bytes_copied": 0,
+                      "dropped": 0, "requeued": 0}
 
-    def transfer(self, handoff: Handoff) -> None:
+    def transfer(self, handoff: Handoff) -> bool:
         """Accept a sequence from the prefill side. Same-host: ownership
         of the (already-held) page references moves to the pending queue
-        — no copy, no refcount churn, no device work."""
+        — no copy, no refcount churn, no device work; delivery cannot
+        fail (returns True — the cross-host implementation returns False
+        when its wire protocol resolves to the drop outcome, and the
+        prefill engine requeues)."""
         self.pending.append(handoff)
         self.stats["transfers"] += 1
+        self.stats["delivered"] += 1
         self.stats["pages_transferred"] += len(handoff.pages)
         self.stats["tokens_transferred"] += handoff.cache_len
+        return True
 
     def take(self) -> Optional[Handoff]:
         """Next sequence for the decode side, priority order (FIFO within
@@ -120,6 +141,156 @@ class PageHandoff:
         best = max(range(len(self.pending)),
                    key=lambda i: (self.pending[i].request.priority, -i))
         return self.pending.pop(best)
+
+    def close(self) -> None:
+        """Same-host: nothing to tear down (interface symmetry with the
+        cross-host transport's sockets + receiver thread)."""
+
+    def __len__(self) -> int:
+        return len(self.pending)
+
+
+class CrossHostPageHandoff:
+    """The documented cross-host branch of :class:`PageHandoff`: the two
+    engines own SEPARATE pools (on a real deployment, separate hosts'
+    HBM), so transferring a sequence moves its actual k/v payload —
+    device-to-host gather out of the sender pool, the
+    ``serve/transport.py`` wire (frame + CRC + ack/commit protocol), and
+    a host-to-device scatter into freshly-allocated receiver pages. The
+    int8 pool's scale rows ride the same frame, so the payload a
+    quantized engine ships is ~the int8 byte ratio of fp32's — the
+    quantization lever halves the wire for free (priced by preflight's
+    ``handoff_wire_bytes_by_kv_dtype``).
+
+    Crash safety is the transport's delivery protocol: every transfer
+    resolves to exactly one of
+
+    - **delivered once** — the record (request + generation state +
+      payload) is in the receiver inbox before ``transfer`` returns, and
+      the sender's pages are freed (ownership moved as bytes);
+    - **dropped** — torn frame / ack timeout / NAK: the receiver
+      committed nothing, the sender's pages are freed, and ``transfer``
+      returns False so the prefill engine requeues the request at its
+      queue's head (recompute + bitwise replay).
+
+    Never a torn page, never a leaked one: sender pages are freed in
+    BOTH outcomes (the in-transit holder is host/wire bytes, not pool
+    refcounts — each pool's ``free + held + cached == capacity`` audit
+    holds independently throughout, chaos-pinned). A ``xfer_id`` dedup
+    at the inbox discards the two-generals residue (a frame committed by
+    the receiver after the sender already gave up and requeued).
+    """
+
+    def __init__(self, send_pool: PagePool, recv_pool: PagePool,
+                 send_pages: dict, recv_pages: dict, *,
+                 kv_dtype: str, ack_timeout_s: float = 2.0):
+        from .transport import loopback_channel
+
+        self.send_pool, self.recv_pool = send_pool, recv_pool
+        self.send_pages, self.recv_pages = send_pages, recv_pages
+        self.kv_dtype = kv_dtype
+        self._sender, self._receiver = loopback_channel(
+            ack_timeout_s=ack_timeout_s)
+        self._xfer = itertools.count()
+        self._delivered_ids: set[int] = set()
+        self._received: list[Handoff] = []
+        self.stats = {"transfers": 0, "delivered": 0, "pages_transferred": 0,
+                      "tokens_transferred": 0, "bytes_copied": 0,
+                      "dropped": 0, "dropped_nak": 0, "dropped_timeout": 0,
+                      "dropped_link": 0, "requeued": 0}
+
+    def transfer(self, handoff: Handoff) -> bool:
+        """Serialize + ship one sequence; free the sender's pages in
+        every outcome; True iff delivered (False -> caller requeues)."""
+        xfer_id = next(self._xfer)
+        payload = gather_payload(self.send_pages, handoff.pages)
+        req = handoff.request
+        frame = encode_frame(xfer_id, {
+            "request": dataclasses.asdict(req),
+            "cache_len": handoff.cache_len,
+            "generated": list(handoff.generated),
+            "submitted_at": handoff.submitted_at,
+            "admitted_at": handoff.admitted_at,
+            "first_token_at": handoff.first_token_at,
+            "resumed": handoff.resumed,
+            "kv_dtype": self.kv_dtype,
+            "n_pages": len(handoff.pages),
+        }, payload)
+        self.stats["transfers"] += 1
+        # mark BEFORE the send: by the time FIN lands the receiver thread
+        # has already inboxed the record under this id
+        self._delivered_ids.add(xfer_id)
+        outcome = self._sender.send(frame, xfer_id)
+        # both outcomes free the sender-side pages: on delivery the
+        # ownership moved as bytes, on a drop the sequence will be
+        # recomputed from its prompt — holding dead pages would leak
+        self.send_pool.free(handoff.pages)
+        if outcome == "delivered":
+            self.stats["delivered"] += 1
+            self.stats["pages_transferred"] += len(handoff.pages)
+            self.stats["tokens_transferred"] += handoff.cache_len
+            self.stats["bytes_copied"] += len(frame)
+            return True
+        self._delivered_ids.discard(xfer_id)
+        self.stats["dropped"] += 1
+        self.stats[outcome] += 1
+        return False
+
+    def _drain_inbox(self) -> None:
+        while True:
+            try:
+                xfer_id, header, payload = self._receiver.inbox.get_nowait()
+            except queue_mod.Empty:
+                return
+            if xfer_id not in self._delivered_ids:
+                continue        # sender already resolved this id to a drop
+            self._delivered_ids.discard(xfer_id)
+            self._received.append(Handoff(
+                request=Request(**header["request"]), pages=[],
+                cache_len=int(header["cache_len"]),
+                generated=list(header["generated"]),
+                submitted_at=header["submitted_at"],
+                admitted_at=header["admitted_at"],
+                first_token_at=header["first_token_at"],
+                resumed=bool(header["resumed"]), payload=payload,
+                xfer_id=xfer_id))
+
+    @property
+    def pending(self) -> list[Handoff]:
+        """Received-but-not-seated records (payload held as host bytes,
+        NO pool pages yet) — the facade's in-transit view for deadline
+        expiry, streaming taps, and has_work."""
+        self._drain_inbox()
+        return self._received
+
+    def take(self) -> Optional[Handoff]:
+        """Seat the highest-priority received record: allocate its pages
+        from the RECEIVER pool and scatter the payload in. Returns None
+        when nothing is pending or the head record's pages don't fit yet
+        (strict priority — it retries next iteration; decode-side
+        eviction/preemption frees the pool it is waiting on)."""
+        self._drain_inbox()
+        if not self._received:
+            return None
+        best = max(range(len(self._received)),
+                   key=lambda i: (self._received[i].request.priority, -i))
+        h = self._received[best]
+        pages = self.recv_pool.alloc(
+            pages_for_tokens(h.cache_len, self.recv_pool.page_size))
+        if pages is None:
+            return None
+        self._received.pop(best)
+        self.recv_pages.update(
+            scatter_payload(self.recv_pages, pages, h.payload))
+        h.pages, h.payload = pages, None
+        return h
+
+    def close(self) -> None:
+        for sock in (self._sender.sock, self._receiver.sock):
+            try:
+                sock.close()
+            except OSError:
+                pass
 
     def __len__(self) -> int:
         return len(self.pending)
@@ -159,11 +330,20 @@ class PrefillEngine:
             if res is not None:            # finished on the first token
                 return res
         slot, submitted_at = sched.release_slot(adm.slot_idx)
-        self.handoff.transfer(Handoff(
+        delivered = self.handoff.transfer(Handoff(
             request=slot.request, pages=list(slot.pages),
             cache_len=slot.cache_len, generated=list(slot.generated),
             submitted_at=submitted_at, admitted_at=slot.admitted_at,
             first_token_at=slot.first_token_at, resumed=adm.resumed))
+        if not delivered:
+            # the crash/timeout protocol's only failure outcome: payload
+            # dropped, sender pages freed (the transport did both) — the
+            # request re-enters THIS queue's head under its own id,
+            # re-prefills, and replays its generated tokens bitwise
+            self.handoff.stats["requeued"] += 1
+            sched.requeue(slot.request, slot.generated,
+                          first_token_at=slot.first_token_at,
+                          submitted_at=submitted_at, new_id=False)
         return None
 
     def step(self) -> list[RequestResult]:
@@ -219,6 +399,10 @@ class DecodeEngine:
     def _seat_handoffs(self) -> None:
         while self.handoff.pending and None in self.sched.slots:
             h = self.handoff.take()
+            if h is None:
+                # cross-host: the head record's receiver-side pages don't
+                # fit yet — it stays in transit and retries next iteration
+                break
             self.sched.adopt(
                 request=h.request, pages=h.pages, cache_len=h.cache_len,
                 generated=h.generated, submitted_at=h.submitted_at,
@@ -275,6 +459,16 @@ class DisaggEngine:
     default pool holds full residency for decode slots plus prefill
     slots; size ``n_pages`` below that to engage backpressure/preemption
     exactly as in the monolith.
+
+    ``transport="cross_host"`` runs the documented multi-host branch:
+    the two engines own SEPARATE pools (``n_pages`` sizes the decode
+    side, ``n_prefill_pages`` the prefill side) and every handoff moves
+    the sequence's real serialized k/v payload through
+    ``serve/transport.py`` (device-to-host -> socket -> host-to-device)
+    with the crash-safe delivery protocol — ``handoff_ack_timeout_s``
+    bounds how long a transfer waits before resolving to the
+    drop-and-requeue outcome. Does not compose with ``shard_kv`` yet
+    (the per-chip slice gather/scatter is the TPU rung of this seam).
     """
 
     def __init__(self, bundle: ModelBundle, params, *, n_slots: int = 8,
@@ -285,13 +479,25 @@ class DisaggEngine:
                  prefill_chunk: Optional[int] = None,
                  prefix_cache: bool = True, attend_impl: str = "auto",
                  shard_kv: bool = False, max_queue: Optional[int] = None,
-                 speculate=None, spec_k: int = 4, kv_dtype=None):
+                 speculate=None, spec_k: int = 4, kv_dtype=None,
+                 transport: str = "same_host",
+                 n_prefill_pages: Optional[int] = None,
+                 handoff_ack_timeout_s: float = 2.0):
         if n_prefill_slots < 1:
             raise ValueError(f"n_prefill_slots must be >= 1, got "
                              f"{n_prefill_slots}")
         if prefill_chunk is not None and prefill_chunk < 1:
             raise ValueError(f"prefill_chunk must be >= 1, got "
                              f"{prefill_chunk}")
+        if transport not in TRANSPORTS:
+            raise ValueError(f"transport must be one of {TRANSPORTS}, got "
+                             f"{transport!r}")
+        if transport == "cross_host" and shard_kv:
+            raise ValueError(
+                "transport='cross_host' does not compose with shard_kv "
+                "yet: the wire gathers/scatters whole pool leaves, not "
+                "per-chip slices (the ICI/DCN path is the TPU rung of "
+                "this seam)")
         drafter = resolve_drafter(speculate, spec_k=spec_k,
                                   n_slots=n_slots)
         if drafter is not None and attend_impl == "auto":
@@ -317,10 +523,8 @@ class DisaggEngine:
         self.page_size = page_size
         self.n_slots = n_slots
         self.n_prefill_slots = n_prefill_slots
-        if n_pages is None:
-            n_pages = 1 + (n_slots + n_prefill_slots) * self.max_pages
-        self.pool = PagePool(n_pages, page_size)
-        self.handoff = PageHandoff(self.pool)
+        self.transport = transport
+        self.draining = False
         self.prefill_chunk = prefill_chunk
         if prefill_buckets is None:
             prefill_buckets = default_prefill_buckets(self.max_pages,
@@ -328,7 +532,33 @@ class DisaggEngine:
         prefill_buckets = validate_prefill_buckets(
             prefill_buckets, max_pages=self.max_pages, page_size=page_size,
             max_model_len=self.max_model_len)
-        self.pages = self.programs.init_device_pages(n_pages, page_size)
+
+        if transport == "cross_host":
+            # two pools, one per "host": the prefill pool holds prompts
+            # mid-computation plus the prefix cache, the decode pool the
+            # resident generation state — each audits independently
+            if n_pages is None:
+                n_pages = 1 + n_slots * self.max_pages
+            if n_prefill_pages is None:
+                n_prefill_pages = 1 + n_prefill_slots * self.max_pages
+            self.pool = PagePool(n_prefill_pages, page_size)
+            self.decode_pool = PagePool(n_pages, page_size)
+            self.pages = self.programs.init_device_pages(n_prefill_pages,
+                                                         page_size)
+            self.decode_pages = self.programs.init_device_pages(n_pages,
+                                                                page_size)
+            self.handoff = CrossHostPageHandoff(
+                self.pool, self.decode_pool, self.pages, self.decode_pages,
+                kv_dtype=self.kv_dtype,
+                ack_timeout_s=handoff_ack_timeout_s)
+        else:
+            if n_pages is None:
+                n_pages = 1 + (n_slots + n_prefill_slots) * self.max_pages
+            self.pool = PagePool(n_pages, page_size)
+            self.decode_pool = self.pool
+            self.pages = self.programs.init_device_pages(n_pages, page_size)
+            self.decode_pages = self.pages
+            self.handoff = PageHandoff(self.pool)
 
         prefill_sched = Scheduler(
             n_slots=n_prefill_slots, pool=self.pool,
@@ -342,31 +572,83 @@ class DisaggEngine:
             # (late-bound closure — decode_sched is created just below).
             # Under decode-side speculation the margin widens to the k
             # in-flight speculated tokens each decode can scatter.
-            admission_headroom=lambda: len(decode_sched.active_indices()),
+            # Cross-host the pools are SEPARATE: prefill admission cannot
+            # starve decode growth, so no cross-engine headroom applies.
+            admission_headroom=(
+                None if transport == "cross_host"
+                else lambda: len(decode_sched.active_indices())),
             spec_lookahead=drafter.k if drafter else 0)
         # the decode scheduler shares the prefill side's PrefixCache
         # object (or runs cache-less): growth under pressure must be able
-        # to evict idle cached pages before preempting a live sequence
+        # to evict idle cached pages before preempting a live sequence.
+        # Cross-host the cache's pages live in the OTHER pool — evicting
+        # them frees nothing decode growth can use, so no cache is shared.
         decode_sched = Scheduler(
-            n_slots=n_slots, pool=self.pool, max_len=self.max_model_len,
+            n_slots=n_slots, pool=self.decode_pool,
+            max_len=self.max_model_len,
             max_pages_per_slot=self.max_pages,
-            prefix_cache=prefill_sched.cache
-            if prefill_sched.cache is not None else False,
+            prefix_cache=(prefill_sched.cache
+                          if transport == "same_host"
+                          and prefill_sched.cache is not None else False),
             spec_lookahead=drafter.k if drafter else 0)
         self.prefill = PrefillEngine(
             self.programs, self.pages, prefill_sched, self.handoff,
             prefill_chunk=prefill_chunk, prefill_buckets=prefill_buckets)
-        self.decode = DecodeEngine(self.programs, self.pages, decode_sched,
-                                   self.handoff, drafter=drafter)
+        self.decode = DecodeEngine(self.programs, self.decode_pages,
+                                   decode_sched, self.handoff,
+                                   drafter=drafter)
         self._lat = LatencyMeter()
 
     # ---- the ServeEngine driving surface -----------------------------------
     def submit(self, request: Request) -> int:
+        sched = self.prefill.sched
+        if self.draining:
+            sched.refuse("draining",
+                         "engine is draining: finishing in-flight work, "
+                         "not accepting new requests", http_status=503,
+                         retry_after_s=sched.retry_after_hint())
         try:
             self.programs.check_prompt(request)
         except ValueError as exc:
-            self.prefill.sched.refuse("bad_prompt", str(exc))
-        return self.prefill.sched.submit(request)
+            sched.refuse("bad_prompt", str(exc))
+        if self.transport == "cross_host":
+            # submit() validates worst-case pages against the PREFILL
+            # pool; the decode pool must also fit one worst-case request
+            # or the grow/preempt/requeue loop could never terminate
+            need = pages_for_tokens(
+                len(request.prompt_ids) + request.max_new_tokens,
+                self.page_size)
+            if need > self.decode_pool.capacity:
+                sched.refuse(
+                    "exceeds_pool",
+                    f"request needs {need} pages, more than the decode "
+                    f"pool ({self.decode_pool.capacity}) — it could never "
+                    f"run to completion even alone")
+        return sched.submit(request)
+
+    def resubmit(self, request: Request, generated=(), *,
+                 first_token_at: float = 0.0) -> int:
+        """Router fence recovery: re-admit a request that already ran on
+        a dead/wedged replica, with its recorded tokens replaying through
+        the decode program (see Scheduler.requeue)."""
+        if self.draining:
+            self.prefill.sched.refuse(
+                "draining", "engine is draining: not accepting resubmits",
+                http_status=503)
+        return self.prefill.sched.requeue(request, generated,
+                                          first_token_at=first_token_at)
+
+    def drain(self) -> None:
+        """Stop admitting; in-flight work (queued, prefilling, in
+        transit, decoding) runs to completion through step() as usual —
+        the graceful half of shutdown. The router reads ``draining``
+        from stats() and stops routing here."""
+        self.draining = True
+
+    def close(self) -> None:
+        """Tear down the handoff transport (sockets + receiver thread
+        under cross_host; a no-op same-host)."""
+        self.handoff.close()
 
     @property
     def has_work(self) -> bool:
@@ -420,9 +702,7 @@ class DisaggEngine:
         # requeue preempted entries at the head of their priority class on
         # the prefill side, oldest-preempted last so relative order holds
         for entry, t_submit in reversed(preempted):
-            self.prefill.sched._submit_times[entry.request.request_id] = \
-                t_submit
-            self.prefill.sched._queue_insert(entry, front=True)
+            self.prefill.sched.requeue_entry(entry, t_submit)
         self._lat.note(finished)
         return finished
 
@@ -451,15 +731,23 @@ class DisaggEngine:
         for k in ("preempted", "deadline_expired", "cache_evicted_pages",
                   "finished", "spec_lookahead_clamped"):
             s[k] = p.stats[k] + d.stats[k]
-        return {
+        cross = self.transport == "cross_host"
+        out = {
             **s,
+            "draining": self.draining,
+            "transport": self.transport,
+            "max_queue": p.max_queue,
             "queued": len(p.queue),
             "handoff_pending": len(self.handoff),
             "prefilling_slots": len(p.prefilling_indices()),
             "active_slots": len(d.active_indices()),
             "n_prefill_slots": self.n_prefill_slots,
+            # pool metrics read the DECODE pool (the serving-capacity
+            # currency); same-host that IS the one shared pool, and the
+            # cache pages live in whichever pool backs the prefill side
             **derived_pool_metrics(
-                pool=self.pool, cached_pages=p.cache_pages_held(),
+                pool=self.decode_pool,
+                cached_pages=0 if cross else p.cache_pages_held(),
                 n_slots=self.n_slots,
                 decode_steps=self.decode.decode_steps,
                 decode_tokens=self.decode.decode_tokens,
@@ -475,10 +763,24 @@ class DisaggEngine:
                            drafter=self.decode.drafter),
             **{f"handoff_{k}": v for k, v in self.handoff.stats.items()},
         }
+        if cross:
+            out.update({
+                "prefill_pages_capacity": self.pool.capacity,
+                "prefill_pages_free": self.pool.n_free,
+                "prefill_pages_cached": p.cache_pages_held(),
+            })
+        return out
 
     def kv_report(self) -> dict:
-        return build_kv_report(
-            self.programs, page_size=self.page_size, pool=self.pool,
-            cached_pages=self.prefill.sched.cache_pages_held(),
-            n_slots=self.n_slots, max_pages=self.max_pages,
-            pool_bytes=pool_nbytes(self.pages))
+        pool_bytes = pool_nbytes(self.pages)
+        if self.transport == "cross_host":
+            pool_bytes += pool_nbytes(self.decode_pages)
+        return {
+            **build_kv_report(
+                self.programs, page_size=self.page_size,
+                pool=self.decode_pool,
+                cached_pages=self.prefill.sched.cache_pages_held(),
+                n_slots=self.n_slots, max_pages=self.max_pages,
+                pool_bytes=pool_bytes),
+            "transport": self.transport,
+        }

@@ -931,6 +931,7 @@ class ServeEngine:
         # decode arrays (None = rebuild from the scheduler next decode)
         self._pending: dict[int, Admission] = {}
         self._dev: Optional[dict] = None
+        self.draining = False
         # decode throughput + latency counters (api.py metrics; all
         # host-side — see stats())
         self.decode_steps = 0
@@ -948,11 +949,40 @@ class ServeEngine:
 
     # ---- serving loop ------------------------------------------------------
     def submit(self, request: Request) -> int:
+        if self.draining:
+            self.scheduler.refuse(
+                "draining",
+                "engine is draining: finishing in-flight work, not "
+                "accepting new requests", http_status=503,
+                retry_after_s=self.scheduler.retry_after_hint())
         try:
             self.programs.check_prompt(request)
         except ValueError as exc:
             self.scheduler.refuse("bad_prompt", str(exc))
         return self.scheduler.submit(request)
+
+    def resubmit(self, request: Request, generated=(), *,
+                 first_token_at: float = 0.0) -> int:
+        """Router fence recovery: re-admit a request that already ran on
+        a dead/wedged replica. The prompt re-prefills and the recorded
+        ``generated`` tokens REPLAY through the decode program — the
+        replicas share params, so position-keyed sampling makes the
+        continuation token-identical to the uninterrupted run (the same
+        bitwise-recompute rule preemption already owns)."""
+        if self.draining:
+            self.scheduler.refuse(
+                "draining", "engine is draining: not accepting resubmits",
+                http_status=503)
+        return self.scheduler.requeue(request, generated,
+                                      first_token_at=first_token_at)
+
+    def drain(self) -> None:
+        """Stop admitting; in-flight work runs to completion through
+        step() as usual — the graceful half of SIGTERM/stop. The router
+        reads ``draining`` from stats() and marks this replica
+        unroutable; the HTTP worker keeps stepping until pending futures
+        empty (api.py ``_EngineWorker.stop(drain=True)``)."""
+        self.draining = True
 
     @property
     def has_work(self) -> bool:
@@ -1059,6 +1089,8 @@ class ServeEngine:
              for k, v in sched.stats.items()}
         return {
             **s,
+            "draining": self.draining,
+            "max_queue": sched.max_queue,
             "queued": len(sched.queue),
             "active_slots": len(sched.active_indices()),
             "prefilling_slots": len(sched.prefilling_indices()),

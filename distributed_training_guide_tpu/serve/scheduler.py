@@ -84,6 +84,10 @@ class RefusalError(ValueError):
         self.reason = reason
         self.http_status = http_status
         self.detail = dict(detail or {})
+        # backpressure refusals carry a retry hint (seconds) derived from
+        # the refusing scheduler's load; the HTTP layer maps it to a
+        # Retry-After header and the fleet router to a routing penalty
+        self.retry_after_s: Optional[float] = self.detail.get("retry_after_s")
 
 
 @dataclasses.dataclass
@@ -366,6 +370,51 @@ class Scheduler:
         raise RefusalError(reason, message, http_status=http_status,
                            detail={"queue_depth": len(self.queue), **detail})
 
+    def retry_after_hint(self) -> float:
+        """Seconds a refused client should wait before retrying — a HINT
+        monotone in load, not a promise: one nominal iteration's worth of
+        time per queued-ahead request, scaled up as the decode batch
+        fills (a saturated batch drains its queue slower). Derived only
+        from queue depth and decode occupancy, the two numbers the
+        scheduler itself owns; the aggregate-latency refinement lives
+        with whoever holds a LatencyMeter."""
+        occupancy = len(self.active_indices()) / self.n_slots
+        return round(0.05 * (1 + len(self.queue)) * (1 + occupancy), 3)
+
+    def requeue_entry(self, entry: _QueueEntry, submitted_at: float) -> None:
+        """Re-enter an EXISTING entry (its request_id and submit time
+        survive) at the head of its priority class — the disaggregated
+        facade moves decode-side preemptions back to the prefill queue
+        through this, and the cross-host handoff requeues a sequence
+        whose transfer crashed or timed out mid-flight."""
+        self._submit_times[entry.request.request_id] = submitted_at
+        self._queue_insert(entry, front=True)
+
+    def requeue(self, request: Request, generated=(), *,
+                first_token_at: float = 0.0,
+                submitted_at: Optional[float] = None,
+                front: bool = True, new_id: bool = True) -> int:
+        """Admit an ALREADY-VALIDATED request carrying a generated suffix
+        into this scheduler — the router's fence recovery (a request in
+        flight on a dead/wedged replica resubmits here under a fresh
+        local id) and the cross-host handoff's drop recovery (the same
+        sequence returns to ITS OWN queue, ``new_id=False`` keeping the
+        id its submitter holds). Either way the prompt re-prefills and
+        the recorded tokens REPLAY through the decode program
+        (position-keyed sampling makes the continuation token-identical
+        to the uninterrupted run). Skips submit()'s validation — the
+        original submit already ran it — and defaults to the queue head:
+        the request is older than anything queued here. Returns the
+        local request id."""
+        if new_id or request.request_id is None:
+            request = dataclasses.replace(request,
+                                          request_id=next(self._ids))
+        self._submit_times[request.request_id] = (
+            self._clock() if submitted_at is None else submitted_at)
+        self._queue_insert(_QueueEntry(request, list(generated),
+                                       first_token_at), front=front)
+        return request.request_id
+
     def _queue_insert(self, entry: _QueueEntry, *, front: bool = False) -> None:
         """Ordered insert: after every entry of >= priority (submit — FIFO
         within the class), or before every entry of <= priority (``front``
@@ -460,7 +509,8 @@ class Scheduler:
             self.refuse(
                 "queue_full",
                 f"admission queue is full ({len(self.queue)} >= "
-                f"{self.max_queue}); retry later", http_status=429)
+                f"{self.max_queue}); retry later", http_status=429,
+                retry_after_s=self.retry_after_hint())
         request = dataclasses.replace(request,
                                       request_id=next(self._ids))
         self._submit_times[request.request_id] = self._clock()

@@ -386,11 +386,20 @@ def run_preflight(trainer, *, global_batch: int, seq_length: int,
     # cross-host handoff payload, and the slots-per-HBM-byte capacity.
     by_dtype = {name: kv_page_bytes(cfg, page_size=page_size, kv_dtype=name)
                 for name in KV_DTYPES}
+    slot_by_dtype = {name: b * pages_per_slot for name, b in by_dtype.items()}
+    int8_ratio = round(by_dtype["int8"] / by_dtype["fp32"], 4)
     report["serve_kv"].update({
         "bytes_per_page_by_kv_dtype": by_dtype,
-        "bytes_per_slot_by_kv_dtype": {
-            name: b * pages_per_slot for name, b in by_dtype.items()},
-        "int8_bytes_vs_fp32": round(by_dtype["int8"] / by_dtype["fp32"], 4),
+        "bytes_per_slot_by_kv_dtype": slot_by_dtype,
+        "int8_bytes_vs_fp32": int8_ratio,
+        # cross-host handoff wire (serve/transport.py): one transfer
+        # moves the sequence's pool leaves as raw bytes, so the payload
+        # IS the per-slot bytes at the pool's kv_dtype (int8 ships its
+        # fp32 scales and still ~thirds the frame; the ~few-hundred-byte
+        # header/CRC envelope vanishes against any real context) — the
+        # wire keys alias the slot table rather than re-deriving it
+        "handoff_wire_bytes_by_kv_dtype": slot_by_dtype,
+        "handoff_wire_int8_vs_fp32": int8_ratio,
     })
     # speculative decoding (serve/spec.py): decode's OTHER traffic is the
     # weight read — every spec-off token pays the full per-chip param

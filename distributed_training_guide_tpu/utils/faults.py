@@ -18,6 +18,28 @@ the same switches:
 - ``DTG_FAULT_SAVE_LATENCY_S=X``: sleep X seconds inside every checkpoint
   save (slow-NFS simulation; exercises async-save overlap and heartbeats).
 
+Serve-plane faults (the multi-host fabric's drills — serve/transport.py
+and serve/router.py consume these; ``tests/test_chaos_serve.py`` is the
+executable documentation):
+
+- ``DTG_FAULT_HANDOFF_CRASH_XFER=N``: the Nth cross-host page handoff
+  (0-indexed transfer id) tears mid-flight — the payload bytes on the
+  wire are corrupted the way a sender crash mid-write leaves them, the
+  receiver's CRC rejects the frame, and the protocol's only outcome is
+  "payload dropped, sender pages freed, request requeued at the prefill
+  queue's head".
+- ``DTG_FAULT_HANDOFF_TIMEOUT_XFER=N``: the receiver sits on transfer N
+  past the sender's ack timeout; the sender aborts the transfer with the
+  same drop-free-requeue outcome (the late ack is discarded by id).
+- ``DTG_FAULT_REPLICA_KILL=<name>@<step>``: SIGKILL-shaped replica death
+  — at router iteration ``step``, replica ``name`` stops instantly with
+  NO cleanup (no drain, no handoff); the router fences it on the next
+  health check and resubmits its in-flight requests.
+- ``DTG_FAULT_REPLICA_WEDGE=<name>@<step>``: the wedged-but-alive case —
+  the replica stops stepping AND stops heartbeating (a stuck device op),
+  while its process would still answer liveness; only the heartbeat-age
+  fence catches it.
+
 All faults are deterministic functions of (env, step): a drill that kills a
 run at step N kills every rerun at step N too, so kill -> restart -> resume
 trajectories can be compared bit-for-bit against an uninterrupted run.
@@ -38,6 +60,10 @@ ENV_CRASH_MODE = "DTG_FAULT_CRASH_MODE"
 ENV_NAN_LOSS_STEP = "DTG_FAULT_NAN_LOSS_STEP"
 ENV_CORRUPT_CKPT_STEP = "DTG_FAULT_CORRUPT_CKPT_STEP"
 ENV_SAVE_LATENCY_S = "DTG_FAULT_SAVE_LATENCY_S"
+ENV_HANDOFF_CRASH_XFER = "DTG_FAULT_HANDOFF_CRASH_XFER"
+ENV_HANDOFF_TIMEOUT_XFER = "DTG_FAULT_HANDOFF_TIMEOUT_XFER"
+ENV_REPLICA_KILL = "DTG_FAULT_REPLICA_KILL"
+ENV_REPLICA_WEDGE = "DTG_FAULT_REPLICA_WEDGE"
 
 _CORRUPT_BYTES = 256
 
@@ -53,6 +79,20 @@ def _env_int(name: str) -> Optional[int]:
         return None
 
 
+def _env_target(name: str) -> Optional[tuple[str, int]]:
+    """Parse a ``<replica_name>@<step>`` fault target."""
+    raw = os.environ.get(name)
+    if not raw:
+        return None
+    target, _, step = raw.partition("@")
+    try:
+        return (target, int(step))
+    except ValueError:
+        LOGGER.warning("ignoring malformed %s=%r (want <name>@<step>)",
+                       name, raw)
+        return None
+
+
 @dataclasses.dataclass(frozen=True)
 class FaultSpec:
     crash_step: Optional[int] = None
@@ -60,6 +100,10 @@ class FaultSpec:
     nan_loss_step: Optional[int] = None
     corrupt_ckpt_step: Optional[int] = None
     save_latency_s: float = 0.0
+    handoff_crash_xfer: Optional[int] = None
+    handoff_timeout_xfer: Optional[int] = None
+    replica_kill: Optional[tuple[str, int]] = None    # (name, router step)
+    replica_wedge: Optional[tuple[str, int]] = None
 
 
 def active_faults() -> FaultSpec:
@@ -75,7 +119,39 @@ def active_faults() -> FaultSpec:
         nan_loss_step=_env_int(ENV_NAN_LOSS_STEP),
         corrupt_ckpt_step=_env_int(ENV_CORRUPT_CKPT_STEP),
         save_latency_s=latency,
+        handoff_crash_xfer=_env_int(ENV_HANDOFF_CRASH_XFER),
+        handoff_timeout_xfer=_env_int(ENV_HANDOFF_TIMEOUT_XFER),
+        replica_kill=_env_target(ENV_REPLICA_KILL),
+        replica_wedge=_env_target(ENV_REPLICA_WEDGE),
     )
+
+
+def handoff_fault(xfer_id: int) -> Optional[str]:
+    """The injected failure for cross-host handoff transfer ``xfer_id``
+    (a monotone 0-indexed id shared by sender and receiver — it IS the
+    wire frame's id, so both ends agree on which transfer to break):
+    "crash" (torn payload on the wire), "timeout" (receiver sits past the
+    sender's ack window), or None."""
+    spec = active_faults()
+    if spec.handoff_crash_xfer is not None \
+            and xfer_id == spec.handoff_crash_xfer:
+        return "crash"
+    if spec.handoff_timeout_xfer is not None \
+            and xfer_id == spec.handoff_timeout_xfer:
+        return "timeout"
+    return None
+
+
+def replica_fault(name: str, step: int) -> Optional[str]:
+    """The injected failure for replica ``name`` at router iteration
+    ``step``: "kill" (instant death, no cleanup), "wedge" (stops stepping
+    and heartbeating but stays 'alive'), or None."""
+    spec = active_faults()
+    if spec.replica_kill is not None and spec.replica_kill == (name, step):
+        return "kill"
+    if spec.replica_wedge is not None and spec.replica_wedge == (name, step):
+        return "wedge"
+    return None
 
 
 def maybe_crash(global_step: int) -> None:

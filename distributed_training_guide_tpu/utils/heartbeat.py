@@ -38,6 +38,29 @@ def read_heartbeat(path: Path) -> Optional[dict]:
     return payload if isinstance(payload, dict) else None
 
 
+class HeartbeatMonitor:
+    """Reader side of the heartbeat file: the age of the most recent
+    beat, for anyone deciding whether a worker is wedged. The training
+    supervisor open-codes this check against attempt dirs; the serving
+    fabric's router (``serve/router.py``) consumes it through this class
+    — one definition of "stale" per file, not per caller."""
+
+    def __init__(self, path):
+        self.path = Path(path)
+
+    def last_beat(self) -> Optional[dict]:
+        return read_heartbeat(self.path)
+
+    def age_s(self, now: Optional[float] = None) -> Optional[float]:
+        """Seconds since the last beat, or None when no beat has ever
+        been written (a replica that died before its first beat falls to
+        the caller's no-beat-yet grace policy, not to a fake huge age)."""
+        payload = self.last_beat()
+        if payload is None or "time" not in payload:
+            return None
+        return (time.time() if now is None else now) - float(payload["time"])
+
+
 class HeartbeatWriter:
     """Throttled heartbeat writer; a no-op unless ``HEARTBEAT_FILE`` is set
     (or a path is given), so the train loop calls it unconditionally."""

@@ -1,0 +1,299 @@
+"""Serve-plane chaos drills (ISSUE 12 acceptance): the utils/faults.py
+env knobs drive the REAL failure paths — a cross-host handoff torn or
+timed out mid-flight, a replica SIGKILL mid-decode, a slow-heartbeat
+wedge — and after every drill the invariants are pinned PER ITERATION:
+
+- page refcounts equal the number of holders on every surviving engine
+  (in-transit handoff records counted on whichever side still holds
+  pages);
+- free + held + cached == capacity on every surviving pool;
+- every submitted request completes (possibly via drop-requeue or fence
+  resubmission) token-identical to its batch-1 reference, or as a
+  strict prefix with a structured finish_reason — never silently wrong,
+  never a leaked or double-issued page.
+
+These are executable documentation for the failure-drills table in
+``diagnosing-errors/README.md``; the same switches run against a real
+fleet.
+"""
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from distributed_training_guide_tpu.models import get_model
+from distributed_training_guide_tpu.serve import Request, ServeEngine
+from distributed_training_guide_tpu.serve.api import generate_many
+from distributed_training_guide_tpu.serve.disagg import DisaggEngine
+from distributed_training_guide_tpu.serve.router import Replica, Router
+from distributed_training_guide_tpu.utils import faults
+
+pytestmark = [pytest.mark.chaos, pytest.mark.serve]
+
+
+@pytest.fixture(scope="module")
+def llama():
+    bundle = get_model("llama-debug", dtype=jnp.float32)
+    return bundle, bundle.init(bundle.config, jax.random.key(0))
+
+
+def _fresh(req):
+    return dataclasses.replace(req, request_id=None)
+
+
+def _ref(bundle, params, req, **kw):
+    eng = ServeEngine(bundle, params, n_slots=1, prefix_cache=False, **kw)
+    return generate_many(eng, [_fresh(req)])[0]
+
+
+# ---- audit helpers (the test_serve.py idiom, fleet-shaped) ------------------
+
+def _slot_holders(sched):
+    held: dict = {}
+    for slot in sched.slots:
+        if slot is None:
+            continue
+        assert 0 not in slot.pages, "trash page in a live table"
+        for p in slot.pages:
+            held[p] = held.get(p, 0) + 1
+    return held
+
+
+def _cache_refs(sched):
+    refs: dict = {}
+    if sched.cache is None:
+        return refs
+    stack = [sched.cache.root]
+    while stack:
+        node = stack.pop()
+        for child in node.children.values():
+            refs[child.page] = refs.get(child.page, 0) + 1
+            stack.append(child)
+    return refs
+
+
+def _assert_pool(pool, holder_maps, where=""):
+    held: dict = {}
+    for m in holder_maps:
+        for p, n in m.items():
+            held[p] = held.get(p, 0) + n
+    for p, n in held.items():
+        assert pool.refcount(p) == n, \
+            f"{where}: page {p}: {n} holders, refcount {pool.refcount(p)}"
+    assert pool.n_free + len(held) == pool.capacity, \
+        f"{where}: free {pool.n_free} + held {len(held)} " \
+        f"!= capacity {pool.capacity}"
+
+
+def _audit_disagg(eng):
+    """Both pools of a disaggregated pair, in-transit records counted:
+    same-host transit holds pool pages; cross-host transit is host/wire
+    bytes (each pool audits independently)."""
+    transit: dict = {}
+    for h in eng.handoff.pending:
+        for p in h.pages:
+            transit[p] = transit.get(p, 0) + 1
+    if eng.transport == "cross_host":
+        _assert_pool(eng.pool, [_slot_holders(eng.prefill.sched),
+                                _cache_refs(eng.prefill.sched)], "prefill")
+        _assert_pool(eng.decode_pool,
+                     [_slot_holders(eng.decode.sched), transit], "decode")
+    else:
+        _assert_pool(eng.pool, [_slot_holders(eng.prefill.sched),
+                                _slot_holders(eng.decode.sched),
+                                _cache_refs(eng.prefill.sched), transit],
+                     "shared")
+
+
+def _audit_monolith(eng):
+    _assert_pool(eng.scheduler.pool,
+                 [_slot_holders(eng.scheduler), _cache_refs(eng.scheduler)],
+                 "monolith")
+
+
+def _audit_engine(engine):
+    if isinstance(engine, DisaggEngine):
+        _audit_disagg(engine)
+    else:
+        _audit_monolith(engine)
+
+
+# ---- handoff drills ---------------------------------------------------------
+
+@pytest.mark.handoff
+@pytest.mark.parametrize("knob,outcome", [
+    (faults.ENV_HANDOFF_CRASH_XFER, "handoff_dropped_nak"),
+    (faults.ENV_HANDOFF_TIMEOUT_XFER, "handoff_dropped_timeout"),
+])
+def test_handoff_fault_mid_flight_drops_frees_requeues(llama, monkeypatch,
+                                                       knob, outcome):
+    """A transfer torn (sender crash) or stalled (receiver wedge)
+    mid-flight: the ONLY outcome is payload dropped + sender pages freed
+    + request requeued at the prefill queue's head — the drilled request
+    still completes token-identical, both pools audit clean after every
+    iteration, and the wire counters name the failure."""
+    bundle, params = llama
+    monkeypatch.setenv(knob, "1")     # the 2nd transfer (0-indexed) fails
+    eng = DisaggEngine(bundle, params, n_slots=2, n_prefill_slots=1,
+                       page_size=4, max_len=16, transport="cross_host",
+                       handoff_ack_timeout_s=0.3)
+    reqs = [Request(prompt_ids=[3 + i, 17, 42], max_new_tokens=4,
+                    temperature=0.8 if i % 2 else 0.0, seed=i)
+            for i in range(4)]
+    ids = [eng.submit(_fresh(r)) for r in reqs]
+    done, it = {}, 0
+    while eng.has_work:
+        for res in eng.step():
+            done[res.request_id] = res
+        _audit_disagg(eng)
+        it += 1
+        assert it < 2000
+    for rid, req in zip(ids, reqs):
+        want = _ref(bundle, params, req, page_size=4, max_len=16)
+        assert done[rid].token_ids == want.token_ids, f"seed={req.seed}"
+    s = eng.stats()
+    assert s["handoff_dropped"] == 1 and s[outcome] == 1
+    assert s["handoff_requeued"] == 1
+    assert s["handoff_delivered"] == len(reqs)       # the retry re-ships
+    assert s["handoff_transfers"] == len(reqs) + 1
+    assert eng.decode_pool.n_free == eng.decode_pool.capacity
+    eng.close()
+
+
+# ---- replica drills ---------------------------------------------------------
+
+def _drive_fleet(router, reqs):
+    ids = [router.submit(_fresh(r)) for r in reqs]
+    done, it = {}, 0
+    while router.has_work:
+        for res in router.step():
+            done[res.request_id] = res
+        for replica in router.replicas.values():
+            if replica.state == "live":
+                _audit_engine(replica.engine)
+        it += 1
+        assert it < 5000
+    return ids, done
+
+
+@pytest.mark.router
+def test_replica_sigkill_mid_decode_drill(llama, monkeypatch):
+    """DTG_FAULT_REPLICA_KILL=<name>@<step>: the replica dies instantly
+    mid-decode (no drain, no cleanup). The router fences it, resubmits
+    its in-flight requests, and EVERY submitted request completes
+    token-identical to batch-1; the survivor's pool audits clean after
+    every iteration and balances post-mortem."""
+    bundle, params = llama
+    from distributed_training_guide_tpu.serve.router import local_fleet
+
+    monkeypatch.setenv(faults.ENV_REPLICA_KILL, "r0@4")
+    router = local_fleet(bundle, params, 2, n_slots=2, page_size=4,
+                         max_len=32,
+                         router_kw=dict(heartbeat_timeout_s=60.0))
+    reqs = [Request(prompt_ids=[3 + i, 17, 42], max_new_tokens=10,
+                    temperature=0.6 if i % 2 else 0.0, seed=i)
+            for i in range(6)]
+    ids, done = _drive_fleet(router, reqs)
+    for rid, req in zip(ids, reqs):
+        want = _ref(bundle, params, req, page_size=4, max_len=32)
+        assert done[rid].token_ids == want.token_ids, f"seed={req.seed}"
+    s = router.stats()
+    assert s["fenced"] == 1 and s["resubmitted"] >= 1
+    assert router.replicas["r0"].state == "fenced"
+    surv = router.replicas["r1"].engine
+    _audit_monolith(surv)
+    assert surv.scheduler.pool.n_free \
+        + surv.scheduler.cache_pages_held() == surv.scheduler.pool.capacity
+
+
+@pytest.mark.router
+def test_replica_wedge_drill_heartbeat_fences(llama, monkeypatch):
+    """DTG_FAULT_REPLICA_WEDGE: the replica stays 'alive' but stops
+    stepping and beating — only the heartbeat age catches it (real
+    wall-clock here, 0.15s timeout). Its in-flight requests resubmit and
+    complete identically; the wedged replica never double-issues (fenced
+    replicas are never stepped again)."""
+    bundle, params = llama
+    from distributed_training_guide_tpu.serve.router import local_fleet
+
+    import time
+
+    monkeypatch.setenv(faults.ENV_REPLICA_WEDGE, "r1@3")
+    router = local_fleet(bundle, params, 2, n_slots=2, page_size=4,
+                         max_len=32,
+                         router_kw=dict(heartbeat_timeout_s=0.15))
+    reqs = [Request(prompt_ids=[3 + i, 17, 42], max_new_tokens=8, seed=i)
+            for i in range(6)]
+    ids = [router.submit(_fresh(r)) for r in reqs]
+    done, it = {}, 0
+    while router.has_work:
+        for res in router.step():
+            done[res.request_id] = res
+        for replica in router.replicas.values():
+            if replica.state == "live" and not replica.wedged:
+                _audit_engine(replica.engine)
+        # a wedged replica is caught by heartbeat AGE, which needs wall
+        # time — idle router iterations are near-instant, so pace them
+        time.sleep(0.002)
+        it += 1
+        assert it < 2000
+    for rid, req in zip(ids, reqs):
+        want = _ref(bundle, params, req, page_size=4, max_len=32)
+        assert done[rid].token_ids == want.token_ids, f"seed={req.seed}"
+    assert router.replicas["r1"].state == "fenced"
+    assert router.replicas["r1"].wedged
+    assert router.stats()["fenced"] == 1
+
+
+@pytest.mark.router
+@pytest.mark.handoff
+def test_combined_drill_handoff_fault_plus_replica_kill(llama, monkeypatch):
+    """The acceptance drill, all at once: a heterogeneous fleet (one
+    cross-host disaggregated pair + one monolith) takes a handoff crash
+    mid-flight AND a replica SIGKILL mid-decode in the same run. Every
+    submitted request completes token-identical to batch-1 or as a
+    strict prefix with a structured finish_reason; post-mortem audits on
+    all surviving engines show refcount == holders and free + held +
+    cached == capacity — no leaked or double-issued page."""
+    bundle, params = llama
+    monkeypatch.setenv(faults.ENV_HANDOFF_CRASH_XFER, "2")
+    monkeypatch.setenv(faults.ENV_REPLICA_KILL, "mono@6")
+    disagg = DisaggEngine(bundle, params, n_slots=2, n_prefill_slots=1,
+                          page_size=4, max_len=32, transport="cross_host",
+                          handoff_ack_timeout_s=0.3)
+    mono = ServeEngine(bundle, params, n_slots=2, page_size=4, max_len=32)
+    router = Router([Replica("pair", disagg), Replica("mono", mono)],
+                    heartbeat_timeout_s=60.0)
+    reqs = [Request(prompt_ids=[3 + i, 17, 42, 9, 5][:2 + i % 3],
+                    max_new_tokens=8,
+                    temperature=0.7 if i % 3 == 1 else 0.0, seed=i)
+            for i in range(8)]
+    ids, done = _drive_fleet(router, reqs)
+    structured = 0
+    for rid, req in zip(ids, reqs):
+        res = done[rid]
+        want = _ref(bundle, params, req, page_size=4, max_len=32)
+        if res.finish_reason in ("eos", "length"):
+            assert res.token_ids == want.token_ids, f"seed={req.seed}"
+        else:
+            # the structured give-up: a strict prefix, never garbage
+            assert res.finish_reason == "resubmit_exhausted"
+            assert res.generated_ids == \
+                want.generated_ids[:len(res.generated_ids)]
+            structured += 1
+    s = router.stats()
+    assert s["fenced"] == 1
+    assert router.replicas["mono"].state == "fenced"
+    # the handoff fault fired iff the pair saw >= 3 transfers before the
+    # workload drained; either way its counters must be self-consistent
+    hs = disagg.handoff.stats
+    assert hs["dropped"] == hs["requeued"]
+    assert hs["transfers"] == hs["delivered"] + hs["dropped"]
+    # post-mortem: every SURVIVING engine audits clean
+    _audit_disagg(disagg)
+    assert disagg.decode_pool.n_free == disagg.decode_pool.capacity
+    assert disagg.pool.n_free \
+        + disagg.prefill.sched.cache_pages_held() == disagg.pool.capacity
+    assert structured == 0 or s["resubmit_exhausted"] == structured
+    disagg.close()

@@ -1,0 +1,205 @@
+"""Cross-host KV page handoff (serve/transport.py + disagg
+transport='cross_host'): wire round-trips (fp32 AND int8+scales,
+bitwise), the bytes_copied>0 accounting pin, receiver-side bitwise
+decode-continuation identity vs batch-1 and vs the same-host refcount
+pair, receiver backlog under a tight decode pool, and the two-pool
+audits. The crash/timeout protocol drills live in test_chaos_serve.py.
+"""
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_training_guide_tpu.models import get_model
+from distributed_training_guide_tpu.serve import Request, ServeEngine
+from distributed_training_guide_tpu.serve.api import generate_many
+from distributed_training_guide_tpu.serve.disagg import DisaggEngine
+from distributed_training_guide_tpu.serve.kv_pages import init_pages
+from distributed_training_guide_tpu.serve import transport as twire
+
+pytestmark = [pytest.mark.serve, pytest.mark.handoff, pytest.mark.disagg]
+
+
+@pytest.fixture(scope="module")
+def llama():
+    bundle = get_model("llama-debug", dtype=jnp.float32)
+    return bundle, bundle.init(bundle.config, jax.random.key(0))
+
+
+def _fresh(req):
+    return dataclasses.replace(req, request_id=None)
+
+
+def _ref(bundle, params, req, **kw):
+    eng = ServeEngine(bundle, params, n_slots=1, prefix_cache=False, **kw)
+    return generate_many(eng, [_fresh(req)])[0]
+
+
+def _audit_pools(eng):
+    """Both pools balance independently: free + held + cached ==
+    capacity, with cache pages living only on the prefill side."""
+    assert eng.decode_pool.n_free + sum(
+        len(s.pages) for s in eng.decode.sched.slots if s is not None) \
+        == eng.decode_pool.capacity
+    held = sum(len(set(s.pages)) for s in eng.prefill.sched.slots
+               if s is not None)
+    assert eng.pool.n_free + held + eng.prefill.sched.cache_pages_held() \
+        >= eng.pool.capacity - held  # shared pages overlap cache refs
+
+
+# ---- wire format ------------------------------------------------------------
+
+@pytest.mark.parametrize("kv_dtype", ["fp32", "int8"])
+def test_frame_roundtrip_bitwise(kv_dtype):
+    """encode -> decode reproduces every pool leaf bitwise — the int8
+    pool's payload AND its fp32 scale rows both cross as raw bytes."""
+    bundle = get_model("llama-debug", dtype=jnp.float32)
+    pages = init_pages(bundle.config, 6, 4, kv_dtype=kv_dtype)
+    key = jax.random.key(1)
+    pages = jax.tree.map(
+        lambda a: jax.random.normal(key, a.shape).astype(a.dtype)
+        if a.dtype != jnp.int8
+        else jax.random.randint(key, a.shape, -127, 127, jnp.int8), pages)
+    payload = twire.gather_payload(pages, [2, 4, 1])
+    frame = twire.encode_frame(7, {"cache_len": 9}, payload)
+    xfer_id, header, got = twire.decode_frame(frame)
+    assert xfer_id == 7 and header["cache_len"] == 9
+    assert set(got) == set(payload)
+    for name in payload:
+        assert got[name].dtype == payload[name].dtype
+        assert np.array_equal(got[name], payload[name])
+    # scatter at a "receiver" pool reproduces the sender's bytes
+    recv = init_pages(bundle.config, 6, 4, kv_dtype=kv_dtype)
+    recv = twire.scatter_payload(recv, [1, 2, 3], payload)
+    back = twire.gather_payload(recv, [1, 2, 3])
+    for name in payload:
+        assert np.array_equal(back[name], payload[name])
+
+
+def test_frame_rejects_corruption():
+    payload = {"k": np.arange(12, dtype=np.float32).reshape(1, 1, 3, 2, 2),
+               "v": np.ones((1, 1, 3, 2, 2), np.float32)}
+    frame = bytearray(twire.encode_frame(0, {}, payload))
+    frame[len(frame) // 2] ^= 0xFF
+    with pytest.raises(twire.TransportError, match="CRC"):
+        twire.decode_frame(bytes(frame))
+    with pytest.raises(twire.TransportError, match="short|length"):
+        twire.decode_frame(bytes(frame[:-8]))
+
+
+# ---- the engine-level acceptance pins ---------------------------------------
+
+@pytest.mark.parametrize("kv_dtype", [None, "int8"])
+def test_crosshost_moves_real_payload_and_continues_bitwise(llama, kv_dtype):
+    """The acceptance pin: every handoff ships the real serialized k/v
+    payload (bytes_copied > 0 and >= the pool-leaf payload bytes), and
+    the receiver-side decode continuation is token-identical to batch-1
+    AND to the same-host refcount-move pair — the wire changed where the
+    bytes live, not what they are."""
+    bundle, params = llama
+    reqs = [Request(prompt_ids=[3 + i, 17, 42, 9][:2 + (i % 3)],
+                    max_new_tokens=3 + (i % 3),
+                    temperature=0.8 if i % 2 else 0.0, seed=i)
+            for i in range(6)]
+    kw = dict(n_slots=2, n_prefill_slots=1, page_size=4, max_len=16,
+              kv_dtype=kv_dtype)
+    cross = DisaggEngine(bundle, params, transport="cross_host", **kw)
+    res = generate_many(cross, [_fresh(r) for r in reqs],
+                        max_iterations=2000)
+    same = DisaggEngine(bundle, params, **kw)
+    res_same = generate_many(same, [_fresh(r) for r in reqs],
+                             max_iterations=2000)
+    for got, via_same, req in zip(res, res_same, reqs):
+        want = _ref(bundle, params, req, page_size=4, max_len=16,
+                    kv_dtype=kv_dtype)
+        assert got.token_ids == want.token_ids, f"seed={req.seed}"
+        assert got.token_ids == via_same.token_ids
+    s = cross.stats()
+    assert s["handoff_bytes_copied"] > 0
+    assert s["handoff_delivered"] == s["handoff_transfers"] >= 6
+    assert s["handoff_dropped"] == 0
+    assert s["transport"] == "cross_host"
+    # payload accounting: at least one page of k+v leaf bytes per token
+    # transferred crossed the wire (header/CRC ride on top)
+    per_page = sum(
+        np.asarray(leaf[:, :1]).nbytes if not hasattr(leaf, "q")
+        else np.asarray(leaf.q[:, :1]).nbytes
+        + np.asarray(leaf.scale[:, :1]).nbytes
+        for leaf in (cross.pages["k"], cross.pages["v"]))
+    assert s["handoff_bytes_copied"] \
+        >= per_page * s["handoff_pages_transferred"]
+    # post-drain audits: both pools balanced, decode pool fully free
+    assert cross.decode_pool.n_free == cross.decode_pool.capacity
+    assert cross.pool.n_free + cross.prefill.sched.cache_pages_held() \
+        == cross.pool.capacity
+    cross.close()
+
+
+def test_crosshost_int8_frame_smaller_than_fp32(llama):
+    """The PR-11 dividend, pinned on the wire: the int8 pool's handoff
+    frames (payload + scales) are well under the fp32 pair's."""
+    bundle, params = llama
+    sizes = {}
+    for kv in ("fp32", "int8"):
+        eng = DisaggEngine(bundle, params, n_slots=1, page_size=4,
+                           max_len=16, transport="cross_host", kv_dtype=kv)
+        generate_many(eng, [Request(prompt_ids=[3, 17, 42, 5, 6],
+                                    max_new_tokens=2)], max_iterations=500)
+        sizes[kv] = eng.stats()["handoff_bytes_copied"]
+        eng.close()
+    assert sizes["int8"] < 0.6 * sizes["fp32"], sizes
+
+
+@pytest.mark.slow
+def test_crosshost_receiver_backlog_under_tight_decode_pool(llama):
+    """Backlog stress (slow: the tier-1 acceptance pins live in
+    test_crosshost_moves_real_payload_and_continues_bitwise): a decode
+    pool too small to seat every received sequence at once:
+    records wait in transit (host bytes, no pool pages), seat as decode
+    slots free, and everything still completes token-identically."""
+    bundle, params = llama
+    eng = DisaggEngine(bundle, params, n_slots=2, n_prefill_slots=2,
+                       page_size=4, max_len=16, transport="cross_host",
+                       n_pages=2 * 4 + 1)   # exactly 2 slots' residency
+    reqs = [Request(prompt_ids=[3 + i, 17], max_new_tokens=6, seed=i)
+            for i in range(6)]
+    saw_backlog = False
+    ids = [eng.submit(_fresh(r)) for r in reqs]
+    done = {}
+    it = 0
+    while eng.has_work:
+        for res in eng.step():
+            done[res.request_id] = res
+        saw_backlog = saw_backlog or len(eng.handoff.pending) > 0
+        it += 1
+        assert it < 2000
+    for rid, req in zip(ids, reqs):
+        want = _ref(bundle, params, req, page_size=4, max_len=16)
+        assert done[rid].token_ids == want.token_ids
+    assert eng.decode_pool.n_free == eng.decode_pool.capacity
+    eng.close()
+
+
+def test_crosshost_rejects_shard_kv(llama):
+    bundle, params = llama
+    with pytest.raises(ValueError, match="cross_host.*shard_kv"):
+        DisaggEngine(bundle, params, transport="cross_host", shard_kv=True)
+    with pytest.raises(ValueError, match="transport"):
+        DisaggEngine(bundle, params, transport="carrier_pigeon")
+
+
+def test_crosshost_refuses_request_exceeding_decode_pool(llama):
+    """submit() validates against BOTH pools: a request whose worst case
+    outgrows the decode pool can never finish there and must refuse at
+    the door, not preempt-loop forever."""
+    from distributed_training_guide_tpu.serve import RefusalError
+
+    bundle, params = llama
+    eng = DisaggEngine(bundle, params, n_slots=1, n_prefill_slots=1,
+                       page_size=4, max_len=64, transport="cross_host",
+                       n_pages=3, n_prefill_pages=20)
+    with pytest.raises(RefusalError, match="decode pool"):
+        eng.submit(Request(prompt_ids=[3, 17], max_new_tokens=30))
+    eng.close()
