@@ -956,6 +956,173 @@ def run_decode_check(only: str = None) -> None:
     _emit(out)
 
 
+def run_elastic_check(only: str = None) -> None:
+    """Elastic-fleet rungs (serve/elastic.py + checkpoint/reshard.py),
+    each with its in-rung STATIC control per the one-new-variable policy:
+
+    - engine_swap_midstream: the slots4 decode workload with a LIVE
+      engine-generation swap (n_slots 4 -> 8, pool regrown) injected
+      after 4 iterations, vs the identical workload on a static 4-slot
+      engine in-rung — the swap is the only variable. Records tokens/s
+      both ways, the swap pause (drain + payload move + seat), pages/
+      bytes moved, seated-vs-requeued split, and the token-identity
+      check against the control results (identical == the swap was
+      invisible to every stream).
+    - reshard_restore: save a 2-step llama-debug run on mesh A (fsdp=8,
+      CPU-forced devices), then restore TWICE: onto the identical mesh
+      (the static control — same save, same bytes, no reshard) and onto
+      mesh B (fsdp=4, half the devices — a different dp/fsdp
+      factorization through the same stamped entry point). Records both
+      restore walls and the 2-step continued-trajectory deviation vs an
+      uninterrupted golden run — the honest price of "shrink and
+      continue".
+    """
+    _configure_jax_cache()
+    import dataclasses
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from distributed_training_guide_tpu.models import get_model
+    from distributed_training_guide_tpu.serve.api import (generate_many,
+                                                          throughput_stats)
+    from distributed_training_guide_tpu.serve.elastic import swap_engine
+    from distributed_training_guide_tpu.serve.engine import ServeEngine
+    from distributed_training_guide_tpu.serve.scheduler import Request
+
+    rungs = (set(only.split(",")) if only
+             else {"engine_swap_midstream", "reshard_restore"})
+    bundle = get_model("llama-debug", dtype=jnp.float32)
+    params = bundle.init(bundle.config, jax.random.key(0))
+    out = {"metric": "elastic", "model": "llama-debug", "value": 0.0}
+
+    if "engine_swap_midstream" in rungs:
+        reqs = [Request(prompt_ids=[3 + i, 17, 42], max_new_tokens=64,
+                        seed=i) for i in range(8)]
+
+        def workload(engine, swap_at=None):
+            generate_many(engine, [Request(prompt_ids=[3, 17, 42],
+                                           max_new_tokens=4)])
+            if swap_at is not None:
+                # compile-outside-the-timed-window, both generations: the
+                # post-swap [8]-slot decode program warms through a
+                # throwaway engine sharing the SAME ModelPrograms (its jit
+                # cache), so the rung prices the swap itself — drain +
+                # payload move + seat — not a first-touch compile that a
+                # production swap pre-warms before draining
+                warm = ServeEngine(bundle, params, n_slots=8, page_size=16,
+                                   max_len=128, programs=engine.programs)
+                generate_many(warm, [Request(prompt_ids=[3, 17, 42],
+                                             max_new_tokens=4)])
+            engine.decode_steps = engine.decode_tokens = 0
+            ids = [engine.submit(dataclasses.replace(r, request_id=None))
+                   for r in reqs]
+            done, it, swap_stats, pause = {}, 0, None, 0.0
+            t0 = time.perf_counter()
+            while engine.has_work:
+                if it == swap_at:
+                    t_swap = time.perf_counter()
+                    engine, evicted, swap_stats = swap_engine(
+                        engine, n_slots=8)
+                    pause = time.perf_counter() - t_swap
+                    assert not evicted
+                for res in engine.step():
+                    done[res.request_id] = res
+                it += 1
+            stats = throughput_stats(list(done.values()),
+                                     time.perf_counter() - t0, engine)
+            return [done[i] for i in ids], stats, swap_stats, pause
+
+        ctl_res, ctl, _, _ = workload(
+            ServeEngine(bundle, params, n_slots=4, page_size=16,
+                        max_len=128))
+        res, stats, swap_stats, pause = workload(
+            ServeEngine(bundle, params, n_slots=4, page_size=16,
+                        max_len=128), swap_at=4)
+        identical = all(a.generated_ids == b.generated_ids
+                        for a, b in zip(res, ctl_res))
+        out["engine_swap_midstream"] = {
+            "tokens_per_s": stats["tokens_per_s"],
+            "control_no_swap_tokens_per_s": ctl["tokens_per_s"],
+            "tokens_per_s_vs_no_swap": round(
+                stats["tokens_per_s"] / max(ctl["tokens_per_s"], 1e-9), 3),
+            "swap_pause_ms": round(1000 * pause, 2),
+            "token_identity_vs_no_swap": identical,
+            **{f"swap_{k}": v for k, v in (swap_stats or {}).items()},
+        }
+        out["value"] = stats["tokens_per_s"]
+        _emit({**out, "partial": True})
+
+    if "reshard_restore" in rungs:
+        import tempfile
+
+        from distributed_training_guide_tpu.checkpoint import (
+            CheckpointIO, restore_train_state, stamp_host_state)
+        from distributed_training_guide_tpu.parallel import (make_mesh,
+                                                             make_plan)
+        from distributed_training_guide_tpu.train import (Trainer,
+                                                          adamw_cosine)
+        from distributed_training_guide_tpu.train.state import \
+            host_state_dict
+
+        n_dev = len(jax.devices())
+        if n_dev < 2:
+            out["reshard_restore"] = {"skipped": "needs >= 2 devices"}
+        else:
+            half = n_dev // 2
+            ids = jnp.asarray(
+                np.random.RandomState(0).randint(0, 512, (8, 16)))
+
+            def steps(t, state, n):
+                batch = {k: jax.device_put(ids, t.batch_shardings()[k])
+                         for k in ("input_ids", "labels")}
+                losses = []
+                for _ in range(n):
+                    state, m = t.step_fn(state, batch)
+                    losses.append(float(m["loss"]))
+                return state, losses
+
+            def trainer(n):
+                return Trainer(bundle=bundle,
+                               optimizer=adamw_cosine(1e-3),
+                               plan=make_plan("fsdp", make_mesh(
+                                   devices=jax.devices()[:n], fsdp=n)),
+                               donate=False)
+
+            tg = trainer(n_dev)
+            _, golden = steps(tg, tg.init_state(0), 4)
+            t_a = trainer(n_dev)
+            state, _ = steps(t_a, t_a.init_state(0), 2)
+            with tempfile.TemporaryDirectory() as tmp:
+                io = CheckpointIO(tmp)
+                host = host_state_dict()
+                host["global_step"] = 2
+                io.save(state, stamp_host_state(host, t_a))
+                t0 = time.perf_counter()
+                restore_train_state(io, trainer(n_dev))
+                same_mesh_s = time.perf_counter() - t0
+                t_b = trainer(half)
+                t0 = time.perf_counter()
+                restored, _ = restore_train_state(io, t_b)
+                reshard_s = time.perf_counter() - t0
+                _, cont = steps(t_b, restored, 2)
+            dev = max(abs(c - g) / abs(g)
+                      for c, g in zip(cont, golden[2:]))
+            out["reshard_restore"] = {
+                "mesh_a": f"fsdp={n_dev}", "mesh_b": f"fsdp={half}",
+                "restore_same_mesh_s": round(same_mesh_s, 3),
+                "restore_resharded_s": round(reshard_s, 3),
+                "reshard_overhead_x": round(
+                    reshard_s / max(same_mesh_s, 1e-9), 3),
+                "continued_traj_max_rel_dev": float(dev),
+                "within_2e4": bool(dev < 2e-4),
+            }
+            if not out["value"]:
+                out["value"] = round(1.0 / max(reshard_s, 1e-9), 3)
+    _emit(out)
+
+
 # ---------------------------------------------------------------------------
 # parent: ladder orchestration (never touches the TPU itself)
 # ---------------------------------------------------------------------------
@@ -1124,6 +1291,18 @@ SWEEP_QUEUE = [
     # variable — plus the cross-process wire digest/MiB/s leg.
     dict(name="router_fleet2", decode_rungs="router_fleet2"),
     dict(name="handoff_crossproc", decode_rungs="handoff_crossproc"),
+    # --- elastic fleet (serve/elastic.py + checkpoint/reshard.py, PR 13;
+    # one new variable each, with the static control measured IN-RUNG).
+    # engine_swap_midstream = the slots4 workload with a live
+    # n_slots 4->8 generation swap injected mid-stream vs the identical
+    # no-swap control (records the swap pause, pages/bytes moved, and
+    # the token-identity bit — the swap must be invisible to every
+    # stream). reshard_restore = restore a stamped checkpoint onto the
+    # SAME mesh (control) then onto a half-size fsdp mesh (the elastic
+    # shrink), recording both restore walls and the continued-trajectory
+    # deviation vs an uninterrupted golden.
+    dict(name="engine_swap_midstream", elastic_rungs="engine_swap_midstream"),
+    dict(name="reshard_restore", elastic_rungs="reshard_restore"),
     # LAST on purpose: fence_every=4 dispatches 4 steps ahead, the exact
     # pattern this pool's documented failure mode punishes — its first
     # attempt (2026-07-31 03:50) stalled and the pool went down with it.
@@ -1346,12 +1525,17 @@ def run_sweep(watchdog: int) -> None:
                 time.sleep(min(300, max(1, deadline - time.time())))
             if time.time() >= deadline:
                 return
-            # serving rungs dispatch the decode-check child instead of a
-            # training rung; their result metric is decode_tput
-            metric = "decode_tput" if exp.get("decode_rungs") else "mfu"
+            # serving/elastic rungs dispatch their check children instead
+            # of a training rung; their result metrics differ
+            metric = ("decode_tput" if exp.get("decode_rungs")
+                      else "elastic" if exp.get("elastic_rungs")
+                      else "mfu")
             if exp.get("decode_rungs"):
                 child_args = ["--check-decode",
                               "--decode-rungs", exp["decode_rungs"]]
+            elif exp.get("elastic_rungs"):
+                child_args = ["--check-elastic",
+                              "--elastic-rungs", exp["elastic_rungs"]]
             else:
                 spec = {k: v for k, v in exp.items() if k != "name"}
                 spec.setdefault("steps", 10)
@@ -1512,6 +1696,8 @@ def main() -> None:
     parser.add_argument("--check-flash", action="store_true", help=argparse.SUPPRESS)
     parser.add_argument("--check-decode", action="store_true", help=argparse.SUPPRESS)
     parser.add_argument("--decode-rungs", default=None, help=argparse.SUPPRESS)
+    parser.add_argument("--check-elastic", action="store_true", help=argparse.SUPPRESS)
+    parser.add_argument("--elastic-rungs", default=None, help=argparse.SUPPRESS)
     args = parser.parse_args()
     if args.remat is False and args.remat_policy:
         parser.error("--no-remat contradicts --remat-policy "
@@ -1525,6 +1711,8 @@ def main() -> None:
         return run_flash_check()
     if args.check_decode:
         return run_decode_check(args.decode_rungs)
+    if args.check_elastic:
+        return run_elastic_check(args.elastic_rungs)
     if args.sweep:
         return run_sweep(args.watchdog)
 

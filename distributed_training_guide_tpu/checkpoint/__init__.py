@@ -1,12 +1,20 @@
 from .orbax_io import (CheckpointCorruptionError, CheckpointIO,
-                       abstract_train_state, restore_train_state)
+                       abstract_train_state, restore_train_state,
+                       stamp_host_state)
 from .manifest import load_manifest, manifest_path, verify_manifest, write_manifest
+from .reshard import (ReshardIncompatibleError, check_reshard_compatibility,
+                      describe_layout, mesh_descriptor)
 
 __all__ = [
     "CheckpointIO",
     "CheckpointCorruptionError",
     "abstract_train_state",
     "restore_train_state",
+    "stamp_host_state",
+    "ReshardIncompatibleError",
+    "check_reshard_compatibility",
+    "describe_layout",
+    "mesh_descriptor",
     "write_manifest",
     "load_manifest",
     "verify_manifest",

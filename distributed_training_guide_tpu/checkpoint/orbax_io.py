@@ -427,14 +427,32 @@ def abstract_train_state(trainer, *, fp32_reference: bool = False):
         state_shapes, shardings)
 
 
-def _recorded_precision_policy(io: CheckpointIO) -> Optional[str]:
-    """Precision-policy stamp of the newest retained checkpoint's manifest
-    host_state, or None (legacy/pre-stamp saves)."""
+def _recorded_host_state(io: CheckpointIO) -> dict:
+    """The newest retained checkpoint's manifest host_state — read ONCE
+    per restore (it carries every stamp restore checks: precision
+    policy, mesh descriptor). Empty for legacy/pre-stamp saves."""
     for path in io._retention_chain()[:1]:
         manifest = manifest_mod.load_manifest(io.exp_dir, path.name)
         if manifest and isinstance(manifest.get("host_state"), dict):
-            return manifest["host_state"].get("precision_policy")
-    return None
+            return manifest["host_state"]
+    return {}
+
+
+def _recorded_precision_policy(io: CheckpointIO) -> Optional[str]:
+    return _recorded_host_state(io).get("precision_policy")
+
+
+def stamp_host_state(host_state: dict, trainer) -> dict:
+    """Stamp the layout facts ``restore_train_state`` verifies into a
+    host_state dict (mutates and returns it): the precision-policy name
+    (policy-mismatch loud failures) and the mesh descriptor
+    (reshard-compatibility — ``checkpoint/reshard.py``). One helper so
+    every save site (train CLI, engine facade, tests) stamps identically."""
+    from .reshard import mesh_descriptor
+
+    host_state["precision_policy"] = trainer.precision.name
+    host_state["mesh"] = mesh_descriptor(trainer)
+    return host_state
 
 
 def restore_train_state(io: CheckpointIO, trainer) -> tuple[Any, dict]:
@@ -450,9 +468,32 @@ def restore_train_state(io: CheckpointIO, trainer) -> tuple[Any, dict]:
     checkpoint into a run that dropped (or changed) its --precision-policy
     raises naming both policies instead of silently resuming an older
     checkpoint from the retention chain and masking the config regression.
-    Unstamped (pre-stamp) checkpoints keep the try-then-fall-back behavior."""
+    Unstamped (pre-stamp) checkpoints keep the try-then-fall-back behavior.
+
+    Mesh changes are first-class (the elastic-restart path): the save side
+    stamps a mesh descriptor (``stamp_host_state``), and a restore whose
+    trainer sits on a DIFFERENT mesh is checked for reshard compatibility
+    (``checkpoint/reshard.py``) before any TensorStore read — a benign
+    dp/fsdp/tp refactorization logs one loud "resharding A -> B" line and
+    restores into the new shardings; a pipeline-stage-split or
+    quantized-block-tiling change raises ``ReshardIncompatibleError``
+    naming both layouts instead of dying inside TensorStore or silently
+    falling back through the retention chain."""
+    from .reshard import (check_reshard_compatibility, describe_layout,
+                          mesh_descriptor)
+
     policy = trainer.precision
-    recorded = _recorded_precision_policy(io)
+    stamps = _recorded_host_state(io)
+    target_layout = mesh_descriptor(trainer)
+    recorded_layout = stamps.get("mesh")
+    if check_reshard_compatibility(recorded_layout, target_layout):
+        LOGGER.warning(
+            "cross-mesh restore: resharding checkpoint saved on [%s] onto "
+            "[%s] — the abstract target carries the new shardings, each "
+            "host reads exactly its new shards",
+            describe_layout(recorded_layout),
+            describe_layout(target_layout))
+    recorded = stamps.get("precision_policy")
     if recorded and recorded != policy.name:
         if recorded == "fp32" and not policy.is_noop:
             # known-fp32 checkpoint into a policy run: skip the doomed

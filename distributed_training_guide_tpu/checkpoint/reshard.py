@@ -1,0 +1,120 @@
+"""Mesh-layout stamps and the reshard-compatibility check for restore.
+
+A checkpoint is global arrays addressed by index ranges (TensorStore), so
+restoring onto a DIFFERENT mesh is, mechanically, a pure layout problem:
+``abstract_train_state(trainer)`` already carries the TARGET shardings and
+Orbax re-slices each host's reads into them (ZeRO's observation — state is
+a global tensor, the partitioning is bookkeeping; Rajbhandari et al.,
+arXiv:1910.02054). Elastic restarts lean on exactly that: lose half the
+pod, rebuild the mesh from the live devices, restore, continue
+(``related-topics/elastic-training``).
+
+What mechanics can NOT express is whether the resulting run is the same
+TRAINING RUN. Two layout families genuinely break across a mesh change
+and previously failed deep inside TensorStore (shape mismatch walls of
+text) or — worse — fell back through the retention chain to an older
+checkpoint, silently rewinding the run:
+
+- **pipeline stage splits**: the pp schedule's manual regions and the
+  stage-owned layer ranges are a function of ``pp``; a checkpoint written
+  under one stage split restored into another has never been validated
+  here and must not be guessed at.
+- **quantized opt-state block tilings**: adam8bit moments are int8
+  payloads + one fp32 scale per block of the trailing axis
+  (``train/precision.py``); the scale SHAPES encode the block size, so a
+  checkpoint written at block 64 cannot restore into a block-128 layout
+  — the abstract target simply has different arrays.
+
+So every save stamps a small **mesh descriptor** into the manifest's
+host_state (next to the precision-policy stamp), and
+``restore_train_state`` compares it against the restoring trainer's
+descriptor: benign refactorizations (dp/fsdp/tp factor changes, fewer or
+more devices) log a loud "resharding A -> B" line and proceed;
+genuinely incompatible layouts raise :class:`ReshardIncompatibleError`
+NAMING BOTH LAYOUTS and the knob to change. Unstamped (pre-stamp)
+checkpoints keep the old behavior.
+"""
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+
+class ReshardIncompatibleError(ValueError):
+    """The checkpoint's recorded layout cannot restore into the target
+    trainer's layout by resharding alone (pp stage split or quantized
+    block tiling changed). Carries both descriptors."""
+
+    def __init__(self, message: str, *, saved: dict, target: dict):
+        super().__init__(message)
+        self.saved = dict(saved)
+        self.target = dict(target)
+
+
+def mesh_descriptor(trainer) -> dict:
+    """The layout stamp for one trainer: the mesh's non-trivial axes, the
+    device count, the sharding strategy, the pipeline stage split, and the
+    quantized-moment block size (None for unquantized policies). Small,
+    JSON-safe, and sufficient for :func:`check_reshard_compatibility` —
+    NOT a full sharding spec (the abstract restore target owns that)."""
+    mesh = trainer.plan.mesh
+    shape = dict(mesh.shape)
+    policy = trainer.precision
+    return {
+        "axes": {k: int(v) for k, v in shape.items() if int(v) > 1},
+        "device_count": int(math.prod(int(v) for v in shape.values())),
+        "strategy": trainer.plan.strategy,
+        "pp_stages": int(shape.get("pp", 1)),
+        "quant_block": (int(policy.block_size)
+                        if policy.quantize_moments else None),
+    }
+
+
+def describe_layout(desc: dict) -> str:
+    """One human line for a descriptor (error messages and reshard logs)."""
+    axes = desc.get("axes") or {}
+    axes_s = ("x".join(f"{k}={v}" for k, v in sorted(axes.items()))
+              or "single")
+    parts = [f"{desc.get('strategy', '?')}[{axes_s}]",
+             f"{desc.get('device_count', '?')} devices"]
+    if desc.get("pp_stages", 1) > 1:
+        parts.append(f"pp_stages={desc['pp_stages']}")
+    if desc.get("quant_block") is not None:
+        parts.append(f"quant_block={desc['quant_block']}")
+    return ", ".join(parts)
+
+
+def check_reshard_compatibility(saved: Optional[dict], target: dict) -> bool:
+    """True when restoring ``saved`` -> ``target`` is a mesh CHANGE that
+    plain resharding covers (the caller logs it); False when the layouts
+    match (nothing to say). Raises :class:`ReshardIncompatibleError` for
+    the two known-breaking families, naming both layouts.
+
+    ``saved=None`` (pre-stamp checkpoint) is treated as unknown-but-
+    allowed — exactly the old behavior."""
+    if not saved:
+        return False
+    saved_pp = int(saved.get("pp_stages", 1))
+    target_pp = int(target.get("pp_stages", 1))
+    if saved_pp != target_pp:
+        raise ReshardIncompatibleError(
+            f"checkpoint was saved under a {saved_pp}-stage pipeline split "
+            f"({describe_layout(saved)}) but this run uses {target_pp} "
+            f"stage(s) ({describe_layout(target)}); pipeline stage splits "
+            f"are not reshard-compatible — restore with the matching "
+            f"pipeline_parallel, or export through the fp32/HF path and "
+            f"re-import", saved=saved, target=target)
+    saved_block = saved.get("quant_block")
+    target_block = target.get("quant_block")
+    if (saved_block is not None and target_block is not None
+            and int(saved_block) != int(target_block)):
+        raise ReshardIncompatibleError(
+            f"checkpoint holds quantized optimizer moments tiled at block "
+            f"size {saved_block} ({describe_layout(saved)}) but this run's "
+            f"precision policy tiles at block size {target_block} "
+            f"({describe_layout(target)}); the per-block scale arrays have "
+            f"different shapes, so this cannot restore by resharding — use "
+            f"a policy with block_size={saved_block}, or restore with the "
+            f"original policy and re-encode", saved=saved, target=target)
+    return (saved.get("axes") != target.get("axes")
+            or saved.get("device_count") != target.get("device_count"))

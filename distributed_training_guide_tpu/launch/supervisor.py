@@ -36,9 +36,30 @@ and the normal restart policy applies. Workers that never write a heartbeat
 (foreign commands, crash before step 1) fall back to the original log-size
 heuristic.
 
+World-size renegotiation (``--elastic-dir``, ``launch/elastic.py``): the
+supervisor joins a membership directory shared by every slice's
+supervisor, and a slice loss/gain becomes "SIGTERM the worker,
+renegotiate the world (leader proposes, all ack, barrier'd world.json),
+re-exec with the renegotiated mesh config" instead of a crash loop
+against the missing slice. The worker command may carry
+``{world_devices}`` / ``{world_batch}`` tokens (re-rendered per world),
+its env gets the forced host-platform device count for the agreed world,
+and every renegotiation appends to the coordination dir's
+``elastic.jsonl`` (old world, new world, trigger, wall time) — the
+membership timeline post-mortems read. Renegotiation restarts are NOT
+failures: they don't consume ``--max-restarts`` and don't back off. The
+resume itself is the normal restore path — the checkpoint reshards into
+the new world's mesh (``checkpoint/reshard.py``).
+
 Usage:
     python -m distributed_training_guide_tpu.launch.supervisor \
         --max-restarts 3 --log-dir ./logs -- python train_llm.py ...
+
+    # elastic: 2 slices x 4 devices, global batch held at 8
+    python -m ...launch.supervisor --elastic-dir /shared/coord \
+        --slice-name slice0 --devices-per-slice 4 \
+        --elastic-global-batch 8 -- \
+        python train_llm.py -b "{world_batch}" ...
 """
 from __future__ import annotations
 
@@ -116,42 +137,96 @@ def _poison_reason(error_file: Path, launched_at: float = 0.0) -> str | None:
     return None
 
 
+def _renegotiate(rt, trigger: str) -> bool:
+    """Establish the next world after ``trigger``; False means this slice
+    was fenced out of the fleet (the caller exits cleanly — its work is
+    covered by the new, smaller world's restore)."""
+    from .elastic import FencedOutError
+
+    try:
+        world = rt.establish(trigger)
+    except FencedOutError as exc:
+        print(f"[supervisor] fenced out of the fleet ({exc}); exiting",
+              flush=True)
+        rt.retire()
+        return False
+    print(f"[supervisor] world {world['world_id']} agreed "
+          f"({trigger}): members {world['members']} -> "
+          f"{rt.world_devices()} devices", flush=True)
+    return True
+
+
 def run_supervised(cmd: list[str], max_restarts: int, log_dir: Path,
                    heartbeat_timeout: float | None = None, *,
                    restart_backoff: float = 1.0, backoff_cap: float = 60.0,
-                   stop_on_poison: bool = True) -> int:
-    attempt = 0
+                   stop_on_poison: bool = True, elastic=None) -> int:
+    rt = None
+    if elastic is not None:
+        from .elastic import ElasticRuntime
+
+        rt = ElasticRuntime(elastic)
+        if not _renegotiate(rt, "start"):
+            return 0
+    attempt = 0          # FAILURES only — renegotiations are free
+    incarnation = 0      # every launch gets its own log dir
     while True:
-        attempt_dir = log_dir / f"attempt_{attempt}"
+        attempt_dir = log_dir / f"attempt_{incarnation}"
         attempt_dir.mkdir(parents=True, exist_ok=True)
         env = dict(os.environ)
         env.setdefault("ERROR_FILE", str(attempt_dir / "error.json"))
         env["HEARTBEAT_FILE"] = str(attempt_dir / "heartbeat.json")
         _fence_stale_error_files(Path(env["ERROR_FILE"]))
+        launch_cmd = cmd
+        if rt is not None:
+            from .elastic import render_worker_cmd, worker_world_env
+
+            launch_cmd = render_worker_cmd(cmd, rt.world_devices(),
+                                           elastic.global_batch)
+            worker_world_env(env, rt.world, rt.world_devices())
         stdout = open(attempt_dir / "stdout.log", "ab")
         stderr = open(attempt_dir / "stderr.log", "ab")
-        print(f"[supervisor] attempt {attempt}: {' '.join(cmd)} -> {attempt_dir}",
-              flush=True)
+        print(f"[supervisor] attempt {incarnation}: "
+              f"{' '.join(launch_cmd)} -> {attempt_dir}", flush=True)
         launched_at = _launch_stamp(attempt_dir)
-        proc = subprocess.Popen(cmd, env=env, stdout=stdout, stderr=stderr)
+        proc = subprocess.Popen(launch_cmd, env=env, stdout=stdout,
+                                stderr=stderr)
 
+        trigger = None
         try:
-            if heartbeat_timeout:
+            if rt is not None:
+                trigger, rc = _wait_elastic(proc, attempt_dir,
+                                            heartbeat_timeout, rt)
+            elif heartbeat_timeout:
                 rc = _wait_with_heartbeat(proc, attempt_dir, heartbeat_timeout)
             else:
                 rc = proc.wait()
         except KeyboardInterrupt:
             proc.send_signal(signal.SIGTERM)
             proc.wait()
+            if rt is not None:
+                rt.retire()
             return 130
         finally:
             stdout.close()
             stderr.close()
 
+        incarnation += 1
+        if trigger is not None:
+            # a renegotiation restart, NOT a failure: the world changed
+            # under the worker — agree on the new one and re-exec with the
+            # renegotiated mesh config (no attempt consumed, no backoff)
+            print(f"[supervisor] attempt {incarnation - 1} stopped for "
+                  f"renegotiation ({trigger})", flush=True)
+            if not _renegotiate(rt, trigger):
+                return 0
+            continue
         if rc == 0:
-            print(f"[supervisor] attempt {attempt} exited cleanly", flush=True)
+            print(f"[supervisor] attempt {incarnation - 1} exited cleanly",
+                  flush=True)
+            if rt is not None:
+                rt.retire()
             return 0
-        print(f"[supervisor] attempt {attempt} failed rc={rc} "
+        print(f"[supervisor] attempt {incarnation - 1} failed rc={rc} "
               f"(error file: {env['ERROR_FILE']})", flush=True)
         if stop_on_poison:
             reason = _poison_reason(Path(env["ERROR_FILE"]), launched_at)
@@ -159,16 +234,41 @@ def run_supervised(cmd: list[str], max_restarts: int, log_dir: Path,
                 print(f"[supervisor] non-retryable failure ({reason}); "
                       f"not restarting — fix the config/data and relaunch",
                       flush=True)
-                return rc
+                if rt is not None:
+                    rt.retire()   # deliberate stop = clean departure: the
+                return rc         # fleet shrinks now, not a timeout later
         if attempt >= max_restarts:
             print(f"[supervisor] max restarts ({max_restarts}) exhausted", flush=True)
+            if rt is not None:
+                rt.retire()
             return rc
         delay = min(backoff_cap, restart_backoff * (2 ** attempt))
         if delay > 0:
             print(f"[supervisor] backing off {delay:.1f}s before attempt "
-                  f"{attempt + 1}", flush=True)
-            time.sleep(delay)
+                  f"{incarnation}", flush=True)
+            if rt is None:
+                time.sleep(delay)
+            else:
+                # keep beating membership AND acking proposals through
+                # the backoff: a silent backoff longer than the fleet's
+                # liveness timeout would read as a lost slice, and a
+                # beat without acks would get this live member dropped
+                # as a straggler by any renegotiation that lands in the
+                # window — both fence a healthy slice over a transient
+                # worker crash
+                end = time.time() + delay
+                while time.time() < end:
+                    rt.member.beat()
+                    rt.negotiator.maybe_ack()
+                    time.sleep(min(0.25, max(0.0, end - time.time())))
         attempt += 1
+        if rt is not None:
+            # the failure may BE a membership event (e.g. the gang lost a
+            # peer slice and collapsed): re-check before relaunching so the
+            # restart comes up on the world that actually exists
+            change = rt.poll()
+            if change is not None and not _renegotiate(rt, change):
+                return 0
 
 
 def _progress_stamp(attempt_dir: Path, logs: list[Path]) -> tuple:
@@ -209,6 +309,49 @@ def _wait_with_heartbeat(proc: subprocess.Popen, attempt_dir: Path,
         time.sleep(min(5.0, timeout / 4))
 
 
+def _wait_elastic(proc: subprocess.Popen, attempt_dir: Path,
+                  heartbeat_timeout: float | None, rt) \
+        -> tuple[str | None, int]:
+    """The elastic wait loop: the normal hang detection, PLUS a
+    membership tick (beat our member file, ack any live proposal, compare
+    liveness against the agreed world). A world change SIGTERMs the
+    worker and returns ``(trigger, rc)``; a normal exit returns
+    ``(None, rc)``."""
+    logs = [attempt_dir / "stdout.log", attempt_dir / "stderr.log"]
+    last_stamp = None
+    last_change = time.time()
+    last_tick = 0.0
+    while True:
+        rc = proc.poll()
+        if rc is not None:
+            return None, rc
+        now = time.time()
+        if now - last_tick >= 0.25:
+            last_tick = now
+            trigger = rt.poll()
+            if trigger is not None:
+                print(f"[supervisor] membership changed ({trigger}); "
+                      f"stopping worker for world renegotiation", flush=True)
+                proc.send_signal(signal.SIGTERM)
+                try:
+                    proc.wait(timeout=30)
+                except subprocess.TimeoutExpired:
+                    proc.kill()
+                    proc.wait()
+                return trigger, proc.returncode
+        if heartbeat_timeout:
+            stamp = _progress_stamp(attempt_dir, logs)
+            if stamp != last_stamp:
+                last_stamp, last_change = stamp, now
+            elif now - last_change > heartbeat_timeout:
+                kind = last_stamp[0] if last_stamp else "logs"
+                print(f"[supervisor] no {kind} progress for "
+                      f"{heartbeat_timeout}s -> SIGKILL (hang)", flush=True)
+                proc.kill()
+                return None, proc.wait() or -9
+        time.sleep(0.2)
+
+
 def main():
     parser = argparse.ArgumentParser()
     parser.add_argument("--max-restarts", type=int, default=3)
@@ -225,17 +368,46 @@ def main():
                         help="restart even when the error file classifies as "
                              "a deterministic poison pill (OOM, shape/"
                              "sharding, guard abort) — default is to stop")
+    parser.add_argument("--elastic-dir", default=None,
+                        help="shared coordination dir: join the elastic "
+                             "fleet (membership heartbeats + barrier'd "
+                             "world agreement + elastic.jsonl events); a "
+                             "slice loss renegotiates the world and "
+                             "re-execs the worker instead of crash-looping")
+    parser.add_argument("--slice-name", default="slice0",
+                        help="this supervisor's member name in the fleet")
+    parser.add_argument("--devices-per-slice", type=int, default=1,
+                        help="devices each live slice contributes; the "
+                             "world total drives {world_devices} and the "
+                             "forced host-platform device count")
+    parser.add_argument("--liveness-timeout", type=float, default=5.0,
+                        help="seconds without a membership beat before a "
+                             "slice counts as lost")
+    parser.add_argument("--elastic-global-batch", type=int, default=None,
+                        help="global batch to hold invariant across "
+                             "worlds: {world_batch} in the worker command "
+                             "renders as global_batch // world_devices")
     parser.add_argument("cmd", nargs=argparse.REMAINDER,
                         help="-- followed by the worker command")
     args = parser.parse_args()
     cmd = args.cmd[1:] if args.cmd and args.cmd[0] == "--" else args.cmd
     if not cmd:
         parser.error("no worker command given (use: supervisor [opts] -- cmd ...)")
+    elastic = None
+    if args.elastic_dir:
+        from .elastic import ElasticConfig
+
+        elastic = ElasticConfig(
+            coord_dir=Path(args.elastic_dir), member=args.slice_name,
+            devices_per_slice=args.devices_per_slice,
+            liveness_timeout_s=args.liveness_timeout,
+            global_batch=args.elastic_global_batch)
     sys.exit(run_supervised(cmd, args.max_restarts, Path(args.log_dir),
                             args.heartbeat_timeout,
                             restart_backoff=args.restart_backoff,
                             backoff_cap=args.backoff_cap,
-                            stop_on_poison=not args.restart_on_poison))
+                            stop_on_poison=not args.restart_on_poison,
+                            elastic=elastic))
 
 
 if __name__ == "__main__":

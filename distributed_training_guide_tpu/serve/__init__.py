@@ -23,8 +23,12 @@ FAULT-TOLERANT MULTI-HOST FABRIC (``serve/router.py`` — prefix-affinity
 + least-loaded routing over N replicas with heartbeat fencing and
 bitwise resubmission replay; ``serve/transport.py`` — the cross-host
 branch of the page handoff: serialized k/v payloads over a CRC-framed
-ack/commit wire whose only failure outcome is drop-free-requeue). See
-related-topics/serving/README.md.
+ack/commit wire whose only failure outcome is drop-free-requeue), now
+ELASTIC at runtime (``serve/elastic.py`` — live engine-generation swaps:
+grow/shrink ``n_slots``/page pool as a coordinated mass preemption that
+seats or bitwise-replays every in-flight request; the router's replica
+set is mutable via ``add_replica``/``remove_replica``/``swap_replica``).
+See related-topics/serving/README.md.
 
     from distributed_training_guide_tpu.serve import (
         Request, ServeEngine, DisaggEngine, generate_many)
@@ -39,8 +43,8 @@ __all__ = [
     "NgramDrafter", "PagePool", "PrefixCache", "RefusalError", "Replica",
     "Request", "RequestResult", "Router", "Scheduler", "ServeEngine",
     "generate_many", "kv_page_bytes", "local_fleet",
-    "match_partition_rules", "pages_for_tokens", "prefix_affinity_key",
-    "serve_http",
+    "match_partition_rules", "new_generation", "pages_for_tokens",
+    "prefix_affinity_key", "serve_http", "swap_engine", "swap_generation",
 ]
 
 
@@ -69,4 +73,8 @@ def __getattr__(name):
         from .sharding import match_partition_rules
 
         return match_partition_rules
+    if name in ("new_generation", "swap_engine", "swap_generation"):
+        from . import elastic
+
+        return getattr(elastic, name)
     raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -427,11 +427,7 @@ class DecodeEngine:
         # a preempted sequence lands in THIS scheduler's queue, but only
         # the prefill engine can recompute its prompt — hand the entries
         # back for requeue-at-head over there (with their submit times)
-        entries = []
-        while sched.queue:
-            entry = sched.queue.pop(0)
-            t_submit = sched._submit_times.pop(entry.request.request_id)
-            entries.append((entry, t_submit))
+        entries = sched.drain_queue()
 
         if sched.active_indices():
             # the spec/plain dispatch is the monolith's, verbatim
@@ -482,7 +478,8 @@ class DisaggEngine:
                  speculate=None, spec_k: int = 4, kv_dtype=None,
                  transport: str = "same_host",
                  n_prefill_pages: Optional[int] = None,
-                 handoff_ack_timeout_s: float = 2.0):
+                 handoff_ack_timeout_s: float = 2.0,
+                 programs: Optional[ModelPrograms] = None):
         if n_prefill_slots < 1:
             raise ValueError(f"n_prefill_slots must be >= 1, got "
                              f"{n_prefill_slots}")
@@ -506,10 +503,13 @@ class DisaggEngine:
             # family the verify forward uses, or TPU flash-vs-gather
             # 1e-5 drift could break spec-on == spec-off identity
             attend_impl = "xla"
-        self.programs = ModelPrograms(bundle, params, plan=plan,
-                                      shard_kv=shard_kv,
-                                      attend_impl=attend_impl,
-                                      kv_dtype=kv_dtype)
+        # a pre-built programs= shares one params layout + jit cache (the
+        # monolith's contract, mirrored here — engine-generation swaps
+        # depend on the new generation running the OLD generation's exact
+        # programs so replayed tokens are bitwise)
+        self.programs = programs if programs is not None else ModelPrograms(
+            bundle, params, plan=plan, shard_kv=shard_kv,
+            attend_impl=attend_impl, kv_dtype=kv_dtype)
         self.bundle, self.config = bundle, bundle.config
         # both halves write/read ONE pool at one storage dtype; the
         # handoff moves page ids, so a quantized page's payload AND its

@@ -272,7 +272,9 @@ class Router:
         self._backlog: list[int] = []
         self.counters = {"routed": 0, "affinity_routed": 0,
                          "spillovers": 0, "fenced": 0, "resubmitted": 0,
-                         "resubmit_exhausted": 0, "refused": {}}
+                         "resubmit_exhausted": 0, "replicas_added": 0,
+                         "replicas_removed": 0, "generation_swaps": 0,
+                         "refused": {}}
 
     # ---- routing -----------------------------------------------------------
     def _routable(self, now: float, exclude=()) -> list[Replica]:
@@ -345,11 +347,12 @@ class Router:
         return record.rid
 
     # ---- health + recovery -------------------------------------------------
-    def _fence(self, replica: Replica) -> None:
-        """Permanently stop routing/stepping a replica and move its
-        in-flight requests to the resubmission backlog."""
-        replica.state = "fenced"
-        self.counters["fenced"] += 1
+    def _resubmit_in_flight(self, replica: Replica) -> int:
+        """Move every request in flight on ``replica`` to the
+        resubmission backlog (the fence-recovery path: the prompt
+        re-prefills elsewhere and the seen tokens replay bitwise).
+        Shared by fencing (failure) and ``remove_replica`` (intent)."""
+        moved = 0
         for rid, record in self._records.items():
             if record.replica == replica.name:
                 self._by_engine.pop((replica.name, record.engine_rid), None)
@@ -359,6 +362,15 @@ class Router:
                 if rid not in self._backlog:
                     self._backlog.append(rid)
                 self.counters["resubmitted"] += 1
+                moved += 1
+        return moved
+
+    def _fence(self, replica: Replica) -> None:
+        """Permanently stop routing/stepping a replica and move its
+        in-flight requests to the resubmission backlog."""
+        replica.state = "fenced"
+        self.counters["fenced"] += 1
+        self._resubmit_in_flight(replica)
 
     def _exhaust(self, record: _RouteRecord,
                  now: float) -> RequestResult:
@@ -481,6 +493,76 @@ class Router:
         finished.extend(self._drain_backlog(self.clock()))
         self._last_step_at = self.clock()
         return finished
+
+    # ---- fleet membership (mutable at runtime) ------------------------------
+    def add_replica(self, replica: Replica) -> None:
+        """Grow the fleet: the replica becomes routable immediately.
+        Rendezvous hashing means only the keys that now score highest on
+        the newcomer move to it — existing replicas' affinity assignments
+        are untouched (the HRW property fencing already leans on)."""
+        if replica.name in self.replicas:
+            raise ValueError(f"replica name {replica.name!r} already in "
+                             f"the fleet")
+        if replica.engine.page_size != self.page_size:
+            raise ValueError(
+                f"replica {replica.name!r} has page_size "
+                f"{replica.engine.page_size} but the fleet routes affinity "
+                f"at page_size {self.page_size} — a mixed fleet would "
+                f"split identical prefixes across engines")
+        self.replicas[replica.name] = replica
+        self.counters["replicas_added"] += 1
+
+    def remove_replica(self, name: str) -> None:
+        """Shrink the fleet WITHOUT killing anything: the replica drains
+        (unroutable, finishes nothing new) and its in-flight requests
+        move through the existing fence-recovery path — resubmitted to
+        healthy replicas where the prompt re-prefills and the seen tokens
+        replay bitwise. The replica then leaves the fleet; its engine's
+        transport is closed. Intent-shaped removal, not a kill: no token
+        any client saw is lost or changed."""
+        if name not in self.replicas:
+            raise ValueError(f"no replica named {name!r}")
+        live_others = [r for n, r in self.replicas.items()
+                       if n != name and r.state == "live"]
+        if not live_others:
+            raise ValueError(
+                f"cannot remove {name!r}: it is the last live replica — "
+                f"its in-flight work would have nowhere to resubmit")
+        replica = self.replicas[name]
+        replica.drain()
+        self._resubmit_in_flight(replica)
+        replica.state = "removed"
+        del self.replicas[name]
+        close = getattr(replica.engine, "close", None)
+        if close is not None:
+            close()
+        self.counters["replicas_removed"] += 1
+
+    def swap_replica(self, name: str, **overrides) -> list[RequestResult]:
+        """Live engine-generation swap for one replica
+        (``serve/elastic.py``): grow/shrink its ``n_slots`` / page pool
+        in place without dropping in-flight requests. The swap preserves
+        engine request ids, so the router's ledger — ``_by_engine``,
+        streaming taps, fence recovery — remains valid across it; only
+        shrink-forced evictions surface, translated to router ids with
+        their strict token prefix. Counted in ``generation_swaps``."""
+        from .elastic import swap_engine
+
+        replica = self.replicas.get(name)
+        if replica is None:
+            raise ValueError(f"no replica named {name!r}")
+        if replica.state != "live":
+            raise ValueError(f"replica {name!r} is {replica.state}; only "
+                             f"live replicas swap generations")
+        if overrides.get("page_size", self.page_size) != self.page_size:
+            # checked BEFORE the swap moves any state: the fleet's
+            # affinity keys are page-aligned at one page_size
+            raise ValueError("generation swap cannot change page_size — "
+                             "the fleet's affinity keys would split")
+        new_engine, evicted, stats = swap_engine(replica.engine, **overrides)
+        replica.engine = new_engine
+        self.counters["generation_swaps"] += 1
+        return self._translate(replica, evicted)
 
     # ---- the engine-shaped surface -----------------------------------------
     @property

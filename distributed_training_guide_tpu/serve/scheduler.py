@@ -415,6 +415,29 @@ class Scheduler:
                                        first_token_at), front=front)
         return request.request_id
 
+    def drain_queue(self) -> list[tuple[_QueueEntry, float]]:
+        """Remove and return EVERY queued entry with its submit time, in
+        queue order — the disaggregated decode side hands preempted
+        entries back to the prefill queue through this, and an
+        engine-generation swap (serve/elastic.py) exports the old
+        generation's queue with it. The entries keep their request ids:
+        re-entering them elsewhere goes through ``requeue(new_id=False)``
+        / ``requeue_entry``."""
+        out = []
+        while self.queue:
+            entry = self.queue.pop(0)
+            out.append((entry,
+                        self._submit_times.pop(entry.request.request_id)))
+        return out
+
+    def ensure_ids_above(self, n: int) -> None:
+        """Advance the request-id counter past ``n``: sequences carried
+        into this scheduler from another generation keep their original
+        ids (the caller's handles must survive the swap), so future
+        submits here must never collide with them."""
+        current = next(self._ids)
+        self._ids = itertools.count(max(current, int(n)))
+
     def _queue_insert(self, entry: _QueueEntry, *, front: bool = False) -> None:
         """Ordered insert: after every entry of >= priority (submit — FIFO
         within the class), or before every entry of <= priority (``front``
@@ -800,10 +823,16 @@ class Scheduler:
               first_token_at: float = 0.0, resumed: bool = False) \
             -> Optional[int]:
         """Seat a handed-off sequence (pages already committed elsewhere —
-        the prefill engine) into a free slot, taking over its page
-        references. Returns the slot index, or None when no slot is free.
-        A resumed sequence replays its recorded tokens through the decode
-        program (see the module docstring) before continuing."""
+        the prefill engine, or the previous engine generation) into a free
+        slot, taking over its page references. Returns the slot index, or
+        None when no slot is free. A RESUMED sequence's cache holds only
+        its re-prefilled prompt, so it replays its recorded tokens through
+        the decode program from position 0 (see the module docstring); a
+        non-resumed one arrives with its full k/v — including every
+        generated token's — so the next decode consumes its NEWEST token
+        (replay_pos at the end: a mid-stream generation-swap seat that
+        replayed from 0 would scatter old tokens' k/v at fresh
+        positions)."""
         slot_idx = next((i for i, s in enumerate(self.slots) if s is None),
                         None)
         if slot_idx is None:
@@ -813,7 +842,8 @@ class Scheduler:
             request=request, pages=list(pages), generated=list(generated),
             cache_len=cache_len, admitted_at=admitted_at,
             seq=next(self._seq), target_len=cache_len, prefilling=False,
-            shared_len=0, resumed=resumed, replay_pos=0,
+            shared_len=0, resumed=resumed,
+            replay_pos=(0 if resumed else max(0, len(generated) - 1)),
             first_token_at=first_token_at)
         self.stats["admitted"] += 1
         return slot_idx

@@ -395,10 +395,12 @@ def run_training(args, plan_factory: Callable, *, extra_log: Optional[dict] = No
             LOGGER.info(f"Resumed=False | {host_state}")
     if is_experiment:
         exp_dir.mkdir(parents=True, exist_ok=True)
-    # stamped into every manifest's host_state: restore_train_state uses it
-    # to fail loudly when a run drops/changes its --precision-policy instead
-    # of silently falling back through the retention chain
-    host_state["precision_policy"] = trainer.precision.name
+    # stamped into every manifest's host_state: restore_train_state fails
+    # loudly when a run drops/changes its --precision-policy, and checks the
+    # mesh descriptor for reshard compatibility on elastic restarts
+    from ..checkpoint import stamp_host_state
+
+    stamp_host_state(host_state, trainer)
 
     from ..utils.tracking import make_tracker
 

@@ -337,7 +337,9 @@ class TrainingEngine:
 
         host = host_state_dict()
         host["global_step"] = self._step  # host mirror: no device sync
-        host["precision_policy"] = self.trainer.precision.name
+        from ..checkpoint import stamp_host_state
+
+        stamp_host_state(host, self.trainer)
         self._io_for(save_dir, tag).save(self.state, host)
 
     def load_checkpoint(self, save_dir: str | Path, tag: Optional[str] = None) -> dict:

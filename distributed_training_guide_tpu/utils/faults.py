@@ -40,6 +40,21 @@ executable documentation):
   while its process would still answer liveness; only the heartbeat-age
   fence catches it.
 
+Elastic-fleet faults (the renegotiation and generation-swap drills —
+``launch/elastic.py`` members and ``serve/elastic.py`` swaps consume
+these; ``tests/test_elastic_train.py`` / ``test_elastic_serve.py``):
+
+- ``DTG_FAULT_SLICE_LOSS=<member>@<beat>``: slice loss — the named
+  elastic member stops writing its membership heartbeat after its Nth
+  beat and exits without retiring its file (the no-cleanup death of a
+  whole slice); the surviving supervisors' liveness scan ages it out and
+  the leader renegotiates the world without it.
+- ``DTG_FAULT_SWAP_DROP_SEQ=<n>``: during an engine-generation swap, the
+  Nth resident sequence's gathered k/v payload is dropped (a torn
+  device-to-host read); the swap falls back to requeue-and-replay for
+  that sequence — recompute through the prefill path plus the bitwise
+  decode replay, so the continuation is still token-identical.
+
 All faults are deterministic functions of (env, step): a drill that kills a
 run at step N kills every rerun at step N too, so kill -> restart -> resume
 trajectories can be compared bit-for-bit against an uninterrupted run.
@@ -64,6 +79,8 @@ ENV_HANDOFF_CRASH_XFER = "DTG_FAULT_HANDOFF_CRASH_XFER"
 ENV_HANDOFF_TIMEOUT_XFER = "DTG_FAULT_HANDOFF_TIMEOUT_XFER"
 ENV_REPLICA_KILL = "DTG_FAULT_REPLICA_KILL"
 ENV_REPLICA_WEDGE = "DTG_FAULT_REPLICA_WEDGE"
+ENV_SLICE_LOSS = "DTG_FAULT_SLICE_LOSS"
+ENV_SWAP_DROP_SEQ = "DTG_FAULT_SWAP_DROP_SEQ"
 
 _CORRUPT_BYTES = 256
 
@@ -104,6 +121,8 @@ class FaultSpec:
     handoff_timeout_xfer: Optional[int] = None
     replica_kill: Optional[tuple[str, int]] = None    # (name, router step)
     replica_wedge: Optional[tuple[str, int]] = None
+    slice_loss: Optional[tuple[str, int]] = None      # (member, beat count)
+    swap_drop_seq: Optional[int] = None               # resident index in swap
 
 
 def active_faults() -> FaultSpec:
@@ -123,6 +142,8 @@ def active_faults() -> FaultSpec:
         handoff_timeout_xfer=_env_int(ENV_HANDOFF_TIMEOUT_XFER),
         replica_kill=_env_target(ENV_REPLICA_KILL),
         replica_wedge=_env_target(ENV_REPLICA_WEDGE),
+        slice_loss=_env_target(ENV_SLICE_LOSS),
+        swap_drop_seq=_env_int(ENV_SWAP_DROP_SEQ),
     )
 
 
@@ -152,6 +173,25 @@ def replica_fault(name: str, step: int) -> Optional[str]:
     if spec.replica_wedge is not None and spec.replica_wedge == (name, step):
         return "wedge"
     return None
+
+
+def slice_fault(member: str, beat: int) -> bool:
+    """True when elastic member ``member`` should die (stop beating, no
+    cleanup) at its ``beat``-th membership heartbeat — the slice-loss
+    drill. Deterministic in (env, beat count), like every fault here."""
+    spec = active_faults()
+    return (spec.slice_loss is not None
+            and spec.slice_loss[0] == member
+            and beat >= spec.slice_loss[1])
+
+
+def swap_fault(resident_index: int) -> bool:
+    """True when the ``resident_index``-th resident sequence exported by
+    an engine-generation swap should lose its gathered k/v payload (torn
+    device-to-host read) and take the requeue-and-replay path instead."""
+    spec = active_faults()
+    return (spec.swap_drop_seq is not None
+            and resident_index == spec.swap_drop_seq)
 
 
 def maybe_crash(global_step: int) -> None:

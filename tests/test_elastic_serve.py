@@ -1,0 +1,441 @@
+"""Engine-generation swaps (serve/elastic.py) and runtime fleet
+membership (Router.add/remove/swap_replica): every in-flight request
+crosses a capacity change token-identical to batch-1 (or exits as a
+strict prefix with the structured ``shrink_evicted`` reason), and the
+pool invariants hold per iteration on BOTH generations."""
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from distributed_training_guide_tpu.models import get_model
+from distributed_training_guide_tpu.serve.api import generate_many
+from distributed_training_guide_tpu.serve.disagg import DisaggEngine
+from distributed_training_guide_tpu.serve.elastic import (
+    new_generation, swap_engine, swap_generation)
+from distributed_training_guide_tpu.serve.engine import ServeEngine
+from distributed_training_guide_tpu.serve.router import (Replica, Router,
+                                                         local_fleet)
+from distributed_training_guide_tpu.serve.scheduler import (RefusalError,
+                                                            Request)
+from distributed_training_guide_tpu.utils import faults
+
+pytestmark = [pytest.mark.serve, pytest.mark.elastic]
+
+
+@pytest.fixture(scope="module")
+def bundle_params():
+    bundle = get_model("llama-debug", dtype=jnp.float32)
+    params = bundle.init(bundle.config, jax.random.key(0))
+    return bundle, params
+
+
+def _requests(n=6, max_new=24, long_prompt=False):
+    reqs = []
+    for i in range(n):
+        prompt = ([3 + (j + i) % 200 for j in range(40)] if long_prompt
+                  else [3 + i, 17, 42])
+        reqs.append(Request(prompt_ids=prompt, max_new_tokens=max_new,
+                            seed=i, temperature=0.7 if i % 2 else 0.0))
+    return reqs
+
+
+def _batch1_refs(bundle, params, reqs, programs=None):
+    eng = ServeEngine(bundle, params, n_slots=1, page_size=16, max_len=128,
+                      programs=programs)
+    return [generate_many(eng, [dataclasses.replace(r, request_id=None)])[0]
+            for r in reqs]
+
+
+def _cache_refs(sched) -> dict:
+    out = {}
+    if sched.cache is None:
+        return out
+    stack = [sched.cache.root]
+    while stack:
+        node = stack.pop()
+        for child in node.children.values():
+            out[child.page] = out.get(child.page, 0) + 1
+            stack.append(child)
+    return out
+
+
+def _audit_engine(eng) -> None:
+    """refcount == holders and free + held == capacity, per pool (the
+    repo-wide scheduling invariant, re-pinned across generations).
+    Same-host in-transit handoff records hold refs in the shared pool;
+    duplicate cache views (the disagg pair shares one PrefixCache) are
+    counted once."""
+    if isinstance(eng, DisaggEngine):
+        pairs = [(eng.prefill.sched, eng.pool),
+                 (eng.decode.sched, eng.decode_pool)]
+        in_transit = [(h, eng.pool) for h in eng.handoff.pending]
+    else:
+        pairs = [(eng.scheduler, eng.scheduler.pool)]
+        in_transit = []
+    by_pool: dict = {}
+    seen_caches: set = set()
+    for sched, pool in pairs:
+        held = by_pool.setdefault(id(pool), (pool, {}))[1]
+        for slot in sched.slots:
+            if slot is None:
+                continue
+            assert 0 not in slot.pages, "trash page in a live table"
+            for p in slot.pages:
+                held[p] = held.get(p, 0) + 1
+        if sched.cache is not None and id(sched.cache) not in seen_caches:
+            seen_caches.add(id(sched.cache))
+            for p, n in _cache_refs(sched).items():
+                held[p] = held.get(p, 0) + n
+    for h, pool in in_transit:
+        held = by_pool.setdefault(id(pool), (pool, {}))[1]
+        for p in h.pages:
+            held[p] = held.get(p, 0) + 1
+    for pool, held in by_pool.values():
+        for p, n in held.items():
+            assert pool.refcount(p) == n, \
+                f"page {p}: {n} holders, refcount {pool.refcount(p)}"
+        assert pool.n_free + len(held) == pool.capacity, \
+            (pool.n_free, len(held), pool.capacity)
+
+
+def _finish(eng, done, max_iters=3000):
+    it = 0
+    while eng.has_work:
+        for res in eng.step():
+            done[res.request_id] = res
+        _audit_engine(eng)
+        it += 1
+        assert it < max_iters, "engine stalled"
+    return done
+
+
+# ---------------------------------------------------------------------------
+# monolith swaps
+# ---------------------------------------------------------------------------
+
+def test_swap_grow_midstream_token_identity(bundle_params):
+    """Grow n_slots 4 -> 8 with residents decoding, one mid-chunk
+    prefill, and a queue: every request finishes token-identical to
+    batch-1, invariants audited per iteration on the new generation, and
+    the old generation ends empty (free == capacity)."""
+    bundle, params = bundle_params
+    old = ServeEngine(bundle, params, n_slots=4, page_size=16,
+                      max_len=128, prefill_chunk=16)
+    reqs = _requests(8, long_prompt=True)
+    refs = _batch1_refs(bundle, params, reqs, programs=old.programs)
+    ids = [old.submit(dataclasses.replace(r, request_id=None))
+           for r in reqs]
+    done: dict = {}
+    for _ in range(5):                      # residents + pending chunks
+        for res in old.step():
+            done[res.request_id] = res
+    new, evicted, stats = swap_engine(old, n_slots=8)
+    assert not evicted
+    assert stats["seated"] + stats["requeued"] >= 1
+    assert old.draining and not old.has_work
+    assert old.scheduler.pool.n_free == old.scheduler.pool.capacity
+    _audit_engine(new)
+    _finish(new, done)
+    assert len(done) == len(reqs)
+    for rid, ref in zip(ids, refs):
+        assert done[rid].generated_ids == ref.generated_ids, rid
+
+
+def test_swap_shrink_requeue_and_replay_identity(bundle_params):
+    """Shrink below residency (4 slots -> 2, pool sized down): excess
+    residents take the requeue-and-replay path and STILL finish
+    token-identical — replay is bitwise recompute."""
+    bundle, params = bundle_params
+    old = ServeEngine(bundle, params, n_slots=4, page_size=16, max_len=128)
+    reqs = _requests(6)
+    refs = _batch1_refs(bundle, params, reqs, programs=old.programs)
+    ids = [old.submit(dataclasses.replace(r, request_id=None))
+           for r in reqs]
+    done: dict = {}
+    for _ in range(5):
+        for res in old.step():
+            done[res.request_id] = res
+    new, evicted, stats = swap_engine(
+        old, n_slots=2, n_pages=1 + 2 * old.max_pages)
+    assert not evicted
+    assert stats["requeued"] >= 2           # shrink forced requeues
+    _finish(new, done)
+    assert len(done) == len(reqs)
+    for rid, ref in zip(ids, refs):
+        assert done[rid].generated_ids == ref.generated_ids, rid
+
+
+def test_swap_shrink_forced_eviction_strict_prefix(bundle_params):
+    """A request whose WORST CASE cannot fit the new generation at all
+    finishes at the swap with finish_reason='shrink_evicted' and a
+    STRICT PREFIX of its batch-1 stream — never silently dropped, never
+    divergent. Requests that still fit continue normally."""
+    bundle, params = bundle_params
+    old = ServeEngine(bundle, params, n_slots=2, page_size=16, max_len=128)
+    big = Request(prompt_ids=[3, 17, 42], max_new_tokens=100, seed=0)
+    small = Request(prompt_ids=[5, 19, 44], max_new_tokens=16, seed=1)
+    refs = _batch1_refs(bundle, params, [big, small],
+                        programs=old.programs)
+    ids = [old.submit(dataclasses.replace(r, request_id=None))
+           for r in (big, small)]
+    for _ in range(6):
+        old.step()
+    new, evicted, stats = swap_engine(old, max_len=64)
+    assert stats["evicted"] == 1 and len(evicted) == 1
+    res = evicted[0]
+    assert res.request_id == ids[0]
+    assert res.finish_reason == "shrink_evicted"
+    assert 0 < len(res.generated_ids) < len(refs[0].generated_ids)
+    assert res.generated_ids == \
+        refs[0].generated_ids[:len(res.generated_ids)]
+    done = {res.request_id: res}
+    _finish(new, done)
+    assert done[ids[1]].generated_ids == refs[1].generated_ids
+
+
+def test_swap_payload_drop_fault_falls_back_to_replay(bundle_params,
+                                                      monkeypatch):
+    """DTG_FAULT_SWAP_DROP_SEQ: the Nth exported resident's payload is
+    torn — the swap requeues it (recompute + bitwise replay) instead of
+    seating it, and the continuation is still token-identical."""
+    bundle, params = bundle_params
+    old = ServeEngine(bundle, params, n_slots=4, page_size=16, max_len=128)
+    reqs = _requests(4)
+    refs = _batch1_refs(bundle, params, reqs, programs=old.programs)
+    ids = [old.submit(dataclasses.replace(r, request_id=None))
+           for r in reqs]
+    done: dict = {}
+    for _ in range(4):
+        for res in old.step():
+            done[res.request_id] = res
+    monkeypatch.setenv(faults.ENV_SWAP_DROP_SEQ, "0")
+    new, evicted, stats = swap_engine(old, n_slots=4)
+    assert stats["payload_dropped"] == 1
+    assert stats["requeued"] >= 1
+    _finish(new, done)
+    for rid, ref in zip(ids, refs):
+        assert done[rid].generated_ids == ref.generated_ids, rid
+
+
+def test_swap_id_space_no_collision(bundle_params):
+    """Post-swap submits must never collide with carried-over request
+    ids (ensure_ids_above): every result id is unique and every request
+    completes."""
+    bundle, params = bundle_params
+    old = ServeEngine(bundle, params, n_slots=2, page_size=16, max_len=128)
+    reqs = _requests(4, max_new=8)
+    ids = [old.submit(dataclasses.replace(r, request_id=None))
+           for r in reqs]
+    for _ in range(3):
+        old.step()
+    new, evicted, _ = swap_engine(old, n_slots=4)
+    more = [new.submit(Request(prompt_ids=[9, 9, 9 + i],
+                               max_new_tokens=4, seed=10 + i))
+            for i in range(3)]
+    assert len(set(ids + more)) == len(ids + more), (ids, more)
+    done: dict = {}
+    _finish(new, done)
+    assert set(done) == set(ids + more)
+
+
+def test_swap_with_speculation(bundle_params):
+    """A speculating engine (ngram drafter, lookahead-grown pages) swaps
+    mid-stream: dead lookahead k/v is dropped, not moved, and the
+    continuation stays token-identical (spec-on == spec-off == across-
+    swap)."""
+    bundle, params = bundle_params
+    old = ServeEngine(bundle, params, n_slots=4, page_size=16,
+                      max_len=256, speculate="ngram", spec_k=4)
+    block = [7, 11, 13, 17, 19, 23, 29, 31]
+    prompt = (block * 6)[:48]
+    reqs = [Request(prompt_ids=prompt + [40 + i], max_new_tokens=24,
+                    seed=i) for i in range(4)]
+    refs = _batch1_refs(bundle, params, reqs, programs=old.programs)
+    ids = [old.submit(dataclasses.replace(r, request_id=None))
+           for r in reqs]
+    done: dict = {}
+    for _ in range(4):
+        for res in old.step():
+            done[res.request_id] = res
+    new, evicted, stats = swap_engine(old, n_slots=6)
+    assert not evicted
+    assert new.drafter is old.drafter        # the drafter rides along
+    _finish(new, done)
+    for rid, ref in zip(ids, refs):
+        assert done[rid].generated_ids == ref.generated_ids, rid
+
+
+def test_new_generation_carries_sizing_faithfully(bundle_params):
+    """The serving knobs carry over across a swap unless overridden: an
+    EXPLICITLY under-sized pool (the backpressure configuration) stays
+    under-sized, a default full-residency pool re-derives for a new
+    slot count, and max_model_len does not inflate to the next page
+    boundary."""
+    bundle, params = bundle_params
+    # explicit small pool survives a same-size swap
+    old = ServeEngine(bundle, params, n_slots=4, page_size=16,
+                      max_len=100, n_pages=20)
+    new = new_generation(old)
+    assert new.scheduler.pool.n_pages == 20
+    assert new.max_model_len == old.max_model_len == 100
+    # default pool re-derives for a grown slot count
+    old2 = ServeEngine(bundle, params, n_slots=4, page_size=16,
+                       max_len=100)
+    new2 = new_generation(old2, n_slots=8)
+    assert new2.scheduler.pool.n_pages == 1 + 8 * new2.max_pages
+    assert new2.max_model_len == 100
+    # repeated swaps are a fixed point, not a drift
+    new3 = new_generation(new_generation(old))
+    assert new3.scheduler.pool.n_pages == 20
+    assert new3.max_model_len == 100
+    # disagg: both pools carried under cross_host explicit sizing
+    d = DisaggEngine(bundle, params, n_slots=2, n_prefill_slots=1,
+                     page_size=16, max_len=100, transport="cross_host",
+                     n_pages=18, n_prefill_pages=12)
+    d2 = new_generation(d)
+    assert d2.decode_pool.n_pages == 18
+    assert d2.pool.n_pages == 12
+    assert d2.max_model_len == 100
+    d.close()
+    d2.close()
+
+
+def test_new_generation_rejects_baked_knobs(bundle_params):
+    bundle, params = bundle_params
+    old = ServeEngine(bundle, params, n_slots=2, page_size=16, max_len=64)
+    with pytest.raises(ValueError, match="baked into the shared"):
+        new_generation(old, kv_dtype="int8")
+    with pytest.raises(ValueError, match="ModelPrograms"):
+        swap_generation(old, ServeEngine(bundle, params, n_slots=2,
+                                         page_size=16, max_len=64))
+
+
+# ---------------------------------------------------------------------------
+# disaggregated swaps
+# ---------------------------------------------------------------------------
+
+def test_swap_disagg_with_in_transit_handoffs(bundle_params):
+    """DisaggEngine generation swap with sequences in EVERY station:
+    decoding residents (payload-seated), in-transit handoffs (requeued —
+    a full decode side keeps the handoff queue non-empty), prefill
+    queue. All finish token-identical on the new generation."""
+    bundle, params = bundle_params
+    old = DisaggEngine(bundle, params, n_slots=2, n_prefill_slots=1,
+                       page_size=16, max_len=128)
+    reqs = _requests(6, max_new=16)
+    refs = _batch1_refs(bundle, params, reqs, programs=old.programs)
+    ids = [old.submit(dataclasses.replace(r, request_id=None))
+           for r in reqs]
+    done: dict = {}
+    for _ in range(4):                 # fill decode slots + the handoff
+        for res in old.step():
+            done[res.request_id] = res
+    new, evicted, stats = swap_engine(old, n_slots=4)
+    assert not evicted
+    assert isinstance(new, DisaggEngine)
+    assert old.pool.n_free == old.pool.capacity
+    assert old.decode_pool.n_free == old.decode_pool.capacity
+    _finish(new, done)
+    assert len(done) == len(reqs)
+    for rid, ref in zip(ids, refs):
+        assert done[rid].generated_ids == ref.generated_ids, rid
+
+
+# ---------------------------------------------------------------------------
+# fleet membership at runtime
+# ---------------------------------------------------------------------------
+
+def _drive(router, done, iters):
+    import time
+
+    for _ in range(iters):
+        for res in router.step():
+            done[res.request_id] = res
+        if router._backlog:
+            time.sleep(0.01)           # let resubmit backoff elapse
+
+
+def test_router_add_remove_swap_under_live_load(bundle_params):
+    """The fleet-membership seam end to end: a generation swap of one
+    replica, a replica added mid-flight, and a replica removed (drain +
+    resubmit via the fencing path — not a kill) — every request finishes
+    token-identical, and the counters record the membership churn."""
+    bundle, params = bundle_params
+    refs_src = _requests(8, max_new=20)
+    router = local_fleet(bundle, params, 2, n_slots=4, page_size=16,
+                         max_len=128)
+    programs = router.replicas["r0"].engine.programs
+    refs = _batch1_refs(bundle, params, refs_src, programs=programs)
+    ids = [router.submit(dataclasses.replace(r, request_id=None))
+           for r in refs_src]
+    done: dict = {}
+    _drive(router, done, 4)
+    evicted = router.swap_replica("r0", n_slots=6)
+    assert evicted == []
+    assert router.counters["generation_swaps"] == 1
+    _drive(router, done, 2)
+    router.add_replica(Replica("r2", ServeEngine(
+        bundle, params, programs=programs, n_slots=4, page_size=16,
+        max_len=128)))
+    router.remove_replica("r1")
+    assert sorted(router.replicas) == ["r0", "r2"]
+    assert router.counters["replicas_added"] == 1
+    assert router.counters["replicas_removed"] == 1
+    it = 0
+    while router.has_work and it < 2000:
+        _drive(router, done, 1)
+        it += 1
+    assert len(done) == len(ids)
+    for rid, ref in zip(ids, refs):
+        assert done[rid].generated_ids == ref.generated_ids, rid
+
+
+def test_router_membership_validation(bundle_params):
+    bundle, params = bundle_params
+    router = local_fleet(bundle, params, 2, n_slots=2, page_size=16,
+                         max_len=64)
+    programs = router.replicas["r0"].engine.programs
+    with pytest.raises(ValueError, match="already in"):
+        router.add_replica(Replica("r0", ServeEngine(
+            bundle, params, programs=programs, n_slots=2, page_size=16,
+            max_len=64)))
+    with pytest.raises(ValueError, match="page_size"):
+        router.add_replica(Replica("r9", ServeEngine(
+            bundle, params, n_slots=2, page_size=32, max_len=64)))
+    with pytest.raises(ValueError, match="no replica"):
+        router.remove_replica("ghost")
+    router.remove_replica("r1")
+    with pytest.raises(ValueError, match="last live replica"):
+        router.remove_replica("r0")
+    with pytest.raises(ValueError, match="page_size"):
+        router.swap_replica("r0", page_size=32)
+
+
+def test_router_remove_is_drain_not_kill(bundle_params):
+    """remove_replica with work in flight: the removed replica's
+    requests resubmit (resubmitted counter) and complete elsewhere with
+    replayed prefixes — token identity holds, and nothing was fenced
+    (this was intent, not failure)."""
+    bundle, params = bundle_params
+    router = local_fleet(bundle, params, 2, n_slots=2, page_size=16,
+                         max_len=128)
+    programs = router.replicas["r0"].engine.programs
+    reqs = _requests(6, max_new=20)
+    refs = _batch1_refs(bundle, params, reqs, programs=programs)
+    ids = [router.submit(dataclasses.replace(r, request_id=None))
+           for r in reqs]
+    done: dict = {}
+    _drive(router, done, 3)
+    before = router.counters["resubmitted"]
+    router.remove_replica("r1")
+    assert router.counters["resubmitted"] >= before
+    assert router.counters["fenced"] == 0
+    it = 0
+    while router.has_work and it < 2000:
+        _drive(router, done, 1)
+        it += 1
+    assert len(done) == len(ids)
+    for rid, ref in zip(ids, refs):
+        assert done[rid].generated_ids == ref.generated_ids, rid
