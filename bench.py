@@ -488,6 +488,20 @@ def run_decode_check(only: str = None) -> None:
       iterations (per-iteration fixed cost amortizes over the accepted
       run); the TPU rungs (queued) add the weight-read amortization the
       feature exists for.
+    - spec_flash8 (queued sweep rung): the spec_ngram8 workload with the
+      whole engine on the FLASH family (block_q=T kernel: flash decode
+      + flash verify) vs the in-rung GATHER-family control on the
+      identical workload — one new variable, the attend family.
+      Acceptance and tokens/iteration recorded beside tok/s both ways
+      (the family must not change them: spec identity is pinned in CI).
+      On CPU the flash leg runs the interpret-mode kernel — a
+      correctness emulation, expected slower — so the CPU number prices
+      the emulation, not the kernel; the rung exists for the TPU pool.
+    - chunk_flash (queued sweep rung): the mixed_chunked workload with
+      the chunk program on the multi-token kernel vs the in-rung gather
+      control — iteration-gap p50/max both ways (same CPU interpret
+      caveat as spec_flash8; on TPU the kernel reads the context once
+      per chunk instead of the ~3x gather round-trip).
     - kvq_int8_slots8 (queued sweep rung): the slots8 workload on an
       int8-quantized page pool (serve/kv_pages.py kv_dtype="int8") with
       its fp32-KV control measured in-rung — tokens/sec both ways, the
@@ -532,6 +546,58 @@ def run_decode_check(only: str = None) -> None:
     params = bundle.init(bundle.config, jax.random.key(0))
     out = {"metric": "decode_tput", "model": "llama-debug",
            "unit": "tokens_per_s", "value": 0.0}
+
+    # shared workload definitions: the A/B rungs (spec_flash8,
+    # chunk_flash, kvq_spec_accept) claim to run the spec_ngram8 /
+    # mixed_chunked workloads — enforced by construction, one definition
+    # per workload, instead of by copies that could silently drift
+    spec_prompt = ([7, 11, 13, 17, 19, 23, 29, 31] * 12)[:96]
+
+    def spec_workload(engine):
+        """The lookup-friendly speculation workload: 8 slots, 96 new
+        tokens each, a repeated-block prompt whose greedy continuation
+        cycles. Warmed on the WORKLOAD's own shape — the same prefill
+        bucket and a continuation long enough that the drafter actually
+        drafts; a trivial warm-up would leave the verify program's first
+        touch inside the timed window (the PR-10 lesson). Returns
+        (results, throughput stats)."""
+        from distributed_training_guide_tpu.serve.spec import \
+            new_spec_counters
+
+        generate_many(engine, [Request(prompt_ids=spec_prompt + [39],
+                                       max_new_tokens=16)])
+        engine.decode_steps = engine.decode_tokens = 0
+        engine.spec.update(new_spec_counters())
+        reqs = [Request(prompt_ids=spec_prompt + [40 + i],
+                        max_new_tokens=96, seed=i) for i in range(8)]
+        t0 = time.perf_counter()
+        results = generate_many(engine, reqs)
+        return results, throughput_stats(results,
+                                         time.perf_counter() - t0, engine)
+
+    def mixed_chunk_gaps(engine):
+        """The mixed chunked-prefill workload: one 192-token prompt
+        admitted while 4 decodes are resident — returns the SORTED
+        per-iteration gaps (the resident decodes' latency, the number
+        chunked prefill exists to bound)."""
+        generate_many(engine, [Request(prompt_ids=[3, 17],
+                                       max_new_tokens=4)])
+        residents = [Request(prompt_ids=[5 + i, 6], max_new_tokens=96,
+                             seed=i) for i in range(4)]
+        for r in residents:
+            engine.submit(r)
+        engine.step()
+        engine.submit(Request(
+            prompt_ids=[3 + (i % 200) for i in range(192)],
+            max_new_tokens=8, seed=99))
+        gaps, t_prev = [], time.perf_counter()
+        while engine.has_work:
+            engine.step()
+            now = time.perf_counter()
+            gaps.append(now - t_prev)
+            t_prev = now
+        gaps.sort()
+        return gaps
     for n_slots in (1, 8) if "slots" in rungs else ():
         engine = ServeEngine(bundle, params, n_slots=n_slots, page_size=16,
                              max_len=128)
@@ -584,25 +650,9 @@ def run_decode_check(only: str = None) -> None:
     if "mixed_chunked" in rungs:
         # mixed rung: long prefill chunked against resident decodes — the
         # per-iteration decode gap is the latency chunking bounds
-        engine = ServeEngine(bundle, params, n_slots=5, page_size=16,
-                             max_len=256, prefill_chunk=32)
-        generate_many(engine, [Request(prompt_ids=[3, 17],
-                                       max_new_tokens=4)])
-        residents = [Request(prompt_ids=[5 + i, 6], max_new_tokens=96,
-                             seed=i) for i in range(4)]
-        for r in residents:
-            engine.submit(r)
-        engine.step()
-        long_req = Request(prompt_ids=[3 + (i % 200) for i in range(192)],
-                           max_new_tokens=8, seed=99)
-        engine.submit(long_req)
-        gaps, t_prev = [], time.perf_counter()
-        while engine.has_work:
-            engine.step()
-            now = time.perf_counter()
-            gaps.append(now - t_prev)
-            t_prev = now
-        gaps.sort()
+        gaps = mixed_chunk_gaps(ServeEngine(bundle, params, n_slots=5,
+                                            page_size=16, max_len=256,
+                                            prefill_chunk=32))
         out["mixed_chunked"] = {
             "prefill_chunk": 32,
             "iterations": len(gaps),
@@ -646,31 +696,11 @@ def run_decode_check(only: str = None) -> None:
         # CONTROL runs the identical workload inside the rung, so the
         # recorded speedup isolates the one new variable (the drafter);
         # acceptance rate and tokens-per-iteration land in detail.
-        from distributed_training_guide_tpu.serve.spec import (
-            DraftModelDrafter, new_spec_counters)
+        from distributed_training_guide_tpu.serve.spec import \
+            DraftModelDrafter
 
-        block = [7, 11, 13, 17, 19, 23, 29, 31]
-        prompt = (block * 12)[:96]
-
-        def spec_workload(engine):
-            # warm with the WORKLOAD's shape: the same prefill bucket and
-            # a cycling continuation long enough that the drafter actually
-            # drafts — empty-draft iterations fall back to the plain
-            # program, so a trivial warm-up would leave the verify
-            # program's first touch inside the timed window
-            generate_many(engine, [Request(prompt_ids=prompt + [39],
-                                           max_new_tokens=16)])
-            engine.decode_steps = engine.decode_tokens = 0
-            engine.spec.update(new_spec_counters())
-            reqs = [Request(prompt_ids=prompt + [40 + i],
-                            max_new_tokens=96, seed=i) for i in range(8)]
-            t0 = time.perf_counter()
-            results = generate_many(engine, reqs)
-            return throughput_stats(results, time.perf_counter() - t0,
-                                    engine)
-
-        base = spec_workload(ServeEngine(bundle, params, n_slots=8,
-                                         page_size=16, max_len=256))
+        _, base = spec_workload(ServeEngine(bundle, params, n_slots=8,
+                                            page_size=16, max_len=256))
         for name in ("spec_ngram8", "spec_draft8"):
             if name not in rungs:
                 continue
@@ -680,7 +710,7 @@ def run_decode_check(only: str = None) -> None:
                                                 page_size=16))
             eng = ServeEngine(bundle, params, n_slots=8, page_size=16,
                               max_len=256, speculate=speculate, spec_k=8)
-            stats = spec_workload(eng)
+            _, stats = spec_workload(eng)
             out[name] = {
                 **stats,
                 "spec_k": 8,
@@ -691,6 +721,66 @@ def run_decode_check(only: str = None) -> None:
             }
             out["value"] = stats["tokens_per_s"]
             _emit({**out, "partial": True})
+
+    if "spec_flash8" in rungs:
+        # the kernel-family A/B: ngram speculation with EVERY forward
+        # (decode + verify + empty-draft fallback) on the flash family
+        # vs the gather family, identical workload in-rung. Tokens must
+        # not change (spec identity is family-internal by construction);
+        # what the rung prices is the attend family itself.
+        ctl_res, ctl = spec_workload(ServeEngine(
+            bundle, params, n_slots=8, page_size=16, max_len=256,
+            speculate="ngram", spec_k=8, attend_impl="xla"))
+        res, stats = spec_workload(ServeEngine(
+            bundle, params, n_slots=8, page_size=16, max_len=256,
+            speculate="ngram", spec_k=8, attend_impl="flash"))
+        identical = all(a.token_ids == b.token_ids
+                        for a, b in zip(res, ctl_res))
+        out["spec_flash8"] = {
+            **stats,
+            "spec_k": 8,
+            "attend_impl": "flash",
+            "gather_tokens_per_s": ctl["tokens_per_s"],
+            "gather_acceptance": ctl["spec_acceptance_rate"],
+            "gather_tokens_per_step": ctl["decode_tokens_per_step"],
+            "speedup_vs_gather": (
+                round(stats["tokens_per_s"] / ctl["tokens_per_s"], 3)
+                if ctl["tokens_per_s"] else 0.0),
+            "token_identity_vs_gather": identical,
+            "cpu_interpret_kernel": jax.default_backend() != "tpu",
+        }
+        out["value"] = stats["tokens_per_s"]
+        _emit({**out, "partial": True})
+
+    if "chunk_flash" in rungs:
+        # the chunk program's family A/B on the mixed workload: one long
+        # prompt chunked against resident decodes, chunk attend on the
+        # multi-token kernel vs the gather view
+        def chunk_leg(impl):
+            gaps = mixed_chunk_gaps(ServeEngine(
+                bundle, params, n_slots=5, page_size=16, max_len=256,
+                prefill_chunk=32, attend_impl=impl))
+            return {"iterations": len(gaps),
+                    "iter_ms_p50": round(1000 * gaps[len(gaps) // 2], 2),
+                    "iter_ms_max": round(1000 * gaps[-1], 2)}
+
+        ctl = chunk_leg("xla")
+        res = chunk_leg("flash")
+        out["chunk_flash"] = {
+            "prefill_chunk": 32,
+            "attend_impl": "flash",
+            **res,
+            "gather_iter_ms_p50": ctl["iter_ms_p50"],
+            "gather_iter_ms_max": ctl["iter_ms_max"],
+            "gather_iterations": ctl["iterations"],
+            "cpu_interpret_kernel": jax.default_backend() != "tpu",
+        }
+        # this is a latency rung — the sweep's done-gate needs a
+        # positive `value` on the decode_tput metric line or the entry
+        # re-runs every pass (the reshard_restore convention)
+        if not out["value"]:
+            out["value"] = round(1000.0 / max(res["iter_ms_p50"], 1e-6), 3)
+        _emit({**out, "partial": True})
 
     if "kvq_int8_slots8" in rungs:
         # int8 KV pages: the slots8 workload with the pool quantized and
@@ -742,22 +832,8 @@ def run_decode_check(only: str = None) -> None:
         # sensitive function of cache fidelity (a perturbed verify logit
         # breaks a drafted run immediately), so the delta is the rung's
         # headline. tests/test_kv_quant.py pins |delta| <= 0.02 in CI.
-        from distributed_training_guide_tpu.serve.spec import \
-            new_spec_counters
-
-        block = [7, 11, 13, 17, 19, 23, 29, 31]
-        prompt = (block * 12)[:96]
-
         def accept_workload(engine):
-            generate_many(engine, [Request(prompt_ids=prompt + [39],
-                                           max_new_tokens=16)])
-            engine.decode_steps = engine.decode_tokens = 0
-            engine.spec.update(new_spec_counters())
-            reqs = [Request(prompt_ids=prompt + [40 + i],
-                            max_new_tokens=96, seed=i) for i in range(8)]
-            t0 = time.perf_counter()
-            results = generate_many(engine, reqs)
-            st = throughput_stats(results, time.perf_counter() - t0, engine)
+            _, st = spec_workload(engine)
             return st["tokens_per_s"], st["spec_acceptance_rate"]
 
         tps32, acc32 = accept_workload(ServeEngine(
@@ -1270,6 +1346,14 @@ SWEEP_QUEUE = [
     # on TPU the weight-read amortization is the point).
     dict(name="spec_ngram8", decode_rungs="spec_ngram8"),
     dict(name="spec_draft8", decode_rungs="spec_draft8"),
+    # spec_flash8 / chunk_flash = the block_q=T kernel family A/B: the
+    # spec_ngram8 and mixed_chunked workloads re-run with every paged
+    # forward (decode + verify + chunk) on the flash kernel vs the
+    # in-rung gather-family control — one new variable each (the attend
+    # family). CPU legs price the interpret emulation honestly; the TPU
+    # pool is where the O(context)-vs-3x read claim gets its number.
+    dict(name="spec_flash8", decode_rungs="spec_flash8"),
+    dict(name="chunk_flash", decode_rungs="chunk_flash"),
     # --- quantized KV pages (serve/kv_pages.py kv_dtype="int8"; one new
     # variable each — both rungs measure their fp32-KV control in-rung).
     # kvq_int8_slots8 = the slots8 decode workload on the int8 pool:

@@ -55,7 +55,9 @@ def main(argv=None) -> None:
                         "prompt pages across requests")
     parser.add_argument("--attend-impl", default="auto",
                         choices=("auto", "flash", "xla"),
-                        help="decode attend: the Pallas block-table kernel "
+                        help="paged attend family for every forward "
+                        "(decode, spec verify, prefill chunk): the Pallas "
+                        "block_q=T block-table kernel "
                         "('flash', TPU), the gather reference ('xla'), or "
                         "platform auto-dispatch")
     parser.add_argument("--kv-dtype", default=None,
@@ -199,7 +201,11 @@ def main(argv=None) -> None:
             bundle.config, args.max_len, args.page_size)[0]
         speculate = DraftModelDrafter(
             draft_bundle, draft_params, n_slots=args.n_slots,
-            max_len=target_len, k=args.spec_k, page_size=args.page_size)
+            max_len=target_len, k=args.spec_k, page_size=args.page_size,
+            # drafts are guesses at the target's draws — keep the
+            # drafter on the engine's attend family so self-draft
+            # acceptance doesn't eat cross-family 1e-5 drift
+            attend_impl=args.attend_impl)
     common = dict(n_slots=args.n_slots, page_size=args.page_size,
                   n_pages=args.n_pages, max_len=args.max_len,
                   prefill_chunk=args.prefill_chunk,

@@ -497,12 +497,9 @@ class DisaggEngine:
                 "this seam)")
         drafter = resolve_drafter(speculate, spec_k=spec_k,
                                   n_slots=n_slots)
-        if drafter is not None and attend_impl == "auto":
-            # same program-family rule as the monolith (engine.py): under
-            # speculation the single-token decode stays in the gather
-            # family the verify forward uses, or TPU flash-vs-gather
-            # 1e-5 drift could break spec-on == spec-off identity
-            attend_impl = "xla"
+        # spec under "auto" needs no downgrade since the block_q=T kernel
+        # (see the monolith): decode and verify resolve to the same
+        # attend family by construction, at any T
         # a pre-built programs= shares one params layout + jit cache (the
         # monolith's contract, mirrored here — engine-generation swaps
         # depend on the new generation running the OLD generation's exact

@@ -6,9 +6,12 @@ Compile surface (the whole point — requests come and go, programs don't):
   Block tables / lengths / sampling knobs are int/float ARRAY arguments,
   idle slots compute into the trash page and are masked at the sample —
   admission, eviction, preemption, and page growth never retrace
-  anything. The decode attend defaults to the Pallas block-table kernel
-  on TPU (``ops/paged_decode.py`` — O(live pages) reads, no gathered
-  view); ``attend_impl=`` selects the XLA gather reference explicitly.
+  anything. EVERY paged attend — the decode step, the speculative
+  verify forward, and the prefill chunk — defaults to the Pallas
+  block-table kernel on TPU (``ops/paged_decode.py`` at query-tile
+  block_q=T: O(live pages) reads per forward, no gathered view);
+  ``attend_impl=`` selects the XLA gather reference explicitly, for all
+  three forwards at once (one family per engine, never a mix).
 - One prefill program per LENGTH BUCKET (powers of two up to ``max_len``)
   — or, with ``prefill_chunk=N``, ONE chunk program: the prompt streams
   through the paged decode path N tokens at a time, each chunk attending
@@ -703,15 +706,17 @@ class ModelPrograms:
 
     def chunk_for(self, t: int):
         """The ONE chunk-prefill program: [1, t] tokens run the paged
-        decode path (gather impl — a chunk is compute-bound and needs the
-        multi-token attend), writing their k/v into the slot's pages at
-        positions start..start+t-1 while attending over the committed
-        history. ``n_valid`` routes a final chunk's pad tail to the trash
-        page; ``last_index`` picks the real last token's logits."""
+        decode path — the engine's ``attend_impl`` resolves the
+        multi-token attend exactly like the decode step's (the block_q=T
+        kernel on TPU under "auto"/"flash": one O(context) read per
+        chunk instead of the ~3x gather round-trip) — writing their k/v
+        into the slot's pages at positions start..start+t-1 while
+        attending over the committed history. ``n_valid`` routes a final
+        chunk's pad tail to the trash page; ``last_index`` picks the
+        real last token's logits."""
         if t not in self._chunk_fns:
             def fn(params, kp, vp, ids, start, table, last_index, n_valid):
-                attend = self.make_attend(table, start, impl="xla",
-                                          n_valid=n_valid)
+                attend = self.make_attend(table, start, n_valid=n_valid)
                 logits, cache = self.mod.paged_decode_step(
                     self.config, params, ids, start, {"k": kp, "v": vp},
                     attend, last_index=last_index)
@@ -759,8 +764,7 @@ class ModelPrograms:
         if key not in self._verify_fns:
             def fn(params, kp, vp, ids, lengths, tables, seeds, temps,
                    top_ks, top_ps, actives, n_valid):
-                attend = self.make_attend(tables, lengths, impl="xla",
-                                          n_valid=n_valid)
+                attend = self.make_attend(tables, lengths, n_valid=n_valid)
                 logits, cache = self.mod.paged_decode_step(
                     self.config, params, ids, lengths, {"k": kp, "v": vp},
                     attend, all_logits=True)
@@ -826,11 +830,14 @@ class ServeEngine:
     requests (refcounted, copy-on-write). ``prefill_chunk=N`` streams
     prompts through the paged path N tokens per iteration instead of one
     bucketed prefill (long prompts stop stalling resident decodes; also
-    unlocks mid-page prefix reuse). ``attend_impl`` picks the decode
-    attend: "auto" (flash kernel on TPU, gather elsewhere), "flash",
-    "xla". ``max_queue`` bounds the admission queue — submits past it
-    refuse with a 429-class RefusalError (backpressure the HTTP layer
-    forwards verbatim). ``speculate`` turns on speculative decoding
+    unlocks mid-page prefix reuse). ``attend_impl`` picks the paged
+    attend FAMILY for every forward (decode, spec verify, prefill
+    chunk): "auto" (flash kernel on TPU, gather elsewhere), "flash",
+    "xla" — one family per engine, so identity guarantees never
+    straddle kernels. ``max_queue`` bounds the admission queue —
+    submits past it refuse with a 429-class RefusalError (backpressure
+    the HTTP layer forwards verbatim). ``speculate`` turns on
+    speculative decoding
     ("ngram" for the built-in prompt-lookup drafter at depth ``spec_k``,
     or any ``serve/spec.py`` Drafter instance): drafts verify through
     ONE multi-token forward per iteration with exact acceptance —
@@ -869,19 +876,12 @@ class ServeEngine:
         self.drafter = resolve_drafter(speculate, spec_k=spec_k,
                                        n_slots=n_slots)
         self.spec = new_spec_counters()
-        if self.drafter is not None and attend_impl == "auto":
-            # ONE program family for every emitted token under
-            # speculation: the verify forward is the multi-token GATHER
-            # form, so the single-token program (empty-draft fallback,
-            # replay) must stay in that family too — on TPU the flash
-            # kernel is parity-pinned against the gather path only to
-            # 1e-5, enough to flip a near-tie argmax and silently break
-            # the spec-on == spec-off identity this feature guarantees.
-            # An explicit attend_impl="flash" (or a pre-built programs=)
-            # is the caller's own assertion and rides unchanged; the
-            # block_q=T flash-verify kernel (queued follow-up) removes
-            # the trade.
-            attend_impl = "xla"
+        # spec-on == spec-off identity needs ONE program family for every
+        # emitted token — and since the block_q=T kernel, "auto" IS one
+        # family: the Mosaic gate is T-independent, so decode, verify,
+        # and replay all resolve to flash (TPU, eligible shapes) or all
+        # to gather. The construction-time downgrade to "xla" that used
+        # to live here is gone — flash-everywhere is the default forward.
         self.programs = programs if programs is not None else ModelPrograms(
             bundle, params, plan=plan, shard_kv=shard_kv,
             attend_impl=attend_impl, kv_dtype=kv_dtype)

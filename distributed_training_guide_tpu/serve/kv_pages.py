@@ -29,12 +29,17 @@ recompiles.
 
 ``paged_attend`` has two implementations behind one dispatch:
 ``impl="flash"`` (the Pallas ``ops/paged_decode.py`` kernel — reads k/v
-*through* the block table, O(live pages) traffic, the default on TPU)
-and ``impl="xla"`` (gather the table into a contiguous logical view and
-run the einsum reference — the parity baseline, and the off-TPU default:
-the kernel's interpret mode is for CI correctness, not CPU throughput).
-Multi-token calls (chunked prefill) always take the gather path — the
-kernel is the single-token decode specialist.
+*through* the block table, O(live pages) traffic per forward, the
+default on TPU) and ``impl="xla"`` (gather the table into a contiguous
+logical view and run the einsum reference — the parity baseline, and
+the off-TPU default: the kernel's interpret mode is for CI correctness,
+not CPU throughput). The dispatch is T-INDEPENDENT: the kernel's query
+tile is ``block_q = T``, so single-token decode, the speculative
+verification forward (T = k+1), and chunked prefill (T = chunk) all
+resolve to the same family under one ``impl`` — which is what makes
+"flash everywhere" a construction-time property of an engine rather
+than a per-call choice (serve/engine.py threads its ``attend_impl``
+through every program).
 
 QUANTIZED pools (``kv_dtype="int8"``): the k/v payload is stored int8
 with block-wise absmax scales (``train/precision.py``'s Dettmers
@@ -86,7 +91,7 @@ import jax
 import jax.numpy as jnp
 
 from ..ops.attention import multihead_attention
-from ..ops.paged_decode import paged_decode_eligible, paged_flash_decode
+from ..ops.paged_decode import paged_decode_eligible, paged_flash_attend
 from ..train.precision import (Quantized, dequantize_blockwise,
                                quantize_blockwise)
 
@@ -167,10 +172,11 @@ def check_kv_page_geometry(config, *, page_size: int, kv_dtype,
         warnings.warn(
             f"kv_dtype='int8' with page_size={page_size} (head_dim "
             f"{config.head_size}) is not eligible for the compiled "
-            f"flash-decode kernel (int8 Mosaic tiles need page_size % 32 "
-            f"== 0 and head_dim % 64 == 0): on TPU the decode will run "
-            f"the gather path at ~3x the kernel's HBM traffic. Use "
-            f"page_size=32 to keep the in-kernel dequant.",
+            f"paged flash kernel (int8 Mosaic tiles need page_size % 32 "
+            f"== 0 and head_dim % 64 == 0): on TPU the decode, verify, "
+            f"and chunk forwards will all run the gather path at ~3x the "
+            f"kernel's HBM traffic. Use page_size=32 to keep the "
+            f"in-kernel dequant.",
             stacklevel=3)
 
 
@@ -335,15 +341,18 @@ def paged_attend(q, k_new, v_new, k_pages, v_pages, tables, lengths, *,
     is either live history or rewritten by the same call's scatter before
     the attend, and the causal mask cuts everything past it.
 
-    impl: "flash" routes single-token calls through the Pallas
-    block-table kernel (``ops/paged_decode.py``) — the decode step then
-    reads O(live pages) and materializes nothing context-sized. "xla"
+    impl: "flash" routes the call — at ANY T — through the Pallas
+    block-table kernel (``ops/paged_decode.py``, query-tile block_q=T):
+    the forward then reads O(live pages) once and materializes nothing
+    context-sized, with the read amortized over the T query rows. "xla"
     gathers the table into a [S, M*page, Hkv, D] logical view (a
     TRANSIENT the size of the attended context) and attends with the
-    einsum reference — the parity baseline. "auto" picks flash for
-    single-token calls on TPU when the shapes satisfy the Mosaic tile
-    gate, xla otherwise (off-TPU the kernel only runs interpreted — CI
-    exercises it explicitly; the gather path is the faster CPU program).
+    einsum reference — the parity baseline. "auto" picks flash on TPU
+    when the shapes satisfy the Mosaic tile gate, xla otherwise (off-TPU
+    the kernel only runs interpreted — CI exercises it explicitly; the
+    gather path is the faster CPU program). The gate is T-independent,
+    so "auto" resolves decode, verify, and chunk forwards to the SAME
+    family — the construction the spec-on == spec-off identity leans on.
 
     Positions past ``lengths + n_valid`` hold garbage (trash page / stale
     pages) and are cut by the causal mask — logical position of token j
@@ -381,25 +390,25 @@ def paged_attend(q, k_new, v_new, k_pages, v_pages, tables, lengths, *,
         v_pages = v_pages.at[phys, off].set(v_new.astype(v_pages.dtype))
 
     if impl == "auto":
-        impl = ("flash" if (t == 1 and jax.default_backend() == "tpu"
+        impl = ("flash" if (jax.default_backend() == "tpu"
                             and paged_decode_eligible(q.shape[-1], page,
                                                       quantized=quantized))
                 else "xla")
     if impl == "flash":
-        if t != 1:
-            raise ValueError(
-                f"impl='flash' is the single-token decode kernel; chunked "
-                f"prefill (T={t}) runs the gather path — use impl='auto' "
-                f"or 'xla'")
+        # block_q = T: the same kernel serves the decode step (T == 1),
+        # the verify forward, and a prefill chunk — the scatter above
+        # already landed the T tokens (pad tails in the trash page), so
+        # the kernel's per-row causal mask sees exactly the gather
+        # path's semantics
         if quantized:
-            attn = paged_flash_decode(
-                q[:, 0], k_pages.q, v_pages.q, tables, lengths,
+            attn = paged_flash_attend(
+                q, k_pages.q, v_pages.q, tables, lengths,
                 k_scale=k_pages.scale[..., 0], v_scale=v_pages.scale[..., 0],
-                window=window, scale=scale, softcap=softcap)[:, None]
+                window=window, scale=scale, softcap=softcap)
         else:
-            attn = paged_flash_decode(q[:, 0], k_pages, v_pages, tables,
+            attn = paged_flash_attend(q, k_pages, v_pages, tables,
                                       lengths, window=window, scale=scale,
-                                      softcap=softcap)[:, None]
+                                      softcap=softcap)
         return attn, (k_pages, v_pages)
 
     if quantized:

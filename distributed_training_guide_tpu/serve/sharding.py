@@ -29,7 +29,10 @@ serve mesh must have tp as its only non-trivial axis
 The Mosaic kernel is the forcing function: GSPMD cannot partition a
 ``pallas_call``, so without the manual region a sharded engine ran the
 kernel replicated with a replicated pool. With it, the kernel body is
-unchanged — a per-chip pool slice is just a smaller pool.
+unchanged — a per-chip pool slice is just a smaller pool — and the
+region is T-agnostic: the decode step (T=1), the speculative verify
+forward (T=k+1), and a prefill chunk all run the same block_q=T kernel
+per chip through this one attend wrapper.
 """
 from __future__ import annotations
 

@@ -56,6 +56,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..models.registry import ModelBundle, family_module
+from ..ops.paged_decode import paged_decode_eligible
 from .kv_pages import PagePool, init_pages, make_attend, pages_for_tokens
 
 
@@ -166,11 +167,14 @@ class DraftModelDrafter(Drafter):
 
     def __init__(self, bundle: ModelBundle, params, *, n_slots: int,
                  max_len: int, k: int = 4, page_size: int = 16,
-                 chunk: int = 16):
+                 chunk: int = 16, attend_impl: str = "auto"):
         if k < 1:
             raise ValueError(f"k must be >= 1, got {k}")
         if chunk < 1:
             raise ValueError(f"chunk must be >= 1, got {chunk}")
+        if attend_impl not in ("auto", "flash", "xla"):
+            raise ValueError(f"attend_impl must be 'auto', 'flash' or "
+                             f"'xla', got {attend_impl!r}")
         self.bundle = bundle
         self.config = bundle.config
         self.mod = family_module(bundle.family)
@@ -183,12 +187,31 @@ class DraftModelDrafter(Drafter):
         max_pos = getattr(self.config, "max_position_embeddings", None)
         self.max_len = min(max_len, max_pos) if max_pos else max_len
         self.page_size = page_size
+        if (attend_impl == "flash" and jax.default_backend() == "tpu"
+                and not paged_decode_eligible(self.config.head_size,
+                                              page_size)):
+            # the DRAFT model's geometry gates the compiled kernel, not
+            # the target's — surface the mismatch here instead of inside
+            # the first draft forward of a live decode iteration
+            raise ValueError(
+                f"attend_impl='flash': draft model head_size "
+                f"{self.config.head_size} with page_size {page_size} is "
+                f"not eligible for the compiled paged flash kernel "
+                f"(head_dim % 64 == 0 and page_size % 8 == 0) — use "
+                f"attend_impl='auto' (gather fallback) or adjust "
+                f"page_size")
         self.max_pages = pages_for_tokens(self.max_len, page_size)
         n_pages = 1 + n_slots * self.max_pages
         self.pool = PagePool(n_pages, page_size)
         self.pages = init_pages(self.config, n_pages, page_size)
         self.params = params
         self.chunk = chunk
+        # the drafter's own forwards ride the same paged dispatch as the
+        # target's (the block_q=T kernel under "auto" on TPU) — drafts
+        # are guesses, so this is a quality/throughput knob, not an
+        # identity one; match the target engine's family for the best
+        # self-draft acceptance
+        self.attend_impl = attend_impl
         self._slot_pages: list[list] = [[] for _ in range(n_slots)]
         self._consumed: list[list] = [[] for _ in range(n_slots)]
         self._counters = {"draft_model_steps": 0, "catchup_tokens": 0,
@@ -200,7 +223,7 @@ class DraftModelDrafter(Drafter):
     def _step(self, params, kp, vp, tokens, lengths, tables):
         """One batched greedy draft step over [n_slots] lanes (idle lanes
         carry zero tables and write into the trash page)."""
-        attend = make_attend(tables, lengths, impl="xla")
+        attend = make_attend(tables, lengths, impl=self.attend_impl)
         logits, cache = self.mod.paged_decode_step(
             self.config, params, tokens[:, None], lengths,
             {"k": kp, "v": vp}, attend)
@@ -211,7 +234,8 @@ class DraftModelDrafter(Drafter):
         """Feed one catch-up chunk of a slot's context into the draft
         cache ([1, chunk] padded; the logits are discarded — the chunk
         exists only to write k/v)."""
-        attend = make_attend(table, start, impl="xla", n_valid=n_valid)
+        attend = make_attend(table, start, impl=self.attend_impl,
+                             n_valid=n_valid)
         _, cache = self.mod.paged_decode_step(
             self.config, params, ids, start, {"k": kp, "v": vp}, attend)
         return cache["k"], cache["v"]

@@ -379,6 +379,20 @@ def run_preflight(trainer, *, global_batch: int, seq_length: int,
         "handoff_bytes_same_host": 0,
         "handoff_bytes_cross_host_at_seq": per_slot,
     }
+    # multi-token paged forwards (the block_q=T kernel family,
+    # ops/paged_decode.py): a speculative VERIFY step ([S, k+1] per slot)
+    # and a chunked-prefill chunk ([1, C]) read the slot's live context
+    # ONCE through the block table — the same O(context) kernel bytes the
+    # decode row above pays, amortized over the T tokens the forward
+    # emits/commits — while the gather form pays the ~3x logical-view
+    # round-trip PER FORWARD. Decode was already priced per token; these
+    # are the multi-token rows that used to be gather-only.
+    report["serve_kv"].update({
+        "verify_read_bytes_per_step_flash": kernel_read,
+        "verify_traffic_bytes_per_step_gather": gather_traffic,
+        "chunk_prefill_read_bytes_per_chunk_flash": kernel_read,
+        "chunk_prefill_traffic_bytes_per_chunk_gather": gather_traffic,
+    })
     # kv_dtype column (serve/kv_pages.py): every per-page/per-slot figure
     # above parameterizes on the pool's storage dtype — int8 rows INCLUDE
     # the per-(position, kv-head) fp32 scales (payload bytes alone would
@@ -416,6 +430,11 @@ def run_preflight(trainer, *, global_batch: int, seq_length: int,
         "weight_read_bytes_per_token_spec_off": params_b,
         "weight_read_bytes_per_token_spec_accept_0.7": _amortized(0.7),
         "weight_read_bytes_per_token_spec_accept_1.0": _amortized(1.0),
+        # the kv-side twin of the weight amortization: one flash verify
+        # forward's O(context) read divided over its k+1 emitted tokens
+        # at full acceptance (the gather form paid 3x this, per forward)
+        "verify_read_bytes_per_token_flash_accept_1.0":
+            kernel_read // (spec_k + 1),
     })
     LOGGER.info(
         f"serve KV pricing: {per_page / 2**10:.1f} KiB/page "
@@ -431,8 +450,10 @@ def run_preflight(trainer, *, global_batch: int, seq_length: int,
         f"{by_dtype['int8'] / by_dtype['fp32']:.2f}x of fp32, the same "
         f"factor on decode reads and the cross-host handoff payload"
         f"; decode reads {kernel_read / 2**20:.2f} MiB/token "
-        f"through the flash-decode kernel (the gather view moved "
-        f"~{gather_traffic / 2**20:.2f} MiB/token); a {shared_tokens}-token "
+        f"through the paged flash kernel (the gather view moved "
+        f"~{gather_traffic / 2**20:.2f} MiB/token; verify and prefill "
+        f"chunks pay the same O(context) kernel read ONCE per multi-token "
+        f"forward — the block_q=T rows above); a {shared_tokens}-token "
         f"shared prefix amortizes {shared_bytes / 2**20:.2f} MiB per "
         f"additional co-resident slot; prefill->decode handoff moves 0 B "
         f"same-host (refcount transfer), {per_slot / 2**20:.2f} MiB "

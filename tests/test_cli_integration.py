@@ -404,6 +404,21 @@ def test_preflight_budget_and_lowering(eight_devices):
     assert sk["shared_prefix_tokens_nominal"] == 64          # min(512, seq)
     assert sk["shared_prefix_bytes_amortized_per_extra_slot"] == \
         4 * sk["bytes_per_page"]
+    # multi-token forwards (the block_q=T kernel family): a verify step
+    # and a prefill chunk each read the context ONCE through the kernel
+    # (same O(context) bytes as a decode token, amortized over T rows);
+    # the gather form paid the 3x round-trip per forward. The per-token
+    # verify row divides the kernel read over k+1 at full acceptance.
+    assert sk["verify_read_bytes_per_step_flash"] == \
+        sk["bytes_per_slot_at_seq"]
+    assert sk["verify_traffic_bytes_per_step_gather"] == \
+        3 * sk["bytes_per_slot_at_seq"]
+    assert sk["chunk_prefill_read_bytes_per_chunk_flash"] == \
+        sk["bytes_per_slot_at_seq"]
+    assert sk["chunk_prefill_traffic_bytes_per_chunk_gather"] == \
+        3 * sk["bytes_per_slot_at_seq"]
+    assert sk["verify_read_bytes_per_token_flash_accept_1.0"] == \
+        sk["bytes_per_slot_at_seq"] // (sk["spec_k_nominal"] + 1)
     # fsdp mesh: tp=1, pool replicated — per-chip column equals the full
     # one; handoff is 0 B same-host, per-slot payload cross-host
     assert sk["kv_shards"] == 1

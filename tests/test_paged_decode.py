@@ -1,9 +1,11 @@
-"""Flash-decode kernel correctness: interpret-mode parity against the XLA
+"""Paged flash kernel correctness: interpret-mode parity against the XLA
 gather reference across the serving feature grid (GQA, sliding window —
 static and traced, score scale, softcap, shuffled physical page layouts,
-page-boundary lengths), plus the engine-level pins: flash and xla attends
-produce identical tokens, and the flash decode program's HLO carries no
-[S, M*page, Hkv, D] gathered view."""
+page-boundary lengths) at EVERY query-tile size — T=1 decode, T>1
+verify/chunk tiles with ``n_valid`` pad tails, int8 and bf16 pools —
+plus the engine-level pins: flash and xla attends produce identical
+tokens, and the flash decode/chunk/verify programs' HLO carries no
+[S, M*page, Hkv, D] gathered view (the xla programs show it)."""
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -12,8 +14,9 @@ import pytest
 from distributed_training_guide_tpu.ops.attention import multihead_attention
 from distributed_training_guide_tpu.utils import hlo as hlo_util
 from distributed_training_guide_tpu.ops.paged_decode import (
-    paged_decode_eligible, paged_flash_decode)
-from distributed_training_guide_tpu.serve.kv_pages import paged_attend
+    paged_decode_eligible, paged_flash_attend, paged_flash_decode)
+from distributed_training_guide_tpu.serve.kv_pages import (paged_attend,
+                                                           quantize_kv)
 
 pytestmark = [pytest.mark.serve, pytest.mark.flash_decode]
 
@@ -159,11 +162,6 @@ def test_paged_attend_flash_matches_xla_dispatch():
     # the scatter is shared: pools must be BITWISE identical
     np.testing.assert_array_equal(outs["flash"][1], outs["xla"][1])
     np.testing.assert_array_equal(outs["flash"][2], outs["xla"][2])
-    with pytest.raises(ValueError, match="single-token"):
-        paged_attend(jnp.zeros((1, 2, hq, d)), jnp.zeros((1, 2, hkv, d)),
-                     jnp.zeros((1, 2, hkv, d)), jnp.asarray(k_pages),
-                     jnp.asarray(v_pages), jnp.asarray(tables[:1]),
-                     lengths[:1], impl="flash")
 
 
 # ---- engine-level pins ------------------------------------------------------
@@ -207,3 +205,238 @@ def test_engine_flash_decode_tokens_and_hlo_pin():
                 == expect_view), (
             f"{impl}: gathered-view tensor "
             f"{'missing' if expect_view else 'present'} in the decode HLO")
+
+
+# ---- the multi-token tile (block_q = T): verify / chunked prefill ----------
+
+def _multitok_case(rng, *, s=3, t=4, m=4, page=4, n_pages=16, hq=4, hkv=2,
+                   d=8):
+    """Shuffled physical layout + a fresh [S, T] call's inputs: lengths
+    hit zero / mid-page / a page crossing, and n_valid exercises full,
+    partial, and single-token tails (the padded final chunk / short-draft
+    shapes)."""
+    tables, k_pages, v_pages = _random_paged_state(
+        rng, s=s, m=m, page=page, n_pages=n_pages, hkv=hkv, d=d)
+    lengths = np.array([0, 5, 9], np.int32)[:s]
+    n_valid = np.array([t, max(1, t - 1), 1], np.int32)[:s]
+    q = rng.standard_normal((s, t, hq, d)).astype(np.float32)
+    k_new = rng.standard_normal((s, t, hkv, d)).astype(np.float32)
+    v_new = rng.standard_normal((s, t, hkv, d)).astype(np.float32)
+    return tables, k_pages, v_pages, lengths, n_valid, q, k_new, v_new
+
+
+@pytest.mark.paged_multitok
+@pytest.mark.parametrize("hq,hkv", [(4, 2), (2, 2), (8, 1)])
+@pytest.mark.parametrize("kw", FEATURE_GRID,
+                         ids=lambda kw: "-".join(kw) or "causal")
+def test_multitoken_flash_matches_gather(hq, hkv, kw):
+    """The [S, T] form through the full paged_attend contract — scatter
+    of the T new tokens (n_valid tails trash-routed) then attend — must
+    agree flash-vs-xla at <= 1e-5 on EVERY query row (pad rows read the
+    same pool bytes under the same positional mask), with the shared
+    scatter leaving BITWISE-identical pools. Windows at 4 and 9 fall
+    inside / across the 4-token pages."""
+    rng = np.random.default_rng(11)
+    tables, k_pages, v_pages, lengths, n_valid, q, k_new, v_new = \
+        _multitok_case(rng, hq=hq, hkv=hkv)
+    outs = {}
+    for impl in ("flash", "xla"):
+        attn, (kp, vp) = paged_attend(
+            jnp.asarray(q), jnp.asarray(k_new), jnp.asarray(v_new),
+            jnp.asarray(k_pages), jnp.asarray(v_pages), jnp.asarray(tables),
+            jnp.asarray(lengths), impl=impl,
+            n_valid=jnp.asarray(n_valid), **kw)
+        outs[impl] = (np.asarray(attn), np.asarray(kp), np.asarray(vp))
+    np.testing.assert_allclose(outs["flash"][0], outs["xla"][0],
+                               rtol=1e-5, atol=1e-5)
+    np.testing.assert_array_equal(outs["flash"][1], outs["xla"][1])
+    np.testing.assert_array_equal(outs["flash"][2], outs["xla"][2])
+
+
+@pytest.mark.paged_multitok
+def test_multitoken_rank3_is_the_decode_form_bitwise():
+    """T == 1 through the rank-4 tile IS the original decode kernel: the
+    rank-3 entry point and a [S, 1, Hq, D] call must agree BITWISE (the
+    row fold is a no-op transpose at T=1 — same layout, same op
+    sequence)."""
+    rng = np.random.default_rng(12)
+    tables, k_pages, v_pages = _random_paged_state(
+        rng, s=3, m=4, page=4, n_pages=16, hkv=2, d=8)
+    lengths = np.array([3, 7, 12], np.int32)
+    q = rng.standard_normal((3, 4, 8)).astype(np.float32)
+    args = (jnp.asarray(k_pages), jnp.asarray(v_pages), jnp.asarray(tables),
+            jnp.asarray(lengths))
+    r3 = paged_flash_decode(jnp.asarray(q), *args, window=5, interpret=True)
+    r4 = paged_flash_attend(jnp.asarray(q)[:, None], *args, window=5,
+                            interpret=True)
+    np.testing.assert_array_equal(np.asarray(r3), np.asarray(r4[:, 0]))
+
+
+@pytest.mark.paged_multitok
+def test_multitoken_traced_window_matches_static():
+    """A traced window at T > 1 (the per-layer Gemma-2 schedule under the
+    chunk/verify scan) must equal the static bake; 2**30 encodes full
+    causal."""
+    rng = np.random.default_rng(13)
+    tables, k_pages, v_pages, lengths, _, q, _, _ = \
+        _multitok_case(rng, hq=4, hkv=2)
+    args = (jnp.asarray(q), jnp.asarray(k_pages), jnp.asarray(v_pages),
+            jnp.asarray(tables), jnp.asarray(lengths))
+    traced = jax.jit(lambda w: paged_flash_attend(*args, window=w,
+                                                  interpret=True))
+    static = paged_flash_attend(*args, window=6, interpret=True)
+    np.testing.assert_allclose(np.asarray(traced(jnp.asarray(6))),
+                               np.asarray(static), rtol=1e-6, atol=1e-6)
+    np.testing.assert_allclose(
+        np.asarray(traced(jnp.asarray(2 ** 30))),
+        np.asarray(paged_flash_attend(*args, interpret=True)),
+        rtol=1e-6, atol=1e-6)
+
+
+@pytest.mark.paged_multitok
+def test_multitoken_bf16_pages():
+    """bf16 pools at T > 1: fp32 accumulation inside the kernel keeps
+    parity with the gather reference at bf16 tolerance."""
+    rng = np.random.default_rng(14)
+    tables, k_pages, v_pages, lengths, n_valid, q, k_new, v_new = \
+        _multitok_case(rng, hq=4, hkv=2)
+    outs = {}
+    for impl in ("flash", "xla"):
+        attn, _ = paged_attend(
+            jnp.asarray(q, jnp.bfloat16), jnp.asarray(k_new, jnp.bfloat16),
+            jnp.asarray(v_new, jnp.bfloat16),
+            jnp.asarray(k_pages, jnp.bfloat16),
+            jnp.asarray(v_pages, jnp.bfloat16), jnp.asarray(tables),
+            jnp.asarray(lengths), impl=impl, n_valid=jnp.asarray(n_valid))
+        assert attn.dtype == jnp.bfloat16
+        outs[impl] = np.asarray(attn, np.float32)
+    np.testing.assert_allclose(outs["flash"], outs["xla"],
+                               rtol=3e-2, atol=3e-2)
+
+
+@pytest.mark.paged_multitok
+@pytest.mark.kvquant
+def test_multitoken_int8_flash_matches_int8_gather():
+    """The quantized pool at T > 1: in-kernel dequant (scale rows riding
+    the block-table prefetch) vs the dequantized gather view on the SAME
+    int8 pool — 1e-5 (both read identical payload+scale bytes), and the
+    quantize-at-write scatter is bitwise shared (payload AND scales)."""
+    rng = np.random.default_rng(15)
+    tables, k_pages, v_pages, lengths, n_valid, q, k_new, v_new = \
+        _multitok_case(rng, hq=4, hkv=2)
+    kq = quantize_kv(jnp.asarray(k_pages))
+    vq = quantize_kv(jnp.asarray(v_pages))
+    outs = {}
+    for impl in ("flash", "xla"):
+        attn, (kp, vp) = paged_attend(
+            jnp.asarray(q), jnp.asarray(k_new), jnp.asarray(v_new),
+            kq, vq, jnp.asarray(tables), jnp.asarray(lengths), impl=impl,
+            n_valid=jnp.asarray(n_valid), window=6, scale=0.3, softcap=30.0)
+        outs[impl] = (np.asarray(attn), kp, vp)
+    np.testing.assert_allclose(outs["flash"][0], outs["xla"][0],
+                               rtol=1e-5, atol=1e-5)
+    for leaf_f, leaf_x in zip(jax.tree.leaves(outs["flash"][1:]),
+                              jax.tree.leaves(outs["xla"][1:])):
+        np.testing.assert_array_equal(np.asarray(leaf_f), np.asarray(leaf_x))
+
+
+# ---- engine-level multi-token pins ------------------------------------------
+
+@pytest.mark.paged_multitok
+def test_chunk_and_verify_programs_flash_hlo_pin():
+    """THE acceptance pin for the kernel family: the chunk-prefill and
+    spec-verify programs of a flash-family engine lower with NO gathered
+    [S, M*page, Hkv, D] pool-shaped tensor, while the xla family's show
+    it — chunked prefill and verify stopped paying the logical-view
+    round-trip."""
+    from distributed_training_guide_tpu.models import get_model
+    from distributed_training_guide_tpu.serve import ServeEngine
+
+    bundle = get_model("llama-debug", dtype=jnp.float32)
+    params = bundle.init(bundle.config, jax.random.key(0))
+    cfg = bundle.config
+    for impl, expect_view in (("flash", False), ("xla", True)):
+        eng = ServeEngine(bundle, params, n_slots=2, page_size=4,
+                          max_len=16, attend_impl=impl, prefill_chunk=8,
+                          speculate="ngram", spec_k=3)
+        chunk = eng.programs.chunk_for(8).lower(
+            eng.params, eng.pages["k"], eng.pages["v"],
+            jnp.zeros((1, 8), jnp.int32), jnp.zeros((1,), jnp.int32),
+            jnp.zeros((1, eng.max_pages), jnp.int32),
+            jnp.asarray(7, jnp.int32), jnp.asarray([8], jnp.int32))
+        view = (1, eng.max_pages * eng.page_size, cfg.num_kv_heads,
+                cfg.head_size)
+        assert (hlo_util.has_shape_run(chunk.as_text(), view)
+                == expect_view), (
+            f"{impl}: chunk program gathered view "
+            f"{'missing' if expect_view else 'present'}")
+        s = eng.n_slots
+        verify = eng.programs.verify_for(4, greedy=True).lower(
+            eng.params, eng.pages["k"], eng.pages["v"],
+            jnp.zeros((s, 4), jnp.int32), jnp.zeros((s,), jnp.int32),
+            jnp.zeros((s, eng.max_pages), jnp.int32),
+            jnp.zeros((s,), jnp.int32), jnp.zeros((s,), jnp.float32),
+            jnp.zeros((s,), jnp.int32), jnp.zeros((s,), jnp.float32),
+            jnp.zeros((s,), jnp.bool_), jnp.zeros((s,), jnp.int32))
+        view = (s, eng.max_pages * eng.page_size, cfg.num_kv_heads,
+                cfg.head_size)
+        assert (hlo_util.has_shape_run(verify.as_text(), view)
+                == expect_view), (
+            f"{impl}: verify program gathered view "
+            f"{'missing' if expect_view else 'present'}")
+
+
+@pytest.mark.paged_multitok
+def test_engine_chunked_prefill_flash_tokens_match_gather():
+    """An engine whose chunk program runs the multi-token kernel produces
+    the same tokens as the gather engine — prompt long enough for several
+    chunks incl. a padded final one, co-resident decodes riding along."""
+    from distributed_training_guide_tpu.models import get_model
+    from distributed_training_guide_tpu.serve import Request, ServeEngine
+    from distributed_training_guide_tpu.serve.api import generate_many
+
+    bundle = get_model("llama-debug", dtype=jnp.float32)
+    params = bundle.init(bundle.config, jax.random.key(0))
+    prompt = [3 + (i % 40) for i in range(19)]
+    reqs = [Request(prompt_ids=prompt + [50 + i], max_new_tokens=5,
+                    temperature=0.0 if i % 2 == 0 else 0.8, seed=i)
+            for i in range(3)]
+    res = {}
+    for impl in ("flash", "xla"):
+        eng = ServeEngine(bundle, params, n_slots=3, page_size=4,
+                          max_len=32, attend_impl=impl, prefill_chunk=8)
+        res[impl] = generate_many(eng, reqs)
+    for a, b in zip(res["flash"], res["xla"]):
+        assert a.token_ids == b.token_ids
+
+
+@pytest.mark.paged_multitok
+@pytest.mark.spec
+@pytest.mark.slow
+def test_sharded_tp2_flash_multitok_grid(eight_devices):
+    """The >=2-device multi-token grid (slow): tp=2 sharded pool on the
+    FLASH family with chunked prefill AND speculation — the chunk and
+    verify tiles run the kernel per chip inside the manual region, and
+    tokens equal the plain unsharded engine's."""
+    from distributed_training_guide_tpu.models import get_model
+    from distributed_training_guide_tpu.parallel import make_mesh, make_plan
+    from distributed_training_guide_tpu.serve import Request, ServeEngine
+    from distributed_training_guide_tpu.serve.api import generate_many
+
+    bundle = get_model("llama-debug", dtype=jnp.float32)
+    params = bundle.init(bundle.config, jax.random.key(0))
+    plan = make_plan("tp", make_mesh(tp=2, devices=eight_devices[:2]))
+    rep = [9, 8, 7] * 4
+    reqs = [Request(prompt_ids=rep + [40 + i], max_new_tokens=8,
+                    temperature=0.0 if i % 2 == 0 else 0.9, seed=i)
+            for i in range(4)]
+    ref = generate_many(
+        ServeEngine(bundle, params, n_slots=2, page_size=8, max_len=32),
+        reqs)
+    eng = ServeEngine(bundle, params, n_slots=2, page_size=8, max_len=32,
+                      plan=plan, shard_kv=True, attend_impl="flash",
+                      prefill_chunk=8, speculate="ngram", spec_k=3)
+    got = generate_many(eng, reqs)
+    for a, b in zip(got, ref):
+        assert a.token_ids == b.token_ids
+    assert eng.spec["tokens_drafted"] > 0, "the grid never speculated"

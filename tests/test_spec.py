@@ -134,6 +134,22 @@ def test_empty_draft_iterations_take_plain_path(llama):
     assert eng.decode_steps > 0
 
 
+def test_draft_flash_ineligible_geometry_refused(llama, monkeypatch):
+    """attend_impl='flash' with a draft geometry the compiled kernel
+    cannot take (the DRAFT model's head_size/page_size, not the
+    target's) refuses at construction — not with a Mosaic-gate
+    ValueError inside the first draft forward of a live iteration."""
+    bundle, params = llama
+    monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
+    with pytest.raises(ValueError, match="not eligible"):
+        DraftModelDrafter(bundle, params, n_slots=2, max_len=32, k=3,
+                          page_size=4, attend_impl="flash")
+    # 'auto' resolves per-shape (gather for ineligible geometry) and
+    # must keep constructing
+    DraftModelDrafter(bundle, params, n_slots=2, max_len=32, k=3,
+                      page_size=4, attend_impl="auto")
+
+
 def test_drafter_slot_mismatch_refused(llama):
     """A per-slot-stateful drafter smaller than the engine's decode
     batch refuses at construction, not with an IndexError on the first
@@ -231,6 +247,36 @@ def test_spec_preemption_recompute_identity(llama):
             f"seed={req.seed} diverged across preemption under spec"
     pool = eng.scheduler.pool
     assert pool.n_free + eng.scheduler.cache_pages_held() == pool.capacity
+
+
+@pytest.mark.paged_multitok
+def test_spec_flash_family_identity_and_no_downgrade(llama):
+    """The block_q=T acceptance pin: (a) 'auto' under speculation is no
+    longer downgraded at construction — the engine keeps one attend
+    family because the kernel covers decode AND verify, not because it
+    retreated to gather; (b) on the FLASH family end-to-end (flash
+    decode + flash verify + flash empty-draft fallback), spec-on is
+    token-identical to spec-off — greedy and temperature > 0 — the
+    identity that used to require the downgrade now holds by
+    construction."""
+    bundle, params = llama
+    eng_auto = ServeEngine(bundle, params, n_slots=2, page_size=4,
+                           max_len=32, speculate="ngram", spec_k=3)
+    assert eng_auto.attend_impl == "auto", \
+        "the construction-time downgrade block is back"
+    assert eng_auto.programs.attend_impl == "auto"
+
+    reqs = _spec_reqs(4)                      # greedy + temp>0 mix
+    off = generate_many(
+        ServeEngine(bundle, params, n_slots=2, page_size=4, max_len=32,
+                    attend_impl="flash"),
+        [_fresh(r) for r in reqs])
+    eng = ServeEngine(bundle, params, n_slots=2, page_size=4, max_len=32,
+                      attend_impl="flash", speculate="ngram", spec_k=3)
+    on = generate_many(eng, [_fresh(r) for r in reqs])
+    for a, b in zip(off, on):
+        assert a.token_ids == b.token_ids, "flash-family spec-on diverged"
+    assert eng.spec["tokens_drafted"] > 0, "the trace never speculated"
 
 
 # ---- boundary events mid-speculation (satellite) ---------------------------
