@@ -1199,6 +1199,85 @@ def run_elastic_check(only: str = None) -> None:
     _emit(out)
 
 
+def run_post_check(only: str = None) -> None:
+    """Post-training loop rung (post/): rollout → score → update →
+    publish on llama-debug, with the in-rung FROZEN-POLICY control per
+    the one-new-variable policy.
+
+    - post_loop_cpu: 5 loop iterations of REINFORCE-with-baseline on the
+      dense synthetic band reward (fraction of sampled tokens with
+      id < 64 — ~0.125 at init), 24 same-prompt rollouts x 16 new tokens
+      through an 8-slot engine, full-parameter policy at lr 0.1 (the
+      config tests/test_post.py pins as measurably learning). The
+      control is the IDENTICAL loop with ``frozen=True`` — rollout +
+      score only, no update, no publish — so the update+publish half is
+      the only new variable: its reward trajectory stays at the init
+      band rate and its rollout tok/s prices the engine alone.
+      Records per-arm reward trajectories, warm rollout tok/s (iteration
+      0 carries the compiles — reported separately), publish latency ms,
+      and step time."""
+    _configure_jax_cache()
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from distributed_training_guide_tpu.models import get_model
+    from distributed_training_guide_tpu.post import (PostTrainingLoop,
+                                                     ProgrammaticScorer,
+                                                     band_reward,
+                                                     merged_params)
+    from distributed_training_guide_tpu.serve.engine import ServeEngine
+    from distributed_training_guide_tpu.train.optimizer import adamw_cosine
+    from distributed_training_guide_tpu.train.step import Trainer
+
+    rungs = set(only.split(",")) if only else {"post_loop_cpu"}
+    out = {"metric": "post_loop", "model": "llama-debug", "value": 0.0}
+    if "post_loop_cpu" in rungs:
+        bundle = get_model("llama-debug", dtype=jnp.float32)
+        n_iter = 5
+
+        def arm(frozen: bool):
+            trainer = Trainer(bundle=bundle, optimizer=adamw_cosine(0.1),
+                              guard_policy="skip")
+            state = trainer.init_state(0)
+            engine = ServeEngine(bundle, merged_params(trainer, state),
+                                 n_slots=8, page_size=16, max_len=64)
+            loop = PostTrainingLoop(
+                trainer, engine, ProgrammaticScorer(band_reward(64)),
+                [[3, 10, 17]] * 24, state=state, max_new_tokens=16,
+                temperature=1.0, base_seed=0, frozen=frozen)
+            hist = loop.run(n_iter)
+            warm = hist[1:]          # iteration 0 pays the compiles
+            return {
+                "reward_trajectory": [round(m["reward_mean"], 4)
+                                      for m in hist],
+                "rollout_tokens_per_s": round(float(np.mean(
+                    [m["rollout_tokens_per_s"] for m in warm])), 1),
+                "rollout_tokens_per_s_cold": hist[0][
+                    "rollout_tokens_per_s"],
+                "publish_ms_mean": round(float(np.mean(
+                    [m["publish_ms"] for m in warm])), 2),
+                "step_s_mean": round(float(np.mean(
+                    [m["step_s"] for m in warm])), 4),
+                "publishes": loop.publishes,
+            }
+
+        live = arm(frozen=False)
+        ctl = arm(frozen=True)
+        traj = live["reward_trajectory"]
+        out["post_loop_cpu"] = {
+            "iterations": n_iter,
+            **live,
+            "reward_delta": round(traj[-1] - traj[0], 4),
+            "control_frozen": ctl,
+            "control_reward_delta": round(
+                ctl["reward_trajectory"][-1]
+                - ctl["reward_trajectory"][0], 4),
+        }
+        out["value"] = live["rollout_tokens_per_s"]
+    _emit(out)
+
+
 # ---------------------------------------------------------------------------
 # parent: ladder orchestration (never touches the TPU itself)
 # ---------------------------------------------------------------------------
@@ -1387,6 +1466,14 @@ SWEEP_QUEUE = [
     # deviation vs an uninterrupted golden.
     dict(name="engine_swap_midstream", elastic_rungs="engine_swap_midstream"),
     dict(name="reshard_restore", elastic_rungs="reshard_restore"),
+    # --- post-training loop (post/, PR 15): rollout→score→update→publish
+    # on llama-debug with the IN-RUNG frozen-policy control (rollout +
+    # score only — the update/publish half is the one new variable).
+    # Records reward trajectories both arms (live must climb, frozen must
+    # not), warm rollout tok/s, publish latency, step time. CPU rung by
+    # design: the loop is host-driven scheduling + debug-size compute;
+    # the TPU story is the trainer/engine rungs it composes.
+    dict(name="post_loop_cpu", post_rungs="post_loop_cpu"),
     # LAST on purpose: fence_every=4 dispatches 4 steps ahead, the exact
     # pattern this pool's documented failure mode punishes — its first
     # attempt (2026-07-31 03:50) stalled and the pool went down with it.
@@ -1613,6 +1700,7 @@ def run_sweep(watchdog: int) -> None:
             # of a training rung; their result metrics differ
             metric = ("decode_tput" if exp.get("decode_rungs")
                       else "elastic" if exp.get("elastic_rungs")
+                      else "post_loop" if exp.get("post_rungs")
                       else "mfu")
             if exp.get("decode_rungs"):
                 child_args = ["--check-decode",
@@ -1620,6 +1708,9 @@ def run_sweep(watchdog: int) -> None:
             elif exp.get("elastic_rungs"):
                 child_args = ["--check-elastic",
                               "--elastic-rungs", exp["elastic_rungs"]]
+            elif exp.get("post_rungs"):
+                child_args = ["--check-post",
+                              "--post-rungs", exp["post_rungs"]]
             else:
                 spec = {k: v for k, v in exp.items() if k != "name"}
                 spec.setdefault("steps", 10)
@@ -1782,6 +1873,8 @@ def main() -> None:
     parser.add_argument("--decode-rungs", default=None, help=argparse.SUPPRESS)
     parser.add_argument("--check-elastic", action="store_true", help=argparse.SUPPRESS)
     parser.add_argument("--elastic-rungs", default=None, help=argparse.SUPPRESS)
+    parser.add_argument("--check-post", action="store_true", help=argparse.SUPPRESS)
+    parser.add_argument("--post-rungs", default=None, help=argparse.SUPPRESS)
     args = parser.parse_args()
     if args.remat is False and args.remat_policy:
         parser.error("--no-remat contradicts --remat-policy "
@@ -1797,6 +1890,8 @@ def main() -> None:
         return run_decode_check(args.decode_rungs)
     if args.check_elastic:
         return run_elastic_check(args.elastic_rungs)
+    if args.check_post:
+        return run_post_check(args.post_rungs)
     if args.sweep:
         return run_sweep(args.watchdog)
 

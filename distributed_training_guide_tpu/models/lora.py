@@ -190,6 +190,19 @@ def merge_lora(bundle: ModelBundle, params: dict) -> dict:
     return merge(bundle.config, params)
 
 
+def jit_merge(bundle: ModelBundle):
+    """ONE compiled merge program ``{"base","lora"} -> base-layout`` —
+    the post-training publish path (post/loop.py) merges after every
+    policy update, so the W + scale*A@B einsum-and-add must not retrace
+    per publish. The output layout matches the base bundle's params
+    exactly, which is what ``ModelPrograms.publish_params`` validates
+    against."""
+    merge = getattr(bundle, "lora_merge", None)
+    if merge is None:
+        raise ValueError("jit_merge needs a bundle built by lora_bundle")
+    return jax.jit(partial(merge, bundle.config))
+
+
 def lora_labels(params: dict) -> dict:
     """"trainable" for adapter leaves, "frozen" for the base — the
     optax.multi_transform label tree matching the params."""

@@ -1,0 +1,34 @@
+"""On-policy post-training runtime: the trainer drives the serve engine.
+
+The loop this package closes (ROADMAP item 4 — the reference guide
+stops at pretraining):
+
+    rollout  — a co-resident ServeEngine generates variable-length
+               samples under the paged pool, reproducible per derived
+               seed, ledgered per sample (post/rollout.py);
+    score    — programmatic rewards, reward-model forwards, or teacher
+               distributions behind one Scorer interface (post/score.py);
+    update   — the masked ragged post step: rollouts packed by
+               group_sizes through ops/grouped_matmul.py, prompt tokens
+               masked, REINFORCE-with-baseline / distillation-KL behind
+               the post_loss seam, LoRA-sized updates (train/step.py);
+    publish  — refreshed params swap into the engine's already-compiled
+               programs without a retrace (ModelPrograms.publish_params,
+               serve/engine.py), gated on the step guard so a NaN update
+               never poisons the serving policy (post/loop.py).
+
+CLI: ``python -m distributed_training_guide_tpu.post`` (post/cli.py).
+Chapter: ``related-topics/post-training/``.
+"""
+from .loop import PostTrainingLoop, merged_params, pack_rollouts
+from .rollout import (Rollout, RolloutLedger, generate_rollouts,
+                      rollout_seed)
+from .score import (band_reward, match_reward, ProgrammaticScorer,
+                    RewardModelScorer, Score, Scorer, TeacherScorer)
+
+__all__ = [
+    "PostTrainingLoop", "merged_params", "pack_rollouts",
+    "Rollout", "RolloutLedger", "generate_rollouts", "rollout_seed",
+    "ProgrammaticScorer", "RewardModelScorer", "Score", "Scorer",
+    "TeacherScorer", "band_reward", "match_reward",
+]

@@ -642,6 +642,23 @@ class DisaggEngine:
         from stats() and stops routing here."""
         self.draining = True
 
+    def publish_params(self, new_params, *, force: bool = False) -> int:
+        """Publish refreshed weights into the SHARED program cache (both
+        engines run the same ``ModelPrograms`` — one publish updates the
+        prefill and decode sides atomically). Same in-flight-work refusal
+        as ``ServeEngine.publish_params``: a mid-stream publish breaks
+        bitwise replay for the sequences it straddles (including anything
+        sitting in the handoff queue, which re-prefills on failure)."""
+        if not force and self.has_work:
+            raise RuntimeError(
+                f"publish_params with in-flight work "
+                f"(prefill={self.prefill.sched.has_work}, "
+                f"decode={self.decode.sched.has_work}, "
+                f"in_transit={len(self.handoff.pending)}): a mid-stream "
+                f"weight swap breaks bitwise replay — finish or drain "
+                f"first, or pass force=True to accept that")
+        return self.programs.publish_params(new_params)
+
     def close(self) -> None:
         """Tear down the handoff transport (sockets + receiver thread
         under cross_host; a no-op same-host)."""
@@ -692,6 +709,12 @@ class DisaggEngine:
         in-transit deadlines, the decode engine seats handoffs and runs
         one batched decode. Preempted sequences route back to the prefill
         queue head with their generated suffix (recompute + replay)."""
+        if getattr(self, "_publish_pending_swap", False):
+            raise RuntimeError(
+                "new_generation(params=...) already published the next "
+                "policy into this pair's shared programs — stepping it "
+                "before swap_generation would decode old-policy k/v "
+                "under the new weights; run the swap first")
         finished = self.prefill.step()
         finished.extend(self._expire_in_transit())
         decoded, preempted = self.decode.step()

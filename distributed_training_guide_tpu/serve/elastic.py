@@ -212,7 +212,7 @@ def _requeue(sched: Scheduler, exp: _Exported, stats: dict) -> None:
     stats["requeued"] += 1
 
 
-def new_generation(old, **overrides):
+def new_generation(old, *, params=None, **overrides):
     """Build the next engine generation around the OLD generation's
     compiled programs (one params layout, one jit cache — the bitwise
     precondition) with its serving knobs carried over; ``overrides`` are
@@ -220,7 +220,23 @@ def new_generation(old, **overrides):
     ``prefill_chunk``, ``max_queue``, ...). Program-level knobs
     (``kv_dtype`` / ``attend_impl`` / ``plan`` / ``shard_kv``) are baked
     into the shared programs and cannot be overridden here — changing
-    those is a new deployment, not a generation swap."""
+    those is a new deployment, not a generation swap.
+
+    ``params=`` is the published-params path (post-training fleets):
+    SAME-layout refreshed weights are published into the shared programs
+    (``ModelPrograms.publish_params`` — validated, retrace-free), so
+    callers mix a weight-publish with a capacity swap in one call
+    instead of special-casing "did the layout change". The publish
+    happens LAST — after override validation and after the new engine
+    builds — so a rejected override or a failed construction leaves the
+    old generation still serving the OLD weights (publishing first
+    would hand its in-flight sequences new weights over old-policy k/v
+    with no replay to fix them). The returned engine is stamped as
+    requiring the replay seat: ``swap_generation`` refuses to
+    payload-seat k/v computed under the pre-publish policy, even in the
+    two-call form. A publish mid-swap is rejected by the swap guard (a
+    changed layout fails publish validation loudly; that case IS a new
+    deployment)."""
     baked = {"kv_dtype", "attend_impl", "plan", "shard_kv"}
     bad = baked & set(overrides)
     if bad:
@@ -264,28 +280,57 @@ def new_generation(old, **overrides):
                   transport=old.transport,
                   programs=old.programs, **pool_kw)
         kw.update(overrides)
-        return DisaggEngine(old.bundle, old.programs.params, **kw)
-    kw = dict(n_slots=old.n_slots, page_size=old.page_size,
-              max_len=old.max_model_len,
-              n_pages=_carry_pool(old.scheduler.pool.n_pages,
-                                  1 + old.n_slots * old.max_pages),
-              prefill_chunk=old.prefill_chunk,
-              prefix_cache=old.scheduler.cache is not None,
-              max_queue=old.scheduler.max_queue,
-              speculate=old.drafter,
-              programs=old.programs)
-    kw.update(overrides)
-    return ServeEngine(old.bundle, old.programs.params, **kw)
+        new = DisaggEngine(old.bundle, old.programs.params, **kw)
+    else:
+        kw = dict(n_slots=old.n_slots, page_size=old.page_size,
+                  max_len=old.max_model_len,
+                  n_pages=_carry_pool(old.scheduler.pool.n_pages,
+                                      1 + old.n_slots * old.max_pages),
+                  prefill_chunk=old.prefill_chunk,
+                  prefix_cache=old.scheduler.cache is not None,
+                  max_queue=old.scheduler.max_queue,
+                  speculate=old.drafter,
+                  programs=old.programs)
+        kw.update(overrides)
+        new = ServeEngine(old.bundle, old.programs.params, **kw)
+    if params is not None:
+        # publish LAST (both engine shapes): everything that can refuse
+        # already has. From here the old generation's resident k/v is
+        # old-policy — the stamp makes every seat path replay instead of
+        # payload-move, and the OLD engine must not step again before
+        # the swap (its decodes would attend old-policy k/v with the new
+        # weights and the forced replay would then preserve those
+        # mixed-policy tokens verbatim): step() refuses until the swap.
+        old.programs.publish_params(params)
+        new._seat_requires_replay = True
+        old._publish_pending_swap = True
+    return new
 
 
-def swap_generation(old, new) -> tuple[list[RequestResult], dict]:
+def swap_generation(old, new, *,
+                    force_replay: bool = False) \
+        -> tuple[list[RequestResult], dict]:
     """Move EVERY in-flight request from ``old`` to ``new`` (the
     coordinated mass preemption — module docstring has the full
     protocol). Returns ``(shrink_evicted_results, stats)``; everything
     not in the results list continues on the new generation, token-
     identical to an uninterrupted run. The old generation is left
     drained and EMPTY: no queue, no residents, no cache references — its
-    pool audits ``free == capacity``."""
+    pool audits ``free == capacity``.
+
+    ``force_replay=True`` disables the gathered-payload seat path and
+    requeues every carried sequence through recompute instead. A
+    generation built by ``new_generation(params=...)`` forces it
+    REGARDLESS of the caller's flag (the ``_seat_requires_replay``
+    stamp — the two-call form must not seat k/v computed under the
+    pre-publish policy either): seated k/v was computed under the old
+    policy, and attending over it with the new weights would mix
+    policies mid-sequence. Replay rebuilds each sequence's cache under
+    the published weights while preserving the already-emitted tokens
+    verbatim (replay forces the recorded tokens; samples along the way
+    are discarded)."""
+    force_replay = force_replay or getattr(new, "_seat_requires_replay",
+                                           False)
     if old.programs is not new.programs:
         raise ValueError(
             "generation swap requires the new engine to share the old "
@@ -294,12 +339,19 @@ def swap_generation(old, new) -> tuple[list[RequestResult], dict]:
     if getattr(new, "draining", False):
         raise ValueError("the new generation is draining; swap into a "
                          "live engine")
+    # the guard rejects any publish_params landing while the export/seat
+    # window is open — new weights mid-swap would corrupt every replay
+    with old.programs.swap_guard():
+        return _swap_generation_locked(old, new, force_replay)
+
+
+def _swap_generation_locked(old, new, force_replay: bool):
     t0 = time.perf_counter()
     stats = {"seated": 0, "requeued": 0, "evicted": 0, "pages_moved": 0,
              "bytes_moved": 0, "payload_dropped": 0, "cache_dropped": 0,
              "queued_moved": 0}
     old.drain()
-    with_payload = _payload_compatible(old, new)
+    with_payload = _payload_compatible(old, new) and not force_replay
     disagg = isinstance(old, DisaggEngine)
 
     # ---- export from the old generation ------------------------------------
@@ -387,13 +439,22 @@ def swap_generation(old, new) -> tuple[list[RequestResult], dict]:
     return results, stats
 
 
-def swap_engine(old, **overrides):
+def swap_engine(old, *, params=None, **overrides):
     """The one-call form: build the next generation with ``overrides``
     (``new_generation``), run the swap, and return ``(new_engine,
     shrink_evicted_results, stats)``. The old engine is left drained and
-    empty; drop it (or keep it for its counters)."""
-    new = new_generation(old, **overrides)
-    results, stats = swap_generation(old, new)
+    empty; drop it (or keep it for its counters).
+
+    ``params=`` publishes refreshed same-layout weights into the shared
+    programs first (the post-training weight-publish path) and forces
+    the requeue-and-replay seat for every carried sequence — their
+    caches are rebuilt under the published weights while every
+    already-emitted token is preserved verbatim (payload-seated k/v was
+    computed under the OLD policy and must not be attended over with
+    the new one)."""
+    new = new_generation(old, params=params, **overrides)
+    results, stats = swap_generation(old, new,
+                                     force_replay=params is not None)
     close = getattr(old, "close", None)
     if close is not None:              # tear down the old handoff transport
         close()

@@ -55,6 +55,7 @@ pool).
 """
 from __future__ import annotations
 
+import contextlib
 from typing import Optional
 
 import jax
@@ -628,6 +629,13 @@ class ModelPrograms:
             shardings = plan.param_shardings(
                 bundle.param_logical_axes(self.config), shapes)
             params = jax.device_put(params, shardings)
+        else:
+            # canonical COMMITTED placement: params handed straight from
+            # init/jit are uncommitted, and pjit keys its executable cache
+            # on commitment — without this, the first publish_params
+            # (whose device_put output is committed) would retrace every
+            # program once, breaking the cache-flat-across-publishes pin
+            params = jax.device_put(params, jax.devices()[0])
         self.params = params
 
         kv_out = ((self._kv_sharding, self._kv_sharding)
@@ -652,6 +660,131 @@ class ModelPrograms:
             lambda logit, seed, pos, t, tk, tp: _sample_tokens(
                 logit[None], seed[None], pos[None], t[None], tk[None],
                 tp[None])[0])
+        # weight-publish bookkeeping (post-training: post/loop.py). A
+        # publish swaps refreshed buffers into self.params WITHOUT touching
+        # the jit caches above — the programs take params as an argument,
+        # so identical avals mean zero retraces (jit_cache_sizes pins it).
+        self.publish_count = 0
+        self._swap_in_flight = False
+        self._snapshot_fn = None
+
+    # ---- weight publishing (the post-training seam) ------------------------
+    @contextlib.contextmanager
+    def swap_guard(self):
+        """Marks an engine-generation swap in flight on this program cache
+        (``serve/elastic.py swap_generation`` holds it for the whole
+        export/seat window). ``publish_params`` refuses while it is held:
+        the swap replays preempted sequences bitwise through these
+        programs, and a weight publish landing mid-swap would make the
+        replayed tokens diverge from the recorded ones — silent stream
+        corruption, the one outcome the swap protocol exists to prevent."""
+        if self._swap_in_flight:
+            raise RuntimeError("an engine generation swap is already in "
+                               "flight on this ModelPrograms")
+        self._swap_in_flight = True
+        try:
+            yield self
+        finally:
+            self._swap_in_flight = False
+
+    def publish_params(self, new_params) -> int:
+        """Swap refreshed parameters into every compiled program — the
+        trainer->engine seam of the post-training loop (post/loop.py).
+
+        The decode/prefill/verify programs take params as an ARGUMENT, so
+        a publish is a buffer rebind, not a program change: as long as the
+        incoming pytree matches the compiled layout exactly (treedef,
+        per-leaf shape and dtype), every jit cache hits and the next
+        decode step runs the already-compiled executable over the new
+        weights — retrace-free by design, pinned by ``jit_cache_sizes``
+        staying flat across publishes and by decode-after-publish being
+        bitwise equal to a fresh engine built from the published params.
+
+        A mismatched pytree fails LOUDLY naming the offending leaf
+        (a stale-layout publish reaching the embedding gather would
+        produce garbage tokens with a 200, not an error), and a publish
+        is rejected outright while a generation swap is in flight (see
+        ``swap_guard``). Host arrays are accepted: leaves are placed onto
+        the compiled layout's shardings (the plan's param placement, or
+        default device placement for single-device engines). The
+        incoming leaves are COPIED, never donated — the caller keeps its
+        tree (a non-shared fleet publishes one tree into several caches),
+        and see the snapshot comment below for why donation is banned on
+        this jaxlib.
+
+        Returns the new publish count."""
+        if self._swap_in_flight:
+            raise RuntimeError(
+                "cannot publish params while an engine generation swap is "
+                "in flight: the swap replays in-flight sequences bitwise "
+                "through these programs, and new weights mid-swap would "
+                "corrupt every replayed stream — publish before the swap "
+                "or after it completes")
+        old_flat, old_def = jax.tree_util.tree_flatten(self.params)
+        new_flat, new_def = jax.tree_util.tree_flatten(new_params)
+        if old_def != new_def:
+            raise ValueError(
+                f"published params tree does not match the compiled "
+                f"layout: got {new_def}, compiled {old_def} — a "
+                f"stale-layout publish would produce garbage tokens, not "
+                f"an error, so it is refused here")
+        old_paths = jax.tree_util.tree_flatten_with_path(self.params)[0]
+        for (path, old_leaf), new_leaf in zip(old_paths, new_flat):
+            name = jax.tree_util.keystr(path)
+            new_shape = tuple(getattr(new_leaf, "shape", ()))
+            new_dtype = np.asarray(new_leaf).dtype \
+                if not hasattr(new_leaf, "dtype") else new_leaf.dtype
+            if new_shape != tuple(old_leaf.shape):
+                raise ValueError(
+                    f"published leaf {name} has shape {new_shape} but the "
+                    f"compiled layout expects {tuple(old_leaf.shape)}")
+            if jnp.dtype(new_dtype) != jnp.dtype(old_leaf.dtype):
+                raise ValueError(
+                    f"published leaf {name} has dtype {new_dtype} but the "
+                    f"compiled layout expects {old_leaf.dtype}")
+        # SNAPSHOT onto the compiled layout's shardings: the engine OWNS
+        # its buffers. A bare device_put would alias identically-placed
+        # incoming leaves — and the post-training trainer DONATES its
+        # state into the next update step, which would delete the
+        # engine's params out from under the decode ("buffer has been
+        # deleted or donated" mid-rollout, found the hard way when a
+        # guard-skipped publish deferred the rebinding). One compiled
+        # copy program, built on first publish, reused forever — the old
+        # leaves drop their last reference when self.params rebinds.
+        if self._snapshot_fn is None:
+            shardings = jax.tree.map(lambda leaf: leaf.sharding,
+                                     self.params)
+            # ALWAYS copy, never donate: a donate_argnums twin (reusing
+            # the loop's merge-output buffers — one fewer params copy
+            # per publish) segfaulted this container's jaxlib inside a
+            # later persistent-cache executable deserialization, the
+            # ROADMAP caveat-(c) glibc-heap corruption in a new coat.
+            # Re-try the donating twin when jaxlib is upgraded.
+            self._snapshot_fn = jax.jit(
+                lambda p: jax.tree.map(jnp.copy, p),
+                out_shardings=shardings)
+        self.params = self._snapshot_fn(new_params)
+        self.publish_count += 1
+        return self.publish_count
+
+    def jit_cache_sizes(self) -> dict:
+        """Per-program jit cache sizes — the retrace meter. A weight
+        publish must leave every number here unchanged (the acceptance
+        pin of the post-training loop: a policy update is a
+        weight-publish, not a recompile)."""
+        sizes = {
+            "decode": self._decode_fn._cache_size(),
+            "commit": self._commit_fn._cache_size(),
+            "copy": self._copy_fn._cache_size(),
+            "sample_one": self._sample_one._cache_size(),
+        }
+        for b, fn in self._prefill_fns.items():
+            sizes[f"prefill_{b}"] = fn._cache_size()
+        for t, fn in self._chunk_fns.items():
+            sizes[f"chunk_{t}"] = fn._cache_size()
+        for key, fn in self._verify_fns.items():
+            sizes[f"verify_{key}"] = fn._cache_size()
+        return sizes
 
     # ---- state placement ---------------------------------------------------
     def init_device_pages(self, n_pages: int, page_size: int) -> dict:
@@ -984,6 +1117,31 @@ class ServeEngine:
         empty (api.py ``_EngineWorker.stop(drain=True)``)."""
         self.draining = True
 
+    def publish_params(self, new_params, *, force: bool = False) -> int:
+        """Publish refreshed weights into the shared program cache
+        (``ModelPrograms.publish_params`` — layout-validated, retrace-free
+        buffer swap). The post-training loop's policy-update seam.
+
+        Refused while the engine holds IN-FLIGHT work unless ``force``:
+        every identity guarantee in this package (preemption replay,
+        spec-on == spec-off, resubmission recovery) assumes one set of
+        weights per token stream, and a mid-stream publish would make a
+        later bitwise REPLAY of already-emitted tokens diverge from the
+        recording. The on-policy loop publishes between rollout batches,
+        when the engine is drained — exactly the safe window. ``force``
+        is for callers that accept mid-stream policy changes and forgo
+        replay identity for the sequences in flight."""
+        if not force and self.has_work:
+            raise RuntimeError(
+                f"publish_params with "
+                f"{len(self.scheduler.queue)} queued + "
+                f"{len(self.scheduler.active_indices()) + len(self.scheduler.prefilling_indices())} "
+                f"resident sequences in flight: a mid-stream weight swap "
+                f"breaks bitwise replay for them (preemption/resubmit "
+                f"would rewrite history under new weights) — finish or "
+                f"drain first, or pass force=True to accept that")
+        return self.programs.publish_params(new_params)
+
     @property
     def has_work(self) -> bool:
         return self.scheduler.has_work
@@ -1020,6 +1178,14 @@ class ServeEngine:
         prefixes), advance prefill work (whole-bucket, or one
         chunk-budget's worth), then ONE batched decode over the decoding
         slots. Returns finished requests."""
+        if getattr(self, "_publish_pending_swap", False):
+            raise RuntimeError(
+                "new_generation(params=...) already published the next "
+                "policy into this engine's shared programs — stepping it "
+                "before swap_generation would decode old-policy k/v "
+                "under the new weights and the replay would preserve the "
+                "mixed-policy tokens; run the swap (or build the new "
+                "generation without params=)")
         finished = []
         sched = self.scheduler
         expired = sched.expire_deadlines()

@@ -274,7 +274,7 @@ class Router:
                          "spillovers": 0, "fenced": 0, "resubmitted": 0,
                          "resubmit_exhausted": 0, "replicas_added": 0,
                          "replicas_removed": 0, "generation_swaps": 0,
-                         "refused": {}}
+                         "param_publishes": 0, "refused": {}}
 
     # ---- routing -----------------------------------------------------------
     def _routable(self, now: float, exclude=()) -> list[Replica]:
@@ -538,14 +538,22 @@ class Router:
             close()
         self.counters["replicas_removed"] += 1
 
-    def swap_replica(self, name: str, **overrides) -> list[RequestResult]:
+    def swap_replica(self, name: str, *, params=None,
+                     **overrides) -> list[RequestResult]:
         """Live engine-generation swap for one replica
         (``serve/elastic.py``): grow/shrink its ``n_slots`` / page pool
         in place without dropping in-flight requests. The swap preserves
         engine request ids, so the router's ledger — ``_by_engine``,
         streaming taps, fence recovery — remains valid across it; only
         shrink-forced evictions surface, translated to router ids with
-        their strict token prefix. Counted in ``generation_swaps``."""
+        their strict token prefix. Counted in ``generation_swaps``.
+
+        ``params=`` rides through to ``swap_engine``: same-layout
+        refreshed weights publish into the replica's shared programs
+        before the swap and every carried sequence replays (cache
+        rebuilt under the new weights, emitted tokens preserved) — the
+        post-training fleet's "publish AND resize" form. For a pure
+        weight refresh with no capacity change use ``publish_params``."""
         from .elastic import swap_engine
 
         replica = self.replicas.get(name)
@@ -559,10 +567,61 @@ class Router:
             # affinity keys are page-aligned at one page_size
             raise ValueError("generation swap cannot change page_size — "
                              "the fleet's affinity keys would split")
-        new_engine, evicted, stats = swap_engine(replica.engine, **overrides)
+        new_engine, evicted, stats = swap_engine(replica.engine,
+                                                 params=params, **overrides)
         replica.engine = new_engine
         self.counters["generation_swaps"] += 1
+        if params is not None:
+            self.counters["param_publishes"] += 1
         return self._translate(replica, evicted)
+
+    def publish_params(self, params, *, name: Optional[str] = None,
+                       force: bool = False) -> int:
+        """Fleet-wide weight publish (post-training: the trainer's
+        policy update reaching every replica WITHOUT a generation swap).
+        Publishes the same-layout ``params`` into each live replica's
+        program cache — replicas sharing one ``ModelPrograms`` (the
+        ``local_fleet`` shape) publish once, counted once. ``name``
+        restricts to a single replica. Engines with in-flight work
+        refuse unless ``force`` (see ``ServeEngine.publish_params``);
+        the fleet-safe pattern is drain-or-idle, then publish.
+
+        The fence-recovery invariant survives because a resubmitted
+        request replays on a replica with the SAME published weights —
+        publishing to a strict subset of a fleet that shares traffic
+        would break that, so a partial publish is the caller's explicit
+        choice via ``name``. Returns the number of program caches
+        updated."""
+        if name is not None and name not in self.replicas:
+            raise ValueError(f"no replica named {name!r}")
+        targets = ([self.replicas[name]] if name is not None
+                   else [r for r in self.replicas.values()
+                         if r.state == "live"])
+        # all-or-nothing: check EVERY target's in-flight state before
+        # touching ANY program cache — a refusal halfway through would
+        # leave the fleet on mixed weights, and a fenced request
+        # resubmitted across that split would replay its recorded
+        # prefix under different weights (exactly the invariant the
+        # docstring promises)
+        if not force:
+            busy = [r.name for r in targets if r.engine.has_work]
+            if busy:
+                raise RuntimeError(
+                    f"publish_params refused: replicas {busy} have "
+                    f"in-flight work and a partial publish would leave "
+                    f"the fleet on mixed weights — drain first, or pass "
+                    f"force=True to accept mid-stream swaps fleet-wide")
+        seen: set = set()
+        published = 0
+        for replica in targets:
+            programs = replica.engine.programs
+            if id(programs) in seen:
+                continue
+            seen.add(id(programs))
+            replica.engine.publish_params(params, force=force)
+            published += 1
+        self.counters["param_publishes"] += published
+        return published
 
     # ---- the engine-shaped surface -----------------------------------------
     @property

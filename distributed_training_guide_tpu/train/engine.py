@@ -96,6 +96,20 @@ class TrainingEngine:
                 f"moe_dispatch={config['moe_dispatch']!r} is only valid "
                 f"for MoE models; {config['model']!r} rejected it ({exc})")
 
+        # {"rank": 8, "alpha": 16, "targets": ["wq","wv"]} — wrap the model
+        # in LoRA adapters (models/lora.py) and restrict the optimizer to
+        # them (lora_only below: base updates zeroed, moments only for the
+        # adapter leaves) — the parameter-efficient finetune/post-training
+        # configuration, config-file spelled like everything else here
+        lora_cfg = config.get("lora")
+        if lora_cfg:
+            from ..models.lora import DEFAULT_TARGETS, lora_bundle
+
+            bundle = lora_bundle(
+                bundle, rank=lora_cfg.get("rank", 8),
+                alpha=lora_cfg.get("alpha", 16.0),
+                targets=tuple(lora_cfg.get("targets", DEFAULT_TARGETS)))
+
         stage = config.get("zero_optimization", {}).get("stage", 0)
         tp = config.get("tensor_parallel", 1)
         pp = config.get("pipeline_parallel", 1)
@@ -250,6 +264,7 @@ class TrainingEngine:
         self.trainer = Trainer(
             bundle=bundle,
             optimizer=optimizer,
+            lora_only=bool(lora_cfg),
             plan=plan,
             grad_accum=config.get("gradient_accumulation_steps", 1),
             remat=remat,

@@ -186,6 +186,78 @@ def comm_roofline(trainer, *, global_batch: int, seq_length: int,
     return report
 
 
+def _tree_bytes(shapes_tree) -> int:
+    return sum(int(np.prod(sd.shape, dtype=np.int64)) * sd.dtype.itemsize
+               for sd in jax.tree.leaves(shapes_tree))
+
+
+def price_post_colocation(trainer, *, n_slots: int, page_size: int = 16,
+                          max_len: int = 2048, kv_dtype=None,
+                          teacher_bundle=None,
+                          budget_bytes: int | None = None) -> dict:
+    """Price the post-training loop's CO-RESIDENT memory — everything
+    that must live on the chip at once for rollout→score→update→publish
+    (post/loop.py): the trainer's policy state (params + optimizer
+    moments — adapter-only under ``lora_only`` — + transient grads), the
+    serve engine's MERGED policy copy and its page pool, and an optional
+    teacher/reward model's params. Abstract shapes only, no device
+    state; with ``budget_bytes`` an impossible colocation REFUSES here,
+    before any compile burns minutes discovering it as an OOM."""
+    from ..serve.kv_pages import kv_dtype_name, kv_page_bytes, \
+        pages_for_tokens
+
+    cfg = trainer.bundle.config
+    params_b = _per_device_bytes(trainer.param_shapes,
+                                 trainer.param_shardings)
+    opt_shapes = jax.eval_shape(trainer.optimizer.init, trainer.param_shapes)
+    opt_b = _per_device_bytes(opt_shapes, trainer.opt_shardings_device)
+    grad_b = params_b          # transient, resident at the update boundary
+    # the engine serves the MERGED policy (base layout for LoRA bundles)
+    base_bundle = getattr(trainer.bundle, "lora_base", trainer.bundle)
+    engine_params_b = _tree_bytes(jax.eval_shape(
+        lambda: base_bundle.init(cfg, jax.random.key(0))))
+    n_pages = 1 + n_slots * pages_for_tokens(max_len, page_size)
+    pool_b = kv_page_bytes(cfg, page_size=page_size, n_pages=n_pages,
+                           kv_dtype=kv_dtype_name(cfg, kv_dtype))
+    teacher_b = 0
+    if teacher_bundle is not None:
+        teacher_b = _tree_bytes(jax.eval_shape(
+            lambda: teacher_bundle.init(teacher_bundle.config,
+                                        jax.random.key(0))))
+    total = params_b + opt_b + grad_b + engine_params_b + pool_b + teacher_b
+    report = {
+        "policy_param_bytes": params_b,
+        "policy_opt_state_bytes": opt_b,
+        "policy_grad_bytes_transient": grad_b,
+        "engine_param_bytes": engine_params_b,
+        "engine_pool_bytes": pool_b,
+        "engine_pool_pages": n_pages,
+        "teacher_param_bytes": teacher_b,
+        "total_bytes": total,
+        "lora_only": bool(getattr(trainer, "lora_only", False)),
+    }
+    gib = 1 / 2**30
+    LOGGER.info(
+        f"post colocation: policy {params_b * gib:.3f} GiB params + "
+        f"{opt_b * gib:.3f} GiB opt + {grad_b * gib:.3f} GiB grads, "
+        f"engine {engine_params_b * gib:.3f} GiB merged copy + "
+        f"{pool_b * gib:.3f} GiB pool ({n_pages} pages), teacher "
+        f"{teacher_b * gib:.3f} GiB -> total {total * gib:.3f} GiB"
+        + (f" vs budget {budget_bytes * gib:.3f} GiB"
+           if budget_bytes else ""))
+    if budget_bytes is not None and total > budget_bytes:
+        raise ValueError(
+            f"post-training colocation needs {total} bytes "
+            f"({total * gib:.2f} GiB: policy state "
+            f"{(params_b + opt_b + grad_b) * gib:.2f} + engine "
+            f"{(engine_params_b + pool_b) * gib:.2f} + teacher "
+            f"{teacher_b * gib:.2f}) but the budget is {budget_bytes} "
+            f"({budget_bytes * gib:.2f} GiB) — shrink the pool "
+            f"(n_slots/max_len/kv_dtype), use LoRA adapters "
+            f"(lora_only), or drop the co-resident teacher")
+    return report
+
+
 def run_preflight(trainer, *, global_batch: int, seq_length: int,
                   target_device: str | None = None) -> dict:
     """Lower the train step abstractly and report the per-device budget.

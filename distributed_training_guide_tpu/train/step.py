@@ -151,10 +151,29 @@ class Trainer:
     # hidden->loss kernel. Default off — the unscheduled GSPMD program is
     # the parity baseline
     overlap_schedule: bool = False
+    # LoRA-param-only optimizer path (models/lora.py): the bundle must be
+    # lora_bundle-wrapped; the optimizer is mask_optimizer-wrapped here so
+    # base updates are ZEROED and moments exist only for the adapter
+    # leaves — what makes post-training updates cheap enough that publish
+    # frequency is a knob (post/loop.py), and what any LoRA finetune wants
+    lora_only: bool = False
 
     def __post_init__(self):
         validate_guard_policy(self.guard_policy)
         self.precision = resolve_policy(self.precision)
+        if self.lora_only:
+            from ..models.lora import mask_optimizer
+
+            if getattr(self.bundle, "lora_base", None) is None:
+                raise ValueError(
+                    "lora_only=True needs a lora_bundle-wrapped bundle "
+                    "(models/lora.py) — this bundle has no adapters to "
+                    "restrict the optimizer to")
+            # masked BEFORE base_optimizer is captured: the checkpoint
+            # fallback layout and preflight baseline must price the
+            # masked (adapter-moments-only) state, not a phantom full
+            # set of base moments
+            self.optimizer = mask_optimizer(self.optimizer)
         # keep the unwrapped optimizer reachable: preflight prices the fp32
         # baseline with it, and checkpoint restore uses its (fp32) state
         # layout as the fallback target for pre-policy checkpoints
@@ -743,3 +762,210 @@ class Trainer:
         """Global tokens per optimizer step (reference's ``tok_per_step``,
         ``02:167`` — world_size*batch*seq; here data-parallel size*batch*seq)."""
         return self.plan.data_parallel_size * per_device_batch * seq_len * self.grad_accum
+
+
+# ---------------------------------------------------------------------------
+# post-training: masked ragged rollout objectives (post/loop.py's update step)
+# ---------------------------------------------------------------------------
+
+POST_OBJECTIVES = ("reinforce", "distill_kl")
+POST_BASELINES = ("batch", "group", "none")
+
+
+def _pack_ragged(values, prompt_lens, group_sizes, s):
+    """Pack per-token values of B ragged continuations into ONE [M, 1]
+    buffer in group order — the ``ops/grouped_matmul.py`` row layout.
+
+    ``values`` is [B, S] (a value per SOURCE position: the logits row
+    that predicts the next token); continuation g occupies packed rows
+    ``offs[g-1]:offs[g]``, reading source positions
+    ``prompt_lens[g]-1 .. prompt_lens[g]-1+group_sizes[g]-1``. Rows past
+    ``sum(group_sizes)`` are zeroed — exactly the tail contract
+    ``grouped_matmul`` guarantees zeros (and zero grads) for, so the
+    static worst-case packed width B*(S-1) carries no pad FLOPs into the
+    objective. Returns (packed [M, 1], group index per row [M], valid
+    mask [M])."""
+    b = values.shape[0]
+    m_pad = b * (s - 1)
+    offs = jnp.cumsum(group_sizes)
+    starts = offs - group_sizes
+    rows = jnp.arange(m_pad, dtype=group_sizes.dtype)
+    g = jnp.searchsorted(offs, rows, side="right").clip(0, b - 1)
+    j = rows - starts[g]
+    valid = rows < offs[-1]
+    src = jnp.clip(prompt_lens[g] - 1 + j, 0, s - 2)
+    packed = jnp.where(valid, values.reshape(-1)[g * s + src], 0.0)
+    return packed[:, None], g, valid
+
+
+def post_loss(logits, tokens, prompt_lens, total_lens, *,
+              objective: str = "reinforce", advantages=None,
+              teacher_logprobs=None, gmm_impl: str = "auto"):
+    """The one post-training loss seam: REINFORCE-with-baseline and
+    distillation-KL over RAGGED variable-length rollouts.
+
+    The masked-loss contract: ``tokens`` is [B, S] (prompt + sampled
+    continuation, zero-padded); position p carries gradient iff it is a
+    SAMPLED continuation token — source positions
+    ``prompt_lens[b]-1 <= p < total_lens[b]-1`` — so prompt tokens and
+    the pad tail contribute exactly zero loss AND zero gradient (pinned
+    in tests/test_post.py by differentiating w.r.t. the logits). The
+    ragged packing runs through ``ops/grouped_matmul.py``: per-token
+    values pack into one [M, 1] buffer with ``group_sizes`` = per-rollout
+    continuation lengths, and the per-sequence scalar (the REINFORCE
+    advantage, or the KL's 1/length normalizer) rides ``rhs`` [B, 1, 1] —
+    one grouped GEMM broadcasts it onto its ragged token block, with the
+    tail-rows-are-zero contract covering the pad.
+
+    - ``reinforce``: loss = -(1/B) sum_b adv_b * sum_t log pi(y_t | ...)
+      (advantages are data — stop-gradiented here; the baseline that
+      produced them lives in ``make_post_step``).
+    - ``distill_kl``: loss = (1/B) sum_b (1/|y_b|) sum_t
+      KL(teacher_t || student_t) with full-vocab teacher log-probs
+      aligned at source positions (``teacher_logprobs`` [B, S, V]) —
+      on-policy distillation over the student's own rollouts.
+
+    Returns (loss, extras) with static extras keys
+    (``post_tokens``, ``post_logprob_mean``)."""
+    from ..ops.grouped_matmul import grouped_matmul
+
+    if objective not in POST_OBJECTIVES:
+        raise ValueError(f"unknown post objective {objective!r}; choose "
+                         f"from {POST_OBJECTIVES}")
+    b, s, _ = logits.shape
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    group_sizes = (total_lens - prompt_lens).astype(jnp.int32)
+    # token logprob at source position p (predicting tokens[:, p+1]);
+    # the last column has no next token — padded zero, never packed
+    tok_lp = jnp.take_along_axis(logp[:, :-1], tokens[:, 1:, None],
+                                 axis=-1)[..., 0]
+    tok_lp = jnp.pad(tok_lp, ((0, 0), (0, 1)))
+    packed_lp, _, valid = _pack_ragged(tok_lp, prompt_lens, group_sizes, s)
+    n_tok = jnp.maximum(group_sizes.sum(), 1)
+    extras = {
+        "post_tokens": group_sizes.sum().astype(jnp.float32),
+        "post_logprob_mean": (packed_lp.sum() / n_tok).astype(jnp.float32),
+    }
+    if objective == "reinforce":
+        if advantages is None:
+            raise ValueError("objective='reinforce' needs advantages")
+        adv = jax.lax.stop_gradient(advantages.astype(jnp.float32))
+        out = grouped_matmul(packed_lp, adv[:, None, None], group_sizes,
+                             impl=gmm_impl)
+        return -out.sum() / b, extras
+    # distill_kl
+    if teacher_logprobs is None:
+        raise ValueError("objective='distill_kl' needs teacher_logprobs "
+                         "[B, S, V] aligned at source positions")
+    t_lp = jax.lax.stop_gradient(teacher_logprobs.astype(jnp.float32))
+    kl_tok = jnp.sum(jnp.exp(t_lp) * (t_lp - logp), axis=-1)  # [B, S]
+    packed_kl, _, _ = _pack_ragged(kl_tok, prompt_lens, group_sizes, s)
+    inv_len = 1.0 / jnp.maximum(group_sizes.astype(jnp.float32), 1.0)
+    out = grouped_matmul(packed_kl, inv_len[:, None, None], group_sizes,
+                         impl=gmm_impl)
+    return out.sum() / b, extras
+
+
+def make_post_step(trainer: Trainer, *, objective: str = "reinforce",
+                   baseline: str = "batch", gmm_impl: str = "auto"):
+    """Build the jitted POST-TRAINING step for a Trainer: one compiled
+    program consuming a packed rollout batch —
+
+        {"tokens" [B, S] int32, "prompt_lens" [B], "total_lens" [B],
+         "rewards" [B] fp32, "group_ids" [B] int32 (baseline='group'),
+         "teacher_logprobs" [B, S, V] fp32 (objective='distill_kl')}
+
+    — and returning ``(new_state, metrics)`` exactly like ``step_fn``:
+    same optimizer (LoRA-masked under ``lora_only``), same precision
+    policy, same in-jit guard detect+revert (``--guard-policy skip`` is
+    what lets a NaN update revert instead of poisoning the publishing
+    engine — post/loop.py gates the publish on the ``notfinite`` flag).
+
+    ``baseline``: "batch" subtracts the batch-mean reward; "group" is
+    the GRPO form (arXiv:2402.03300) — advantages are group-relative,
+    (r - mean_g) / (std_g + eps) over rollouts sharing a prompt
+    (``group_ids``); "none" uses raw rewards."""
+    if objective not in POST_OBJECTIVES:
+        raise ValueError(f"unknown post objective {objective!r}; choose "
+                         f"from {POST_OBJECTIVES}")
+    if baseline not in POST_BASELINES:
+        raise ValueError(f"unknown post baseline {baseline!r}; choose "
+                         f"from {POST_BASELINES}")
+    if trainer.plan.mesh.shape.get("pp", 1) > 1:
+        raise ValueError(
+            "post-training steps are not implemented under pipeline "
+            "parallelism (the hand-differentiated 1F1B schedule has no "
+            "ragged-objective form); use dp/fsdp/tp plans")
+    if callable(trainer.attn_impl):
+        raise ValueError(
+            "post-training steps do not support a user-supplied callable "
+            "attn_impl — silently substituting 'auto' would optimize a "
+            "different model function than the one generating the "
+            "rollouts; use a named attn_impl on the Trainer")
+    cfg = trainer.bundle.config
+    apply = trainer.bundle.apply
+    act_sharding = trainer.plan.activation_sharding()
+    from ..utils.faults import active_faults
+
+    nan_fault_step = active_faults().nan_loss_step
+
+    def advantages_of(batch):
+        rewards = batch["rewards"].astype(jnp.float32)
+        if baseline == "batch":
+            return rewards - rewards.mean()
+        if baseline == "group":
+            gids = batch["group_ids"]
+            b = rewards.shape[0]
+            onehot = (gids[:, None] == jnp.arange(b)[None, :]) \
+                .astype(jnp.float32)                       # [B, G<=B]
+            cnt = jnp.maximum(onehot.sum(axis=0), 1.0)
+            mean_g = (rewards @ onehot) / cnt
+            var_g = ((rewards ** 2) @ onehot) / cnt - mean_g ** 2
+            return ((rewards - mean_g[gids])
+                    / (jnp.sqrt(jnp.maximum(var_g[gids], 0.0)) + 1e-4))
+        return rewards
+
+    def post_step(state: TrainState, batch: dict):
+        adv = advantages_of(batch)
+
+        def loss_fn(params):
+            logits = apply(cfg, params, batch["tokens"],
+                           remat=trainer.remat,
+                           remat_policy=REMAT_POLICIES[trainer.remat_policy],
+                           attn_impl=trainer.attn_impl,
+                           activation_sharding=act_sharding)
+            return post_loss(
+                logits, batch["tokens"], batch["prompt_lens"],
+                batch["total_lens"], objective=objective, advantages=adv,
+                teacher_logprobs=batch.get("teacher_logprobs"),
+                gmm_impl=gmm_impl)
+
+        (loss, extras), grads = jax.value_and_grad(
+            loss_fn, has_aux=True)(state.params)
+        if nan_fault_step is not None:
+            loss = jnp.where(state.step == nan_fault_step, jnp.nan, loss)
+        updates, new_opt = trainer.optimizer.update(grads, state.opt_state,
+                                                    state.params)
+        new_params = optax.apply_updates(state.params, updates)
+        metrics = {
+            "loss": loss.astype(jnp.float32),
+            "grad_norm": optax.global_norm(grads).astype(jnp.float32),
+            "reward_mean": batch["rewards"].mean().astype(jnp.float32),
+            "advantage_std": adv.std().astype(jnp.float32),
+            **{k: v.astype(jnp.float32) for k, v in extras.items()},
+        }
+        new_state = TrainState(step=state.step + 1, params=new_params,
+                               opt_state=new_opt, rng=state.rng)
+        if trainer.guard_policy != "off":
+            new_state, metrics = apply_step_guard(
+                trainer.guard_policy, state, new_state, metrics)
+        return new_state, metrics
+
+    metric_keys = ("loss", "grad_norm", "reward_mean", "advantage_std",
+                   "post_tokens", "post_logprob_mean") + (
+        ("notfinite",) if trainer.guard_policy != "off" else ())
+    return jax.jit(
+        post_step,
+        out_shardings=(trainer._device_state_shardings,
+                       {k: trainer.plan.replicated() for k in metric_keys}),
+        donate_argnums=(0,) if trainer.donate else ())

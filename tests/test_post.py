@@ -1,0 +1,919 @@
+"""On-policy post-training runtime (post/): rollout → score → update →
+publish, end to end against the serve engine.
+
+The pins that make the loop trustworthy:
+
+- **publish is a weight swap, not a program change**: layout-validated
+  (loud failure naming the leaf), retrace-free (jit cache sizes flat
+  across publishes), and decode-after-publish is BITWISE a fresh engine
+  built from the published params.
+- **rollouts are reproducible**: same seed + same publish schedule ⇒
+  token-identical across engine restarts and spec-on/spec-off (the
+  engine's position-keyed sampling streams + exact acceptance).
+- **the ledger makes batches crash-recoverable**: an engine killed
+  mid-rollout-batch resumes without double-counting, and the resumed
+  samples are bitwise what the dead engine would have produced.
+- **a NaN update never reaches the engine**: the in-jit guard reverts
+  and the loop gates the publish on the ``notfinite`` flag.
+- **the masked ragged objective**: prompt tokens and pad carry exactly
+  zero gradient; the packed grouped-GEMM loss equals a dense reference.
+"""
+import dataclasses
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_training_guide_tpu.models import get_model
+from distributed_training_guide_tpu.models.lora import jit_merge, lora_bundle
+from distributed_training_guide_tpu.post import (PostTrainingLoop,
+                                                 ProgrammaticScorer,
+                                                 Rollout, RolloutLedger,
+                                                 TeacherScorer, band_reward,
+                                                 generate_rollouts,
+                                                 match_reward, merged_params,
+                                                 pack_rollouts, rollout_seed)
+from distributed_training_guide_tpu.serve.api import generate_many
+from distributed_training_guide_tpu.serve.elastic import new_generation
+from distributed_training_guide_tpu.serve.engine import (ModelPrograms,
+                                                         ServeEngine)
+from distributed_training_guide_tpu.serve.router import local_fleet
+from distributed_training_guide_tpu.serve.scheduler import Request
+from distributed_training_guide_tpu.train.optimizer import adamw_cosine
+from distributed_training_guide_tpu.train.step import (POST_BASELINES,
+                                                       POST_OBJECTIVES,
+                                                       Trainer,
+                                                       make_post_step,
+                                                       post_loss)
+
+pytestmark = [pytest.mark.serve, pytest.mark.post]
+
+
+# ---------------------------------------------------------------------------
+# fixtures
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def base():
+    return get_model("llama-debug", dtype=jnp.float32)
+
+
+@pytest.fixture(scope="module")
+def p0(base):
+    return base.init(base.config, jax.random.key(0))
+
+
+@pytest.fixture(scope="module")
+def engine0(base, p0):
+    """READ-ONLY shared engine: always serves ``p0`` — publish/mutation
+    tests use their own programs (``programs_mut``), never this one."""
+    return ServeEngine(base, p0, n_slots=4, page_size=16, max_len=64)
+
+
+@pytest.fixture(scope="module")
+def programs_mut(base, p0):
+    """The program cache the publish/elastic/router tests MUTATE — each
+    test publishes whatever weights it needs first."""
+    return ModelPrograms(base, p0)
+
+
+def _audit(eng):
+    """refcount == holders, free + held + cached == capacity (the
+    repo-wide pool invariant, re-pinned per loop iteration here)."""
+    sched, pool = eng.scheduler, eng.scheduler.pool
+    held: dict = {}
+    for slot in sched.slots:
+        if slot is None:
+            continue
+        assert 0 not in slot.pages, "trash page in a live table"
+        for p in slot.pages:
+            held[p] = held.get(p, 0) + 1
+    if sched.cache is not None:
+        stack = [sched.cache.root]
+        while stack:
+            node = stack.pop()
+            for child in node.children.values():
+                held[child.page] = held.get(child.page, 0) + 1
+                stack.append(child)
+    for p, n in held.items():
+        assert pool.refcount(p) == n, \
+            f"page {p}: {n} holders, refcount {pool.refcount(p)}"
+    assert pool.n_free + len(held) == pool.capacity, \
+        (pool.n_free, len(held), pool.capacity)
+
+
+def _auditing(engine):
+    """Wrap ``engine.step`` so every scheduler iteration re-checks the
+    pool invariants — the acceptance criterion's 'holding throughout'."""
+    orig = engine.step
+
+    def step():
+        out = orig()
+        _audit(engine)
+        return out
+
+    engine.step = step
+    return engine
+
+
+def _reqs(n=4, max_new=12, temp=0.7):
+    return [Request(prompt_ids=[3 + i, 17, 42], max_new_tokens=max_new,
+                    seed=100 + i, temperature=temp) for i in range(n)]
+
+
+# ---------------------------------------------------------------------------
+# units: seeds, ledger, packing
+# ---------------------------------------------------------------------------
+
+def test_rollout_seed_deterministic_and_distinct():
+    assert rollout_seed(0, 3, 5) == rollout_seed(0, 3, 5)
+    seeds = {rollout_seed(0, i, j) for i in range(20) for j in range(32)}
+    assert len(seeds) == 20 * 32          # no collisions in a real batch
+    assert rollout_seed(1, 3, 5) != rollout_seed(0, 3, 5)
+
+
+def test_ledger_roundtrip_skips_torn_line(tmp_path):
+    led = RolloutLedger(tmp_path / "led.jsonl")
+    for idx in range(3):
+        led.record(Rollout(iteration=2, index=idx, prompt_ids=[1, 2],
+                           generated_ids=[4, 5, idx], seed=idx,
+                           finish_reason="length"))
+    with open(led.path, "a") as fp:
+        fp.write('{"iteration": 2, "index": 99, "trunc')   # crash mid-write
+    done = led.completed(2)
+    assert sorted(done) == [0, 1, 2]      # torn line skipped, not fatal
+    assert done[1].generated_ids == [4, 5, 1]
+    assert led.completed(0) == {}
+    assert led.last_iteration() == 2
+
+
+def test_pack_rollouts_layout_and_validation():
+    r = [Rollout(iteration=0, index=i, prompt_ids=[7, 8],
+                 generated_ids=[10 + i] * (i + 1), seed=i,
+                 finish_reason="length", group_id=i // 2) for i in range(3)]
+    scores = [ProgrammaticScorer(lambda p, g: 0.5).score([x])[0] for x in r]
+    batch = pack_rollouts(r, scores, pad_to=8)
+    assert batch["tokens"].shape == (3, 8)
+    assert batch["tokens"][2, :5].tolist() == [7, 8, 12, 12, 12]
+    assert batch["tokens"][2, 5:].tolist() == [0, 0, 0]
+    assert batch["prompt_lens"].tolist() == [2, 2, 2]
+    assert batch["total_lens"].tolist() == [3, 4, 5]
+    assert batch["group_ids"].tolist() == [0, 0, 1]
+    with pytest.raises(ValueError, match="pad_to"):
+        pack_rollouts(r, scores, pad_to=4)
+    with pytest.raises(ValueError, match="vocab_size"):
+        pack_rollouts(r, scores, pad_to=8, with_teacher=True)
+    with pytest.raises(ValueError, match="teacher_logprobs"):
+        pack_rollouts(r, scores, pad_to=8, with_teacher=True, vocab_size=32)
+
+
+def test_pack_rollouts_teacher_rows_at_source_positions():
+    r = [Rollout(iteration=0, index=0, prompt_ids=[7, 8, 9],
+                 generated_ids=[1, 2], seed=0, finish_reason="length")]
+    rows = np.arange(2 * 16, dtype=np.float32).reshape(2, 16)
+    scores = [dataclasses.replace(
+        ProgrammaticScorer(lambda p, g: 0.0).score(r)[0],
+        teacher_logprobs=rows)]
+    batch = pack_rollouts(r, scores, pad_to=8, vocab_size=16,
+                          with_teacher=True)
+    # source position pl-1+j predicts generated token j
+    assert np.array_equal(batch["teacher_logprobs"][0, 2:4], rows)
+    assert not batch["teacher_logprobs"][0, 4:].any()
+    assert not batch["teacher_logprobs"][0, :2].any()
+
+
+# ---------------------------------------------------------------------------
+# the masked ragged objective
+# ---------------------------------------------------------------------------
+
+def _dense_reinforce(logits, tokens, pl, tl, adv):
+    logp = jax.nn.log_softmax(jnp.asarray(logits, jnp.float32), -1)
+    total = 0.0
+    for b in range(tokens.shape[0]):
+        for p in range(pl[b] - 1, tl[b] - 1):
+            total += adv[b] * logp[b, p, tokens[b, p + 1]]
+    return -total / tokens.shape[0]
+
+
+def test_post_loss_reinforce_matches_dense_reference():
+    rng = np.random.RandomState(0)
+    b, s, v = 3, 12, 32
+    logits = jnp.asarray(rng.randn(b, s, v), jnp.float32)
+    tokens = jnp.asarray(rng.randint(0, v, (b, s)), jnp.int32)
+    pl = jnp.asarray([3, 5, 2], jnp.int32)
+    tl = jnp.asarray([9, 6, 12], jnp.int32)
+    adv = jnp.asarray([0.5, -1.0, 2.0], jnp.float32)
+    loss, extras = post_loss(logits, tokens, pl, tl, advantages=adv)
+    ref = _dense_reinforce(np.asarray(logits), np.asarray(tokens),
+                           np.asarray(pl), np.asarray(tl), np.asarray(adv))
+    assert abs(float(loss) - float(ref)) < 1e-5
+    assert float(extras["post_tokens"]) == float((tl - pl).sum())
+
+
+@pytest.mark.parametrize("objective", POST_OBJECTIVES)
+def test_post_loss_masks_prompt_and_pad_gradients(objective):
+    """The masked-loss contract, pinned AT THE GRADIENT: only source
+    positions of sampled continuation tokens (pl-1 .. tl-2) carry
+    gradient; prompt rows and the pad tail are exactly zero."""
+    rng = np.random.RandomState(1)
+    b, s, v = 2, 10, 16
+    logits = jnp.asarray(rng.randn(b, s, v), jnp.float32)
+    tokens = jnp.asarray(rng.randint(0, v, (b, s)), jnp.int32)
+    pl = jnp.asarray([3, 4], jnp.int32)
+    tl = jnp.asarray([7, 10], jnp.int32)
+    kw = (dict(advantages=jnp.asarray([1.0, -0.5]))
+          if objective == "reinforce" else
+          dict(teacher_logprobs=jax.nn.log_softmax(
+              jnp.asarray(rng.randn(b, s, v), jnp.float32), -1)))
+    grads = jax.grad(lambda lg: post_loss(
+        lg, tokens, pl, tl, objective=objective, **kw)[0])(logits)
+    grads = np.asarray(grads)
+    for i in range(b):
+        live = slice(int(pl[i]) - 1, int(tl[i]) - 1)
+        assert np.abs(grads[i, live]).max() > 0
+        dead = np.concatenate([grads[i, :int(pl[i]) - 1],
+                               grads[i, int(tl[i]) - 1:]])
+        assert not dead.any(), f"seq {i}: prompt/pad rows carry gradient"
+
+
+def test_post_loss_validation():
+    logits = jnp.zeros((1, 4, 8))
+    tokens = jnp.zeros((1, 4), jnp.int32)
+    pl = jnp.asarray([1], jnp.int32)
+    tl = jnp.asarray([3], jnp.int32)
+    with pytest.raises(ValueError, match="unknown post objective"):
+        post_loss(logits, tokens, pl, tl, objective="ppo")
+    with pytest.raises(ValueError, match="needs advantages"):
+        post_loss(logits, tokens, pl, tl, objective="reinforce")
+    with pytest.raises(ValueError, match="needs teacher_logprobs"):
+        post_loss(logits, tokens, pl, tl, objective="distill_kl")
+
+
+def test_make_post_step_validation(base):
+    tr = Trainer(bundle=base, optimizer=adamw_cosine(1e-3))
+    with pytest.raises(ValueError, match="unknown post objective"):
+        make_post_step(tr, objective="dpo")
+    with pytest.raises(ValueError, match="unknown post baseline"):
+        make_post_step(tr, baseline="critic")
+    assert "group" in POST_BASELINES     # the GRPO form stays spellable
+    # a callable attn_impl must refuse, not silently swap to 'auto' —
+    # the update would optimize a different model function than the one
+    # generating the rollouts
+    tr_callable = Trainer(bundle=base, optimizer=adamw_cosine(1e-3),
+                          attn_impl=lambda *a, **k: None)
+    with pytest.raises(ValueError, match="callable"):
+        make_post_step(tr_callable)
+
+
+def test_lora_only_requires_lora_bundle(base):
+    with pytest.raises(ValueError, match="lora_bundle"):
+        Trainer(bundle=base, optimizer=adamw_cosine(1e-3), lora_only=True)
+
+
+def test_jit_merge_matches_base_layout(base, p0):
+    wrapped = lora_bundle(base, rank=4, alpha=8.0)
+    lp = wrapped.init(base.config, jax.random.key(1))
+    merged = jit_merge(wrapped)(lp)
+    assert (jax.tree_util.tree_structure(merged)
+            == jax.tree_util.tree_structure(p0))
+    for a, b in zip(jax.tree.leaves(merged), jax.tree.leaves(p0)):
+        assert a.shape == b.shape and a.dtype == b.dtype
+    with pytest.raises(ValueError, match="lora_bundle"):
+        jit_merge(base)
+
+
+# ---------------------------------------------------------------------------
+# publish_params: layout validation + the retrace-free bitwise pin
+# ---------------------------------------------------------------------------
+
+def test_publish_params_validates_layout(base, p0, programs_mut):
+    programs_mut.publish_params(p0)            # reset to known weights
+    flat, treedef = jax.tree_util.tree_flatten(p0)
+
+    bad_shape = jax.tree_util.tree_unflatten(
+        treedef, [jnp.zeros((3, 3), jnp.float32) if i == 0 else leaf
+                  for i, leaf in enumerate(flat)])
+    with pytest.raises(ValueError, match="shape"):
+        programs_mut.publish_params(bad_shape)
+
+    bad_dtype = jax.tree_util.tree_unflatten(
+        treedef, [leaf.astype(jnp.bfloat16) if i == 0 else leaf
+                  for i, leaf in enumerate(flat)])
+    with pytest.raises(ValueError, match="dtype"):
+        programs_mut.publish_params(bad_dtype)
+
+    with pytest.raises(ValueError, match="tree does not match"):
+        programs_mut.publish_params({"wrong": flat[0]})
+
+    # the error names the offending leaf so a stale-layout publish is
+    # debuggable from the message alone
+    try:
+        programs_mut.publish_params(bad_shape)
+    except ValueError as exc:
+        leaf_name = jax.tree_util.keystr(
+            jax.tree_util.tree_flatten_with_path(p0)[0][0][0])
+        assert leaf_name in str(exc)
+
+
+def test_publish_rejected_while_swap_in_flight(base, p0, programs_mut):
+    with programs_mut.swap_guard():
+        with pytest.raises(RuntimeError, match="swap"):
+            programs_mut.publish_params(p0)
+        with pytest.raises(RuntimeError, match="already in flight"):
+            programs_mut.swap_guard().__enter__()
+    programs_mut.publish_params(p0)            # released cleanly
+
+
+def test_publish_refused_with_inflight_work(base, p0, programs_mut):
+    eng = ServeEngine(base, p0, n_slots=2, page_size=16, max_len=64,
+                      programs=programs_mut)
+    eng.programs.publish_params(p0)
+    eng.submit(Request(prompt_ids=[3, 17, 42], max_new_tokens=4))
+    with pytest.raises(RuntimeError, match="in flight"):
+        eng.publish_params(p0)
+    eng.publish_params(p0, force=True)         # the caller's explicit out
+    while eng.has_work:
+        eng.step()
+    eng.publish_params(p0)                     # drained: allowed
+
+
+def test_publish_retrace_free_and_bitwise_vs_fresh_engine(base, p0,
+                                                          programs_mut):
+    """THE acceptance pin: a publish leaves every jit cache untouched,
+    and decode-after-publish is bitwise a fresh engine built from the
+    published params."""
+    programs_mut.publish_params(p0)
+    eng = ServeEngine(base, p0, n_slots=4, page_size=16, max_len=64,
+                      programs=programs_mut)
+    reqs = _reqs(4)
+    before = [r.generated_ids for r in generate_many(
+        eng, [dataclasses.replace(r, request_id=None) for r in reqs])]
+
+    sizes0 = eng.programs.jit_cache_sizes()
+    assert sizes0["decode"] >= 1
+    p1 = jax.tree.map(lambda x: x * 1.05, p0)
+    count = eng.publish_params(p1)
+    assert count == eng.programs.publish_count
+
+    after = [r.generated_ids for r in generate_many(
+        eng, [dataclasses.replace(r, request_id=None) for r in reqs])]
+    assert eng.programs.jit_cache_sizes() == sizes0, \
+        "a weight publish retraced a program"
+    assert after != before                    # the weights actually moved
+
+    fresh = ServeEngine(base, p1, n_slots=4, page_size=16, max_len=64)
+    ref = [r.generated_ids for r in generate_many(
+        fresh, [dataclasses.replace(r, request_id=None) for r in reqs])]
+    assert after == ref, \
+        "decode-after-publish diverged from a fresh engine on the " \
+        "published params"
+
+
+# ---------------------------------------------------------------------------
+# rollout reproducibility
+# ---------------------------------------------------------------------------
+
+PROMPTS = [[3 + i, 17, 42, 17, 42] for i in range(4)]
+
+
+def test_rollouts_reproducible_across_engine_restart(base, p0, engine0):
+    rolls_a, stats = generate_rollouts(
+        engine0, PROMPTS, iteration=3, base_seed=11, max_new_tokens=10,
+        temperature=0.8)
+    assert stats["rollout_tokens"] == sum(
+        len(r.generated_ids) for r in rolls_a)
+    # a RESTARTED engine: fresh programs, fresh pool, same weights
+    restarted = ServeEngine(base, p0, n_slots=4, page_size=16, max_len=64)
+    rolls_b, _ = generate_rollouts(
+        restarted, PROMPTS, iteration=3, base_seed=11, max_new_tokens=10,
+        temperature=0.8)
+    assert [r.generated_ids for r in rolls_a] \
+        == [r.generated_ids for r in rolls_b]
+    assert [r.seed for r in rolls_a] == [r.seed for r in rolls_b]
+    # a different iteration derives different seeds -> different samples
+    rolls_c, _ = generate_rollouts(
+        engine0, PROMPTS, iteration=4, base_seed=11, max_new_tokens=10,
+        temperature=0.8)
+    assert [r.generated_ids for r in rolls_a] \
+        != [r.generated_ids for r in rolls_c]
+
+
+def test_rollouts_identical_spec_on_vs_off(base, p0, engine0):
+    spec_eng = ServeEngine(base, p0, n_slots=4, page_size=16, max_len=64,
+                          programs=engine0.programs, speculate="ngram",
+                          spec_k=4)
+    kw = dict(iteration=5, base_seed=7, max_new_tokens=12, temperature=0.7)
+    plain, _ = generate_rollouts(engine0, PROMPTS, **kw)
+    spec, _ = generate_rollouts(spec_eng, PROMPTS, **kw)
+    assert [r.generated_ids for r in plain] \
+        == [r.generated_ids for r in spec]
+
+
+def test_chaos_engine_killed_mid_batch_resumes_from_ledger(
+        base, p0, engine0, tmp_path):
+    """The chaos drill: the engine dies mid-rollout-batch; a fresh
+    engine + the same ledger finish the batch with no double-counting,
+    bitwise identical to an uninterrupted run."""
+    kw = dict(iteration=7, base_seed=3, max_new_tokens=8, temperature=0.9)
+    golden, _ = generate_rollouts(engine0, PROMPTS, **kw)
+
+    ledger = RolloutLedger(tmp_path / "rollouts.jsonl")
+    doomed = ServeEngine(base, p0, n_slots=2, page_size=16, max_len=64,
+                         programs=engine0.programs)
+    orig = doomed.step
+    calls = {"n": 0}
+
+    def dying_step():
+        if calls["n"] >= 12:                  # mid-batch, some recorded
+            raise RuntimeError("engine killed")
+        calls["n"] += 1
+        return orig()
+
+    doomed.step = dying_step
+    with pytest.raises(RuntimeError, match="killed"):
+        generate_rollouts(doomed, PROMPTS, ledger=ledger, **kw)
+    recorded = ledger.completed(7)
+    assert 0 < len(recorded) < len(PROMPTS), \
+        "the drill must die MID-batch (tune the step budget)"
+
+    # fresh incarnation, same ledger: only the missing samples generate
+    revived = ServeEngine(base, p0, n_slots=2, page_size=16, max_len=64)
+    rolls, stats = generate_rollouts(revived, PROMPTS, ledger=ledger, **kw)
+    assert stats["resumed_from_ledger"] == len(recorded)
+    assert [r.generated_ids for r in rolls] \
+        == [r.generated_ids for r in golden]
+    # throughput counts only the tokens THIS incarnation generated —
+    # ledger-resumed samples at ~0 wall would otherwise report absurd
+    # tok/s into any bench mean
+    assert stats["rollout_tokens"] == sum(
+        len(golden[i].generated_ids) for i in range(len(PROMPTS))
+        if i not in recorded)
+    # no double-counting: exactly one ledger line per (iteration, index)
+    with open(ledger.path) as fp:
+        keys = [(d["iteration"], d["index"]) for d in map(json.loads, fp)]
+    assert sorted(keys) == sorted(set(keys))
+    assert len(keys) == len(PROMPTS)
+
+
+# ---------------------------------------------------------------------------
+# the end-to-end loop (the acceptance criterion)
+# ---------------------------------------------------------------------------
+
+def test_e2e_reinforce_loop_reward_improves(base):
+    """rollout → score → update → publish for 6 iterations on the dense
+    synthetic preference task: (a) reward measurably improves, (b) the
+    publish is retrace-free (jit caches flat), (c) decode-after-publish
+    is bitwise a fresh engine on the final params, (d) pool invariants
+    hold on every engine iteration throughout."""
+    trainer = Trainer(bundle=base, optimizer=adamw_cosine(0.1),
+                      guard_policy="skip")
+    state = trainer.init_state(0)
+    engine = _auditing(ServeEngine(base, merged_params(trainer, state),
+                                   n_slots=8, page_size=16, max_len=64))
+    prompts = [[3, 10, 17] for _ in range(24)]
+    loop = PostTrainingLoop(
+        trainer, engine, ProgrammaticScorer(band_reward(64)), prompts,
+        state=state, max_new_tokens=16, temperature=1.0, base_seed=0)
+    first = loop.run_iteration()
+    sizes0 = engine.programs.jit_cache_sizes()   # everything warmed
+    hist = loop.run(4)
+
+    rewards = [first["reward_mean"]] + [m["reward_mean"] for m in hist]
+    assert rewards[-1] > rewards[0] + 0.2, \
+        f"reward did not improve: {rewards}"
+    assert loop.publishes == 5
+    assert engine.programs.jit_cache_sizes() == sizes0, \
+        "a publish retraced a program mid-loop"
+    assert all(m["publish_ms"] >= 0 and m["published"] for m in hist)
+    assert all(np.isfinite(m["loss"]) for m in hist)
+
+    # (c) the engine after 6 publishes IS a fresh engine on the params
+    final = merged_params(trainer, loop.state)
+    reqs = _reqs(3, max_new=8)
+    got = [r.generated_ids for r in generate_many(
+        engine, [dataclasses.replace(r, request_id=None) for r in reqs])]
+    fresh = ServeEngine(base, final, n_slots=8, page_size=16, max_len=64)
+    ref = [r.generated_ids for r in generate_many(
+        fresh, [dataclasses.replace(r, request_id=None) for r in reqs])]
+    assert got == ref
+
+
+def test_e2e_distill_lora_loop(base):
+    """The LoRA + distillation leg: adapter-only updates (base params
+    bitwise frozen), merged publish through ONE compiled merge, and the
+    KL objective actually descending on the student's own rollouts."""
+    teacher_params = base.init(base.config, jax.random.key(7))
+    bundle = lora_bundle(base, rank=8, alpha=16.0,
+                         targets=("wq", "wv", "down"))
+    trainer = Trainer(bundle=bundle, optimizer=adamw_cosine(0.1),
+                      lora_only=True, guard_policy="skip")
+    state = trainer.init_state(0)
+    base_before = jax.tree.map(np.asarray, state.params["base"])
+    engine = _auditing(ServeEngine(base, merged_params(trainer, state),
+                                   n_slots=8, page_size=16, max_len=64))
+    prompts = [[3 + (g * 7 + j) % 200 for j in range(3)] for g in range(12)]
+    loop = PostTrainingLoop(
+        trainer, engine, TeacherScorer(base, teacher_params), prompts,
+        state=state, objective="distill_kl", max_new_tokens=10,
+        temperature=1.0, base_seed=0)
+    hist = loop.run(4)
+
+    losses = [m["loss"] for m in hist]
+    assert losses[-1] < losses[0], f"KL not descending: {losses}"
+    assert loop.publishes == 4
+    # lora_only: the masked optimizer zeroes every base update
+    for a, b in zip(jax.tree.leaves(base_before),
+                    jax.tree.leaves(loop.state.params["base"])):
+        assert np.array_equal(a, np.asarray(b)), \
+            "lora_only let a base parameter move"
+    # and the adapters did move
+    deltas = [float(jnp.abs(x).max())
+              for x in jax.tree.leaves(loop.state.params["lora"])]
+    assert max(deltas) > 0
+
+
+def test_distill_objective_requires_teacher_scorer(base, p0, engine0):
+    tr = Trainer(bundle=base, optimizer=adamw_cosine(1e-3))
+    with pytest.raises(ValueError, match="TeacherScorer"):
+        PostTrainingLoop(tr, engine0,
+                         ProgrammaticScorer(match_reward(3)), PROMPTS,
+                         state=tr.init_state(0), objective="distill_kl")
+
+
+def test_nan_update_gates_publish(base, monkeypatch):
+    """A NaN update must not poison the publishing engine: the in-jit
+    guard reverts the state and the loop skips that publish — the engine
+    keeps serving the last good policy."""
+    monkeypatch.setenv("DTG_FAULT_NAN_LOSS_STEP", "1")
+    trainer = Trainer(bundle=base, optimizer=adamw_cosine(0.05),
+                      guard_policy="skip")
+    state = trainer.init_state(0)
+    engine = ServeEngine(base, merged_params(trainer, state),
+                         n_slots=4, page_size=16, max_len=64)
+    loop = PostTrainingLoop(
+        trainer, engine, ProgrammaticScorer(band_reward(64)),
+        [[3, 10, 17]] * 2, state=state, max_new_tokens=6,
+        temperature=1.0, base_seed=0)
+    m0 = loop.run_iteration()                 # step 0 -> fine, publishes
+    count_before = engine.programs.publish_count
+    m1 = loop.run_iteration()                 # step 1 -> NaN loss
+    m2 = loop.run_iteration()                 # recovered
+    assert m0["published"] and not m0["publish_skipped_nonfinite"]
+    assert m1["publish_skipped_nonfinite"] and not m1["published"]
+    assert engine.programs.publish_count == count_before + 1  # only m2's
+    assert m2["published"] and np.isfinite(m2["loss"])
+    assert loop.publishes_skipped == 1
+    # the guard reverted: post-NaN params are finite end to end
+    assert all(bool(jnp.isfinite(x).all())
+               for x in jax.tree.leaves(loop.state.params))
+
+
+# ---------------------------------------------------------------------------
+# elastic + router: the published-params path
+# ---------------------------------------------------------------------------
+
+def test_new_generation_rejected_override_leaves_weights_unpublished(
+        base, p0, programs_mut):
+    """Validation failures must precede the publish: a rejected baked
+    override (or a failed construction) leaves the old generation still
+    serving the OLD weights — publishing first would hand its in-flight
+    sequences new weights with no replay."""
+    programs_mut.publish_params(p0)
+    old = ServeEngine(base, p0, n_slots=2, page_size=16, max_len=64,
+                      programs=programs_mut)
+    count = programs_mut.publish_count
+    p1 = jax.tree.map(lambda x: x * 1.01, p0)
+    with pytest.raises(ValueError, match="kv_dtype"):
+        new_generation(old, params=p1, kv_dtype="int8")
+    assert programs_mut.publish_count == count, \
+        "a rejected swap published anyway"
+
+
+def test_new_generation_publishes_params(base, p0, programs_mut):
+    """Weight-publish and capacity swap in ONE call: new_generation
+    (params=) publishes into the shared programs — retrace-free — and
+    the new generation decodes exactly like a fresh engine on the
+    published weights."""
+    programs_mut.publish_params(p0)
+    old = ServeEngine(base, p0, n_slots=2, page_size=16, max_len=64,
+                      programs=programs_mut)
+    generate_many(old, [Request(prompt_ids=[3, 17, 42], max_new_tokens=4)])
+    sizes0 = programs_mut.jit_cache_sizes()
+    p1 = jax.tree.map(lambda x: x * 0.97, p0)
+    count = programs_mut.publish_count
+    new = new_generation(old, params=p1, n_slots=4)
+    assert programs_mut.publish_count == count + 1
+    assert programs_mut.jit_cache_sizes() == sizes0
+
+    reqs = _reqs(2, max_new=8)
+    got = [r.generated_ids for r in generate_many(
+        new, [dataclasses.replace(r, request_id=None) for r in reqs])]
+    fresh = ServeEngine(base, p1, n_slots=4, page_size=16, max_len=64)
+    ref = [r.generated_ids for r in generate_many(
+        fresh, [dataclasses.replace(r, request_id=None) for r in reqs])]
+    assert got == ref
+
+
+def test_swap_with_params_replays_under_new_weights(
+        base, p0, programs_mut):
+    """A swap that also publishes forces the replay seat — pinned on
+    the TWO-CALL form (new_generation + swap_generation, no explicit
+    force flag): the published-params stamp must make the seat replay
+    on its own. Every carried sequence keeps its already-emitted tokens
+    VERBATIM (replay), then continues under the published weights; pool
+    invariants hold on the new generation."""
+    from distributed_training_guide_tpu.serve.elastic import \
+        swap_generation
+
+    programs_mut.publish_params(p0)
+    old = ServeEngine(base, p0, n_slots=4, page_size=16, max_len=64,
+                      programs=programs_mut)
+    reqs = _reqs(4, max_new=16)
+    ids = [old.submit(dataclasses.replace(r, request_id=None))
+           for r in reqs]
+    done: dict = {}
+    for _ in range(6):                        # emit some tokens pre-swap
+        for res in old.step():
+            done[res.request_id] = res
+    pre = {rid: list(toks) for rid, toks in old.partial_tokens().items()}
+    assert any(pre.values())
+
+    p1 = jax.tree.map(lambda x: x * 1.03, p0)
+    new = new_generation(old, params=p1, n_slots=4)
+    # the publish already landed: stepping the OLD engine before the
+    # swap would decode old-policy k/v under the new weights — refused
+    with pytest.raises(RuntimeError, match="swap"):
+        old.step()
+    evicted, stats = swap_generation(old, new)
+    assert not evicted
+    assert stats["seated"] == 0               # payload seat disabled:
+    assert stats["requeued"] > 0              # old-policy k/v not reused
+    new = _auditing(new)
+    while new.has_work:
+        for res in new.step():
+            done[res.request_id] = res
+    for rid in ids:
+        if rid in pre and pre[rid]:
+            assert done[rid].generated_ids[:len(pre[rid])] == pre[rid], \
+                "a replayed sequence rewrote its emitted tokens"
+    # old generation drained empty
+    assert old.scheduler.pool.n_free == old.scheduler.pool.capacity
+
+
+def test_disagg_publish_updates_both_engines_atomically(base, p0):
+    """The disagg pair shares ONE ModelPrograms — a publish updates the
+    prefill and decode sides together, with the same in-flight refusal."""
+    from distributed_training_guide_tpu.serve.disagg import DisaggEngine
+
+    eng = DisaggEngine(base, p0, n_slots=2, page_size=16, max_len=64)
+    eng.submit(Request(prompt_ids=[3, 17, 42], max_new_tokens=4))
+    with pytest.raises(RuntimeError, match="in-flight"):
+        eng.publish_params(p0)
+    while eng.has_work:
+        eng.step()
+    p1 = jax.tree.map(lambda x: x * 1.04, p0)
+    eng.publish_params(p1)
+    assert eng.prefill.programs is eng.decode.programs is eng.programs
+    got = [r.generated_ids for r in generate_many(
+        eng, [dataclasses.replace(r, request_id=None)
+              for r in _reqs(2, max_new=6)])]
+    fresh = ServeEngine(base, p1, n_slots=2, page_size=16, max_len=64)
+    ref = [r.generated_ids for r in generate_many(
+        fresh, [dataclasses.replace(r, request_id=None)
+                for r in _reqs(2, max_new=6)])]
+    assert got == ref
+
+
+def test_model_scorer_tracks_published_params(base, p0, programs_mut):
+    """A scorer pointed at a live engine's programs scores with the
+    CURRENT weights — a publish must not leave it scoring (and pinning
+    in memory) the superseded policy."""
+    from distributed_training_guide_tpu.post import RewardModelScorer
+
+    programs_mut.publish_params(p0)
+    rolls = [Rollout(iteration=0, index=0, prompt_ids=[3, 17, 42],
+                     generated_ids=[5, 9, 11], seed=0,
+                     finish_reason="length")]
+    live = RewardModelScorer(programs_mut)
+    before = live.score(rolls)[0].reward
+    p1 = jax.tree.map(lambda x: x * 1.1, p0)
+    programs_mut.publish_params(p1)
+    after = live.score(rolls)[0].reward
+    assert after != before
+    static = RewardModelScorer(base, p1)
+    assert abs(after - static.score(rolls)[0].reward) < 1e-6
+
+
+def test_loop_run_zero_iterations_returns_empty(base, p0, engine0):
+    tr = Trainer(bundle=base, optimizer=adamw_cosine(1e-3))
+    loop = PostTrainingLoop(tr, engine0,
+                            ProgrammaticScorer(band_reward(8)), PROMPTS,
+                            state=tr.init_state(0), frozen=True)
+    loop.history = [{"stale": True}]         # prior history must not leak
+    assert loop.run(0) == []
+
+
+def test_disagg_swap_with_params_publishes(base, p0):
+    """The disagg branch of new_generation must publish too — a fleet
+    of disagg replicas swapping with params= previously built the new
+    pair and SKIPPED the publish (old policy kept serving while the
+    loop believed the update landed)."""
+    from distributed_training_guide_tpu.serve.disagg import DisaggEngine
+    from distributed_training_guide_tpu.serve.elastic import \
+        swap_generation
+
+    eng = DisaggEngine(base, p0, n_slots=2, page_size=16, max_len=64)
+    generate_many(eng, [Request(prompt_ids=[3, 17, 42], max_new_tokens=2)])
+    p1 = jax.tree.map(lambda x: x * 1.06, p0)
+    count = eng.programs.publish_count
+    new = new_generation(eng, params=p1, n_slots=2)
+    assert eng.programs.publish_count == count + 1
+    with pytest.raises(RuntimeError, match="swap"):
+        eng.step()
+    evicted, _ = swap_generation(eng, new)
+    assert not evicted
+    got = [r.generated_ids for r in generate_many(
+        new, [dataclasses.replace(r, request_id=None)
+              for r in _reqs(2, max_new=6)])]
+    fresh = ServeEngine(base, p1, n_slots=2, page_size=16, max_len=64)
+    ref = [r.generated_ids for r in generate_many(
+        fresh, [dataclasses.replace(r, request_id=None)
+                for r in _reqs(2, max_new=6)])]
+    assert got == ref
+
+
+def test_group_baseline_requires_real_groups(base, p0, engine0):
+    """baseline='group' with singleton groups (the default
+    group_id=index) is all-zero advantages — the loop must refuse, not
+    train nothing while looking busy."""
+    tr = Trainer(bundle=base, optimizer=adamw_cosine(1e-3))
+    with pytest.raises(ValueError, match="group"):
+        PostTrainingLoop(tr, engine0,
+                         ProgrammaticScorer(band_reward(8)), PROMPTS,
+                         state=tr.init_state(0), baseline="group")
+    with pytest.raises(ValueError, match="group"):
+        PostTrainingLoop(tr, engine0,
+                         ProgrammaticScorer(band_reward(8)), PROMPTS,
+                         state=tr.init_state(0), baseline="group",
+                         group_ids=list(range(len(PROMPTS))))
+
+
+def test_skipped_boundary_publish_stays_due(base, monkeypatch):
+    """publish_every > 1: a NaN landing ON the publish boundary must
+    not double the staleness window — the publish stays due and the
+    next finite step delivers it."""
+    monkeypatch.setenv("DTG_FAULT_NAN_LOSS_STEP", "1")
+    trainer = Trainer(bundle=base, optimizer=adamw_cosine(0.05),
+                      guard_policy="skip")
+    state = trainer.init_state(0)
+    engine = ServeEngine(base, merged_params(trainer, state),
+                         n_slots=4, page_size=16, max_len=64)
+    loop = PostTrainingLoop(
+        trainer, engine, ProgrammaticScorer(band_reward(64)),
+        [[3, 10, 17]] * 2, state=state, max_new_tokens=6,
+        temperature=1.0, base_seed=0, publish_every=2)
+    m0 = loop.run_iteration()                 # not a boundary: no publish
+    m1 = loop.run_iteration()                 # boundary + NaN: due, skipped
+    m2 = loop.run_iteration()                 # off-boundary: delivers it
+    assert not m0["published"] and not m0["publish_skipped_nonfinite"]
+    assert m1["publish_skipped_nonfinite"] and not m1["published"]
+    assert m2["published"]
+    assert loop.publishes == 1 and loop.publishes_skipped == 1
+
+
+def test_router_fleet_publish_and_swap(base, p0):
+    fleet = local_fleet(base, p0, 2, n_slots=2, page_size=16, max_len=64)
+    p1 = jax.tree.map(lambda x: x * 1.02, p0)
+    # all-or-nothing: one busy replica refuses the WHOLE publish before
+    # any cache mutates (a partial publish = fleet on mixed weights =
+    # fence-recovery replays under different params)
+    busy = next(iter(fleet.replicas.values()))
+    busy.engine.submit(Request(prompt_ids=[3, 17, 42], max_new_tokens=2))
+    count0 = busy.engine.programs.publish_count
+    with pytest.raises(RuntimeError, match="mixed weights"):
+        fleet.publish_params(p1)
+    assert busy.engine.programs.publish_count == count0
+    assert fleet.counters["param_publishes"] == 0
+    while busy.engine.has_work:
+        busy.engine.step()
+    # shared programs -> ONE cache updated, counted once
+    assert fleet.publish_params(p1) == 1
+    assert fleet.counters["param_publishes"] == 1
+    reqs = _reqs(2, max_new=6)
+    got = [r.generated_ids for r in generate_many(
+        fleet, [dataclasses.replace(r, request_id=None) for r in reqs])]
+    fresh = ServeEngine(base, p1, n_slots=2, page_size=16, max_len=64)
+    ref = [r.generated_ids for r in generate_many(
+        fresh, [dataclasses.replace(r, request_id=None) for r in reqs])]
+    assert got == ref, "fleet decode-after-publish diverged"
+
+    # publish-and-resize through swap_replica rides the same seam
+    name = next(iter(fleet.replicas))
+    p2 = jax.tree.map(lambda x: x * 0.99, p1)
+    fleet.swap_replica(name, params=p2, n_slots=4)
+    assert fleet.counters["generation_swaps"] == 1
+    assert fleet.counters["param_publishes"] == 2
+    with pytest.raises(ValueError, match="no replica"):
+        fleet.publish_params(p1, name="ghost")
+
+
+# ---------------------------------------------------------------------------
+# preflight colocation pricing + engine config + CLI
+# ---------------------------------------------------------------------------
+
+def test_price_post_colocation_and_budget_refusal(base):
+    from distributed_training_guide_tpu.train.preflight import \
+        price_post_colocation
+
+    full = Trainer(bundle=base, optimizer=adamw_cosine(1e-3))
+    lora = Trainer(bundle=lora_bundle(base, rank=4),
+                   optimizer=adamw_cosine(1e-3), lora_only=True)
+    rf = price_post_colocation(full, n_slots=4, max_len=64)
+    rl = price_post_colocation(lora, n_slots=4, max_len=64)
+    for key in ("policy_param_bytes", "policy_opt_state_bytes",
+                "engine_param_bytes", "engine_pool_bytes", "total_bytes"):
+        assert rf[key] > 0
+    # the LoRA promise, priced: adapter-only moments are far smaller
+    assert rl["policy_opt_state_bytes"] < rf["policy_opt_state_bytes"] / 10
+    assert rl["lora_only"] and not rf["lora_only"]
+    # an impossible colocation refuses BEFORE any compile
+    with pytest.raises(ValueError, match="budget"):
+        price_post_colocation(full, n_slots=4, max_len=64, budget_bytes=1)
+    ok = price_post_colocation(full, n_slots=4, max_len=64,
+                               budget_bytes=rf["total_bytes"] + 1)
+    assert ok["total_bytes"] == rf["total_bytes"]
+
+
+def test_training_engine_lora_config(base):
+    from distributed_training_guide_tpu.train.engine import TrainingEngine
+
+    eng = TrainingEngine({"model": "llama-debug",
+                          "lora": {"rank": 4, "alpha": 8.0,
+                                   "targets": ["wq", "wv"]}})
+    assert eng.trainer.lora_only
+    assert getattr(eng.trainer.bundle, "lora_base", None) is not None
+
+
+def test_post_cli_smoke(tmp_path, capsys):
+    from distributed_training_guide_tpu.post.cli import main
+
+    rc = main(["--iterations", "1", "--rollout-batch", "2",
+               "--max-new-tokens", "4", "--prompt-len", "3",
+               "--lora-rank", "0", "--lr", "0.05", "--n-slots", "2",
+               "--ledger", str(tmp_path / "led.jsonl")])
+    assert rc == 0
+    lines = [json.loads(x) for x in
+             capsys.readouterr().out.strip().splitlines()]
+    assert lines[0]["colocation_total_bytes"] > 0
+    assert len(lines) == 2                     # header + 1 iteration
+    for m in lines[1:]:
+        assert m["published"] and np.isfinite(m["loss"])
+        assert m["rollout_tokens"] > 0
+
+
+def test_post_cli_budget_refusal():
+    from distributed_training_guide_tpu.post.cli import main
+
+    with pytest.raises(ValueError, match="budget"):
+        main(["--iterations", "1", "--memory-budget-gb", "0.000001"])
+    # non-divisible grouping refuses up front instead of silently
+    # shrinking the rollout batch
+    with pytest.raises(SystemExit, match="divisible"):
+        main(["--rollout-batch", "8", "--group-size", "3"])
+    # GRPO with singleton groups = all-zero advantages = trains nothing
+    with pytest.raises(SystemExit, match="group-size"):
+        main(["--baseline", "group"])
+
+
+# ---------------------------------------------------------------------------
+# >= 2-device grid (slow per the tier-1 budget policy)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+def test_publish_into_tp_sharded_engine(base, p0, eight_devices):
+    """The sharded publish path: params placed by the plan's shardings,
+    published leaves land on the SAME shardings (device_put conform) —
+    decode-after-publish bitwise a fresh sharded engine."""
+    from distributed_training_guide_tpu.parallel import make_mesh, make_plan
+
+    plan = make_plan("tp", make_mesh(tp=2, devices=eight_devices[:2]))
+    eng = ServeEngine(base, p0, n_slots=2, page_size=16, max_len=64,
+                      plan=plan)
+    reqs = _reqs(2, max_new=6)
+    generate_many(eng, [dataclasses.replace(r, request_id=None)
+                        for r in reqs])
+    sizes0 = eng.programs.jit_cache_sizes()
+    p1 = jax.tree.map(lambda x: x * 1.01, p0)
+    eng.publish_params(p1)
+    got = [r.generated_ids for r in generate_many(
+        eng, [dataclasses.replace(r, request_id=None) for r in reqs])]
+    assert eng.programs.jit_cache_sizes() == sizes0
+    for leaf in jax.tree.leaves(eng.programs.params):
+        assert len(leaf.sharding.device_set) in (1, 2)
+    fresh = ServeEngine(base, p1, n_slots=2, page_size=16, max_len=64,
+                        plan=plan)
+    ref = [r.generated_ids for r in generate_many(
+        fresh, [dataclasses.replace(r, request_id=None) for r in reqs])]
+    assert got == ref
