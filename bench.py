@@ -1278,6 +1278,130 @@ def run_post_check(only: str = None) -> None:
     _emit(out)
 
 
+def run_load_check(only: str = None) -> None:
+    """Open-loop load rungs (serve/loadgen.py + serve/controller.py):
+    the first serve numbers measured under traffic the engine does NOT
+    control — arrivals on a wall-clock schedule, goodput (deadline-met
+    completions/s, the DistServe metric) instead of raw tok/s.
+
+    - load_saturation: the saturation curve on one llama-debug engine —
+      Poisson arrivals at climbing rates, goodput + p50/p99 TTFT/ITL
+      tails per point. The knee where goodput stops following offered
+      load is the engine's capacity, a number a closed-loop bench
+      structurally cannot produce.
+    - load_controller_ab: the SAME seeded burst trace (steady Poisson
+      base + a packed flash crowd) through a STATIC 1-replica fleet
+      (the in-rung control) and an identical fleet under the SLO
+      controller allowed to scale to 2 replicas — the controller is the
+      only variable. The static arm's small admission queue refuses the
+      burst overflow; the controller arm absorbs it by scaling up, so
+      its goodput must match or beat the control on the identical
+      trace. Records both arms, the win, and the measured cold start.
+    """
+    _configure_jax_cache()
+    import dataclasses
+
+    import jax
+    import jax.numpy as jnp
+
+    from distributed_training_guide_tpu.models import get_model
+    from distributed_training_guide_tpu.serve.controller import (Controller,
+                                                                 SLO)
+    from distributed_training_guide_tpu.serve.engine import (ModelPrograms,
+                                                             ServeEngine)
+    from distributed_training_guide_tpu.serve.loadgen import (
+        build_schedule, default_scenarios, poisson_arrivals, run_open_loop,
+        saturation_sweep, trace_arrivals)
+    from distributed_training_guide_tpu.serve.router import Replica, Router
+
+    rungs = (set(only.split(",")) if only
+             else {"load_saturation", "load_controller_ab"})
+    bundle = get_model("llama-debug", dtype=jnp.float32)
+    params = bundle.init(bundle.config, jax.random.key(0))
+    vocab = int(bundle.config.vocab_size)
+    # ONE ModelPrograms for every engine in both rungs (and for the
+    # controller's spawn_like clones): the programs compile once, so the
+    # rungs price scheduling + control, not jit
+    programs = ModelPrograms(bundle, params)
+    kw = dict(n_slots=2, page_size=4, max_len=32)
+    scenarios = default_scenarios(max_len=32, page_size=4, vocab=vocab,
+                                  deadline_s=2.0, seed=0)
+    out = {"metric": "load", "model": "llama-debug", "value": 0.0}
+
+    if "load_saturation" in rungs:
+        sweep = saturation_sweep(
+            lambda: ServeEngine(bundle, params, programs=programs,
+                                max_queue=16, **kw),
+            [1.0, 4.0, 16.0], duration_s=4.0, scenarios=scenarios,
+            vocab=vocab, seed=0, max_wall_s=60.0)
+        knee = max(sweep, key=lambda p: p["goodput_rps"])
+        out["load_saturation"] = {
+            "points": [{k: p[k] for k in (
+                "rate_rps", "offered", "completed", "refused",
+                "deadline_missed", "goodput_rps", "offered_rps",
+                "ttft_p50_s", "ttft_p99_s", "itl_p50_s", "itl_p99_s",
+                "refusal_rate", "wall_s", "timed_out")} for p in sweep],
+            "peak_goodput_rps": knee["goodput_rps"],
+            "peak_at_rate_rps": knee["rate_rps"],
+        }
+        out["value"] = knee["goodput_rps"]
+        _emit({**out, "partial": True})
+
+    if "load_controller_ab" in rungs:
+        # one deterministic burst trace, replayed against both arms: a
+        # 2 rps base over 8 s with ~24 extra arrivals packed into the
+        # third second — the flash crowd a static small-queue fleet
+        # must refuse and an elastic one can absorb
+        base = poisson_arrivals(2.0, 8.0, seed=0)
+        burst = [2.0 + t for t in poisson_arrivals(24.0, 1.0, seed=1)]
+        trace = trace_arrivals(base + burst)
+        schedule = build_schedule(trace, scenarios, vocab=vocab, seed=0)
+
+        def arm(managed: bool) -> dict:
+            engine = ServeEngine(bundle, params, programs=programs,
+                                 max_queue=4, **kw)
+            router = Router([Replica("r0", engine)])
+            controller = None
+            if managed:
+                controller = Controller(
+                    router, slo=SLO(queue_high=2.0), min_replicas=1,
+                    max_replicas=2, hold_up=2, hold_down=10_000,
+                    cooldown_s=0.25)
+            # fresh Request copies per arm: engines stamp request_id
+            sched = [(t, dataclasses.replace(r, request_id=None))
+                     for t, r in schedule]
+            report = run_open_loop(router, sched, controller=controller,
+                                   max_wall_s=90.0)
+            res = {k: getattr(report, k) for k in (
+                "goodput_rps", "offered", "completed", "refused",
+                "deadline_missed", "resubmit_exhausted", "ttft_p50_s",
+                "ttft_p99_s", "itl_p99_s", "refusal_rate", "wall_s",
+                "timed_out")}
+            res["final_replicas"] = len(router.replicas)
+            if controller is not None:
+                cs = controller.stats()
+                res["controller"] = {k: cs[k] for k in (
+                    "state", "observations", "stale_snapshots",
+                    "scale_up", "scale_down", "spawn_failed", "shed_on",
+                    "backpressure_on")}
+                res["cold_start_s"] = [round(c, 4)
+                                       for c in cs["cold_start_s"]]
+            router.close()
+            return res
+
+        static = arm(managed=False)
+        managed = arm(managed=True)
+        out["load_controller_ab"] = {
+            "trace_arrivals": len(trace),
+            "static": static,
+            "controller": managed,
+            "goodput_win_rps": round(
+                managed["goodput_rps"] - static["goodput_rps"], 3),
+        }
+        out["value"] = managed["goodput_rps"]
+    _emit(out)
+
+
 # ---------------------------------------------------------------------------
 # parent: ladder orchestration (never touches the TPU itself)
 # ---------------------------------------------------------------------------
@@ -1474,6 +1598,15 @@ SWEEP_QUEUE = [
     # design: the loop is host-driven scheduling + debug-size compute;
     # the TPU story is the trainer/engine rungs it composes.
     dict(name="post_loop_cpu", post_rungs="post_loop_cpu"),
+    # --- open-loop load harness + SLO control plane (serve/loadgen.py +
+    # serve/controller.py, PR 16). load_saturation = the goodput-vs-
+    # offered-rate curve on one llama-debug engine (the capacity knee a
+    # closed-loop bench cannot see). load_controller_ab = one seeded
+    # burst trace through a static 1-replica fleet (in-rung control) vs
+    # the SLO controller allowed to scale to 2 — the controller is the
+    # only variable and must match or beat the static arm's goodput.
+    dict(name="load_saturation", load_rungs="load_saturation"),
+    dict(name="load_controller_ab", load_rungs="load_controller_ab"),
     # LAST on purpose: fence_every=4 dispatches 4 steps ahead, the exact
     # pattern this pool's documented failure mode punishes — its first
     # attempt (2026-07-31 03:50) stalled and the pool went down with it.
@@ -1701,6 +1834,7 @@ def run_sweep(watchdog: int) -> None:
             metric = ("decode_tput" if exp.get("decode_rungs")
                       else "elastic" if exp.get("elastic_rungs")
                       else "post_loop" if exp.get("post_rungs")
+                      else "load" if exp.get("load_rungs")
                       else "mfu")
             if exp.get("decode_rungs"):
                 child_args = ["--check-decode",
@@ -1711,6 +1845,9 @@ def run_sweep(watchdog: int) -> None:
             elif exp.get("post_rungs"):
                 child_args = ["--check-post",
                               "--post-rungs", exp["post_rungs"]]
+            elif exp.get("load_rungs"):
+                child_args = ["--check-load",
+                              "--load-rungs", exp["load_rungs"]]
             else:
                 spec = {k: v for k, v in exp.items() if k != "name"}
                 spec.setdefault("steps", 10)
@@ -1875,6 +2012,8 @@ def main() -> None:
     parser.add_argument("--elastic-rungs", default=None, help=argparse.SUPPRESS)
     parser.add_argument("--check-post", action="store_true", help=argparse.SUPPRESS)
     parser.add_argument("--post-rungs", default=None, help=argparse.SUPPRESS)
+    parser.add_argument("--check-load", action="store_true", help=argparse.SUPPRESS)
+    parser.add_argument("--load-rungs", default=None, help=argparse.SUPPRESS)
     args = parser.parse_args()
     if args.remat is False and args.remat_policy:
         parser.error("--no-remat contradicts --remat-policy "
@@ -1892,6 +2031,8 @@ def main() -> None:
         return run_elastic_check(args.elastic_rungs)
     if args.check_post:
         return run_post_check(args.post_rungs)
+    if args.check_load:
+        return run_load_check(args.load_rungs)
     if args.sweep:
         return run_sweep(args.watchdog)
 

@@ -27,7 +27,13 @@ ack/commit wire whose only failure outcome is drop-free-requeue), now
 ELASTIC at runtime (``serve/elastic.py`` — live engine-generation swaps:
 grow/shrink ``n_slots``/page pool as a coordinated mass preemption that
 seats or bitwise-replays every in-flight request; the router's replica
-set is mutable via ``add_replica``/``remove_replica``/``swap_replica``).
+set is mutable via ``add_replica``/``remove_replica``/``swap_replica``),
+with an OPEN-LOOP LOAD HARNESS (``serve/loadgen.py`` — Poisson/trace
+arrivals over mixed scenario profiles, goodput + p50/p99 TTFT/ITL
+tails, saturation sweeps) and an SLO-DRIVEN CONTROL PLANE
+(``serve/controller.py`` — polls the lock-free stats snapshots and
+actuates the elastic seams with hysteresis, cooldowns, drain-before-
+remove scale-down, and an explicit degradation ladder).
 See related-topics/serving/README.md.
 
     from distributed_training_guide_tpu.serve import (
@@ -39,12 +45,16 @@ from .scheduler import (PrefixCache, RefusalError, Request, RequestResult,
                         Scheduler)
 
 __all__ = [
-    "DisaggEngine", "Drafter", "DraftModelDrafter", "ModelPrograms",
-    "NgramDrafter", "PagePool", "PrefixCache", "RefusalError", "Replica",
-    "Request", "RequestResult", "Router", "Scheduler", "ServeEngine",
-    "generate_many", "kv_page_bytes", "local_fleet",
-    "match_partition_rules", "new_generation", "pages_for_tokens",
-    "prefix_affinity_key", "serve_http", "swap_engine", "swap_generation",
+    "Controller", "DisaggEngine", "Drafter", "DraftModelDrafter",
+    "LoadReport", "ModelPrograms", "NgramDrafter", "PagePool",
+    "PrefixCache", "RefusalError", "Replica", "Request", "RequestResult",
+    "Router", "SLO", "Scenario", "Scheduler", "ServeEngine",
+    "build_schedule", "default_scenarios", "generate_many",
+    "kv_page_bytes", "local_fleet", "match_partition_rules",
+    "new_generation", "pages_for_tokens", "poisson_arrivals",
+    "prefix_affinity_key", "run_open_loop", "saturation_sweep",
+    "serve_http", "spawn_like", "swap_engine", "swap_generation",
+    "trace_arrivals",
 ]
 
 
@@ -73,8 +83,19 @@ def __getattr__(name):
         from .sharding import match_partition_rules
 
         return match_partition_rules
-    if name in ("new_generation", "swap_engine", "swap_generation"):
+    if name in ("new_generation", "spawn_like", "swap_engine",
+                "swap_generation"):
         from . import elastic
 
         return getattr(elastic, name)
+    if name in ("LoadReport", "Scenario", "build_schedule",
+                "default_scenarios", "poisson_arrivals", "run_open_loop",
+                "saturation_sweep", "trace_arrivals"):
+        from . import loadgen
+
+        return getattr(loadgen, name)
+    if name in ("Controller", "SLO"):
+        from . import controller
+
+        return getattr(controller, name)
     raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -82,11 +82,21 @@ def throughput_stats(results: list[RequestResult],
     lat = sorted(r.latency_s for r in results)
     ttft = sorted(r.ttft_s for r in results if r.first_token_at)
     es = engine.stats()
+    # goodput (DistServe's serving metric — serve/loadgen.py owns the
+    # open-loop harness around it): completions that met their deadline
+    # per wall second. A completed request met its deadline by
+    # construction — past-deadline work is evicted at every iteration
+    # boundary with finish_reason="deadline", never finished.
+    met = sum(1 for r in results if r.finish_reason in ("eos", "length"))
     return {
         "n_requests": len(results),
         "generated_tokens": gen,
         "wall_s": round(wall_s, 4),
         "tokens_per_s": round(gen / wall_s, 2) if wall_s else 0.0,
+        "goodput_rps": round(met / wall_s, 3) if wall_s else 0.0,
+        "deadline_met": met,
+        "deadline_missed_queued": es.get("deadline_missed_queued", 0),
+        "deadline_missed_running": es.get("deadline_missed_running", 0),
         "decode_steps": engine.decode_steps,
         # slot occupancy of the decode program: 1.0 = every lane of every
         # step carried a live request (continuous batching's win over

@@ -595,6 +595,10 @@ class DisaggEngine:
                                    decode_sched, self.handoff,
                                    drafter=drafter)
         self._lat = LatencyMeter()
+        # see ServeEngine: per-iteration staleness sequence + the parked
+        # drafter for the controller's spec on/off toggle
+        self.stats_seq = 0
+        self._parked_drafter = None
 
     # ---- the ServeEngine driving surface -----------------------------------
     def submit(self, request: Request) -> int:
@@ -624,16 +628,20 @@ class DisaggEngine:
         return sched.submit(request)
 
     def resubmit(self, request: Request, generated=(), *,
-                 first_token_at: float = 0.0) -> int:
+                 first_token_at: float = 0.0,
+                 submitted_at: Optional[float] = None) -> int:
         """Router fence recovery: re-admit a request that already ran on
         a dead/wedged replica, with its recorded tokens replaying through
-        the decode program (see Scheduler.requeue)."""
+        the decode program (see Scheduler.requeue). ``submitted_at`` is
+        the FIRST client submit time — deadline/TTFT accounting must not
+        restart at each hop (see ServeEngine.resubmit)."""
         if self.draining:
             self.prefill.sched.refuse(
                 "draining", "engine is draining: not accepting resubmits",
                 http_status=503)
         return self.prefill.sched.requeue(request, generated,
-                                          first_token_at=first_token_at)
+                                          first_token_at=first_token_at,
+                                          submitted_at=submitted_at)
 
     def drain(self) -> None:
         """Stop admitting; in-flight work (queued, prefilling, in
@@ -641,6 +649,22 @@ class DisaggEngine:
         the graceful half of shutdown. The router reads ``draining``
         from stats() and stops routing here."""
         self.draining = True
+
+    def set_speculation(self, on: bool) -> bool:
+        """Toggle the DECODE side's drafter at an iteration boundary —
+        identical contract to ``ServeEngine.set_speculation`` (spec-on ==
+        spec-off identity makes the mid-stream toggle legal; no-op when
+        built without ``speculate``). Returns whether spec is on."""
+        dec = self.decode
+        if on and dec.drafter is None and self._parked_drafter is not None:
+            dec.drafter = self._parked_drafter
+            self._parked_drafter = None
+            dec._dev = None
+        elif not on and dec.drafter is not None:
+            self._parked_drafter = dec.drafter
+            dec.drafter = None
+            dec._dev = None
+        return dec.drafter is not None
 
     def publish_params(self, new_params, *, force: bool = False) -> int:
         """Publish refreshed weights into the SHARED program cache (both
@@ -695,6 +719,10 @@ class DisaggEngine:
             self.handoff.pending.remove(h)
             self.pool.free(h.pages)
             self.prefill.sched.stats["deadline_expired"] += 1
+            # in-transit counts as a running eviction: the sequence had
+            # already been admitted and prefilled — this is decode-rate /
+            # handoff latency, not an admission bottleneck
+            self.prefill.sched.stats["deadline_missed_running"] += 1
             results.append(RequestResult(
                 request_id=h.request.request_id,
                 prompt_ids=list(h.request.prompt_ids),
@@ -715,6 +743,7 @@ class DisaggEngine:
                 "policy into this pair's shared programs — stepping it "
                 "before swap_generation would decode old-policy k/v "
                 "under the new weights; run the swap first")
+        self.stats_seq += 1
         finished = self.prefill.step()
         finished.extend(self._expire_in_transit())
         decoded, preempted = self.decode.step()
@@ -749,15 +778,22 @@ class DisaggEngine:
         # admission counters stay prefill-side (the decode scheduler's
         # adopt() is a handoff, not a new admission)
         for k in ("preempted", "deadline_expired", "cache_evicted_pages",
-                  "finished", "spec_lookahead_clamped"):
+                  "finished", "spec_lookahead_clamped",
+                  "deadline_missed_queued", "deadline_missed_running"):
             s[k] = p.stats[k] + d.stats[k]
+        depths = p.queue_depth_by_priority()
+        for prio, n in d.queue_depth_by_priority().items():
+            depths[prio] = depths.get(prio, 0) + n
         cross = self.transport == "cross_host"
         out = {
             **s,
+            "stats_seq": self.stats_seq,
+            "preemptions": s.get("preempted", 0),
             "draining": self.draining,
             "transport": self.transport,
             "max_queue": p.max_queue,
             "queued": len(p.queue),
+            "queue_depth_by_priority": depths,
             "handoff_pending": len(self.handoff),
             "prefilling_slots": len(p.prefilling_indices()),
             "active_slots": len(d.active_indices()),

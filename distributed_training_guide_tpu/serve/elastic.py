@@ -439,6 +439,44 @@ def _swap_generation_locked(old, new, force_replay: bool):
     return results, stats
 
 
+def spawn_like(router, *, name: Optional[str] = None,
+               source: Optional[str] = None,
+               heartbeat_path: Optional[str] = None, **overrides):
+    """Build a NEW replica cloned from a live replica's serving config —
+    the scale-UP half of fleet elasticity, and the control plane's
+    default spawn factory. The clone shares the source's compiled
+    ``ModelPrograms`` (one params layout, one jit cache — the same
+    precondition generation swaps and fence-recovery replay stand on),
+    carries its serving knobs through ``new_generation``, and gets a
+    fresh pool/scheduler; ``overrides`` turn individual knobs.
+
+    Returns the Replica WITHOUT adding it to the router: the caller
+    times cold-start (construction here -> ``readiness()`` true) and
+    then calls ``router.add_replica`` — serve/controller.py records
+    exactly that window per scale-up. ``name`` defaults to the first
+    free ``rN``; ``source`` picks which live replica to clone (the
+    first live one otherwise)."""
+    from .router import Replica
+
+    if source is not None:
+        src = router.replicas.get(source)
+        if src is None or src.state != "live":
+            raise ValueError(f"source replica {source!r} is not live")
+    else:
+        src = next((r for r in router.replicas.values()
+                    if r.state == "live"), None)
+        if src is None:
+            raise ValueError("no live replica to clone a spawn from")
+    if name is None:
+        i = 0
+        while f"r{i}" in router.replicas:
+            i += 1
+        name = f"r{i}"
+    engine = new_generation(src.engine, **overrides)
+    return Replica(name, engine, heartbeat_path=heartbeat_path,
+                   clock=router.clock)
+
+
 def swap_engine(old, *, params=None, **overrides):
     """The one-call form: build the next generation with ``overrides``
     (``new_generation``), run the swap, and return ``(new_engine,

@@ -1070,6 +1070,16 @@ class ServeEngine:
         self.decode_steps = 0
         self.decode_tokens = 0
         self._lat = LatencyMeter()
+        # monotone per-ITERATION sequence number surfaced in stats(): a
+        # poller seeing the same value twice knows the snapshot is stale
+        # (the engine has not iterated between reads), which is how the
+        # control plane distinguishes "idle but alive" from "wedged"
+        # without trusting the snapshot's own timestamps
+        self.stats_seq = 0
+        # set_speculation(False) parks the drafter here so a later
+        # set_speculation(True) restores the SAME drafter (spec-on ==
+        # spec-off identity is what makes the mid-stream toggle legal)
+        self._parked_drafter = None
 
     # ---- delegation (kept public: tests/bench lower these directly) --------
     @property
@@ -1095,19 +1105,28 @@ class ServeEngine:
         return self.scheduler.submit(request)
 
     def resubmit(self, request: Request, generated=(), *,
-                 first_token_at: float = 0.0) -> int:
+                 first_token_at: float = 0.0,
+                 submitted_at: Optional[float] = None) -> int:
         """Router fence recovery: re-admit a request that already ran on
         a dead/wedged replica. The prompt re-prefills and the recorded
         ``generated`` tokens REPLAY through the decode program — the
         replicas share params, so position-keyed sampling makes the
         continuation token-identical to the uninterrupted run (the same
-        bitwise-recompute rule preemption already owns)."""
+        bitwise-recompute rule preemption already owns).
+
+        ``submitted_at`` is the FIRST client submit time: without it the
+        scheduler restamps its own clock at requeue, and every TTFT or
+        deadline measured afterwards silently forgets the time the
+        request already spent queued, running, and bouncing between
+        replicas — a resubmitted request would get a fresh deadline per
+        hop."""
         if self.draining:
             self.scheduler.refuse(
                 "draining", "engine is draining: not accepting resubmits",
                 http_status=503)
         return self.scheduler.requeue(request, generated,
-                                      first_token_at=first_token_at)
+                                      first_token_at=first_token_at,
+                                      submitted_at=submitted_at)
 
     def drain(self) -> None:
         """Stop admitting; in-flight work runs to completion through
@@ -1116,6 +1135,30 @@ class ServeEngine:
         unroutable; the HTTP worker keeps stepping until pending futures
         empty (api.py ``_EngineWorker.stop(drain=True)``)."""
         self.draining = True
+
+    def set_speculation(self, on: bool) -> bool:
+        """Turn speculative decoding on/off at an iteration boundary —
+        the controller's load actuation. Drafting spends extra compute
+        per iteration to shorten per-request latency; under a saturated
+        batch that compute is better spent on the batch itself, so the
+        control plane parks the drafter at high load and restores it
+        when traffic thins. Legal mid-stream BECAUSE spec-on == spec-off
+        is a token-identity invariant (the verifier only ever accepts
+        what the plain path would have sampled); in-flight sequences
+        continue bitwise across the toggle. No-op (returns False) when
+        the engine was built without a drafter. The admission margin
+        (``spec_lookahead``) stays at the drafter's k even while parked
+        — conservative, and it means re-enabling never over-admits.
+        Returns whether speculation is on after the call."""
+        if on and self.drafter is None and self._parked_drafter is not None:
+            self.drafter = self._parked_drafter
+            self._parked_drafter = None
+            self._dev = None
+        elif not on and self.drafter is not None:
+            self._parked_drafter = self.drafter
+            self.drafter = None
+            self._dev = None
+        return self.drafter is not None
 
     def publish_params(self, new_params, *, force: bool = False) -> int:
         """Publish refreshed weights into the shared program cache
@@ -1186,6 +1229,7 @@ class ServeEngine:
                 "under the new weights and the replay would preserve the "
                 "mixed-policy tokens; run the swap (or build the new "
                 "generation without params=)")
+        self.stats_seq += 1
         finished = []
         sched = self.scheduler
         expired = sched.expire_deadlines()
@@ -1255,9 +1299,12 @@ class ServeEngine:
              for k, v in sched.stats.items()}
         return {
             **s,
+            "stats_seq": self.stats_seq,
+            "preemptions": s.get("preempted", 0),
             "draining": self.draining,
             "max_queue": sched.max_queue,
             "queued": len(sched.queue),
+            "queue_depth_by_priority": sched.queue_depth_by_priority(),
             "active_slots": len(sched.active_indices()),
             "prefilling_slots": len(sched.prefilling_indices()),
             **derived_pool_metrics(

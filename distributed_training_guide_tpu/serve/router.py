@@ -174,6 +174,12 @@ class Replica:
     def step(self) -> list[RequestResult]:
         if self.state != "live" or self.wedged:
             return []
+        # the gray-failure drill: a targeted replica keeps stepping and
+        # beating, but every iteration drags — nothing here fences it,
+        # only load-aware routing and the control plane's SLO loop see it
+        drag = faults.replica_slow(self.name)
+        if drag > 0 and self.engine.has_work:
+            time.sleep(drag)
         finished = self.engine.step() if self.engine.has_work else []
         self.steps += 1
         self._beat_at = self.clock()
@@ -275,6 +281,15 @@ class Router:
                          "resubmit_exhausted": 0, "replicas_added": 0,
                          "replicas_removed": 0, "generation_swaps": 0,
                          "param_publishes": 0, "refused": {}}
+        # the control plane's degradation-ladder knobs (serve/controller
+        # sets them; anything may): ``min_priority`` sheds submits below
+        # that class with a 429 before routing even starts, and
+        # ``retry_after_floor_s`` raises every backpressure refusal's
+        # retry hint so clients back off harder under sustained overload.
+        # Both act only on NEW admissions — in-flight sequences are never
+        # touched (refuse, never corrupt).
+        self.min_priority: Optional[int] = None
+        self.retry_after_floor_s: float = 0.0
 
     # ---- routing -----------------------------------------------------------
     def _routable(self, now: float, exclude=()) -> list[Replica]:
@@ -314,9 +329,14 @@ class Router:
         for i, replica in enumerate(candidates[:self.max_route_attempts]):
             try:
                 if record.generated or record.resubmits:
+                    # thread the ORIGINAL client submit time through: the
+                    # engine-side scheduler would otherwise restamp its
+                    # clock at requeue, and TTFT/deadline accounting
+                    # would restart on every fence/spillover hop
                     erid = replica.engine.resubmit(
                         record.request, record.generated,
-                        first_token_at=record.first_token_at)
+                        first_token_at=record.first_token_at,
+                        submitted_at=record.submitted_at)
                 else:
                     erid = replica.engine.submit(record.request)
             except RefusalError as exc:
@@ -336,10 +356,35 @@ class Router:
             if i > 0:
                 self.counters["spillovers"] += 1
             return
+        if self.retry_after_floor_s and (
+                last_429.retry_after_s is None
+                or last_429.retry_after_s < self.retry_after_floor_s):
+            # ladder rung 2 (tighten admission): every propagated
+            # backpressure hint is at least the controller's floor
+            last_429 = RefusalError(
+                last_429.reason, str(last_429),
+                http_status=last_429.http_status,
+                detail={**last_429.detail,
+                        "retry_after_s": self.retry_after_floor_s})
         raise last_429
 
     def submit(self, request: Request) -> int:
         now = self.clock()
+        if self.min_priority is not None \
+                and request.priority < self.min_priority:
+            # ladder rung 1 (shed): lowest-priority classes refuse at the
+            # front door under sustained overload — a structured 429 with
+            # a retry hint, never an admitted request later corrupted
+            self.counters["refused"]["shed_low_priority"] = \
+                self.counters["refused"].get("shed_low_priority", 0) + 1
+            raise RefusalError(
+                "shed_low_priority",
+                f"fleet is shedding priority < {self.min_priority} under "
+                f"sustained overload; retry later",
+                http_status=429,
+                detail={"queue_depth": len(self._backlog),
+                        "retry_after_s": max(self.retry_after_floor_s,
+                                             self.resubmit_backoff_s)})
         record = _RouteRecord(rid=next(self._ids), request=request,
                               submitted_at=now)
         self._place(record, now)
@@ -662,9 +707,11 @@ class Router:
                 if record.generated}
 
     _SUM_KEYS = (
-        "admitted", "finished", "preempted", "admission_blocked",
-        "prefix_hits", "prefix_tokens_shared", "cow_forks",
-        "cache_evicted_pages", "deadline_expired", "spec_lookahead_clamped",
+        "admitted", "finished", "preempted", "preemptions",
+        "admission_blocked", "prefix_hits", "prefix_tokens_shared",
+        "cow_forks", "cache_evicted_pages", "deadline_expired",
+        "deadline_missed_queued", "deadline_missed_running",
+        "spec_lookahead_clamped",
         "queued", "active_slots", "prefilling_slots", "pages_capacity",
         "pages_free", "pages_held", "pages_cached", "decode_steps",
         "decode_tokens", "spec_steps", "spec_tokens_drafted",
@@ -678,6 +725,7 @@ class Router:
         from the sums, not averaged."""
         per, agg = {}, {k: 0 for k in self._SUM_KEYS}
         refused: dict = {}
+        depths: dict = {}
         now = self.clock()
         for name, replica in self.replicas.items():
             s = replica.engine.stats() if replica.state != "dead" else {}
@@ -685,11 +733,14 @@ class Router:
                 agg[k] += s.get(k, 0)
             for reason, n in s.get("refused", {}).items():
                 refused[reason] = refused.get(reason, 0) + n
+            for prio, n in s.get("queue_depth_by_priority", {}).items():
+                depths[prio] = depths.get(prio, 0) + n
             per[name] = {
                 "state": replica.state,
                 "wedged": replica.wedged,
                 "draining": replica.draining,
                 "heartbeat_age_s": round(replica.heartbeat_age(now), 4),
+                "stats_seq": s.get("stats_seq", 0),
                 "queued": s.get("queued", 0),
                 "active_slots": s.get("active_slots", 0),
                 "pool_occupancy": s.get("pool_occupancy", 0.0),
@@ -703,6 +754,14 @@ class Router:
             **agg,
             "refused": refused,
             "router": True,
+            # the router's own iteration count doubles as the fleet-level
+            # staleness sequence: a poller seeing it unchanged knows
+            # NOBODY is driving the fleet (per-replica seqs are itemized
+            # under "replicas" for per-engine wedge detection)
+            "stats_seq": self.step_count,
+            "queue_depth_by_priority": depths,
+            "min_priority": self.min_priority,
+            "retry_after_floor_s": self.retry_after_floor_s,
             "n_replicas": len(self.replicas),
             "live_replicas": sum(1 for r in self.replicas.values()
                                  if r.state == "live"),

@@ -359,6 +359,13 @@ class Scheduler:
                       "preempted": 0, "prefix_hits": 0,
                       "prefix_tokens_shared": 0, "cow_forks": 0,
                       "cache_evicted_pages": 0, "deadline_expired": 0,
+                      # deadline_expired split BY REASON — a controller
+                      # reads these very differently: queued expiry means
+                      # admission is the bottleneck (scale up / shed),
+                      # running eviction means deadlines are too tight
+                      # for the decode rate itself
+                      "deadline_missed_queued": 0,
+                      "deadline_missed_running": 0,
                       "spec_lookahead_clamped": 0, "refused": {}}
 
     # ---- refusals / queue order --------------------------------------------
@@ -380,6 +387,17 @@ class Scheduler:
         with whoever holds a LatencyMeter."""
         occupancy = len(self.active_indices()) / self.n_slots
         return round(0.05 * (1 + len(self.queue)) * (1 + occupancy), 3)
+
+    def queue_depth_by_priority(self) -> dict[int, int]:
+        """Queued entries per priority class (higher = more urgent).
+        A flat queue depth hides WHO is waiting: the controller's shed
+        ladder needs to see low-priority work backing up separately from
+        interactive traffic before it refuses anybody."""
+        depths: dict[int, int] = {}
+        for entry in self.queue:
+            p = int(entry.request.priority)
+            depths[p] = depths.get(p, 0) + 1
+        return depths
 
     def requeue_entry(self, entry: _QueueEntry, submitted_at: float) -> None:
         """Re-enter an EXISTING entry (its request_id and submit time
@@ -765,8 +783,9 @@ class Scheduler:
     # ---- deadlines ---------------------------------------------------------
     def _deadline_result(self, req: Request, generated: list,
                          admitted_at: float, first_token_at: float,
-                         now: float) -> RequestResult:
+                         now: float, where: str = "queued") -> RequestResult:
         self.stats["deadline_expired"] += 1
+        self.stats[f"deadline_missed_{where}"] += 1
         return RequestResult(
             request_id=req.request_id, prompt_ids=list(req.prompt_ids),
             generated_ids=list(generated), finish_reason="deadline",
@@ -795,14 +814,14 @@ class Scheduler:
             self.queue.remove(entry)
             results.append(self._deadline_result(
                 entry.request, entry.generated, now, entry.first_token_at,
-                now))
+                now, where="queued"))
         for i, slot in enumerate(self.slots):
             if slot is not None and expired(slot.request):
                 self.pool.free(slot.pages)
                 self.slots[i] = None
                 results.append(self._deadline_result(
                     slot.request, slot.generated, slot.admitted_at,
-                    slot.first_token_at, now))
+                    slot.first_token_at, now, where="running"))
         return results
 
     # ---- page handoff (disaggregated serving seam) -------------------------

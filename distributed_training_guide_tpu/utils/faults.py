@@ -39,6 +39,16 @@ executable documentation):
   the replica stops stepping AND stops heartbeating (a stuck device op),
   while its process would still answer liveness; only the heartbeat-age
   fence catches it.
+- ``DTG_FAULT_ARRIVAL_BURST=<mult>@<start>:<end>``: traffic-shape fault
+  for the open-loop load harness — the arrival rate is multiplied by
+  ``mult`` for offsets in ``[start, end)`` seconds from the start of the
+  trace (``serve/loadgen.py`` consumes it when building Poisson
+  schedules). A flash crowd on demand, deterministic per seed.
+- ``DTG_FAULT_REPLICA_SLOW=<name>@<delay_s>``: the gray-failure case the
+  kill/wedge drills cannot produce — replica ``name`` keeps stepping and
+  heartbeating but every iteration is inflated by ``delay_s`` seconds (a
+  thermally throttled chip, a noisy co-tenant). Nothing fences it; only
+  load-aware routing and the controller's SLO loop notice.
 
 Elastic-fleet faults (the renegotiation and generation-swap drills —
 ``launch/elastic.py`` members and ``serve/elastic.py`` swaps consume
@@ -79,6 +89,8 @@ ENV_HANDOFF_CRASH_XFER = "DTG_FAULT_HANDOFF_CRASH_XFER"
 ENV_HANDOFF_TIMEOUT_XFER = "DTG_FAULT_HANDOFF_TIMEOUT_XFER"
 ENV_REPLICA_KILL = "DTG_FAULT_REPLICA_KILL"
 ENV_REPLICA_WEDGE = "DTG_FAULT_REPLICA_WEDGE"
+ENV_ARRIVAL_BURST = "DTG_FAULT_ARRIVAL_BURST"
+ENV_REPLICA_SLOW = "DTG_FAULT_REPLICA_SLOW"
 ENV_SLICE_LOSS = "DTG_FAULT_SLICE_LOSS"
 ENV_SWAP_DROP_SEQ = "DTG_FAULT_SWAP_DROP_SEQ"
 
@@ -110,6 +122,35 @@ def _env_target(name: str) -> Optional[tuple[str, int]]:
         return None
 
 
+def _env_burst(name: str) -> Optional[tuple[float, float, float]]:
+    """Parse a ``<mult>@<start>:<end>`` arrival-burst window."""
+    raw = os.environ.get(name)
+    if not raw:
+        return None
+    mult, _, window = raw.partition("@")
+    start, _, end = window.partition(":")
+    try:
+        return (float(mult), float(start), float(end))
+    except ValueError:
+        LOGGER.warning("ignoring malformed %s=%r (want <mult>@<start>:<end>)",
+                       name, raw)
+        return None
+
+
+def _env_slow(name: str) -> Optional[tuple[str, float]]:
+    """Parse a ``<replica_name>@<delay_s>`` slow-replica target."""
+    raw = os.environ.get(name)
+    if not raw:
+        return None
+    target, _, delay = raw.partition("@")
+    try:
+        return (target, float(delay))
+    except ValueError:
+        LOGGER.warning("ignoring malformed %s=%r (want <name>@<delay_s>)",
+                       name, raw)
+        return None
+
+
 @dataclasses.dataclass(frozen=True)
 class FaultSpec:
     crash_step: Optional[int] = None
@@ -121,6 +162,8 @@ class FaultSpec:
     handoff_timeout_xfer: Optional[int] = None
     replica_kill: Optional[tuple[str, int]] = None    # (name, router step)
     replica_wedge: Optional[tuple[str, int]] = None
+    arrival_burst: Optional[tuple[float, float, float]] = None  # (mult, t0, t1)
+    replica_slow: Optional[tuple[str, float]] = None  # (name, delay seconds)
     slice_loss: Optional[tuple[str, int]] = None      # (member, beat count)
     swap_drop_seq: Optional[int] = None               # resident index in swap
 
@@ -142,6 +185,8 @@ def active_faults() -> FaultSpec:
         handoff_timeout_xfer=_env_int(ENV_HANDOFF_TIMEOUT_XFER),
         replica_kill=_env_target(ENV_REPLICA_KILL),
         replica_wedge=_env_target(ENV_REPLICA_WEDGE),
+        arrival_burst=_env_burst(ENV_ARRIVAL_BURST),
+        replica_slow=_env_slow(ENV_REPLICA_SLOW),
         slice_loss=_env_target(ENV_SLICE_LOSS),
         swap_drop_seq=_env_int(ENV_SWAP_DROP_SEQ),
     )
@@ -173,6 +218,29 @@ def replica_fault(name: str, step: int) -> Optional[str]:
     if spec.replica_wedge is not None and spec.replica_wedge == (name, step):
         return "wedge"
     return None
+
+
+def arrival_burst(offset_s: float) -> float:
+    """The arrival-rate multiplier at trace offset ``offset_s`` seconds —
+    1.0 outside the injected burst window, ``mult`` inside it. The load
+    generator folds this into its Poisson gap draws, so the burst is as
+    deterministic as the schedule's seed."""
+    spec = active_faults()
+    if spec.arrival_burst is None:
+        return 1.0
+    mult, start, end = spec.arrival_burst
+    return mult if start <= offset_s < end else 1.0
+
+
+def replica_slow(name: str) -> float:
+    """Injected per-iteration latency inflation (seconds) for replica
+    ``name`` — 0.0 unless the slow-replica fault targets it. Unlike
+    kill/wedge this is not a one-shot event: the drag applies to every
+    iteration while the env var is set (gray failure, not death)."""
+    spec = active_faults()
+    if spec.replica_slow is not None and spec.replica_slow[0] == name:
+        return max(0.0, spec.replica_slow[1])
+    return 0.0
 
 
 def slice_fault(member: str, beat: int) -> bool:

@@ -84,3 +84,15 @@ def pytest_terminal_summary(terminalreporter, exitstatus, config):
     for rep in slowest:
         terminalreporter.write_line(
             f"  {rep.duration:7.2f}s  {rep.nodeid}")
+    # newest test families itemized (they are the budget's marginal cost:
+    # an older family's creep already shows in the slowest-12 list)
+    families = {}
+    for rep in reports:
+        for fam in ("loadgen", "control"):
+            if fam in rep.keywords:
+                families.setdefault(fam, [0, 0.0])
+                families[fam][0] += 1
+                families[fam][1] += rep.duration
+    for fam, (n, secs) in sorted(families.items()):
+        terminalreporter.write_line(
+            f"  family {fam:8s}: {secs:6.2f}s across {n} calls")

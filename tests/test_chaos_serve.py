@@ -297,3 +297,144 @@ def test_combined_drill_handoff_fault_plus_replica_kill(llama, monkeypatch):
         + disagg.prefill.sched.cache_pages_held() == disagg.pool.capacity
     assert structured == 0 or s["resubmit_exhausted"] == structured
     disagg.close()
+
+
+# ---- control-plane drills (ISSUE 16 acceptance) -----------------------------
+
+@pytest.mark.router
+@pytest.mark.control
+@pytest.mark.loadgen
+def test_controller_scale_down_races_replica_kill_under_open_load(
+        llama, monkeypatch):
+    """The acceptance drill: open-loop Poisson arrivals drive a
+    2-replica fleet under the SLO controller while chaos SIGKILLs r0
+    mid-run — concurrent with whatever membership intent (drain/remove)
+    the controller has in flight. Invariants: every admitted request
+    finishes batch-1 token-identical or as a structured strict-prefix
+    give-up (zero dropped tokens); the controller never leaves a route
+    pointing at a fenced replica and never scales into one; live pools
+    audit clean every iteration; the controller itself never raises,
+    whichever way the drain-vs-kill race lands."""
+    bundle, params = llama
+    from distributed_training_guide_tpu.serve.controller import Controller
+    from distributed_training_guide_tpu.serve.loadgen import poisson_arrivals
+    from distributed_training_guide_tpu.serve.router import local_fleet
+    from distributed_training_guide_tpu.serve.scheduler import RefusalError
+
+    monkeypatch.setenv(faults.ENV_REPLICA_KILL, "r0@10")
+    router = local_fleet(bundle, params, 2, n_slots=2, page_size=4,
+                         max_len=32,
+                         router_kw=dict(heartbeat_timeout_s=60.0))
+    controller = Controller(router, hold_up=3, hold_down=2, cooldown_s=0.0,
+                            min_replicas=1, max_replicas=2)
+    # arrivals keyed to ROUTER STEPS (not wall time): deterministic, and
+    # still open loop — submission never waits on a completion
+    offsets = poisson_arrivals(1.5, 8.0, seed=0)
+    arrival_step = [int(t * 3) for t in offsets]
+    reqs = [Request(prompt_ids=[3 + i, 17, 42, 9][:2 + i % 3],
+                    max_new_tokens=6,
+                    temperature=0.7 if i % 2 else 0.0, seed=i)
+            for i in range(len(offsets))]
+    ids, done, refused = {}, {}, []
+    it, next_i = 0, 0
+    while next_i < len(reqs) or router.has_work:
+        while next_i < len(reqs) and arrival_step[next_i] <= it:
+            try:
+                ids[next_i] = router.submit(_fresh(reqs[next_i]))
+            except RefusalError as exc:
+                refused.append((next_i, exc.reason))
+            next_i += 1
+        controller.step()               # must never raise, whatever chaos
+        for res in router.step():
+            done[res.request_id] = res
+        for replica in router.replicas.values():
+            if replica.state == "live":
+                _audit_engine(replica.engine)
+        it += 1
+        assert it < 5000
+    # zero dropped tokens: every ADMITTED request produced a result
+    assert set(ids.values()) <= set(done), "an admitted request vanished"
+    structured = 0
+    for i, rid in ids.items():
+        res = done[rid]
+        want = _ref(bundle, params, reqs[i], page_size=4, max_len=32)
+        if res.finish_reason in ("eos", "length"):
+            assert res.token_ids == want.token_ids, f"seed={reqs[i].seed}"
+        else:
+            assert res.finish_reason == "resubmit_exhausted"
+            assert res.generated_ids == \
+                want.generated_ids[:len(res.generated_ids)]
+            structured += 1
+    # no route may point at a non-live replica once the dust settles
+    for (name, _erid) in router._by_engine:
+        assert router.replicas[name].state == "live"
+    # the controller never scaled INTO a fenced replica: spawn targets
+    # are fresh names, never a name the router fenced
+    fenced = {n for n, r in router.replicas.items() if r.state == "fenced"}
+    for action in controller.actions:
+        if action["kind"] == "scale_up":
+            assert action["target"] not in fenced
+        if action["kind"] == "scale_down":
+            kinds_before = [a["kind"] for a in controller.actions
+                            if a["t"] <= action["t"]
+                            and a.get("target") == action["target"]]
+            assert "drain" in kinds_before, "remove without drain"
+    # post-mortem: survivors audit clean
+    for replica in router.replicas.values():
+        if replica.state == "live":
+            _audit_engine(replica.engine)
+    assert controller.counters["observations"] > 0
+    assert router.stats()["fenced"] <= 2
+
+
+@pytest.mark.router
+@pytest.mark.control
+def test_replica_slow_gray_failure_is_never_fenced(llama, monkeypatch):
+    """DTG_FAULT_REPLICA_SLOW=<name>@<delay>: the gray failure — r0 keeps
+    stepping and beating but every iteration drags. Nothing may fence it
+    (fencing a live replica double-risks its work); the workload still
+    completes token-identical, and only load-aware signals see the
+    drag."""
+    bundle, params = llama
+    from distributed_training_guide_tpu.serve.router import local_fleet
+
+    monkeypatch.setenv(faults.ENV_REPLICA_SLOW, "r0@0.01")
+    router = local_fleet(bundle, params, 2, n_slots=2, page_size=4,
+                         max_len=32,
+                         router_kw=dict(heartbeat_timeout_s=60.0))
+    reqs = [Request(prompt_ids=[3 + i, 17, 42], max_new_tokens=4, seed=i)
+            for i in range(4)]
+    ids, done = _drive_fleet(router, reqs)
+    for rid, req in zip(ids, reqs):
+        want = _ref(bundle, params, req, page_size=4, max_len=32)
+        assert done[rid].token_ids == want.token_ids, f"seed={req.seed}"
+    assert router.replicas["r0"].state == "live", \
+        "a slow replica is a capacity problem, not a health problem"
+    assert router.stats()["fenced"] == 0
+
+
+@pytest.mark.loadgen
+def test_open_loop_harness_over_real_engine_accounts_every_request(llama):
+    """run_open_loop over a REAL engine: wall-clock Poisson arrivals,
+    no deadline (pure accounting pin) — offered == completed + refused +
+    exhausted + missed, goodput positive, and the engine drains clean."""
+    bundle, params = llama
+    from distributed_training_guide_tpu.serve.loadgen import (
+        build_schedule, default_scenarios, poisson_arrivals, run_open_loop)
+
+    engine = ServeEngine(bundle, params, n_slots=2, page_size=4,
+                         max_len=32, max_queue=16)
+    vocab = int(bundle.config.vocab_size)
+    scenarios = default_scenarios(max_len=32, page_size=4, vocab=vocab,
+                                  deadline_s=None, seed=0)
+    schedule = build_schedule(poisson_arrivals(5.0, 2.0, seed=0),
+                              scenarios, vocab=vocab, seed=0)
+    report = run_open_loop(engine, schedule, max_wall_s=60.0)
+    assert not report.timed_out
+    assert report.offered == len(schedule)
+    assert report.completed + report.refused + report.deadline_missed \
+        + report.resubmit_exhausted + report.other_failed == report.offered
+    assert report.completed > 0 and report.goodput_rps > 0
+    assert report.ttft_p99_s >= report.ttft_p50_s >= 0
+    assert not engine.has_work
+    _audit_monolith(engine)

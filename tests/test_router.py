@@ -108,8 +108,9 @@ class FakeEngine:
         self.submitted.append(request)
         return next(self._ids)
 
-    def resubmit(self, request, generated=(), first_token_at=0.0):
-        self.resubmitted.append((request, list(generated)))
+    def resubmit(self, request, generated=(), first_token_at=0.0,
+                 submitted_at=None):
+        self.resubmitted.append((request, list(generated), submitted_at))
         return next(self._ids)
 
     def partial_tokens(self):
@@ -365,6 +366,34 @@ def test_resubmit_exhausted_is_a_structured_strict_prefix_result():
     assert out[0].generated_ids == [5, 6]
     assert not router.has_work
     assert router.stats()["resubmit_exhausted"] == 1
+
+
+def test_resubmission_preserves_original_submit_timestamp():
+    """Bugfix pin: a fence/spillover resubmission carries the ORIGINAL
+    client submit time through to the engine's requeue — TTFT and
+    deadline accounting measure from FIRST submit, not from the hop
+    (the scheduler would otherwise restamp its clock and a twice-moved
+    request would look forever young to its own deadline)."""
+    t = [10.0]
+    clock = lambda: t[0]  # noqa: E731
+    router = _fake_fleet(2, clock=clock)
+    rid = router.submit(Request(prompt_ids=[1, 2, 3]))
+    record = router._records[rid]
+    assert record.submitted_at == 10.0
+    victim = record.replica
+    other = next(n for n in router.replicas if n != victim)
+    record.generated = [5]              # a token the router already saw
+    router.replicas[victim].kill()
+    t[0] = 25.0
+    router.step()                       # fences victim -> backlog
+    t[0] = 25.1                         # past the resubmit backoff
+    router.step()                       # re-places on the survivor
+    assert router._records[rid].replica == other
+    assert router._records[rid].submitted_at == 10.0
+    req, gen, submitted_at = router.replicas[other].engine.resubmitted[-1]
+    assert gen == [5]
+    assert submitted_at == 10.0, \
+        "resubmission must thread the original client submit time"
 
 
 # ---- real-engine identity ---------------------------------------------------
