@@ -515,6 +515,20 @@ def run_decode_check(only: str = None) -> None:
       perturbs the verify logits and breaks drafted runs long before
       evals move), so this is the serving plane's built-in quality
       meter for quantized pages. Target: |delta| <= 0.02.
+    - wq_int8_slots8 (queued sweep rung): the slots8 workload with the
+      WEIGHTS block-quantized (serve/weights.py weight_dtype="int8",
+      dequantized in-kernel by ops/quantized_matmul.py) vs the
+      fp32-weight control in-rung — tok/s both ways, the resident
+      weight byte ratio with scales included (~0.28x on llama-debug,
+      the >= 1.9x-smaller claim; the publish payload shrinks by the
+      same ratio), and the greedy-divergence positions.
+    - wq_spec_accept (queued sweep rung): the spec_ngram8 workload's
+      ACCEPTANCE-RATE meter pointed at weight fidelity — int8 weights
+      vs the SNAPPED-FP control (the identical int8-rounded policy in
+      fp storage, post.qlora_base), so the storage+dequant path is the
+      one new variable; gate |delta| <= 0.02. The raw-fp acceptance
+      rides along ungated (the rounding's own effect — visible on this
+      random-init toy, noise on trained models).
     - router_fleet2 (queued sweep rung): 16 requests in two shared-
       prefix groups over a 2-replica fleet behind the router
       (serve/router.py) vs one identical single engine in-rung — prices
@@ -849,6 +863,100 @@ def run_decode_check(only: str = None) -> None:
             "acceptance_int8": acc8,
             "acceptance_fp32": acc32,
             "acceptance_delta": round(acc8 - acc32, 4),
+        }
+        out["value"] = tps8
+        _emit({**out, "partial": True})
+
+    if "wq_int8_slots8" in rungs:
+        # int8 WEIGHTS: the slots8 workload with the params block-
+        # quantized (serve/weights.py weight_dtype="int8", dequantized
+        # inside the matmul by ops/quantized_matmul.py) and the
+        # fp32-weight control measured in-rung on the identical workload
+        # — one new variable, the weight storage dtype. Beside tok/s the
+        # headline is the byte ratio: resident weight bytes AND the
+        # publish/swap payload shrink together (scales included), the
+        # >= 1.9x-vs-fp32 claim tests/test_weight_quant.py pins. Greedy
+        # divergence positions are the coarse quality meter beside
+        # wq_spec_accept's acceptance delta; -1 = token-identical.
+        def wq_workload(engine):
+            generate_many(engine, [Request(prompt_ids=[3, 17, 42],
+                                           max_new_tokens=4)])
+            engine.decode_steps = engine.decode_tokens = 0
+            reqs = [Request(prompt_ids=[3 + i, 17, 42], max_new_tokens=64,
+                            seed=i) for i in range(8)]
+            t0 = time.perf_counter()
+            results = generate_many(engine, reqs)
+            return results, throughput_stats(
+                results, time.perf_counter() - t0, engine)
+
+        ctl_eng = ServeEngine(bundle, params, n_slots=8, page_size=16,
+                              max_len=128)
+        ctl_res, ctl = wq_workload(ctl_eng)
+        eng = ServeEngine(bundle, params, n_slots=8, page_size=16,
+                          max_len=128, weight_dtype="int8")
+        res, stats = wq_workload(eng)
+        div = []
+        for a, b in zip(res, ctl_res):
+            n = next((j for j, (x, y) in enumerate(
+                zip(a.generated_ids, b.generated_ids)) if x != y), -1)
+            div.append(n)
+        rep = eng.weight_report()
+        out["wq_int8_slots8"] = {
+            **stats,
+            "weight_dtype": "int8",
+            "weight_bytes": eng.weight_bytes(),
+            "fp32_weight_bytes": ctl_eng.weight_bytes(),
+            "bytes_vs_fp32": round(
+                eng.weight_bytes() / ctl_eng.weight_bytes(), 4),
+            "publish_payload_bytes": rep["publish_payload_bytes"],
+            "fp_publish_payload_bytes": rep["publish_payload_bytes_fp"],
+            "fp32_weight_tokens_per_s": ctl["tokens_per_s"],
+            "speedup_vs_fp32_weights": (
+                round(stats["tokens_per_s"] / ctl["tokens_per_s"], 3)
+                if ctl["tokens_per_s"] else 0.0),
+            "greedy_divergence_positions": div,
+        }
+        out["value"] = stats["tokens_per_s"]
+        _emit({**out, "partial": True})
+
+    if "wq_spec_accept" in rungs:
+        # the WEIGHT-quality meter: n-gram speculation on the
+        # lookup-friendly workload, kvq_spec_accept's methodology
+        # pointed at weight fidelity. The GATED delta (|delta| <= 0.02,
+        # pinned in tests) is int8 vs the SNAPPED-FP control — the same
+        # int8-rounded policy served from fp storage through fp matmuls
+        # (post.qlora_base), so the storage dtype + in-kernel dequant
+        # path is the one new variable and the serving plane must not
+        # perturb acceptance beyond it. The raw-fp acceptance is
+        # recorded beside it ungated: on THIS random-init debug model
+        # the rounding itself moves acceptance (~-0.10; near-uniform
+        # logits flip under any perturbation), a toy-model artifact a
+        # trained model's confident logits don't share — splitting the
+        # two deltas is what keeps the meter honest about which half
+        # the serve plane owns.
+        def wq_accept_workload(engine):
+            _, st = spec_workload(engine)
+            return st["tokens_per_s"], st["spec_acceptance_rate"]
+
+        from distributed_training_guide_tpu.post import qlora_base
+
+        wq_kw = dict(n_slots=8, page_size=16, max_len=256,
+                     speculate="ngram", spec_k=8)
+        tps_fp, acc_fp = wq_accept_workload(ServeEngine(
+            bundle, params, **wq_kw))
+        tps_snap, acc_snap = wq_accept_workload(ServeEngine(
+            bundle, qlora_base(params), **wq_kw))
+        tps8, acc8 = wq_accept_workload(ServeEngine(
+            bundle, params, weight_dtype="int8", **wq_kw))
+        out["wq_spec_accept"] = {
+            "spec_k": 8,
+            "tokens_per_s": tps8,
+            "fp32_weight_tokens_per_s": tps_fp,
+            "acceptance_int8": acc8,
+            "acceptance_snapped_fp": acc_snap,
+            "acceptance_fp32": acc_fp,
+            "acceptance_delta": round(acc8 - acc_snap, 4),
+            "rounding_delta_ungated": round(acc_snap - acc_fp, 4),
         }
         out["value"] = tps8
         _emit({**out, "partial": True})
@@ -1215,7 +1323,17 @@ def run_post_check(only: str = None) -> None:
       band rate and its rollout tok/s prices the engine alone.
       Records per-arm reward trajectories, warm rollout tok/s (iteration
       0 carries the compiles — reported separately), publish latency ms,
-      and step time."""
+      and step time.
+    - post_qlora_cpu (queued sweep rung): the QLoRA shape
+      (arXiv:2305.14314) of the same loop — an int8-SNAPPED frozen base
+      (post.qlora_base) + fp LoRA adapters rolling out through a
+      weight_dtype="int8" engine, so the adapters learn residuals of
+      the policy the serve plane actually runs. The in-rung control is
+      the IDENTICAL lora_only loop on the untouched fp base + fp
+      engine: the quantized base is the only new variable, and the gate
+      is the reward trajectory tracking the control's. Every publish is
+      the normal fp merge — the engine re-quantizes through one
+      compiled program, pinned retrace-free (jit cache sizes flat)."""
     _configure_jax_cache()
     import jax
     import jax.numpy as jnp
@@ -1275,6 +1393,68 @@ def run_post_check(only: str = None) -> None:
                 - ctl["reward_trajectory"][0], 4),
         }
         out["value"] = live["rollout_tokens_per_s"]
+
+    if "post_qlora_cpu" in rungs:
+        # QLoRA (arXiv:2305.14314): int8-snapped frozen base + fp LoRA,
+        # rollouts through an int8-weights engine; control = the same
+        # lora_only loop on the fp base + fp engine (one new variable —
+        # the quantized base). The merge→publish path re-quantizes
+        # inside the engine's one compiled requant program; the cache
+        # sizes recorded per arm pin it retrace-free.
+        from distributed_training_guide_tpu.models.lora import lora_bundle
+        from distributed_training_guide_tpu.post import qlora_base
+
+        base = get_model("llama-debug", dtype=jnp.float32)
+        n_iter = 5
+
+        def qlora_arm(quantized: bool):
+            wrapped = lora_bundle(base, rank=8, alpha=16.0)
+            init = wrapped.init(wrapped.config, jax.random.key(0))
+            if quantized:
+                init = {"base": qlora_base(init["base"]),
+                        "lora": init["lora"]}
+            trainer = Trainer(bundle=wrapped, optimizer=adamw_cosine(0.1),
+                              lora_only=True, guard_policy="skip")
+            state = trainer.init_state_from_params(init)
+            engine = ServeEngine(
+                base, merged_params(trainer, state), n_slots=8,
+                page_size=16, max_len=64,
+                weight_dtype="int8" if quantized else None)
+            loop = PostTrainingLoop(
+                trainer, engine, ProgrammaticScorer(band_reward(64)),
+                [[3, 10, 17]] * 24, state=state, max_new_tokens=16,
+                temperature=1.0, base_seed=0)
+            hist = loop.run(1)            # iteration 0 pays the compiles
+            sizes0 = engine.programs.jit_cache_sizes()
+            hist += loop.run(n_iter - 1)
+            warm = hist[1:]
+            return {
+                "reward_trajectory": [round(m["reward_mean"], 4)
+                                      for m in hist],
+                "rollout_tokens_per_s": round(float(np.mean(
+                    [m["rollout_tokens_per_s"] for m in warm])), 1),
+                "publish_ms_mean": round(float(np.mean(
+                    [m["publish_ms"] for m in warm])), 2),
+                "publishes": loop.publishes,
+                "weight_bytes": engine.weight_bytes(),
+                "retrace_free": (
+                    engine.programs.jit_cache_sizes() == sizes0),
+            }
+
+        q = qlora_arm(quantized=True)
+        fp = qlora_arm(quantized=False)
+        qt, ft = q["reward_trajectory"], fp["reward_trajectory"]
+        out["post_qlora_cpu"] = {
+            "iterations": n_iter,
+            **{f"qlora_{k}": v for k, v in q.items()},
+            "qlora_reward_delta": round(qt[-1] - qt[0], 4),
+            "control_fp_lora": fp,
+            "control_reward_delta": round(ft[-1] - ft[0], 4),
+            "weight_bytes_vs_fp": round(
+                q["weight_bytes"] / fp["weight_bytes"], 4),
+            "reward_final_gap_vs_fp": round(qt[-1] - ft[-1], 4),
+        }
+        out["value"] = q["rollout_tokens_per_s"]
     _emit(out)
 
 
@@ -1607,6 +1787,23 @@ SWEEP_QUEUE = [
     # only variable and must match or beat the static arm's goodput.
     dict(name="load_saturation", load_rungs="load_saturation"),
     dict(name="load_controller_ab", load_rungs="load_controller_ab"),
+    # --- int8 serve-plane WEIGHTS (serve/weights.py weight_dtype="int8",
+    # dequantized in-kernel by ops/quantized_matmul.py; one new variable
+    # each, fp control in-rung). wq_int8_slots8 = the slots8 decode
+    # workload on block-quantized params: tok/s, the resident-weight AND
+    # publish-payload byte ratio (~0.28x on llama-debug — the >= 1.9x
+    # claim), greedy divergence positions. wq_spec_accept = the
+    # kvq_spec_accept acceptance-delta methodology pointed at weight
+    # fidelity — int8 vs the snapped-fp control (same rounded policy,
+    # fp storage) gated |delta| <= 0.02 and pinned in tests, raw-fp
+    # acceptance recorded ungated beside it. post_qlora_cpu =
+    # the post_loop_cpu shape with an int8-snapped frozen base + fp LoRA
+    # (QLoRA) rolling out through an int8-weights engine vs the fp
+    # lora_only control — reward trajectory must track the control's,
+    # publishes stay retrace-free through the requant program.
+    dict(name="wq_int8_slots8", decode_rungs="wq_int8_slots8"),
+    dict(name="wq_spec_accept", decode_rungs="wq_spec_accept"),
+    dict(name="post_qlora_cpu", post_rungs="post_qlora_cpu"),
     # LAST on purpose: fence_every=4 dispatches 4 steps ahead, the exact
     # pattern this pool's documented failure mode punishes — its first
     # attempt (2026-07-31 03:50) stalled and the pool went down with it.

@@ -32,6 +32,7 @@ from jax.ad_checkpoint import checkpoint_name
 
 from ..ops.attention import multihead_attention
 from ..ops.collectives import psum as _psum
+from ..ops.quantized_matmul import quantized_matmul, quantized_take
 from ..ops.rope import apply_rope, freeze_rope_scaling
 
 
@@ -214,6 +215,24 @@ ACT_FNS = {
 }
 
 
+def _is_qt(w) -> bool:
+    """Duck-typed ``train/precision.py`` ``Quantized`` check: the serving
+    engine stores its projection weights as int8 payload + per-block fp32
+    scales under ``weight_dtype='int8'`` (serve/weights.py). Structural,
+    not isinstance — ``train`` imports ``models`` (train/step.py), so the
+    model family cannot import ``train.precision`` back."""
+    return hasattr(w, "q") and hasattr(w, "scale")
+
+
+def _wmat(h: jnp.ndarray, w, cdt) -> jnp.ndarray:
+    """``h @ w`` in compute dtype for a float weight; block-dequant matmul
+    (fp32 accumulate, then the same compute-dtype cast) for a Quantized
+    one — no full fp32 weight tensor materializes on that path."""
+    if _is_qt(w):
+        return quantized_matmul(h, w).astype(cdt)
+    return h @ w.astype(cdt)
+
+
 def _rmsnorm(x: jnp.ndarray, scale: jnp.ndarray, eps: float,
              plus_one: bool = False) -> jnp.ndarray:
     dtype = x.dtype
@@ -293,7 +312,7 @@ def attention_sublayer(config, x: jnp.ndarray, attn_params: dict, norm_scale,
     else:
         h = _rmsnorm(x, norm_scale, config.rms_norm_eps,
                      getattr(config, "norm_plus_one", False))
-    q, k, v = (h @ attn_params[w].astype(cdt) for w in ("wq", "wk", "wv"))
+    q, k, v = (_wmat(h, attn_params[w], cdt) for w in ("wq", "wk", "wv"))
     if "bq" in attn_params:  # Qwen2-style QKV biases; shard-local under
         q = q + attn_params["bq"].astype(cdt)  # manual tp (bias carries the
         k = k + attn_params["bk"].astype(cdt)  # same heads/kv logical axis
@@ -329,7 +348,7 @@ def attention_sublayer(config, x: jnp.ndarray, attn_params: dict, norm_scale,
     if attend_override is not None:
         attn, aux = attend_override(q, k, v, window=window, scale=attn_scale,
                                     softcap=softcap)
-        out = attn.reshape(b, s, -1) @ attn_params["wo"].astype(cdt)
+        out = _wmat(attn.reshape(b, s, -1), attn_params["wo"], cdt)
         if tp_axis is not None:
             out = _psum(out, tp_axis)
         return (out, aux) if return_kv else out
@@ -361,7 +380,7 @@ def attention_sublayer(config, x: jnp.ndarray, attn_params: dict, norm_scale,
                                    standard_layout=standard_layout,
                                    window=window, scale=attn_scale,
                                    logit_softcap=softcap)
-    out = attn.reshape(b, s, -1) @ attn_params["wo"].astype(cdt)
+    out = _wmat(attn.reshape(b, s, -1), attn_params["wo"], cdt)
     if tp_axis is not None:
         out = _psum(out, tp_axis)
     if return_kv:
@@ -381,13 +400,13 @@ def mlp_sublayer(config, x: jnp.ndarray, layer: dict,
     else:
         h = _rmsnorm(x, scale, config.rms_norm_eps,
                      getattr(config, "norm_plus_one", False))
-    gate = h @ layer["mlp"]["gate"].astype(cdt)
-    up = h @ layer["mlp"]["up"].astype(cdt)
+    gate = _wmat(h, layer["mlp"]["gate"], cdt)
+    up = _wmat(h, layer["mlp"]["up"], cdt)
     act_fn = ACT_FNS[getattr(config, "act_fn", "silu")]
     # tagged for REMAT_POLICIES["attn_mlp"]: saving the [B,S,I] inner
     # activation skips the gate/up matmul recompute in backward
     act = checkpoint_name(act_fn(gate) * up, "mlp_act")
-    down = act @ layer["mlp"]["down"].astype(cdt)
+    down = _wmat(act, layer["mlp"]["down"], cdt)
     if tp_axis is not None:  # megatron Rowwise: down-proj partial sums
         down = _psum(down, tp_axis)
     return down
@@ -439,7 +458,12 @@ def embed_tokens(config: LlamaConfig, params: dict, input_ids: jnp.ndarray,
                  positions: jnp.ndarray) -> jnp.ndarray:
     """Embedding sub-forward (pipeline stage-0 entry)."""
     del positions  # rope is applied inside blocks
-    x = jnp.take(params["embed"]["embedding"], input_ids, axis=0).astype(config.dtype)
+    table = params["embed"]["embedding"]
+    if _is_qt(table):  # int8 serve weights: gather rows THEN dequantize —
+        # only the looked-up tokens, never the whole table
+        x = quantized_take(table, input_ids).astype(config.dtype)
+    else:
+        x = jnp.take(table, input_ids, axis=0).astype(config.dtype)
     if getattr(config, "scale_embed", False):   # Gemma's sqrt(E) normalizer
         x = x * jnp.asarray(config.hidden_size ** 0.5, config.dtype)
     return x
@@ -450,6 +474,15 @@ def output_weights(config: LlamaConfig, params: dict) -> jnp.ndarray:
     if config.tie_word_embeddings:
         return params["embed"]["embedding"].T.astype(config.dtype)
     return params["lm_head"].astype(config.dtype)
+
+
+def _output_container(config: LlamaConfig, params: dict):
+    """The raw output-projection leaf (tied table or lm_head) plus whether
+    the quantized matmul must run in transpose form (tied: blocks tile the
+    contracted embed axis)."""
+    if config.tie_word_embeddings:
+        return params["embed"]["embedding"], True
+    return params["lm_head"], False
 
 
 def tp_embed(config: LlamaConfig, params: dict, input_ids: jnp.ndarray,
@@ -474,9 +507,15 @@ def final_hidden(config: LlamaConfig, params: dict, x: jnp.ndarray) -> jnp.ndarr
 
 def lm_head_logits(config: LlamaConfig, params: dict, x: jnp.ndarray) -> jnp.ndarray:
     """Final norm + output projection (pipeline last-stage exit)."""
-    logits = jnp.dot(final_hidden(config, params, x),
-                     output_weights(config, params),
-                     preferred_element_type=jnp.float32)
+    w, transpose = _output_container(config, params)
+    if _is_qt(w):  # fp32 accumulate either way; the fp32 [tokens, V]
+        # accumulator of the transpose form IS the logits tensor
+        logits = quantized_matmul(final_hidden(config, params, x), w,
+                                  transpose=transpose)
+    else:
+        logits = jnp.dot(final_hidden(config, params, x),
+                         output_weights(config, params),
+                         preferred_element_type=jnp.float32)
     cap = getattr(config, "final_logit_softcap", None)
     if cap:   # Gemma-2 final logit capping
         logits = jnp.tanh(logits / cap) * cap

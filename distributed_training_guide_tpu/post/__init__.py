@@ -20,14 +20,15 @@ stops at pretraining):
 CLI: ``python -m distributed_training_guide_tpu.post`` (post/cli.py).
 Chapter: ``related-topics/post-training/``.
 """
-from .loop import PostTrainingLoop, merged_params, pack_rollouts
+from .loop import (PostTrainingLoop, merged_params, pack_rollouts,
+                   qlora_base)
 from .rollout import (Rollout, RolloutLedger, generate_rollouts,
                       rollout_seed)
 from .score import (band_reward, match_reward, ProgrammaticScorer,
                     RewardModelScorer, Score, Scorer, TeacherScorer)
 
 __all__ = [
-    "PostTrainingLoop", "merged_params", "pack_rollouts",
+    "PostTrainingLoop", "merged_params", "pack_rollouts", "qlora_base",
     "Rollout", "RolloutLedger", "generate_rollouts", "rollout_seed",
     "ProgrammaticScorer", "RewardModelScorer", "Score", "Scorer",
     "TeacherScorer", "band_reward", "match_reward",

@@ -77,6 +77,14 @@ def build_parser() -> argparse.ArgumentParser:
                    help="rollout ledger path (crash-recoverable batches)")
     p.add_argument("--n-slots", type=int, default=8)
     p.add_argument("--page-size", type=int, default=16)
+    p.add_argument("--weight-dtype", default=None,
+                   choices=("fp32", "bf16", "int8"),
+                   help="serve-engine param storage (serve/weights.py). "
+                        "'int8' with --lora-rank > 0 is the QLoRA shape: "
+                        "the frozen base is SNAPPED onto the engine's "
+                        "int8 grid (post.qlora_base) so the adapters "
+                        "train against the policy actually served, and "
+                        "every publish moves the quantized payload")
     p.add_argument("--speculate", default="off", choices=("off", "ngram"))
     p.add_argument("--spec-k", type=int, default=4)
     p.add_argument("--guard-policy", default="skip",
@@ -137,14 +145,25 @@ def main(argv=None) -> int:
               if args.memory_budget_gb else None)
     colo = price_post_colocation(
         trainer, n_slots=args.n_slots, page_size=args.page_size,
-        max_len=max_len, teacher_bundle=teacher, budget_bytes=budget)
+        max_len=max_len, weight_dtype=args.weight_dtype,
+        teacher_bundle=teacher, budget_bytes=budget)
 
     import jax
 
-    state = trainer.init_state(args.seed)
+    if args.weight_dtype == "int8" and args.lora_rank > 0:
+        # the QLoRA shape: snap the frozen base onto the engine's exact
+        # int8 grid before training — idempotent, so the engine's
+        # quantization of every merged publish reproduces it bitwise
+        from .loop import qlora_base
+
+        init = bundle.init(bundle.config, jax.random.key(args.seed))
+        init = {"base": qlora_base(init["base"]), "lora": init["lora"]}
+        state = trainer.init_state_from_params(init, seed=args.seed)
+    else:
+        state = trainer.init_state(args.seed)
     engine = ServeEngine(base, merged_params(trainer, state),
                          n_slots=args.n_slots, page_size=args.page_size,
-                         max_len=max_len,
+                         max_len=max_len, weight_dtype=args.weight_dtype,
                          speculate=args.speculate
                          if args.speculate != "off" else None,
                          spec_k=args.spec_k)

@@ -253,3 +253,30 @@ def merged_params(trainer, state):
 
         merged = jax.jit(lambda t: jax.tree.map(jnp.copy, t))(merged)
     return merged
+
+
+def qlora_base(base_params, *, family: str = "llama"):
+    """The QLoRA frozen-base snap (arXiv:2305.14314): round the base
+    params onto the serve plane's int8 grid — quantize → dequantize of
+    exactly the leaves ``serve/weights.py`` quantizes, block size and
+    all — BEFORE wrapping with ``lora_bundle``.
+
+    QLoRA's trade is a quantized frozen base plus fp LoRA updates. With
+    the base snapped here, the ``lora_only`` trainer computes gradients
+    against the SAME base a ``weight_dtype='int8'`` engine dequantizes
+    (block quantization is idempotent: re-quantizing a snapped base
+    reproduces its own grid), so the adapters learn residuals of the
+    policy actually being served rather than of an fp base the serve
+    plane never sees. Publishing stays the normal fp merge —
+    ``publish_params`` re-quantizes through its one compiled program,
+    retrace-free. Norms/biases pass through untouched, like serving."""
+    import jax
+
+    from ..serve.weights import store_weights
+    from ..train.precision import _is_quantized, dequantize_blockwise
+
+    snapped = store_weights(base_params, "int8", family=family)
+    return jax.tree.map(
+        lambda orig, snap: (dequantize_blockwise(snap, dtype=orig.dtype)
+                            if _is_quantized(snap) else snap),
+        base_params, snapped)

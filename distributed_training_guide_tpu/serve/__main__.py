@@ -72,6 +72,16 @@ def main(argv=None) -> None:
                         "engine whose page size would demote an otherwise "
                         "kernel-eligible model to the gather path warns at "
                         "construction)")
+    parser.add_argument("--weight-dtype", default=None,
+                        choices=("fp32", "bf16", "int8"),
+                        help="param storage (default: the model dtype). "
+                        "'int8' stores block-wise absmax-quantized "
+                        "projection weights with per-(row, 32-col-block) "
+                        "fp32 scales, dequantized inside the matmul loop "
+                        "— ~3.5x smaller params AND the same factor off "
+                        "every publish/swap payload (llama family only; "
+                        "the weight_report line prices it). Baked per "
+                        "fleet like --kv-dtype: all replicas share it")
     parser.add_argument("--speculate", default="off",
                         choices=("off", "ngram", "draft"),
                         help="speculative decoding: 'ngram' is the "
@@ -213,7 +223,7 @@ def main(argv=None) -> None:
                   attend_impl=args.attend_impl, plan=plan,
                   shard_kv=args.shard_kv, max_queue=args.max_queue,
                   speculate=speculate, spec_k=args.spec_k,
-                  kv_dtype=args.kv_dtype)
+                  kv_dtype=args.kv_dtype, weight_dtype=args.weight_dtype)
     if args.replicas > 1 and args.disagg:
         raise SystemExit("--replicas fronts ServeEngine replicas; combine "
                          "with --disagg per replica is future work")
@@ -223,6 +233,7 @@ def main(argv=None) -> None:
         engine = local_fleet(bundle, params, args.replicas, **common)
         report = {"replicas": args.replicas,
                   **engine.replicas["r0"].engine.kv_report()}
+        programs = engine.replicas["r0"].engine.programs
     elif args.disagg:
         from .disagg import DisaggEngine
 
@@ -230,10 +241,18 @@ def main(argv=None) -> None:
                               n_prefill_slots=args.prefill_slots,
                               transport=args.transport, **common)
         report = engine.kv_report()
+        programs = engine.programs
     else:
         engine = ServeEngine(bundle, params, **common)
         report = engine.kv_report()
-    print(json.dumps({"kv_report": report}))
+        programs = engine.programs
+    out = {"kv_report": report}
+    if args.weight_dtype is not None:
+        # price what --weight-dtype bought: storage + publish/swap payload
+        from .engine import build_weight_report
+
+        out["weight_report"] = build_weight_report(programs)
+    print(json.dumps(out))
 
     if args.http_port is not None:
         import signal

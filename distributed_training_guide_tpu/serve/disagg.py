@@ -476,7 +476,7 @@ class DisaggEngine:
                  prefix_cache: bool = True, attend_impl: str = "auto",
                  shard_kv: bool = False, max_queue: Optional[int] = None,
                  speculate=None, spec_k: int = 4, kv_dtype=None,
-                 transport: str = "same_host",
+                 weight_dtype=None, transport: str = "same_host",
                  n_prefill_pages: Optional[int] = None,
                  handoff_ack_timeout_s: float = 2.0,
                  programs: Optional[ModelPrograms] = None):
@@ -506,12 +506,16 @@ class DisaggEngine:
         # programs so replayed tokens are bitwise)
         self.programs = programs if programs is not None else ModelPrograms(
             bundle, params, plan=plan, shard_kv=shard_kv,
-            attend_impl=attend_impl, kv_dtype=kv_dtype)
+            attend_impl=attend_impl, kv_dtype=kv_dtype,
+            weight_dtype=weight_dtype)
         self.bundle, self.config = bundle, bundle.config
         # both halves write/read ONE pool at one storage dtype; the
         # handoff moves page ids, so a quantized page's payload AND its
         # scale rows transfer by refcount exactly like float pages
         self.kv_dtype = self.programs.kv_dtype
+        # both halves likewise run ONE params layout (shared programs) —
+        # a quantized base serves prefill and decode from the same bytes
+        self.weight_dtype = self.programs.weight_dtype
         max_len, self.max_model_len, self.max_pages = \
             resolve_context_bounds(self.config, max_len, page_size)
         check_kv_page_geometry(self.config, page_size=page_size,

@@ -220,7 +220,9 @@ def new_generation(old, *, params=None, **overrides):
     ``prefill_chunk``, ``max_queue``, ...). Program-level knobs
     (``kv_dtype`` / ``attend_impl`` / ``plan`` / ``shard_kv``) are baked
     into the shared programs and cannot be overridden here — changing
-    those is a new deployment, not a generation swap.
+    those is a new deployment, not a generation swap. ``weight_dtype``
+    is baked the same way: the shared programs ARE the quantized params
+    layout, so a precision change cannot ride a capacity swap.
 
     ``params=`` is the published-params path (post-training fleets):
     SAME-layout refreshed weights are published into the shared programs
@@ -237,7 +239,7 @@ def new_generation(old, *, params=None, **overrides):
     two-call form. A publish mid-swap is rejected by the swap guard (a
     changed layout fails publish validation loudly; that case IS a new
     deployment)."""
-    baked = {"kv_dtype", "attend_impl", "plan", "shard_kv"}
+    baked = {"kv_dtype", "weight_dtype", "attend_impl", "plan", "shard_kv"}
     bad = baked & set(overrides)
     if bad:
         raise ValueError(

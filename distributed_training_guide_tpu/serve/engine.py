@@ -69,6 +69,9 @@ from .kv_pages import (check_kv_page_geometry, commit_prefill, copy_pages,
                        PagePool, pages_for_tokens, pool_nbytes)
 from .scheduler import Admission, Request, RequestResult, Scheduler
 from .spec import Drafter, NgramDrafter, new_spec_counters
+from .weights import (params_nbytes, quantized_param_shardings,
+                      store_weights, weight_bytes_by_dtype,
+                      weight_dtype_name)
 
 
 def _sample_tokens(logits, seeds, positions, temps, top_ks, top_ps):
@@ -564,6 +567,31 @@ def build_kv_report(programs: "ModelPrograms", *, page_size: int,
     }
 
 
+def build_weight_report(programs: "ModelPrograms") -> dict:
+    """The preflight-style byte table for one engine's WEIGHTS — the twin
+    of :func:`build_kv_report`, priced at the params' own storage dtype
+    (int8 scale bytes included) with the fp32 cost alongside so the
+    quantization gain is a checkable ratio. ``publish_payload_bytes`` is
+    what a quantized-layout publish (or an engine swap's param export)
+    moves; ``publish_payload_bytes_fp`` is the fp-layout payload a trainer
+    hands ``publish_params`` before the engine re-quantizes."""
+    by_dtype = weight_bytes_by_dtype(programs._fp_layout,
+                                     getattr(programs.bundle, "family", None))
+    stored = params_nbytes(programs.params)
+    fp_payload = sum(
+        leaf.size * jnp.dtype(leaf.dtype).itemsize
+        for leaf in jax.tree_util.tree_leaves(programs._fp_layout))
+    return {
+        "weight_dtype": programs.weight_dtype,
+        "weight_bytes": stored,
+        "weight_bytes_fp32": by_dtype["fp32"],
+        "bytes_vs_fp32": round(stored / by_dtype["fp32"], 4),
+        "weight_bytes_by_dtype": by_dtype,
+        "publish_payload_bytes": stored,
+        "publish_payload_bytes_fp": fp_payload,
+    }
+
+
 class ModelPrograms:
     """The compiled-program cache for one (model, params, sharding)
     triple: the batched decode step, per-bucket prefill programs, the
@@ -580,7 +608,7 @@ class ModelPrograms:
 
     def __init__(self, bundle: ModelBundle, params, *, plan=None,
                  shard_kv: bool = False, attend_impl: str = "auto",
-                 kv_dtype=None):
+                 kv_dtype=None, weight_dtype=None):
         self.bundle = bundle
         self.config = bundle.config
         self.mod = family_module(bundle.family)
@@ -597,6 +625,24 @@ class ModelPrograms:
         # pool-touching program below threads them transparently, and the
         # scales are first-class pool state (CoW/commit/handoff/sharding)
         self.kv_dtype = kv_dtype_name(self.config, kv_dtype)
+        # the PARAM storage dtype ("fp32" | "bf16" | "int8"; None inherits
+        # the model's param dtype with NO transform — the pre-quantization
+        # behavior, bit for bit). int8 params are Quantized pytrees
+        # (serve/weights.py): int8 payload + per-block fp32 scales,
+        # dequantized inside the matmul loops (ops/quantized_matmul.py),
+        # never as a full fp32 tensor (the decode HLO pin).
+        self.weight_dtype = weight_dtype_name(self.config, weight_dtype)
+        # the fp layout is what trainers publish (post/loop.py merges in
+        # fp); captured pre-transform so publish_params can accept either
+        # layout and re-quantize through one compiled program
+        self._fp_layout = jax.tree.map(
+            lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype), params)
+        if weight_dtype is not None:
+            _wname, _wfam = self.weight_dtype, bundle.family
+            self._store_weights = (
+                lambda p: store_weights(p, _wname, family=_wfam))
+        else:
+            self._store_weights = None
         self.plan = plan
         self.shard_kv = bool(shard_kv)
         self.mesh = plan.mesh if plan is not None else None
@@ -624,12 +670,20 @@ class ModelPrograms:
         else:
             commit_impl, copy_impl = commit_prefill, copy_pages
         if plan is not None:
+            # shardings come from the FP layout (param_shardings' axes-tree
+            # walk treats tuples as leaves, and Quantized IS a NamedTuple);
+            # a storage transform then derives per-container shardings
             shapes = jax.tree.map(
                 lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype), params)
             shardings = plan.param_shardings(
                 bundle.param_logical_axes(self.config), shapes)
+            if self._store_weights is not None:
+                params = self._store_weights(params)
+                shardings = quantized_param_shardings(shardings, params)
             params = jax.device_put(params, shardings)
         else:
+            if self._store_weights is not None:
+                params = self._store_weights(params)
             # canonical COMMITTED placement: params handed straight from
             # init/jit are uncommitted, and pjit keys its executable cache
             # on commitment — without this, the first publish_params
@@ -667,6 +721,7 @@ class ModelPrograms:
         self.publish_count = 0
         self._swap_in_flight = False
         self._snapshot_fn = None
+        self._requant_fn = None
 
     # ---- weight publishing (the post-training seam) ------------------------
     @contextlib.contextmanager
@@ -723,6 +778,12 @@ class ModelPrograms:
         old_flat, old_def = jax.tree_util.tree_flatten(self.params)
         new_flat, new_def = jax.tree_util.tree_flatten(new_params)
         if old_def != new_def:
+            # a weight-transformed engine (weight_dtype=) also accepts the
+            # FP layout the trainer naturally produces, re-quantizing it
+            # through one compiled program on the validated path below
+            if (self._store_weights is not None and new_def
+                    == jax.tree_util.tree_structure(self._fp_layout)):
+                return self._publish_fp(new_params)
             raise ValueError(
                 f"published params tree does not match the compiled "
                 f"layout: got {new_def}, compiled {old_def} — a "
@@ -764,6 +825,44 @@ class ModelPrograms:
                 lambda p: jax.tree.map(jnp.copy, p),
                 out_shardings=shardings)
         self.params = self._snapshot_fn(new_params)
+        self.publish_count += 1
+        return self.publish_count
+
+    def _publish_fp(self, new_params) -> int:
+        """FP-layout publish into a weight-transformed engine: validate
+        against the captured fp layout (same loud per-leaf contract as the
+        compiled-layout path), then quantize/cast + copy under ONE compiled
+        program pinned to the compiled layout's shardings. Built once on
+        first fp publish, reused forever — the serving programs never see a
+        new aval, so every jit cache stays flat (the retrace-free pin).
+        The trailing tree.map(jnp.copy) exists for the leaves the storage
+        transform passes through untouched (norm scales, biases): without
+        it the jit would alias the trainer's buffers, which the trainer
+        then donates into its next update step (see the snapshot comment
+        above — same hazard, same cure, never donate)."""
+        fp_paths = jax.tree_util.tree_flatten_with_path(self._fp_layout)[0]
+        new_flat = jax.tree_util.tree_leaves(new_params)
+        for (path, fp_leaf), new_leaf in zip(fp_paths, new_flat):
+            name = jax.tree_util.keystr(path)
+            new_shape = tuple(getattr(new_leaf, "shape", ()))
+            new_dtype = np.asarray(new_leaf).dtype \
+                if not hasattr(new_leaf, "dtype") else new_leaf.dtype
+            if new_shape != tuple(fp_leaf.shape):
+                raise ValueError(
+                    f"published leaf {name} has shape {new_shape} but the "
+                    f"fp publish layout expects {tuple(fp_leaf.shape)}")
+            if jnp.dtype(new_dtype) != jnp.dtype(fp_leaf.dtype):
+                raise ValueError(
+                    f"published leaf {name} has dtype {new_dtype} but the "
+                    f"fp publish layout expects {fp_leaf.dtype}")
+        if self._requant_fn is None:
+            shardings = jax.tree.map(lambda leaf: leaf.sharding,
+                                     self.params)
+            store = self._store_weights
+            self._requant_fn = jax.jit(
+                lambda p: jax.tree.map(jnp.copy, store(p)),
+                out_shardings=shardings)
+        self.params = self._requant_fn(new_params)
         self.publish_count += 1
         return self.publish_count
 
@@ -1005,7 +1104,8 @@ class ServeEngine:
                  prefix_cache: bool = True, attend_impl: str = "auto",
                  shard_kv: bool = False, max_queue: Optional[int] = None,
                  programs: Optional[ModelPrograms] = None,
-                 speculate=None, spec_k: int = 4, kv_dtype=None):
+                 speculate=None, spec_k: int = 4, kv_dtype=None,
+                 weight_dtype=None):
         self.drafter = resolve_drafter(speculate, spec_k=spec_k,
                                        n_slots=n_slots)
         self.spec = new_spec_counters()
@@ -1017,9 +1117,14 @@ class ServeEngine:
         # to live here is gone — flash-everywhere is the default forward.
         self.programs = programs if programs is not None else ModelPrograms(
             bundle, params, plan=plan, shard_kv=shard_kv,
-            attend_impl=attend_impl, kv_dtype=kv_dtype)
+            attend_impl=attend_impl, kv_dtype=kv_dtype,
+            weight_dtype=weight_dtype)
         self.bundle = self.programs.bundle
         self.kv_dtype = self.programs.kv_dtype
+        # like kv_dtype: when a pre-built ``programs`` is shared in, the
+        # storage dtypes are ITS dtypes — the kwarg only shapes a fresh
+        # ModelPrograms (spawned replicas inherit the fleet's precision)
+        self.weight_dtype = self.programs.weight_dtype
         self.config = self.programs.config
         self.mod = self.programs.mod
         self.plan = self.programs.plan
@@ -1330,3 +1435,12 @@ class ServeEngine:
             cached_pages=self.scheduler.cache_pages_held(),
             n_slots=self.n_slots, max_pages=self.max_pages,
             pool_bytes=self.kv_cache_bytes())
+
+    def weight_report(self) -> dict:
+        """The preflight-style byte table for this engine's weights."""
+        return build_weight_report(self.programs)
+
+    def weight_bytes(self) -> int:
+        """Actual param storage bytes (int8 payload + scales under
+        weight_dtype='int8') — the weights twin of kv_cache_bytes."""
+        return params_nbytes(self.programs.params)

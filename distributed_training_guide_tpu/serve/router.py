@@ -263,8 +263,18 @@ class Router:
                 f"replicas disagree on page_size ({sorted(page_sizes)}) — "
                 f"the prefix-affinity key is page-aligned and a mixed "
                 f"fleet would split identical prefixes across engines")
+        for knob in ("kv_dtype", "weight_dtype"):
+            vals = {getattr(r.engine, knob, None) for r in replicas}
+            if len(vals) != 1:
+                raise ValueError(
+                    f"replicas disagree on {knob} ({sorted(map(str, vals))})"
+                    f" — a mixed-precision fleet breaks routing identity "
+                    f"(the same request would sample different tokens per "
+                    f"replica) and the all-or-nothing publish contract")
         self.replicas: dict[str, Replica] = {r.name: r for r in replicas}
         self.page_size = page_sizes.pop()
+        self.kv_dtype = getattr(replicas[0].engine, "kv_dtype", None)
+        self.weight_dtype = getattr(replicas[0].engine, "weight_dtype", None)
         self.heartbeat_timeout_s = heartbeat_timeout_s
         self.max_route_attempts = max_route_attempts
         self.max_resubmits = max_resubmits
@@ -554,6 +564,17 @@ class Router:
                 f"{replica.engine.page_size} but the fleet routes affinity "
                 f"at page_size {self.page_size} — a mixed fleet would "
                 f"split identical prefixes across engines")
+        for knob, fleet_val in (("kv_dtype", self.kv_dtype),
+                                ("weight_dtype", self.weight_dtype)):
+            val = getattr(replica.engine, knob, None)
+            if val != fleet_val:
+                raise ValueError(
+                    f"replica {replica.name!r} has {knob}={val!r} but the "
+                    f"fleet serves {knob}={fleet_val!r} — a scale-up "
+                    f"replica at a different precision breaks routing "
+                    f"identity and the all-or-nothing publish contract "
+                    f"(spawn_like inherits the source engine's config; "
+                    f"use it instead of a bare constructor)")
         self.replicas[replica.name] = replica
         self.counters["replicas_added"] += 1
 
@@ -808,7 +829,8 @@ def local_fleet(bundle, params, n_replicas: int = 2, *,
             bundle, params, plan=engine_kw.get("plan"),
             shard_kv=engine_kw.get("shard_kv", False),
             attend_impl=engine_kw.get("attend_impl", "auto"),
-            kv_dtype=engine_kw.get("kv_dtype"))
+            kv_dtype=engine_kw.get("kv_dtype"),
+            weight_dtype=engine_kw.get("weight_dtype"))
     replicas = []
     for i in range(n_replicas):
         engine = ServeEngine(bundle, params, programs=programs, **engine_kw)

@@ -1,0 +1,220 @@
+"""Serve-plane weight storage precision (``weight_dtype=`` on the engine).
+
+KV pages went int8 in the kv_pages PR; base weights are the last
+unquantized tensor in the system — the largest HBM tenant and the bytes
+every bandwidth-bound decode step streams. This module owns the policy
+half of the change: which leaves quantize, at what block size, and what
+the bytes cost per dtype. The mechanism half (block-dequant fused into
+the matmul loops) lives in ``ops/quantized_matmul.py``.
+
+Storage layout: selected 2-D/stacked-3-D projection leaves become
+``train/precision.py`` ``Quantized`` containers — int8 payload (same
+shape) plus per-block fp32 absmax scales over the TRAILING axis
+(Dettmers, arXiv:2110.02861). Norm scales, biases, and q/k-norm leaves
+stay in the model's param dtype: they are vectors, a rounding-off of the
+normalizer costs far more quality than their bytes are worth.
+
+Leaf selection is by name, for the llama family only (the same loud
+refusal contract as ``models/lora.py``'s TARGET_PATHS): embed table,
+lm_head, the four attention projections, and the three MLP projections.
+Other families refuse before compile rather than silently serving a
+half-quantized model.
+
+Block size: 32 along the trailing axis, clamped so every leaf gets at
+least two blocks (``bs = d // 2`` for narrow leaves) — the engine's HLO
+pin that no full fp32 weight tensor materializes is only honest if even
+the per-layer scan slice dequantizes block-by-block. At bs=32 the cost
+is one fp32 scale per 32 int8 weights: ~1.125 bytes/param, a ~3.5x
+shrink vs fp32 params (+scales) and ~2x the int8 win of bs=128 pallas
+tiles would give on debug-sized models; real-model TPU kernels can
+re-quantize at 128 when the pallas path matters.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..train.precision import (Quantized, _is_quantized, cast_floats,
+                               quantize_blockwise)
+
+__all__ = ["WEIGHT_DTYPES", "WEIGHT_BLOCK", "weight_dtype_name",
+           "weight_block_size", "is_quantizable_path", "store_weights",
+           "params_nbytes", "weight_tree_bytes", "weight_bytes_by_dtype"]
+
+WEIGHT_DTYPES = ("fp32", "bf16", "int8")
+
+# trailing-axis block size (see module docstring for the 32-vs-128 trade)
+WEIGHT_BLOCK = 32
+
+# llama-family projection leaves that quantize (path form: dict keys joined
+# by "/", the layer-scan "layers" level included)
+_QUANTIZABLE = re.compile(
+    r"^(embed/embedding|lm_head"
+    r"|layers/attn/(wq|wk|wv|wo)"
+    r"|layers/mlp/(gate|up|down))$")
+
+
+def weight_dtype_name(config, weight_dtype=None) -> str:
+    """Normalize the engine's ``weight_dtype=`` knob: None inherits the
+    model's param storage dtype (the pre-quantization behavior — no
+    transform at all), otherwise one of ``WEIGHT_DTYPES``. Mirrors
+    ``kv_pages.kv_dtype_name``; the name — not a jnp dtype — is canonical
+    because "int8" is payload + scales, not a single dtype."""
+    if weight_dtype is None:
+        pdt = jnp.dtype(getattr(config, "param_dtype", config.dtype))
+        return "bf16" if pdt == jnp.bfloat16 else "fp32"
+    name = str(weight_dtype).lower()
+    alias = {"float32": "fp32", "bfloat16": "bf16"}
+    name = alias.get(name, name)
+    if name not in WEIGHT_DTYPES:
+        raise ValueError(f"weight_dtype must be one of {WEIGHT_DTYPES}, "
+                         f"got {weight_dtype!r}")
+    return name
+
+
+def weight_block_size(d: int) -> int:
+    """Block size for a leaf with trailing dim ``d``: WEIGHT_BLOCK, clamped
+    so the leaf always splits into >= 2 blocks (the no-full-fp32-transient
+    guarantee holds per leaf, not just for wide ones)."""
+    if d >= 2 * WEIGHT_BLOCK:
+        return WEIGHT_BLOCK
+    return max(1, d // 2)
+
+
+def _path_str(path) -> str:
+    parts = []
+    for entry in path:
+        key = getattr(entry, "key", None)
+        if key is None:
+            key = getattr(entry, "name", entry)
+        parts.append(str(key))
+    return "/".join(parts)
+
+
+def is_quantizable_path(path) -> bool:
+    """True for the llama-family projection leaves that go int8 (``path``
+    is a jax key-path tuple or a pre-joined "a/b/c" string)."""
+    s = path if isinstance(path, str) else _path_str(path)
+    return bool(_QUANTIZABLE.match(s))
+
+
+def _require_llama(family: Optional[str]) -> None:
+    if family != "llama":
+        raise ValueError(
+            f"weight_dtype='int8' leaf selection is defined for the llama "
+            f"family only (got family={family!r}); extend "
+            f"serve/weights.py _QUANTIZABLE before serving other families "
+            f"quantized — silently skipping unknown leaves would serve a "
+            f"half-quantized model")
+
+
+def store_weights(params, weight_dtype: str, *, family: Optional[str]):
+    """fp-layout params -> storage-layout params for a canonical
+    ``weight_dtype`` name. Pure jnp (jit-able: the publish re-quantize
+    path runs this under one compiled program). fp32/bf16 cast every
+    inexact leaf; int8 quantizes the selected projection leaves block-wise
+    and leaves vectors (norms/biases) in their param dtype."""
+    if weight_dtype != "int8":
+        return cast_floats(
+            params, jnp.float32 if weight_dtype == "fp32" else jnp.bfloat16)
+    _require_llama(family)
+
+    def one(path, leaf):
+        if is_quantizable_path(path):
+            return quantize_blockwise(
+                leaf, block_size=weight_block_size(leaf.shape[-1]))
+        return leaf
+
+    return jax.tree_util.tree_map_with_path(one, params)
+
+
+def quantized_param_shardings(fp_shardings, params):
+    """Shardings for a ``store_weights``-transformed tree, derived from the
+    FP tree's shardings. The plan's ``param_shardings`` cannot run on a
+    quantized tree directly — its axes-tree walk treats tuples as leaves
+    and ``Quantized`` IS a NamedTuple — so the engine computes the fp
+    shardings first and this maps them across: the int8 payload inherits
+    its leaf's sharding verbatim; the scale keeps the spec on the leading
+    dims and shards its trailing block axis only when every shard would
+    hold whole blocks (otherwise that axis replicates — scales are tiny)."""
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    def one(sh, leaf):
+        if not _is_quantized(leaf):
+            return sh
+        q, scale = leaf.q, leaf.scale
+        spec = list(sh.spec) + [None] * (q.ndim - len(sh.spec))
+        trail = spec[-1]
+        keep_trail = False
+        if trail is not None:
+            axes = trail if isinstance(trail, tuple) else (trail,)
+            t = 1
+            for a in axes:
+                t *= sh.mesh.shape[a]
+            nb = scale.shape[-1]
+            bs = -(-q.shape[-1] // nb)
+            keep_trail = (t > 0 and nb % t == 0
+                          and (q.shape[-1] // t) % bs == 0)
+        sspec = PartitionSpec(*spec[:-1], trail if keep_trail else None)
+        return Quantized(q=sh, scale=NamedSharding(sh.mesh, sspec))
+
+    # fp_shardings is a tree-prefix of the transformed params (a sharding
+    # LEAF sits where params has a Quantized node), so tree.map hands the
+    # whole container to ``one``
+    return jax.tree.map(one, fp_shardings, params)
+
+
+def params_nbytes(params) -> int:
+    """Actual storage bytes of a (possibly Quantized) param tree — int8
+    payloads and fp32 scales each count at their own width."""
+    return sum(x.dtype.itemsize * x.size
+               for x in jax.tree_util.tree_leaves(params))
+
+
+def _leaf_bytes(shape, dtype, name: str, quantizable: bool) -> int:
+    n = 1
+    for d in shape:
+        n *= d
+    if not jnp.issubdtype(dtype, jnp.inexact):
+        return n * jnp.dtype(dtype).itemsize      # int leaves ride along
+    if name == "int8" and quantizable:
+        d = shape[-1] if shape else 1
+        bs = weight_block_size(d)
+        nblocks = -(-d // max(bs, 1))
+        lead = n // max(d, 1)
+        return n + lead * nblocks * 4             # int8 payload + fp32 scales
+    if name in ("fp32", "bf16"):
+        return n * (4 if name == "fp32" else 2)
+    return n * jnp.dtype(dtype).itemsize          # int8, non-quantized leaf
+
+
+def weight_tree_bytes(shapes_tree, weight_dtype: str,
+                      family: Optional[str]) -> int:
+    """Analytic storage bytes for an fp-layout shapes tree (eval_shape
+    output) stored at ``weight_dtype`` — the pricing twin of
+    ``kv_pages.kv_page_bytes``, used by preflight before any compile."""
+    name = weight_dtype_name(None, weight_dtype)  # explicit name required
+    if name == "int8":
+        _require_llama(family)
+    total = 0
+    for path, leaf in jax.tree_util.tree_flatten_with_path(shapes_tree)[0]:
+        total += _leaf_bytes(leaf.shape, leaf.dtype, name,
+                             name == "int8" and is_quantizable_path(path))
+    return total
+
+
+def weight_bytes_by_dtype(shapes_tree, family: Optional[str]) -> dict:
+    """{dtype name: storage bytes} for every supported weight_dtype; the
+    int8 row only appears when the family has a leaf-selection rule (the
+    serve README's per-model byte table and preflight's serve_weights
+    report both render this)."""
+    out = {}
+    for name in WEIGHT_DTYPES:
+        if name == "int8" and family != "llama":
+            continue
+        out[name] = weight_tree_bytes(shapes_tree, name, family)
+    return out

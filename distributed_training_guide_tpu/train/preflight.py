@@ -193,7 +193,7 @@ def _tree_bytes(shapes_tree) -> int:
 
 def price_post_colocation(trainer, *, n_slots: int, page_size: int = 16,
                           max_len: int = 2048, kv_dtype=None,
-                          teacher_bundle=None,
+                          weight_dtype=None, teacher_bundle=None,
                           budget_bytes: int | None = None) -> dict:
     """Price the post-training loop's CO-RESIDENT memory — everything
     that must live on the chip at once for rollout→score→update→publish
@@ -212,10 +212,21 @@ def price_post_colocation(trainer, *, n_slots: int, page_size: int = 16,
     opt_shapes = jax.eval_shape(trainer.optimizer.init, trainer.param_shapes)
     opt_b = _per_device_bytes(opt_shapes, trainer.opt_shardings_device)
     grad_b = params_b          # transient, resident at the update boundary
-    # the engine serves the MERGED policy (base layout for LoRA bundles)
+    # the engine serves the MERGED policy (base layout for LoRA bundles),
+    # priced at the engine's weight_dtype: the QLoRA colocation is a
+    # quantized base copy + fp adapters in the trainer + the teacher,
+    # and it is exactly the int8 engine copy that makes all three fit
     base_bundle = getattr(trainer.bundle, "lora_base", trainer.bundle)
-    engine_params_b = _tree_bytes(jax.eval_shape(
-        lambda: base_bundle.init(cfg, jax.random.key(0))))
+    engine_shapes = jax.eval_shape(
+        lambda: base_bundle.init(cfg, jax.random.key(0)))
+    if weight_dtype is None:
+        wname = "model"
+        engine_params_b = _tree_bytes(engine_shapes)
+    else:
+        from ..serve.weights import weight_dtype_name, weight_tree_bytes
+        wname = weight_dtype_name(cfg, weight_dtype)
+        engine_params_b = weight_tree_bytes(
+            engine_shapes, wname, getattr(base_bundle, "family", None))
     n_pages = 1 + n_slots * pages_for_tokens(max_len, page_size)
     pool_b = kv_page_bytes(cfg, page_size=page_size, n_pages=n_pages,
                            kv_dtype=kv_dtype_name(cfg, kv_dtype))
@@ -230,6 +241,7 @@ def price_post_colocation(trainer, *, n_slots: int, page_size: int = 16,
         "policy_opt_state_bytes": opt_b,
         "policy_grad_bytes_transient": grad_b,
         "engine_param_bytes": engine_params_b,
+        "engine_weight_dtype": wname,
         "engine_pool_bytes": pool_b,
         "engine_pool_pages": n_pages,
         "teacher_param_bytes": teacher_b,
@@ -533,6 +545,43 @@ def run_preflight(trainer, *, global_batch: int, seq_length: int,
         f"amortizes the {params_b / 2**20:.0f} MiB/chip weight read to "
         f"{_amortized(0.7) / 2**20:.0f} MiB/token at 0.7 acceptance "
         f"({_amortized(1.0) / 2**20:.0f} at full)")
+
+    # weight_dtype column (serve/weights.py): the params are the decode
+    # step's OTHER byte stream, and with int8 KV they are the largest
+    # remaining HBM tenant. Rows are STORAGE bytes per dtype — int8
+    # includes the per-block fp32 scales (payload alone would overstate
+    # the win, same rule as the kv rows above). The publish/swap payload
+    # IS the storage: a quantized-layout publish or an engine-generation
+    # swap moves exactly these bytes, and an fp-layout publish into a
+    # quantized engine moves the fp32 row once before the engine
+    # re-quantizes on-device. The int8 row appears only for families
+    # with a leaf-selection rule (llama); others refuse before compile.
+    from ..serve.weights import weight_bytes_by_dtype
+    serve_bundle = getattr(trainer.bundle, "lora_base", trainer.bundle)
+    weight_shapes = jax.eval_shape(
+        lambda: serve_bundle.init(cfg, jax.random.key(0)))
+    w_by_dtype = weight_bytes_by_dtype(
+        weight_shapes, getattr(serve_bundle, "family", None))
+    report["serve_weights"] = {
+        "weight_bytes_by_dtype": w_by_dtype,
+        "publish_payload_bytes_by_dtype": dict(w_by_dtype),
+        "swap_payload_bytes_by_dtype": dict(w_by_dtype),
+        "int8_supported": "int8" in w_by_dtype,
+    }
+    if "int8" in w_by_dtype:
+        w_ratio = round(w_by_dtype["int8"] / w_by_dtype["fp32"], 4)
+        report["serve_weights"]["int8_bytes_vs_fp32"] = w_ratio
+        LOGGER.info(
+            f"serve weight pricing: params {w_by_dtype['fp32'] / 2**20:.2f}"
+            f" MiB fp32 / {w_by_dtype['bf16'] / 2**20:.2f} MiB bf16 / "
+            f"{w_by_dtype['int8'] / 2**20:.2f} MiB int8 (block scales "
+            f"included, {w_ratio:.2f}x of fp32) — the same factor on every "
+            f"publish/swap payload and on the per-token weight read above")
+    else:
+        LOGGER.info(
+            f"serve weight pricing: params {w_by_dtype['fp32'] / 2**20:.2f}"
+            f" MiB fp32 / {w_by_dtype['bf16'] / 2**20:.2f} MiB bf16; no "
+            f"int8 leaf-selection rule for this family (serve/weights.py)")
 
     if target_device is None and jax.default_backend() != "tpu":
         target_device = "v5p"  # the 405B recipe's stated target pod

@@ -76,11 +76,28 @@ def pytest_terminal_summary(terminalreporter, exitstatus, config):
     full_run = len(reports) > 200        # don't flag `pytest -k one_test`
     flag = (" ** OVER BUDGET — trim or mark slow **"
             if full_run and headroom < 0 else "")
+    # fixed host-speed microbench: a 256x256 fp32 numpy matmul x10 —
+    # the SAME work every run on every machine, so when the timing
+    # block's numbers drift across runs, this line says whether the
+    # suite got slower or the host did (a cross-run diff of test
+    # durations alone cannot tell the two apart)
+    import time as _time
+
+    import numpy as _np
+
+    _a = _np.ones((256, 256), _np.float32)
+    _t0 = _time.perf_counter()
+    for _ in range(10):
+        _a @ _a
+    host_ms = (_time.perf_counter() - _t0) * 100.0   # ms per matmul
     terminalreporter.write_sep(
         "-", f"tier-1 timing: {total:.1f}s across {len(reports)} test "
              f"calls (budget {budget:.0f}s incl. setup/collection; "
              f"headroom {headroom:+.1f}s after a {margin:.0f}s "
              f"overhead margin){flag}")
+    terminalreporter.write_line(
+        f"  host speed: {host_ms:.3f} ms per 256x256 fp32 matmul "
+        f"(fixed microbench — normalizes this block across machines)")
     for rep in slowest:
         terminalreporter.write_line(
             f"  {rep.duration:7.2f}s  {rep.nodeid}")

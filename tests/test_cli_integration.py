@@ -440,6 +440,49 @@ def test_preflight_budget_and_lowering(eight_devices):
     assert sk["bytes_per_slot_by_kv_dtype"]["int8"] == 4 * by["int8"]
     assert sk["int8_bytes_vs_fp32"] <= 0.55
 
+    # weight_dtype rows (serve/weights.py): STORAGE bytes per dtype —
+    # the int8 row includes the per-block fp32 scales, same rule as the
+    # kv rows above — and a publish or generation swap moves exactly
+    # these bytes, so the payload tables equal the storage table
+    sw = rep["serve_weights"]
+    wb = sw["weight_bytes_by_dtype"]
+    n_weights = sum(
+        int(np.prod(sd.shape, dtype=np.int64)) for sd in jax.tree.leaves(
+            jax.eval_shape(lambda: bundle.init(dcfg, jax.random.key(0)))))
+    assert wb["fp32"] == 4 * n_weights
+    assert wb["bf16"] == 2 * n_weights
+    assert sw["int8_supported"] and 0 < wb["int8"] < wb["bf16"]
+    assert sw["publish_payload_bytes_by_dtype"] == wb
+    assert sw["swap_payload_bytes_by_dtype"] == wb
+    # the acceptance pin: int8 weights (scales included) at least 1.9x
+    # smaller than fp32 on every publish/swap payload
+    assert sw["int8_bytes_vs_fp32"] <= 0.53
+    # ...and the analytic rows match what an engine actually holds
+    from distributed_training_guide_tpu.serve.engine import ServeEngine
+    w_eng = ServeEngine(bundle, bundle.init(dcfg, jax.random.key(0)),
+                        n_slots=2, page_size=16, max_len=64,
+                        weight_dtype="int8")
+    assert w_eng.weight_bytes() == wb["int8"]
+
+    # colocation pricing under QLoRA (post/loop.py): the engine's merged
+    # copy is priced at ITS weight_dtype — quantized base + fp adapters
+    # in the trainer + an fp teacher all priced in one report
+    from distributed_training_guide_tpu.models.lora import lora_bundle
+    from distributed_training_guide_tpu.train.preflight import \
+        price_post_colocation
+    lt = Trainer(bundle=lora_bundle(bundle, rank=4),
+                 optimizer=adamw_cosine(1e-3), lora_only=True)
+    colo = price_post_colocation(lt, n_slots=4, max_len=64,
+                                 weight_dtype="int8", teacher_bundle=bundle)
+    assert colo["engine_weight_dtype"] == "int8"
+    assert colo["engine_param_bytes"] == wb["int8"]
+    assert colo["teacher_param_bytes"] == wb["fp32"]
+    colo_fp = price_post_colocation(lt, n_slots=4, max_len=64)
+    assert colo_fp["engine_weight_dtype"] == "model"
+    assert colo_fp["engine_param_bytes"] == wb["fp32"]
+    assert colo["total_bytes"] == \
+        colo_fp["total_bytes"] - wb["fp32"] + wb["int8"] + wb["fp32"]
+
     # tp mesh: the sharded pool (serve/sharding.py kv-head split) halves
     # the per-CHIP page/slot bytes at tp=2 (llama-debug: 2 kv heads)
     tp_t = Trainer(bundle=bundle, optimizer=adamw_cosine(1e-3),

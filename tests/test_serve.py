@@ -442,9 +442,12 @@ def _cache_page_refs(sched) -> dict:
 
 
 @pytest.mark.parametrize(
-    "kv_dtype", [None, pytest.param("int8", marks=pytest.mark.kvquant)],
-    ids=["fp32", "int8"])
-def test_scheduler_random_trace_invariants(llama, kv_dtype):
+    "kv_dtype,weight_dtype",
+    [(None, None),
+     pytest.param("int8", None, marks=pytest.mark.kvquant),
+     pytest.param(None, "int8", marks=pytest.mark.wquant)],
+    ids=["fp32", "kv-int8", "w-int8"])
+def test_scheduler_random_trace_invariants(llama, kv_dtype, weight_dtype):
     """Property-style trace over refcounted CoW pages: random
     submit/step events on a tight pool with chunked prefill, asserting
     after EVERY iteration that (a) page refcounts equal the number of
@@ -454,11 +457,15 @@ def test_scheduler_random_trace_invariants(llama, kv_dtype):
     the int8-quantized pool (the kvquant satellite): the allocator never
     sees dtypes, but the DEVICE side does — preempt/replay/CoW/commit all
     rewrite quantized bytes + scales, and the batch-1 oracle (itself
-    int8) pins that those rewrites are bitwise."""
+    int8) pins that those rewrites are bitwise. The THIRD run is the
+    wquant satellite — int8 WEIGHTS over an fp32 pool: every program
+    (prefill, decode, replay) reads the same quantized params, so the
+    invariants and the batch-1 oracle must hold unchanged."""
     bundle, params = llama
     rng = np.random.default_rng(42)
     eng = ServeEngine(bundle, params, n_slots=3, page_size=4, max_len=16,
-                      n_pages=7, prefill_chunk=4, kv_dtype=kv_dtype)
+                      n_pages=7, prefill_chunk=4, kv_dtype=kv_dtype,
+                      weight_dtype=weight_dtype)
     sched, pool = eng.scheduler, eng.scheduler.pool
     done, submitted = [], []
     for it in range(400):
@@ -504,7 +511,7 @@ def test_scheduler_random_trace_invariants(llama, kv_dtype):
     # is engine-config-relative — so the reference runs the same chunk
     # program (see serve/kv_pages.py docstring).
     ref_eng = _ref_engine(bundle, params, page_size=4, max_len=16,
-                          kv_dtype=kv_dtype,
+                          kv_dtype=kv_dtype, weight_dtype=weight_dtype,
                           prefill_chunk=4 if kv_dtype == "int8" else None)
     for rid, req in submitted:
         ref = generate_many(ref_eng, [_fresh(req)])[0]
