@@ -529,6 +529,18 @@ def run_decode_check(only: str = None) -> None:
       one new variable; gate |delta| <= 0.02. The raw-fp acceptance
       rides along ungated (the rounding's own effect — visible on this
       random-init toy, noise on trained models).
+    - multilora_slots8 (queued sweep rung): 8 slots serving 4 LoRA
+      tenants CO-RESIDENT (requests carry adapter_id; one ragged
+      grouped GEMM per target projection applies every tenant's delta
+      in the batched decode step) vs two in-rung controls on the
+      identical workload — base-only (the lora-path overhead) and one
+      MERGED engine per tenant stepped serially (the pool-less
+      dedicated-replica world). Headline: the consolidation factor,
+      mixed tok/s over the per-tenant serial aggregate.
+    - multilora_publish (queued sweep rung): adapter-slot republish
+      latency (adapter-sized payload through one cached jit with a
+      traced slot index) vs full publish_params on the same engine —
+      the tenant-churn price; jit caches must stay flat across both.
     - router_fleet2 (queued sweep rung): 16 requests in two shared-
       prefix groups over a 2-replica fleet behind the router
       (serve/router.py) vs one identical single engine in-rung — prices
@@ -959,6 +971,134 @@ def run_decode_check(only: str = None) -> None:
             "rounding_delta_ungated": round(acc_snap - acc_fp, 4),
         }
         out["value"] = tps8
+        _emit({**out, "partial": True})
+
+    if "multilora_slots8" in rungs:
+        # batched multi-LoRA: 8 slots serving 4 TENANTS co-resident —
+        # requests carry adapter_id and each decode step applies every
+        # tenant's delta through one ragged grouped GEMM (gather-sorted
+        # by adapter, group_sizes from the batch histogram). Controls
+        # in-rung on the identical workload: base-only (the lora
+        # overhead row — same engine shape, no pool) and the pool-less
+        # world (one MERGED engine per tenant, each batching only its
+        # own 2 requests, stepped serially — dedicated-replica serving).
+        # The headline is the CONSOLIDATION factor: mixed tok/s over the
+        # per-tenant serial aggregate — multi-LoRA's reason to exist is
+        # that tenants share the batch, so occupancy stays at 8 where
+        # dedicated engines idle 6 of 8 slots each (S-LoRA/Punica's
+        # claim, priced on this engine).
+        from distributed_training_guide_tpu.models.lora import (lora_bundle,
+                                                                merge_lora)
+
+        ml_lb = lora_bundle(bundle, rank=8)
+        tenants = [jax.tree.map(lambda x: x * 0.05,
+                                ml_lb.init(ml_lb.config,
+                                           jax.random.key(100 + i))["lora"])
+                   for i in range(4)]
+
+        def ml_workload(engine, adapter_ids):
+            generate_many(engine, [Request(prompt_ids=[3, 17, 42],
+                                           max_new_tokens=4,
+                                           adapter_id=adapter_ids[0])])
+            engine.decode_steps = engine.decode_tokens = 0
+            reqs = [Request(prompt_ids=[3 + i, 17, 42], max_new_tokens=64,
+                            seed=i,
+                            adapter_id=adapter_ids[i % len(adapter_ids)])
+                    for i in range(8)]
+            t0 = time.perf_counter()
+            results = generate_many(engine, reqs)
+            return results, throughput_stats(
+                results, time.perf_counter() - t0, engine)
+
+        ml_eng = ServeEngine(bundle, params, n_slots=8, page_size=16,
+                             max_len=128, max_adapters=8, adapter_rank=8)
+        ml_slots = [ml_eng.publish_adapter(t, name=f"tenant-{i}")
+                    for i, t in enumerate(tenants)]
+        _, mixed = ml_workload(ml_eng, ml_slots)
+        _, base_only = ml_workload(
+            ServeEngine(bundle, params, n_slots=8, page_size=16,
+                        max_len=128), [0])
+        # dedicated-replica control: build + warm the merged engines
+        # OUTSIDE the timed window (compile is not a serving cost both
+        # worlds pay per request), then serve each tenant's slice
+        merged_engines = []
+        for i, t in enumerate(tenants):
+            m_eng = ServeEngine(
+                bundle, merge_lora(ml_lb, {"base": params, "lora": t}),
+                n_slots=8, page_size=16, max_len=128)
+            generate_many(m_eng, [Request(prompt_ids=[3, 17, 42],
+                                          max_new_tokens=4)])
+            m_eng.decode_steps = m_eng.decode_tokens = 0
+            merged_engines.append(m_eng)
+        t0 = time.perf_counter()
+        merged_tokens = 0
+        for i, m_eng in enumerate(merged_engines):
+            res = generate_many(m_eng, [
+                Request(prompt_ids=[3 + j, 17, 42], max_new_tokens=64,
+                        seed=j) for j in range(8) if j % 4 == i])
+            merged_tokens += sum(len(r.generated_ids) for r in res)
+        merged_wall = time.perf_counter() - t0
+        merged_tps = (round(merged_tokens / merged_wall, 1)
+                      if merged_wall > 0 else 0.0)
+        out["multilora_slots8"] = {
+            **mixed,
+            "n_adapters": len(ml_slots),
+            "adapter_rank": 8,
+            "base_only_tokens_per_s": base_only["tokens_per_s"],
+            "lora_overhead_vs_base": (
+                round(mixed["tokens_per_s"] / base_only["tokens_per_s"], 3)
+                if base_only["tokens_per_s"] else 0.0),
+            "merged_serial_tokens_per_s": merged_tps,
+            "consolidation_factor": (
+                round(mixed["tokens_per_s"] / merged_tps, 3)
+                if merged_tps else 0.0),
+        }
+        out["value"] = mixed["tokens_per_s"]
+        _emit({**out, "partial": True})
+
+    if "multilora_publish" in rungs:
+        # tenant churn pricing: republishing an adapter into its pool
+        # slot (one cached jit, traced slot index, adapter-sized
+        # payload) vs a full publish_params (whole-model payload) on the
+        # same engine — the ratio is what makes per-tenant policy
+        # updates cheap enough to ride every post-training boundary.
+        # Both loops block on the result; jit caches must stay FLAT
+        # across the churn (the retrace-free contract, pinned in tests).
+        from distributed_training_guide_tpu.models.lora import lora_bundle
+
+        mp_lb = lora_bundle(bundle, rank=8)
+        mp_eng = ServeEngine(bundle, params, n_slots=2, page_size=16,
+                             max_len=64, max_adapters=8, adapter_rank=8)
+        payloads = [jax.tree.map(lambda x: x * 0.05,
+                                 mp_lb.init(mp_lb.config,
+                                            jax.random.key(200 + i))["lora"])
+                    for i in range(6)]
+        slot = mp_eng.publish_adapter(payloads[0], name="churn")  # warm
+        mp_eng.publish_params(params)                             # warm
+        jax.block_until_ready(mp_eng.programs.adapter_stacks)
+        caches_before = dict(mp_eng.programs.jit_cache_sizes())
+        t0 = time.perf_counter()
+        for p in payloads:
+            mp_eng.publish_adapter(p, slot=slot)
+        jax.block_until_ready(mp_eng.programs.adapter_stacks)
+        insert_ms = 1000 * (time.perf_counter() - t0) / len(payloads)
+        t0 = time.perf_counter()
+        for _ in payloads:
+            mp_eng.publish_params(params)
+        jax.block_until_ready(mp_eng.programs.params)
+        publish_ms = 1000 * (time.perf_counter() - t0) / len(payloads)
+        rep = mp_eng.adapter_report()
+        out["multilora_publish"] = {
+            "adapter_insert_ms": round(insert_ms, 3),
+            "publish_params_ms": round(publish_ms, 3),
+            "insert_speedup": (round(publish_ms / insert_ms, 2)
+                               if insert_ms > 0 else 0.0),
+            "adapter_payload_bytes": rep["publish_payload_bytes"],
+            "pool_bytes": rep["pool_bytes"],
+            "retrace_free": (dict(mp_eng.programs.jit_cache_sizes())
+                             == caches_before),
+        }
+        out["value"] = out.get("value") or 0.0
         _emit({**out, "partial": True})
 
     if "disagg_prefill192_decode4" in rungs:
@@ -1804,6 +1944,16 @@ SWEEP_QUEUE = [
     dict(name="wq_int8_slots8", decode_rungs="wq_int8_slots8"),
     dict(name="wq_spec_accept", decode_rungs="wq_spec_accept"),
     dict(name="post_qlora_cpu", post_rungs="post_qlora_cpu"),
+    # multi-LoRA rungs: multilora_slots8 = 8 slots serving 4 co-resident
+    # tenants through the ragged grouped-GEMM decode path, with the
+    # base-only and dedicated-merged-engine controls in-rung — the
+    # consolidation factor (mixed tok/s over per-tenant serial) is the
+    # headline, S-LoRA/Punica's claim priced on this engine.
+    # multilora_publish = adapter insert latency (one cached jit,
+    # traced slot index) vs a full publish_params on the same engine,
+    # jit caches pinned flat across the churn.
+    dict(name="multilora_slots8", decode_rungs="multilora_slots8"),
+    dict(name="multilora_publish", decode_rungs="multilora_publish"),
     # LAST on purpose: fence_every=4 dispatches 4 steps ahead, the exact
     # pattern this pool's documented failure mode punishes — its first
     # attempt (2026-07-31 03:50) stalled and the pool went down with it.

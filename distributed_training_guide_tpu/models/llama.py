@@ -277,7 +277,8 @@ def attention_sublayer(config, x: jnp.ndarray, attn_params: dict, norm_scale,
                        standard_layout: bool = True,
                        tp_axis: Optional[str] = None,
                        kv_cache=None, return_kv: bool = False,
-                       window_override=None, attend_override=None):
+                       window_override=None, attend_override=None,
+                       wmat_override=None):
     """norm -> rope'd GQA attention -> output proj (residual added by caller).
 
     Shared by the dense Llama block and the MoE family (config is duck-typed:
@@ -303,16 +304,26 @@ def attention_sublayer(config, x: jnp.ndarray, attn_params: dict, norm_scale,
     projections and the family-resolved attention extras, and whatever
     functional cache state it updates rides back through ``aux`` (returned
     in place of (k, v) when ``return_kv``). Mutually exclusive with
-    ``kv_cache``."""
+    ``kv_cache``.
+
+    ``wmat_override`` (the multi-LoRA serving hook): a callable
+    ``(name, h, w) -> out`` replacing each target projection's
+    ``_wmat`` — the batched adapter delta adds there without ever
+    materializing a merged weight. Default None keeps every training
+    path byte-identical."""
     b, s, e = x.shape
     d = config.head_size
     cdt = config.dtype
+    if wmat_override is None:
+        def wmat_override(name, hh, ww):
+            return _wmat(hh, ww, cdt)
     if norm_scale is None:  # post-norm wiring (OLMo-2): raw residual in;
         h = x               # the caller norms the OUTPUT instead
     else:
         h = _rmsnorm(x, norm_scale, config.rms_norm_eps,
                      getattr(config, "norm_plus_one", False))
-    q, k, v = (_wmat(h, attn_params[w], cdt) for w in ("wq", "wk", "wv"))
+    q, k, v = (wmat_override(w, h, attn_params[w])
+               for w in ("wq", "wk", "wv"))
     if "bq" in attn_params:  # Qwen2-style QKV biases; shard-local under
         q = q + attn_params["bq"].astype(cdt)  # manual tp (bias carries the
         k = k + attn_params["bk"].astype(cdt)  # same heads/kv logical axis
@@ -348,7 +359,7 @@ def attention_sublayer(config, x: jnp.ndarray, attn_params: dict, norm_scale,
     if attend_override is not None:
         attn, aux = attend_override(q, k, v, window=window, scale=attn_scale,
                                     softcap=softcap)
-        out = _wmat(attn.reshape(b, s, -1), attn_params["wo"], cdt)
+        out = wmat_override("wo", attn.reshape(b, s, -1), attn_params["wo"])
         if tp_axis is not None:
             out = _psum(out, tp_axis)
         return (out, aux) if return_kv else out
@@ -380,7 +391,7 @@ def attention_sublayer(config, x: jnp.ndarray, attn_params: dict, norm_scale,
                                    standard_layout=standard_layout,
                                    window=window, scale=attn_scale,
                                    logit_softcap=softcap)
-    out = _wmat(attn.reshape(b, s, -1), attn_params["wo"], cdt)
+    out = wmat_override("wo", attn.reshape(b, s, -1), attn_params["wo"])
     if tp_axis is not None:
         out = _psum(out, tp_axis)
     if return_kv:
@@ -389,24 +400,28 @@ def attention_sublayer(config, x: jnp.ndarray, attn_params: dict, norm_scale,
 
 
 def mlp_sublayer(config, x: jnp.ndarray, layer: dict,
-                 tp_axis: Optional[str] = None) -> jnp.ndarray:
+                 tp_axis: Optional[str] = None,
+                 wmat_override=None) -> jnp.ndarray:
     """post-attn norm -> gated MLP (residual added by caller). Under
     post-norm wiring (no ``post_attn_norm`` leaf) the raw stream feeds the
     MLP and the caller norms the output."""
     cdt = config.dtype
+    if wmat_override is None:
+        def wmat_override(name, hh, ww):
+            return _wmat(hh, ww, cdt)
     scale = layer.get("post_attn_norm")
     if scale is None:
         h = x
     else:
         h = _rmsnorm(x, scale, config.rms_norm_eps,
                      getattr(config, "norm_plus_one", False))
-    gate = _wmat(h, layer["mlp"]["gate"], cdt)
-    up = _wmat(h, layer["mlp"]["up"], cdt)
+    gate = wmat_override("gate", h, layer["mlp"]["gate"])
+    up = wmat_override("up", h, layer["mlp"]["up"])
     act_fn = ACT_FNS[getattr(config, "act_fn", "silu")]
     # tagged for REMAT_POLICIES["attn_mlp"]: saving the [B,S,I] inner
     # activation skips the gate/up matmul recompute in backward
     act = checkpoint_name(act_fn(gate) * up, "mlp_act")
-    down = _wmat(act, layer["mlp"]["down"], cdt)
+    down = wmat_override("down", act, layer["mlp"]["down"])
     if tp_axis is not None:  # megatron Rowwise: down-proj partial sums
         down = _psum(down, tp_axis)
     return down
@@ -600,7 +615,7 @@ def apply(
 # Training paths are unaffected (separate entry points).
 # ---------------------------------------------------------------------------
 
-def _decode_residuals(config, x, layer, attn):
+def _decode_residuals(config, x, layer, attn, wmat_override=None):
     """Shared residual wiring for the prefill/decode bodies (pre-, post-,
     and sandwich-norm variants); returns (new_x, None)."""
     plus_one = getattr(config, "norm_plus_one", False)
@@ -608,12 +623,81 @@ def _decode_residuals(config, x, layer, attn):
                                                       False):
         x = x + _rmsnorm(attn, layer["attn_out_norm"], config.rms_norm_eps,
                          plus_one)
-        x = x + _rmsnorm(mlp_sublayer(config, x, layer),
+        x = x + _rmsnorm(mlp_sublayer(config, x, layer,
+                                      wmat_override=wmat_override),
                          layer["mlp_out_norm"], config.rms_norm_eps, plus_one)
     else:
         x = x + attn
-        x = x + mlp_sublayer(config, x, layer)
+        x = x + mlp_sublayer(config, x, layer, wmat_override=wmat_override)
     return x, None
+
+
+# ---------------------------------------------------------------------------
+# Batched multi-LoRA (serve/adapters.py): the low-rank delta
+# ``scale * (x @ A_g) @ B_g`` added per target projection as a RAGGED
+# GROUPED GEMM over rows sorted by adapter (S-LoRA arXiv:2311.03285 /
+# Punica arXiv:2310.18547 — the MoE dispatch pattern applied to the decode
+# batch). The base projection is NEVER merged with the delta into a dense
+# ``W + scale*A@B`` weight: over a quantized base the merged tensor does
+# not even exist in fp, and per-adapter merges would materialize
+# ``[G, in, out]`` copies of every target — the delta stays a separate
+# rank-r bottleneck add (HLO-pinned in tests).
+# ---------------------------------------------------------------------------
+
+def _lora_sort(adapters, t: int, g: int):
+    """The PR-3 dispatch triplet for a ``[S]`` per-slot adapter vector:
+    stable sort order, its int32 inversion, and the per-group SORTED-ROW
+    counts (slot histogram x the T tokens each slot contributes)."""
+    ids = adapters.astype(jnp.int32)
+    order = jnp.argsort(ids)
+    inv = jnp.argsort(order)
+    sizes = jnp.zeros((g,), jnp.int32).at[ids].add(jnp.int32(t))
+    return order, inv, sizes
+
+
+def _lora_wmat_override(config, lora, lstack, sort):
+    """Per-layer projection hook: base ``_wmat`` plus the grouped-GEMM
+    adapter delta for targets present in ``lstack`` (this layer's
+    ``{t: {"a" [G, in, r], "b" [G, r, out]}}`` pool slices). Slot 0's
+    rows are zeros, so base-only requests contribute an exact fp ``+0``
+    — the adapter-0 == base-engine bitwise identity."""
+    from ..ops.grouped_matmul import grouped_matmul
+
+    order, inv, sizes = sort
+    cdt = config.dtype
+    scale = lora["scale"]
+    impl = lora.get("impl", "auto")
+
+    def ov(name, h, w):
+        base = _wmat(h, w, cdt)
+        pair = lstack.get(name)
+        if pair is None:
+            return base
+        s, t, k = h.shape
+        hs = h[order].reshape(s * t, k).astype(jnp.float32)
+        d = grouped_matmul(hs, pair["a"], sizes, impl=impl)
+        d = grouped_matmul(d, pair["b"], sizes, impl=impl)
+        d = d.reshape(s, t, -1)[inv]
+        return base + (jnp.float32(scale) * d).astype(base.dtype)
+
+    return ov
+
+
+def _lora_scan_xs(params, cache, wins, lora):
+    """Scan columns for the lora-threaded layer scans: the usual
+    (layers, k, v[, wins]) plus each target's per-layer A/B pool slices
+    (stacks are ``[L, G, ...]`` — the layer axis leads, like every other
+    scanned leaf)."""
+    if wins is None:
+        return (params["layers"], cache["k"], cache["v"], lora["stacks"])
+    return (params["layers"], cache["k"], cache["v"], wins, lora["stacks"])
+
+
+def _lora_unpack(inputs, wins):
+    if wins is None:
+        layer, ck, cv, lstack = inputs
+        return layer, ck, cv, None, lstack
+    return inputs
 
 
 def _layer_window_column(config):
@@ -654,29 +738,48 @@ def init_cache(config: LlamaConfig, batch: int, max_len: int) -> dict:
 
 
 def prefill(config: LlamaConfig, params: dict, input_ids: jnp.ndarray,
-            cache: dict, last_pos=None):
+            cache: dict, last_pos=None, lora=None):
     """Causal forward over the prompt, writing each layer's rope'd k/v into
     cache[:, :, :prompt_len]. Returns (logits [B, V] at ``last_pos`` —
     default the final position; the serving engine pads prompts to a bucket
-    and passes the real last index as a traced scalar — and the cache)."""
+    and passes the real last index as a traced scalar — and the cache).
+
+    ``lora`` (multi-LoRA serving): ``{"scale", "adapters" [B] int32,
+    "stacks" {t: {"a" [L, G, in, r], "b" [L, G, r, out]}}, "impl"}`` —
+    each example's adapter delta is added per target projection through
+    the same grouped-GEMM dispatch the paged step uses (rows = B x P,
+    each example's P rows contiguous after the sort)."""
     b, p = input_ids.shape
     positions = jnp.broadcast_to(jnp.arange(p)[None, :], (b, p))
     x = embed_tokens(config, params, input_ids, positions)
 
     wins = _layer_window_column(config)
+    sort = None
+    if lora is not None:
+        g = jax.tree.leaves(lora["stacks"])[0].shape[1]
+        sort = _lora_sort(lora["adapters"], p, g)
 
     def body(x, inputs):
-        layer, ck, cv, w = inputs
+        if lora is None:
+            layer, ck, cv, w = inputs
+            ov = None
+        else:
+            layer, ck, cv, w, lstack = _lora_unpack(inputs, wins)
+            ov = _lora_wmat_override(config, lora, lstack, sort)
         attn, (k, v) = attention_sublayer(
             config, x, layer["attn"],
             None if config.post_norm else layer["input_norm"], positions,
-            "xla", return_kv=True, window_override=w)
-        x, _ = _decode_residuals(config, x, layer, attn)
+            "xla", return_kv=True, window_override=w, wmat_override=ov)
+        x, _ = _decode_residuals(config, x, layer, attn, wmat_override=ov)
         nk = jax.lax.dynamic_update_slice(ck, k.astype(ck.dtype), (0, 0, 0, 0))
         nv = jax.lax.dynamic_update_slice(cv, v.astype(cv.dtype), (0, 0, 0, 0))
         return x, (nk, nv)
 
-    x, (ks, vs) = _scan_kv_layers(body, x, params, cache, wins)
+    if lora is None:
+        x, (ks, vs) = _scan_kv_layers(body, x, params, cache, wins)
+    else:
+        x, (ks, vs) = jax.lax.scan(body, x,
+                                   _lora_scan_xs(params, cache, wins, lora))
     # slice BEFORE the head: projecting all P positions to [B, P, V] fp32
     # only to keep one row would cost P x the lm_head matmul and a
     # prompt-length-scaled logits buffer (norm + projection are per-position)
@@ -740,7 +843,7 @@ def paged_logits_at(lm_head, config, params, x, last_index,
 def paged_decode_step(config: LlamaConfig, params: dict,
                       token_ids: jnp.ndarray, positions: jnp.ndarray,
                       cache: dict, attend, last_index=None,
-                      all_logits=False):
+                      all_logits=False, lora=None):
     """One step over a PAGED multi-request cache (serve/engine.py):
     ``token_ids`` [S, T] are each slot's next T tokens starting at
     PER-SLOT position ``positions`` [S] (the contiguous-cache
@@ -757,14 +860,30 @@ def paged_decode_step(config: LlamaConfig, params: dict,
     window, scale, softcap)`` (built by serve/kv_pages.py) scatters the
     new k/v into the layer's pages and attends each slot over its own
     block table. Returns (logits [S, V] — or [S, T, V] under
-    ``all_logits`` — and the updated cache)."""
+    ``all_logits`` — and the updated cache).
+
+    ``lora`` (multi-LoRA serving, see ``_lora_wmat_override``):
+    ``{"scale", "adapters" [S] int32, "stacks", "impl"}`` — per-slot
+    adapter deltas batched as one ragged grouped GEMM per target per
+    layer, slots gather-sorted by adapter and int32-inversion unsorted.
+    The SAME compiled program serves every adapter mix: the stacks and
+    the adapter vector are array arguments, never trace constants."""
     pos2d = paged_positions(token_ids, positions)
     x = embed_tokens(config, params, token_ids, pos2d)
 
     wins = _layer_window_column(config)
+    sort = None
+    if lora is not None:
+        g = jax.tree.leaves(lora["stacks"])[0].shape[1]
+        sort = _lora_sort(lora["adapters"], token_ids.shape[1], g)
 
     def body(x, inputs):
-        layer, kp, vp, w = inputs
+        if lora is None:
+            layer, kp, vp, w = inputs
+            ov = None
+        else:
+            layer, kp, vp, w, lstack = _lora_unpack(inputs, wins)
+            ov = _lora_wmat_override(config, lora, lstack, sort)
 
         def override(q, k, v, *, window, scale, softcap):
             return attend(q, k, v, kp, vp, window=window, scale=scale,
@@ -774,11 +893,15 @@ def paged_decode_step(config: LlamaConfig, params: dict,
             config, x, layer["attn"],
             None if config.post_norm else layer["input_norm"], pos2d,
             "xla", return_kv=True, window_override=w,
-            attend_override=override)
-        x, _ = _decode_residuals(config, x, layer, attn)
+            attend_override=override, wmat_override=ov)
+        x, _ = _decode_residuals(config, x, layer, attn, wmat_override=ov)
         return x, (nkp, nvp)
 
-    x, (ks, vs) = _scan_kv_layers(body, x, params, cache, wins)
+    if lora is None:
+        x, (ks, vs) = _scan_kv_layers(body, x, params, cache, wins)
+    else:
+        x, (ks, vs) = jax.lax.scan(body, x,
+                                   _lora_scan_xs(params, cache, wins, lora))
     return (paged_logits_at(lm_head_logits, config, params, x, last_index,
                             all_logits),
             {"k": ks, "v": vs})

@@ -112,7 +112,7 @@ class PostTrainingLoop:
                  objective: str = "reinforce", baseline: str = "batch",
                  max_new_tokens: int = 16, temperature: float = 0.7,
                  top_k: int = 0, top_p: float = 1.0, base_seed: int = 0,
-                 publish_every: int = 1,
+                 publish_every: int = 1, publish_mode: str = "merged",
                  ledger: Optional[RolloutLedger] = None,
                  group_ids=None, frozen: bool = False,
                  gmm_impl: str = "auto"):
@@ -129,6 +129,23 @@ class PostTrainingLoop:
         self.top_k, self.top_p = top_k, top_p
         self.base_seed = base_seed
         self.publish_every = publish_every
+        if publish_mode not in ("merged", "adapter"):
+            raise ValueError(f"publish_mode must be 'merged' or "
+                             f"'adapter', got {publish_mode!r}")
+        if publish_mode == "adapter" and not frozen:
+            # fail at construction, not at the first publish boundary:
+            # adapter mode needs a LoRA-shaped state AND a pooled engine
+            adapter_payload(state.params)
+            if getattr(engine, "adapter_pool", None) is None:
+                raise ValueError(
+                    "publish_mode='adapter' needs an engine built with "
+                    "max_adapters= (an adapter pool to insert into)")
+        self.publish_mode = publish_mode
+        # the tenant's pool slot; allocated by the first boundary
+        # publish, then republished in place. Iteration 0 rolls out on
+        # adapter 0 (the base policy) — identical to the merged policy
+        # because LoRA's B factor initializes to zero.
+        self.adapter_slot: Optional[int] = None
         self.ledger = ledger
         self.group_ids = group_ids
         self.frozen = frozen
@@ -169,7 +186,8 @@ class PostTrainingLoop:
             base_seed=self.base_seed, max_new_tokens=self.max_new_tokens,
             temperature=self.temperature, top_k=self.top_k,
             top_p=self.top_p, group_ids=self.group_ids,
-            ledger=self.ledger)
+            ledger=self.ledger,
+            adapter_id=(self.adapter_slot or 0))
         scores = self.scorer.score(rollouts)
         metrics = {"iteration": i, **rstats,
                    "reward_mean": float(np.mean([s.reward
@@ -206,7 +224,17 @@ class PostTrainingLoop:
                     metrics["publish_skipped_nonfinite"] = True
             elif self._publish_due:
                 t0 = time.perf_counter()
-                self.engine.publish_params(self._merge(self.state.params))
+                if self.publish_mode == "adapter":
+                    # adapter-sized publish: insert (then republish in
+                    # place) the trained factors as a pool tenant — the
+                    # engine keeps serving base traffic on adapter 0
+                    # while the policy rides its own slot
+                    self.adapter_slot = self.engine.publish_adapter(
+                        adapter_payload(self.state.params),
+                        name="post-policy", slot=self.adapter_slot)
+                else:
+                    self.engine.publish_params(
+                        self._merge(self.state.params))
                 metrics["publish_ms"] = round(
                     1000 * (time.perf_counter() - t0), 2)
                 metrics["published"] = True
@@ -222,6 +250,35 @@ class PostTrainingLoop:
             self.run_iteration()
         # NOT [-n:]: [-0:] would hand back the ENTIRE past history
         return self.history[len(self.history) - n_iterations:]
+
+
+def adapter_payload(params) -> dict:
+    """The trained LoRA factors in the EXACT layout the serve plane's
+    adapter pool ingests (``{target: {"a": [L, in, r], "b": [L, r, out]}}``
+    — the ``params["lora"]`` subtree as the trainer threads it, no
+    reshaping). Raises when the state carries no LoRA subtree: a dense
+    policy has no adapter-sized publish, use ``publish_params``."""
+    if not isinstance(params, dict) or "lora" not in params:
+        raise ValueError(
+            "state.params has no 'lora' subtree — adapter publishing "
+            "needs a lora_bundle-wrapped trainer (dense policies "
+            "publish merged weights via publish_params)")
+    return params["lora"]
+
+
+def publish_trained_adapter(target, state, *, name=None, slot=None,
+                            force: bool = False) -> int:
+    """Publish a trainer state's LoRA adapter into a serving target's
+    adapter pool — ``target`` is a ServeEngine, DisaggEngine, or Router
+    (same ``publish_adapter`` facade on all three; the router makes it
+    fleet-wide all-or-nothing). The payload is adapter-sized: for a
+    rank-8 two-target debug model that's ~100x smaller than a full
+    ``publish_params``, and the insert is one cached jit with a traced
+    slot index, so pushing every boundary never retraces. Returns the
+    pool slot the tenant landed in (pass it back as ``slot=`` to
+    republish in place)."""
+    return target.publish_adapter(adapter_payload(state.params),
+                                  name=name, slot=slot, force=force)
 
 
 def merge_fn(bundle):

@@ -149,7 +149,8 @@ def generate_rollouts(engine, prompts, *, iteration: int, base_seed: int,
                       top_k: int = 0, top_p: float = 1.0,
                       group_ids=None, eos_id: Optional[int] = None,
                       ledger: Optional[RolloutLedger] = None,
-                      max_iterations: Optional[int] = 20000) -> tuple:
+                      max_iterations: Optional[int] = 20000,
+                      adapter_id: int = 0) -> tuple:
     """One rollout batch through the serve engine: submit every sample
     of ``iteration`` not already in the ledger, step the engine to
     completion, and return ``(rollouts in index order, stats)``.
@@ -172,7 +173,8 @@ def generate_rollouts(engine, prompts, *, iteration: int, base_seed: int,
         rid = engine.submit(Request(
             prompt_ids=list(prompt), max_new_tokens=max_new_tokens,
             temperature=temperature, top_k=top_k, top_p=top_p,
-            eos_id=eos_id, seed=rollout_seed(base_seed, iteration, idx)))
+            eos_id=eos_id, seed=rollout_seed(base_seed, iteration, idx),
+            adapter_id=adapter_id))
         pending[rid] = idx
     iters = 0
     while pending:

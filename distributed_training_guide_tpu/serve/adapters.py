@@ -1,0 +1,338 @@
+"""Multi-tenant LoRA adapter pool for the serving plane.
+
+S-LoRA (Sheng et al., arXiv:2311.03285) and Punica (Chen et al.,
+arXiv:2310.18547) serve N fine-tunes from ONE base model: the low-rank
+delta ``scale * (x @ A) @ B`` is added per target projection as a ragged
+grouped GEMM over the decode batch sorted by adapter — exactly the
+``ops/grouped_matmul.py`` compute, applied to decode slots instead of
+MoE tokens. This module owns the serving-side state that makes that
+batched form retrace-free and refcount-safe:
+
+- **Stacked device buffers** (``init_adapter_stacks``): every adapter
+  slot's ``A``/``B`` for every target lives in ONE device array per
+  target, ``a [L, max_adapters, in, r]`` / ``b [L, max_adapters, r,
+  out]`` — the layer axis LEADS so the per-layer slices ride the llama
+  family's ``lax.scan`` over stacked layers like every other param leaf,
+  and the adapter axis is indexed by ``group_sizes`` inside the grouped
+  GEMM. The stack is a program ARGUMENT with a fixed aval, so
+  insert/evict/publish never retrace (the tables/lengths discipline);
+  an insert is one compiled ``dynamic_update_slice`` at a TRACED slot
+  index (jit-cache-flat across slots).
+- **Slot 0 is the zero adapter** (``ZERO_ADAPTER``): its stack rows are
+  zeros and are never written, so base-only requests co-batch freely
+  with adapted ones — their delta is an exact fp ``+0`` (A@B with B=0),
+  which is what makes the adapter-0 == base-engine bitwise pin hold.
+- **Host-side refcounts** (:class:`AdapterPool`): the ``kv_pages``
+  PagePool discipline applied to adapter slots — all-or-nothing alloc,
+  retain/release per in-flight request, eviction REFUSES while any
+  request references the slot, LRU among idle adapters under pressure,
+  validation before mutation, ``describe()`` diagnostics.
+
+The grouped-GEMM application itself lives in ``models/llama.py``
+(``paged_decode_step(..., lora=)``) — models must not import serve; the
+engine builds the lora context dict from this module's stacks.
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from ..models.lora import DEFAULT_TARGETS, TARGET_PATHS, _get
+
+# slot 0 never holds a tenant adapter: its stack rows stay exactly zero,
+# so a base-only request's delta is an exact fp +0 and mixed batches
+# containing base requests need no special-casing anywhere
+ZERO_ADAPTER = 0
+
+
+def adapter_shapes(config, *, rank: int,
+                   targets: Sequence[str] = DEFAULT_TARGETS,
+                   bundle=None) -> dict:
+    """One adapter's per-target leaf shapes in the POOL INSERT layout —
+    ``{t: {"a": (L, in, r), "b": (L, r, out)}}``, exactly the leaves
+    ``models/lora.py`` trains (``params["lora"]``), so a trained adapter
+    publishes without reshaping."""
+    unknown = [t for t in targets if t not in TARGET_PATHS]
+    if unknown:
+        raise ValueError(f"unknown adapter targets {unknown}; choose from "
+                         f"{sorted(TARGET_PATHS)}")
+    if rank < 1:
+        raise ValueError(f"adapter rank must be >= 1, got {rank}")
+    if bundle is None:
+        from ..models.llama import init as llama_init
+        shapes = jax.eval_shape(lambda: llama_init(config,
+                                                   jax.random.key(0)))
+    else:
+        base = getattr(bundle, "lora_base", None) or bundle
+        shapes = jax.eval_shape(lambda: base.init(config,
+                                                  jax.random.key(0)))
+    out = {}
+    for t in targets:
+        l, fan_in, fan_out = _get(shapes, TARGET_PATHS[t]).shape
+        out[t] = {"a": (l, fan_in, rank), "b": (l, rank, fan_out)}
+    return out
+
+
+def adapter_nbytes(config, *, rank: int,
+                   targets: Sequence[str] = DEFAULT_TARGETS,
+                   bundle=None) -> int:
+    """Bytes ONE adapter occupies in the pool (fp32 — adapters stay fp
+    even over an int8 base, the QLoRA serving shape). This is also the
+    per-insert publish payload: an adapter publish moves exactly one
+    slot's leaves, never the base weights."""
+    shapes = adapter_shapes(config, rank=rank, targets=targets,
+                            bundle=bundle)
+    total = 0
+    for pair in shapes.values():
+        for shape in pair.values():
+            n = 1
+            for d in shape:
+                n *= int(d)
+            total += 4 * n
+    return total
+
+
+def adapter_pool_bytes(config, *, max_adapters: int, rank: int,
+                       targets: Sequence[str] = DEFAULT_TARGETS,
+                       bundle=None) -> int:
+    """Device-resident bytes of the whole stacked pool at
+    ``(max_adapters, rank, targets)`` — slot 0 (the zero adapter)
+    included: it is real HBM, priced honestly."""
+    if max_adapters < 2:
+        raise ValueError(f"max_adapters must be >= 2 (slot 0 is reserved "
+                         f"for the zero adapter), got {max_adapters}")
+    return max_adapters * adapter_nbytes(config, rank=rank, targets=targets,
+                                         bundle=bundle)
+
+
+def init_adapter_stacks(config, *, max_adapters: int, rank: int,
+                        targets: Sequence[str] = DEFAULT_TARGETS,
+                        bundle=None) -> dict:
+    """The zero-initialized stacked pool:
+    ``{t: {"a": [L, G, in, r], "b": [L, G, r, out]}}`` fp32. Layer axis
+    leading (rides the llama layer scan), adapter axis second (the
+    grouped GEMM's group axis after a per-layer slice)."""
+    if max_adapters < 2:
+        raise ValueError(f"max_adapters must be >= 2 (slot 0 is reserved "
+                         f"for the zero adapter), got {max_adapters}")
+    shapes = adapter_shapes(config, rank=rank, targets=targets,
+                            bundle=bundle)
+    stacks = {}
+    for t, pair in shapes.items():
+        (l, fan_in, r), (_, _, fan_out) = pair["a"], pair["b"]
+        stacks[t] = {
+            "a": jnp.zeros((l, max_adapters, fan_in, r), jnp.float32),
+            "b": jnp.zeros((l, max_adapters, r, fan_out), jnp.float32),
+        }
+    return stacks
+
+
+def validate_adapter_params(expected_shapes: dict, adapter_params) -> None:
+    """Loud, per-leaf validation of an insert payload against the pool's
+    ``(rank, targets)`` geometry — the ``publish_params`` discipline: a
+    wrong tenant artifact must fail HERE with the leaf named, never as a
+    shape error inside a compiled program."""
+    if not isinstance(adapter_params, dict):
+        raise ValueError(
+            f"adapter params must be {{target: {{'a', 'b'}}}} "
+            f"(models/lora.py params['lora'] layout), got "
+            f"{type(adapter_params).__name__}")
+    exp_t, got_t = sorted(expected_shapes), sorted(adapter_params)
+    if exp_t != got_t:
+        raise ValueError(
+            f"adapter targets mismatch: pool serves {exp_t}, payload has "
+            f"{got_t} — the pool's (rank, targets) geometry is fixed at "
+            f"engine construction")
+    for t in exp_t:
+        pair = adapter_params[t]
+        if sorted(pair) != ["a", "b"]:
+            raise ValueError(f"adapter target {t!r} must hold leaves "
+                             f"{{'a', 'b'}}, got {sorted(pair)}")
+        for leaf in ("a", "b"):
+            want = tuple(expected_shapes[t][leaf])
+            got = tuple(jnp.shape(pair[leaf]))
+            if want != got:
+                raise ValueError(
+                    f"adapter leaf {t}/{leaf} shape mismatch: pool expects "
+                    f"{want}, payload has {got} (rank and targets are "
+                    f"pool geometry — retrain or re-export to match)")
+            if not jnp.issubdtype(jnp.result_type(pair[leaf]),
+                                  jnp.floating):
+                raise ValueError(
+                    f"adapter leaf {t}/{leaf} must be floating "
+                    f"(fp deltas ride over the base, quantized or not); "
+                    f"got {jnp.result_type(pair[leaf])}")
+
+
+class AdapterPool:
+    """Host-side bookkeeping for the stacked adapter slots — the
+    ``kv_pages.PagePool`` discipline, one slot per tenant adapter.
+
+    Slot 0 is :data:`ZERO_ADAPTER` and is never allocated, refcounted,
+    or evicted. Refcounts track IN-FLIGHT REQUESTS (the scheduler
+    retains on submit/requeue/adopt and releases when the request
+    leaves), so eviction can refuse loudly while a tenant's generation
+    is mid-stream. ``alloc`` is all-or-nothing: it returns a slot or
+    evicts exactly one LRU idle adapter to make room; if every live
+    adapter is referenced it returns ``None`` and mutates NOTHING.
+    """
+
+    def __init__(self, max_adapters: int, *, rank: int,
+                 alpha: float = 16.0,
+                 targets: Sequence[str] = DEFAULT_TARGETS):
+        if max_adapters < 2:
+            raise ValueError(
+                f"max_adapters must be >= 2 (slot 0 is reserved for the "
+                f"zero adapter), got {max_adapters}")
+        if rank < 1:
+            raise ValueError(f"adapter rank must be >= 1, got {rank}")
+        unknown = [t for t in targets if t not in TARGET_PATHS]
+        if unknown:
+            raise ValueError(f"unknown adapter targets {unknown}; choose "
+                             f"from {sorted(TARGET_PATHS)}")
+        self.max_adapters = max_adapters
+        self.rank = rank
+        self.alpha = float(alpha)
+        self.targets = tuple(targets)
+        # LIFO free list + membership set, like PagePool: O(1) alloc and
+        # a cheap "is this slot free" check for validation
+        self._free = list(range(max_adapters - 1, 0, -1))
+        self._free_set = set(self._free)
+        self._refs = [0] * max_adapters
+        self._names: dict[int, Optional[str]] = {}   # live slot -> label
+        self._tick = 0                               # LRU clock
+        self._last_used = [0] * max_adapters
+        self.stats = {"inserts": 0, "updates": 0, "evictions": 0,
+                      "lru_evictions": 0}
+
+    @property
+    def scale(self) -> float:
+        return self.alpha / self.rank
+
+    @property
+    def capacity(self) -> int:
+        """Tenant slots (slot 0 excluded)."""
+        return self.max_adapters - 1
+
+    @property
+    def n_live(self) -> int:
+        return len(self._names)
+
+    @property
+    def n_free(self) -> int:
+        return len(self._free)
+
+    def live_slots(self) -> list[int]:
+        return sorted(self._names)
+
+    def name_of(self, slot: int) -> Optional[str]:
+        return self._names.get(slot)
+
+    def is_live(self, slot) -> bool:
+        """Whether ``slot`` is servable: the zero adapter always, a
+        tenant slot iff inserted and not evicted."""
+        if not isinstance(slot, (int,)) or isinstance(slot, bool):
+            return False
+        return slot == ZERO_ADAPTER or slot in self._names
+
+    def refcount(self, slot: int) -> int:
+        self._check_range(slot)
+        return self._refs[slot]
+
+    def _check_range(self, slot: int) -> None:
+        if not 0 <= slot < self.max_adapters:
+            raise ValueError(f"adapter slot {slot} out of range "
+                             f"[0, {self.max_adapters})")
+
+    def _touch(self, slot: int) -> None:
+        self._tick += 1
+        self._last_used[slot] = self._tick
+
+    def alloc(self, name: Optional[str] = None) -> Optional[int]:
+        """Claim a slot for a new adapter: a free slot if any, else
+        evict the least-recently-used IDLE (refcount-0) live adapter.
+        Returns the slot (refcount 0 — requests retain separately), or
+        ``None`` when every live adapter is referenced (all-or-nothing:
+        nothing was mutated). ``name`` is a diagnostic label."""
+        if self._free:
+            slot = self._free.pop()
+            self._free_set.discard(slot)
+        else:
+            idle = [s for s in self._names if self._refs[s] == 0]
+            if not idle:
+                return None
+            slot = min(idle, key=lambda s: self._last_used[s])
+            del self._names[slot]
+            self.stats["evictions"] += 1
+            self.stats["lru_evictions"] += 1
+        self._names[slot] = name
+        self._touch(slot)
+        self.stats["inserts"] += 1
+        return slot
+
+    def retain(self, slot: int) -> None:
+        """One more in-flight request on ``slot`` (no-op for the zero
+        adapter — it is never evictable, so it needs no protection)."""
+        self._check_range(slot)
+        if slot == ZERO_ADAPTER:
+            return
+        if slot not in self._names:
+            raise ValueError(f"retain of adapter slot {slot} which is not "
+                             f"live (free or evicted)")
+        self._refs[slot] += 1
+        self._touch(slot)
+
+    def release(self, slot: int) -> None:
+        self._check_range(slot)
+        if slot == ZERO_ADAPTER:
+            return
+        if slot not in self._names:
+            raise ValueError(f"release of adapter slot {slot} which is "
+                             f"not live")
+        if self._refs[slot] <= 0:
+            raise ValueError(f"release of adapter slot {slot} with "
+                             f"refcount 0 (double release)")
+        self._refs[slot] -= 1
+
+    def evict(self, slot: int) -> None:
+        """Explicitly retire a tenant adapter. Refuses (mutating
+        nothing) while requests reference it — drain the tenant first."""
+        self._check_range(slot)
+        if slot == ZERO_ADAPTER:
+            raise ValueError("adapter slot 0 is the zero adapter and is "
+                             "never evictable")
+        if slot not in self._names:
+            raise ValueError(f"evict of adapter slot {slot} which is not "
+                             f"live")
+        if self._refs[slot] > 0:
+            raise ValueError(
+                f"evict of adapter slot {slot} with {self._refs[slot]} "
+                f"in-flight request(s) — finish or drain the tenant "
+                f"first")
+        del self._names[slot]
+        self._free.append(slot)
+        self._free_set.add(slot)
+        self.stats["evictions"] += 1
+
+    def mark_update(self, slot: int) -> None:
+        """Record an in-place republish into a live slot (continual
+        tuning: same tenant, refreshed weights)."""
+        self._check_range(slot)
+        if slot != ZERO_ADAPTER and slot not in self._names:
+            raise ValueError(f"update of adapter slot {slot} which is not "
+                             f"live")
+        self._touch(slot)
+        self.stats["updates"] += 1
+
+    def describe(self, slot: int) -> str:
+        self._check_range(slot)
+        if slot == ZERO_ADAPTER:
+            return "slot 0: the zero adapter (reserved, refcount-free)"
+        if slot in self._free_set:
+            return f"slot {slot}: free"
+        name = self._names.get(slot)
+        label = f" name={name!r}" if name else ""
+        return (f"slot {slot}: live{label} refs={self._refs[slot]} "
+                f"last_used={self._last_used[slot]}")

@@ -59,7 +59,9 @@ import queue as queue_mod
 from typing import Optional
 
 from ..models.registry import ModelBundle
-from .engine import (LatencyMeter, ModelPrograms, advance_prefill_chunks,
+from .adapters import DEFAULT_TARGETS
+from .engine import (LatencyMeter, ModelPrograms, adapter_metrics,
+                     advance_prefill_chunks, build_adapter_report,
                      build_kv_report, collect_partial_tokens,
                      default_prefill_buckets, derived_pool_metrics,
                      drop_stale_pending, resolve_context_bounds,
@@ -479,7 +481,10 @@ class DisaggEngine:
                  weight_dtype=None, transport: str = "same_host",
                  n_prefill_pages: Optional[int] = None,
                  handoff_ack_timeout_s: float = 2.0,
-                 programs: Optional[ModelPrograms] = None):
+                 programs: Optional[ModelPrograms] = None,
+                 max_adapters: Optional[int] = None, adapter_rank: int = 8,
+                 adapter_alpha: float = 16.0,
+                 adapter_targets=DEFAULT_TARGETS):
         if n_prefill_slots < 1:
             raise ValueError(f"n_prefill_slots must be >= 1, got "
                              f"{n_prefill_slots}")
@@ -507,7 +512,14 @@ class DisaggEngine:
         self.programs = programs if programs is not None else ModelPrograms(
             bundle, params, plan=plan, shard_kv=shard_kv,
             attend_impl=attend_impl, kv_dtype=kv_dtype,
-            weight_dtype=weight_dtype)
+            weight_dtype=weight_dtype, max_adapters=max_adapters,
+            adapter_rank=adapter_rank, adapter_alpha=adapter_alpha,
+            adapter_targets=adapter_targets)
+        # ONE adapter pool for both halves (shared programs): the handoff
+        # releases the prefill side's reference and the decode adopt
+        # retains — net-neutral on the shared pool, so a tenant's
+        # refcount tracks its true in-flight total across the pair
+        self.adapter_pool = self.programs.adapter_pool
         self.bundle, self.config = bundle, bundle.config
         # both halves write/read ONE pool at one storage dtype; the
         # handoff moves page ids, so a quantized page's payload AND its
@@ -578,7 +590,8 @@ class DisaggEngine:
             admission_headroom=(
                 None if transport == "cross_host"
                 else lambda: len(decode_sched.active_indices())),
-            spec_lookahead=drafter.k if drafter else 0)
+            spec_lookahead=drafter.k if drafter else 0,
+            adapter_pool=self.adapter_pool)
         # the decode scheduler shares the prefill side's PrefixCache
         # object (or runs cache-less): growth under pressure must be able
         # to evict idle cached pages before preempting a live sequence.
@@ -591,7 +604,8 @@ class DisaggEngine:
             prefix_cache=(prefill_sched.cache
                           if transport == "same_host"
                           and prefill_sched.cache is not None else False),
-            spec_lookahead=drafter.k if drafter else 0)
+            spec_lookahead=drafter.k if drafter else 0,
+            adapter_pool=self.adapter_pool)
         self.prefill = PrefillEngine(
             self.programs, self.pages, prefill_sched, self.handoff,
             prefill_chunk=prefill_chunk, prefill_buckets=prefill_buckets)
@@ -686,6 +700,47 @@ class DisaggEngine:
                 f"weight swap breaks bitwise replay — finish or drain "
                 f"first, or pass force=True to accept that")
         return self.programs.publish_params(new_params)
+
+    def publish_adapter(self, adapter_params, *, name: Optional[str] = None,
+                        slot: Optional[int] = None,
+                        force: bool = False) -> int:
+        """Insert (or republish) a LoRA adapter into the shared pool.
+
+        Same busy refusal as ``publish_params``: an insert into a slot
+        the LRU just recycled would splice a different tenant's weights
+        into sequences mid-decode (including anything in the handoff
+        queue). The recycled slot's prefix-cache namespace is dropped so
+        a new tenant can never hit the old tenant's cached prefixes."""
+        if not force and self.has_work:
+            raise RuntimeError(
+                f"publish_adapter with in-flight work "
+                f"(prefill={self.prefill.sched.has_work}, "
+                f"decode={self.decode.sched.has_work}, "
+                f"in_transit={len(self.handoff.pending)}): a mid-stream "
+                f"adapter insert can splice weights into live sequences — "
+                f"finish or drain first, or pass force=True to accept "
+                f"that")
+        slot_id = self.programs.publish_adapter(adapter_params, name=name,
+                                                slot=slot)
+        # the cache object is shared same-host; cross-host each side has
+        # its own, and only the prefill side registers prefixes
+        for sched in (self.prefill.sched, self.decode.sched):
+            if sched.cache:
+                sched.cache.drop_namespace(slot_id)
+        return slot_id
+
+    def evict_adapter(self, slot: int) -> None:
+        """Free an idle adapter slot and drop its cached prefixes."""
+        if self.adapter_pool is None:
+            raise ValueError("engine has no adapter pool "
+                             "(max_adapters not set)")
+        self.adapter_pool.evict(slot)
+        for sched in (self.prefill.sched, self.decode.sched):
+            if sched.cache:
+                sched.cache.drop_namespace(slot)
+
+    def adapter_report(self) -> dict:
+        return build_adapter_report(self.programs)
 
     def close(self) -> None:
         """Tear down the handoff transport (sockets + receiver thread
@@ -785,6 +840,14 @@ class DisaggEngine:
                   "finished", "spec_lookahead_clamped",
                   "deadline_missed_queued", "deadline_missed_running"):
             s[k] = p.stats[k] + d.stats[k]
+        # per-adapter request counts are charged at submit (prefill side
+        # only — adopt is a handoff, not a new request); merge the decode
+        # side's dict anyway so a directly-submitted decode request is
+        # never silently dropped from the tally
+        areq = dict(p.stats.get("adapter_requests", {}))
+        for aid, n in d.stats.get("adapter_requests", {}).items():
+            areq[aid] = areq.get(aid, 0) + n
+        s["adapter_requests"] = areq
         depths = p.queue_depth_by_priority()
         for prio, n in d.queue_depth_by_priority().items():
             depths[prio] = depths.get(prio, 0) + n
@@ -822,6 +885,8 @@ class DisaggEngine:
                            decode_tokens=self.decode.decode_tokens,
                            drafter=self.decode.drafter),
             **{f"handoff_{k}": v for k, v in self.handoff.stats.items()},
+            **adapter_metrics(self.adapter_pool,
+                              publishes=self.programs.adapter_publish_count),
         }
         if cross:
             out.update({

@@ -222,7 +222,11 @@ def new_generation(old, *, params=None, **overrides):
     into the shared programs and cannot be overridden here — changing
     those is a new deployment, not a generation swap. ``weight_dtype``
     is baked the same way: the shared programs ARE the quantized params
-    layout, so a precision change cannot ride a capacity swap.
+    layout, so a precision change cannot ride a capacity swap. The
+    adapter pool (``max_adapters`` and the device-resident stacks) also
+    lives on the shared programs, so every live tenant and its refcounts
+    ride the swap untouched — a resubmitted multi-LoRA request replays
+    under the SAME adapter slot on the new generation.
 
     ``params=`` is the published-params path (post-training fleets):
     SAME-layout refreshed weights are published into the shared programs

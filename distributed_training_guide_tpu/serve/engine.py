@@ -64,6 +64,9 @@ import numpy as np
 
 from ..models.registry import ModelBundle, family_module
 from ..train.precision import Quantized
+from .adapters import (AdapterPool, DEFAULT_TARGETS, ZERO_ADAPTER,
+                       adapter_nbytes, adapter_pool_bytes, adapter_shapes,
+                       init_adapter_stacks, validate_adapter_params)
 from .kv_pages import (check_kv_page_geometry, commit_prefill, copy_pages,
                        init_pages, kv_dtype_name, kv_page_bytes, make_attend,
                        PagePool, pages_for_tokens, pool_nbytes)
@@ -183,6 +186,31 @@ def spec_metrics(spec: dict, *, decode_steps: int, decode_tokens: int,
     if drafter is not None:
         out.update(drafter.stats())
     return out
+
+
+def adapter_metrics(pool: Optional[AdapterPool], *,
+                    publishes: int = 0) -> dict:
+    """The multi-tenant tail of stats(): pool occupancy gauges plus
+    insert/update/evict counters (LRU evictions split out — churn under
+    pressure reads very differently from explicit retirement). Empty
+    without a pool, so an adapter-free engine's stats() keys are exactly
+    the pre-adapter set. The per-adapter request counts live in the
+    scheduler's ``adapter_requests`` dict alongside this."""
+    if pool is None:
+        return {}
+    return {
+        "adapter_slots": pool.max_adapters,
+        "adapter_capacity": pool.capacity,
+        "adapters_live": pool.n_live,
+        "adapters_free": pool.n_free,
+        "adapter_occupancy": (round(pool.n_live / pool.capacity, 3)
+                              if pool.capacity else 0.0),
+        "adapter_inserts": pool.stats["inserts"],
+        "adapter_updates": pool.stats["updates"],
+        "adapter_evictions": pool.stats["evictions"],
+        "adapter_lru_evictions": pool.stats["lru_evictions"],
+        "adapter_publishes": publishes,
+    }
 
 
 def resolve_drafter(speculate, *, spec_k: int,
@@ -312,7 +340,8 @@ def run_bucket_prefill(programs: "ModelPrograms", pages: dict,
     ids = np.zeros((1, bucket), np.int32)
     ids[0, :n] = tokens
     logit, kd, vd = programs.prefill_for(bucket)(
-        programs.params, jnp.asarray(ids), jnp.asarray(n - 1))
+        programs.params, jnp.asarray(ids), jnp.asarray(n - 1),
+        *programs.lora_call_args([adm.request.adapter_id]))
     table_row = jnp.asarray(sched.table_row(adm.slot_idx))
     pages["k"], pages["v"] = programs._commit_fn(
         pages["k"], pages["v"], kd, vd, table_row,
@@ -356,7 +385,8 @@ def advance_prefill_chunks(programs: "ModelPrograms", pages: dict,
             jnp.asarray(ids), jnp.asarray([start], jnp.int32),
             jnp.asarray(sched.table_row(slot_idx)[None]),
             jnp.asarray(real - 1, jnp.int32),
-            jnp.asarray([real], jnp.int32))
+            jnp.asarray([real], jnp.int32),
+            *programs.lora_call_args([adm.request.adapter_id]))
         sched.commit_tokens(slot_idx, real)
         if not sched.slots[slot_idx].prefilling:   # final chunk landed
             pending.pop(slot_idx)
@@ -445,7 +475,7 @@ def run_spec_decode(programs: "ModelPrograms", pages: dict,
         dev = {"kind": "spec",
                **{key: jnp.asarray(arr[key])
                   for key in ("lengths", "tables", "seeds", "temps",
-                              "top_ks", "top_ps", "actives")}}
+                              "top_ks", "top_ps", "actives", "adapters")}}
     elif grew:      # lookahead growth extended a block table mid-flight
         dev["tables"] = jnp.asarray(sched.decode_arrays()["tables"])
     # static greedy specialization: when every active slot decodes at
@@ -459,7 +489,8 @@ def run_spec_decode(programs: "ModelPrograms", pages: dict,
             programs.params, pages["k"], pages["v"], jnp.asarray(ids),
             dev["lengths"], dev["tables"], dev["seeds"], dev["temps"],
             dev["top_ks"], dev["top_ps"], dev["actives"],
-            jnp.asarray(n_valid))
+            jnp.asarray(n_valid),
+            *programs.lora_call_args(dev["adapters"]))
     targets = np.asarray(targets)
     n_acc = np.asarray(n_acc)
     finished, emitted_total = [], 0
@@ -512,7 +543,8 @@ def run_decode_iteration(programs: "ModelPrograms", pages: dict,
     nxt, new_len, pages["k"], pages["v"] = programs._decode_fn(
         programs.params, pages["k"], pages["v"],
         dev["tokens"], dev["lengths"], dev["tables"], dev["seeds"],
-        dev["temps"], dev["top_ks"], dev["top_ps"], dev["actives"])
+        dev["temps"], dev["top_ks"], dev["top_ps"], dev["actives"],
+        *programs.lora_call_args(dev["adapters"]))
     dev["tokens"], dev["lengths"] = nxt, new_len
     nxt_host = np.asarray(nxt)
     finished = []
@@ -592,6 +624,30 @@ def build_weight_report(programs: "ModelPrograms") -> dict:
     }
 
 
+def build_adapter_report(programs: "ModelPrograms") -> dict:
+    """The preflight-style byte table for one engine's ADAPTER pool —
+    the third sibling of :func:`build_kv_report` /
+    :func:`build_weight_report`. ``bytes_per_adapter`` is also the
+    publish payload per insert: an adapter publish moves one slot's
+    leaves, never the base weights — the consolidation lever this
+    subsystem exists for."""
+    pool = programs.adapter_pool
+    if pool is None:
+        return {}
+    per = adapter_nbytes(programs.config, rank=pool.rank,
+                         targets=pool.targets, bundle=programs.bundle)
+    return {
+        "max_adapters": pool.max_adapters,
+        "rank": pool.rank,
+        "targets": list(pool.targets),
+        "bytes_per_adapter": per,
+        "pool_bytes": pool.max_adapters * per,
+        "publish_payload_bytes": per,
+        "adapters_live": pool.n_live,
+        "adapters_free": pool.n_free,
+    }
+
+
 class ModelPrograms:
     """The compiled-program cache for one (model, params, sharding)
     triple: the batched decode step, per-bucket prefill programs, the
@@ -608,7 +664,10 @@ class ModelPrograms:
 
     def __init__(self, bundle: ModelBundle, params, *, plan=None,
                  shard_kv: bool = False, attend_impl: str = "auto",
-                 kv_dtype=None, weight_dtype=None):
+                 kv_dtype=None, weight_dtype=None,
+                 max_adapters: Optional[int] = None, adapter_rank: int = 8,
+                 adapter_alpha: float = 16.0,
+                 adapter_targets=DEFAULT_TARGETS):
         self.bundle = bundle
         self.config = bundle.config
         self.mod = family_module(bundle.family)
@@ -616,6 +675,11 @@ class ModelPrograms:
             raise ValueError(
                 f"family {bundle.family!r} has no KV-cached decode — the "
                 f"serving engine needs init_cache/prefill/paged_decode_step")
+        if max_adapters is not None and not hasattr(self.mod, "_lora_sort"):
+            raise ValueError(
+                f"family {bundle.family!r} has no batched multi-LoRA "
+                f"decode path — max_adapters needs the grouped-GEMM lora "
+                f"hooks in models/llama.py")
         if attend_impl not in ("auto", "flash", "xla"):
             raise ValueError(f"attend_impl must be 'auto', 'flash' or "
                              f"'xla', got {attend_impl!r}")
@@ -691,6 +755,36 @@ class ModelPrograms:
             # program once, breaking the cache-flat-across-publishes pin
             params = jax.device_put(params, jax.devices()[0])
         self.params = params
+
+        # ---- pooled multi-LoRA adapters (serve/adapters.py) ----
+        # the stacked A/B buffers are program ARGUMENTS (fixed avals, like
+        # tables/lengths), so insert/evict/publish swap buffers without
+        # touching any jit cache below; placement mirrors the params'
+        # COMMITTED placement so the first insert can't retrace either
+        self.adapter_pool: Optional[AdapterPool] = None
+        self.adapter_stacks = None
+        self._adapter_shapes = None
+        self._insert_fn = None
+        self.adapter_publish_count = 0
+        if max_adapters is not None:
+            self.adapter_pool = AdapterPool(
+                max_adapters, rank=adapter_rank, alpha=adapter_alpha,
+                targets=adapter_targets)
+            self._adapter_shapes = adapter_shapes(
+                self.config, rank=adapter_rank, targets=adapter_targets,
+                bundle=bundle)
+            stacks = init_adapter_stacks(
+                self.config, max_adapters=max_adapters, rank=adapter_rank,
+                targets=adapter_targets, bundle=bundle)
+            if plan is not None:
+                stacks = jax.device_put(stacks, plan.replicated())
+            else:
+                stacks = jax.device_put(stacks, jax.devices()[0])
+            self.adapter_stacks = stacks
+            # ONE compiled insert for every slot: the slot index is a
+            # TRACED scalar, so publishing into slot 3 and slot 7 hit the
+            # same executable (jit-cache-flat across inserts)
+            self._insert_fn = jax.jit(self._adapter_insert)
 
         kv_out = ((self._kv_sharding, self._kv_sharding)
                   if self.shard_kv else None)
@@ -866,6 +960,75 @@ class ModelPrograms:
         self.publish_count += 1
         return self.publish_count
 
+    # ---- adapter publishing (the multi-tenant seam) ------------------------
+    def _adapter_insert(self, stacks, payload, slot):
+        """One adapter's leaves into the stacked pool at a TRACED slot —
+        ``dynamic_update_slice`` on the adapter axis (axis 1, after the
+        leading layer axis), fp32 like the stacks. Copies, never donates
+        (the publish-snapshot discipline: the caller keeps its tree)."""
+        out = {}
+        for t, pair in stacks.items():
+            upd = {}
+            for leaf in ("a", "b"):
+                buf = pair[leaf]
+                new = jnp.expand_dims(
+                    payload[t][leaf].astype(buf.dtype), 1)
+                start = (0, slot) + (0,) * (buf.ndim - 2)
+                upd[leaf] = jax.lax.dynamic_update_slice(buf, new, start)
+            out[t] = upd
+        return out
+
+    def publish_adapter(self, adapter_params, *, name: Optional[str] = None,
+                        slot: Optional[int] = None) -> int:
+        """Insert (or republish) ONE tenant adapter into the stacked pool
+        — ``publish_params``' little sibling: validated per leaf against
+        the pool's (rank, targets) geometry, refused while a generation
+        swap is in flight, and retrace-free by construction (the stacks
+        are program arguments; the insert runs one compiled
+        ``dynamic_update_slice`` whatever the slot). ``slot=None`` claims
+        a slot (LRU-evicting an idle adapter under pressure); a concrete
+        ``slot`` republishes a live tenant in place (continual tuning).
+        The payload is ``models/lora.py``'s ``params['lora']`` layout —
+        a trained adapter publishes without reshaping. Returns the slot
+        id requests should carry as ``adapter_id``."""
+        if self.adapter_pool is None:
+            raise ValueError(
+                "this engine serves no adapter pool (built with "
+                "max_adapters=None) — adapters cannot be published into "
+                "it; rebuild with max_adapters=")
+        if self._swap_in_flight:
+            raise RuntimeError(
+                "cannot publish an adapter while an engine generation "
+                "swap is in flight: the swap replays in-flight sequences "
+                "bitwise through these programs — publish before the "
+                "swap or after it completes")
+        validate_adapter_params(self._adapter_shapes, adapter_params)
+        pool = self.adapter_pool
+        if slot is None:
+            slot = pool.alloc(name)
+            if slot is None:
+                raise RuntimeError(
+                    f"adapter pool exhausted: all {pool.capacity} tenant "
+                    f"slots are live with in-flight requests — drain a "
+                    f"tenant or build the engine with a larger "
+                    f"max_adapters")
+        else:
+            if slot == ZERO_ADAPTER:
+                raise ValueError("adapter slot 0 is the zero adapter and "
+                                 "is never published into")
+            if not pool.is_live(int(slot)):
+                raise ValueError(
+                    f"adapter slot {slot} is not live — omit slot= to "
+                    f"allocate one, or publish into a live slot "
+                    f"({pool.live_slots()}) to refresh that tenant")
+            slot = int(slot)
+            pool.mark_update(slot)
+        self.adapter_stacks = self._insert_fn(
+            self.adapter_stacks, adapter_params,
+            jnp.asarray(slot, jnp.int32))
+        self.adapter_publish_count += 1
+        return slot
+
     def jit_cache_sizes(self) -> dict:
         """Per-program jit cache sizes — the retrace meter. A weight
         publish must leave every number here unchanged (the acceptance
@@ -877,6 +1040,8 @@ class ModelPrograms:
             "copy": self._copy_fn._cache_size(),
             "sample_one": self._sample_one._cache_size(),
         }
+        if self._insert_fn is not None:
+            sizes["adapter_insert"] = self._insert_fn._cache_size()
         for b, fn in self._prefill_fns.items():
             sizes[f"prefill_{b}"] = fn._cache_size()
         for t, fn in self._chunk_fns.items():
@@ -911,12 +1076,32 @@ class ModelPrograms:
         return make_attend(tables, lengths, impl=impl, n_valid=n_valid)
 
     # ---- compiled programs -------------------------------------------------
+    def _lora_ctx(self, lora_args) -> Optional[dict]:
+        """The ``lora=`` dict the model forwards take, from the optional
+        trailing ``(stacks, adapters)`` program arguments — None when the
+        engine serves no adapter pool, and the programs then trace
+        exactly the pre-adapter graph (byte-identical compile surface)."""
+        if not lora_args:
+            return None
+        stacks, adapters = lora_args
+        return {"scale": self.adapter_pool.scale, "adapters": adapters,
+                "stacks": stacks, "impl": "auto"}
+
+    def lora_call_args(self, adapters) -> tuple:
+        """Trailing program arguments for one forward: ``()`` without a
+        pool, else ``(stacks, adapters[int32])`` — both ARRAYS, so any
+        adapter mix and any pool content run the one compiled program."""
+        if self.adapter_pool is None:
+            return ()
+        return (self.adapter_stacks, jnp.asarray(adapters, jnp.int32))
+
     def _decode(self, params, kp, vp, tokens, lengths, tables, seeds, temps,
-                top_ks, top_ps, actives):
+                top_ks, top_ps, actives, *lora_args):
         attend = self.make_attend(tables, lengths)
         logits, cache = self.mod.paged_decode_step(
             self.config, params, tokens[:, None], lengths,
-            {"k": kp, "v": vp}, attend)
+            {"k": kp, "v": vp}, attend,
+            **({"lora": self._lora_ctx(lora_args)} if lora_args else {}))
         nxt = _sample_tokens(logits.astype(jnp.float32), seeds, lengths + 1,
                              temps, top_ks, top_ps)
         nxt = jnp.where(actives, nxt, 0)
@@ -927,10 +1112,12 @@ class ModelPrograms:
 
     def prefill_for(self, bucket: int):
         if bucket not in self._prefill_fns:
-            def fn(params, ids, last_pos):
+            def fn(params, ids, last_pos, *lora_args):
                 cache = self.mod.init_cache(self.config, 1, bucket)
-                logit, cache = self.mod.prefill(self.config, params, ids,
-                                                cache, last_pos=last_pos)
+                logit, cache = self.mod.prefill(
+                    self.config, params, ids, cache, last_pos=last_pos,
+                    **({"lora": self._lora_ctx(lora_args)}
+                       if lora_args else {}))
                 return logit[0], cache["k"][:, 0], cache["v"][:, 0]
 
             self._prefill_fns[bucket] = jax.jit(fn)
@@ -947,11 +1134,14 @@ class ModelPrograms:
         chunk's pad tail to the trash page; ``last_index`` picks the
         real last token's logits."""
         if t not in self._chunk_fns:
-            def fn(params, kp, vp, ids, start, table, last_index, n_valid):
+            def fn(params, kp, vp, ids, start, table, last_index, n_valid,
+                   *lora_args):
                 attend = self.make_attend(table, start, n_valid=n_valid)
                 logits, cache = self.mod.paged_decode_step(
                     self.config, params, ids, start, {"k": kp, "v": vp},
-                    attend, last_index=last_index)
+                    attend, last_index=last_index,
+                    **({"lora": self._lora_ctx(lora_args)}
+                       if lora_args else {}))
                 return logits[0], cache["k"], cache["v"]
 
             kv_out = ((self._repl, self._kv_sharding, self._kv_sharding)
@@ -995,11 +1185,13 @@ class ModelPrograms:
         key = (t, bool(greedy))
         if key not in self._verify_fns:
             def fn(params, kp, vp, ids, lengths, tables, seeds, temps,
-                   top_ks, top_ps, actives, n_valid):
+                   top_ks, top_ps, actives, n_valid, *lora_args):
                 attend = self.make_attend(tables, lengths, n_valid=n_valid)
                 logits, cache = self.mod.paged_decode_step(
                     self.config, params, ids, lengths, {"k": kp, "v": vp},
-                    attend, all_logits=True)
+                    attend, all_logits=True,
+                    **({"lora": self._lora_ctx(lora_args)}
+                       if lora_args else {}))
                 if greedy:
                     targets = jnp.argmax(logits, axis=-1).astype(jnp.int32)
                 else:
@@ -1105,7 +1297,9 @@ class ServeEngine:
                  shard_kv: bool = False, max_queue: Optional[int] = None,
                  programs: Optional[ModelPrograms] = None,
                  speculate=None, spec_k: int = 4, kv_dtype=None,
-                 weight_dtype=None):
+                 weight_dtype=None, max_adapters: Optional[int] = None,
+                 adapter_rank: int = 8, adapter_alpha: float = 16.0,
+                 adapter_targets=DEFAULT_TARGETS):
         self.drafter = resolve_drafter(speculate, spec_k=spec_k,
                                        n_slots=n_slots)
         self.spec = new_spec_counters()
@@ -1118,13 +1312,18 @@ class ServeEngine:
         self.programs = programs if programs is not None else ModelPrograms(
             bundle, params, plan=plan, shard_kv=shard_kv,
             attend_impl=attend_impl, kv_dtype=kv_dtype,
-            weight_dtype=weight_dtype)
+            weight_dtype=weight_dtype, max_adapters=max_adapters,
+            adapter_rank=adapter_rank, adapter_alpha=adapter_alpha,
+            adapter_targets=adapter_targets)
         self.bundle = self.programs.bundle
         self.kv_dtype = self.programs.kv_dtype
         # like kv_dtype: when a pre-built ``programs`` is shared in, the
         # storage dtypes are ITS dtypes — the kwarg only shapes a fresh
         # ModelPrograms (spawned replicas inherit the fleet's precision)
         self.weight_dtype = self.programs.weight_dtype
+        # shared-programs inheritance, like the dtypes: a spawned replica
+        # or a disagg pair serves the FLEET's pool, never a private one
+        self.adapter_pool = self.programs.adapter_pool
         self.config = self.programs.config
         self.mod = self.programs.mod
         self.plan = self.programs.plan
@@ -1155,7 +1354,8 @@ class ServeEngine:
             allow_partial_share=prefill_chunk is not None,
             # admission headroom scales to the k in-flight speculated
             # tokens a verify step can scatter per running decode
-            spec_lookahead=self.drafter.k if self.drafter else 0)
+            spec_lookahead=self.drafter.k if self.drafter else 0,
+            adapter_pool=self.adapter_pool)
         if prefill_buckets is None:
             prefill_buckets = default_prefill_buckets(self.max_pages,
                                                       page_size)
@@ -1289,6 +1489,47 @@ class ServeEngine:
                 f"would rewrite history under new weights) — finish or "
                 f"drain first, or pass force=True to accept that")
         return self.programs.publish_params(new_params)
+
+    def publish_adapter(self, adapter_params, *,
+                        name: Optional[str] = None,
+                        slot: Optional[int] = None,
+                        force: bool = False) -> int:
+        """Publish ONE tenant adapter into the shared pool
+        (``ModelPrograms.publish_adapter`` — validated, retrace-free).
+        Returns the slot id requests carry as ``adapter_id``.
+
+        Refused while the engine holds in-flight work unless ``force``,
+        mirroring ``publish_params``: a republish into a live slot would
+        rewrite a mid-stream tenant's weights (breaking bitwise replay
+        for its sequences), and even a fresh insert can LRU-recycle a
+        slot id an about-to-replay sequence still names. The post loop
+        publishes between rollout batches — the drained window. The
+        recycled slot's prefix-cache namespace is dropped here: cached
+        k/v computed under the old tenant must never serve the new one."""
+        if not force and self.has_work:
+            raise RuntimeError(
+                f"publish_adapter with "
+                f"{len(self.scheduler.queue)} queued + "
+                f"{len(self.scheduler.active_indices()) + len(self.scheduler.prefilling_indices())} "
+                f"resident sequences in flight — finish or drain first, "
+                f"or pass force=True to accept mid-stream adapter churn")
+        slot_id = self.programs.publish_adapter(adapter_params, name=name,
+                                                slot=slot)
+        if self.scheduler.cache is not None:
+            self.scheduler.cache.drop_namespace(slot_id)
+        return slot_id
+
+    def evict_adapter(self, slot: int) -> None:
+        """Retire a tenant adapter (refuses while its requests are in
+        flight — AdapterPool.evict) and drop its prefix-cache namespace:
+        the slot id is about to be recycled, and a stale cached page
+        under it would silently corrupt the next tenant's prompts."""
+        if self.adapter_pool is None:
+            raise ValueError("this engine serves no adapter pool (built "
+                             "with max_adapters=None)")
+        self.adapter_pool.evict(slot)
+        if self.scheduler.cache is not None:
+            self.scheduler.cache.drop_namespace(slot)
 
     @property
     def has_work(self) -> bool:
@@ -1425,6 +1666,9 @@ class ServeEngine:
             **spec_metrics(self.spec, decode_steps=self.decode_steps,
                            decode_tokens=self.decode_tokens,
                            drafter=self.drafter),
+            **adapter_metrics(
+                self.adapter_pool,
+                publishes=self.programs.adapter_publish_count),
         }
 
     def kv_report(self) -> dict:
@@ -1439,6 +1683,11 @@ class ServeEngine:
     def weight_report(self) -> dict:
         """The preflight-style byte table for this engine's weights."""
         return build_weight_report(self.programs)
+
+    def adapter_report(self) -> dict:
+        """The preflight-style byte table for this engine's adapter pool
+        (empty without one)."""
+        return build_adapter_report(self.programs)
 
     def weight_bytes(self) -> int:
         """Actual param storage bytes (int8 payload + scales under

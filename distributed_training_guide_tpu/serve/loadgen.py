@@ -100,7 +100,10 @@ class Scenario:
     sampled per request; ``shared_prefix`` is prepended VERBATIM to
     every prompt (the chat profile's system prompt — page-aligned
     lengths hit the prefix cache and the router's affinity key).
-    ``priority``/``deadline_s`` ride straight onto the Request."""
+    ``priority``/``deadline_s`` ride straight onto the Request.
+    ``adapter_ids``/``adapter_weights`` make the profile multi-tenant:
+    each request draws its LoRA pool slot by weight (empty = all base
+    traffic on adapter 0)."""
 
     name: str
     prompt_len: tuple[int, int]
@@ -110,16 +113,24 @@ class Scenario:
     deadline_s: Optional[float] = None
     temperature: float = 0.0
     weight: float = 1.0
+    adapter_ids: tuple = ()
+    adapter_weights: tuple = ()
 
     def sample(self, rng: random.Random, vocab: int, index: int) -> Request:
         n_prompt = rng.randint(*self.prompt_len)
         n_gen = rng.randint(*self.max_new_tokens)
         prompt = list(self.shared_prefix) + [
             rng.randrange(1, vocab) for _ in range(n_prompt)]
+        adapter = 0
+        if self.adapter_ids:
+            adapter = rng.choices(
+                self.adapter_ids,
+                weights=self.adapter_weights or None, k=1)[0]
         return Request(prompt_ids=prompt, max_new_tokens=n_gen,
                        temperature=self.temperature,
                        seed=index, priority=self.priority,
-                       deadline_s=self.deadline_s)
+                       deadline_s=self.deadline_s,
+                       adapter_id=int(adapter))
 
 
 def default_scenarios(*, max_len: int, page_size: int, vocab: int,
@@ -158,6 +169,45 @@ def default_scenarios(*, max_len: int, page_size: int, vocab: int,
         Scenario("batch", prompt_len=(2, qtr), max_new_tokens=(2, qtr),
                  priority=0, deadline_s=dl(4.0), weight=1.0),
     ]
+
+
+def zipf_weights(n: int, s: float = 1.1) -> list[float]:
+    """Normalized Zipf pmf over ranks 1..n (weight of rank k is
+    1/k^s): the canonical multi-tenant popularity curve — a few hot
+    adapters dominate, a long tail stays resident but rarely batched.
+    S-LoRA and Punica both benchmark against exactly this shape."""
+    if n < 1:
+        raise ValueError(f"need n >= 1 adapters, got {n}")
+    raw = [1.0 / (k ** s) for k in range(1, n + 1)]
+    total = sum(raw)
+    return [w / total for w in raw]
+
+
+def adapter_mix_scenario(*, max_len: int, n_adapters: int,
+                         zipf_s: float = 1.1, base_share: float = 0.2,
+                         deadline_s: Optional[float] = None,
+                         weight: float = 4.0,
+                         name: str = "adapter_mix") -> Scenario:
+    """The multi-tenant profile: every arrival (the Poisson schedule is
+    unchanged — tenancy shapes WHICH adapter, not WHEN) draws a pool
+    slot Zipf-weighted by slot rank, slot 1 hottest. ``base_share`` of
+    the traffic stays on adapter 0 (the base model — real fleets serve
+    both). Drive it against an engine whose pool has slots 1..n_adapters
+    published; an unpublished slot refuses at submit, which is itself a
+    measurable failure mode (refused_by_reason['unknown_adapter'])."""
+    if not 0.0 <= base_share < 1.0:
+        raise ValueError(f"base_share must be in [0, 1), got {base_share}")
+    qtr = max(2, max(8, max_len) // 4)
+    ids = list(range(1, n_adapters + 1))
+    weights = [w * (1.0 - base_share) for w in zipf_weights(n_adapters,
+                                                            zipf_s)]
+    if base_share > 0:
+        ids = [0] + ids
+        weights = [base_share] + weights
+    return Scenario(name, prompt_len=(2, qtr), max_new_tokens=(2, qtr),
+                    deadline_s=deadline_s, weight=weight,
+                    adapter_ids=tuple(ids),
+                    adapter_weights=tuple(weights))
 
 
 def build_schedule(arrivals: list[float], scenarios: list[Scenario], *,
@@ -382,6 +432,13 @@ def main(argv=None) -> int:
     parser.add_argument("--page-size", type=int, default=16)
     parser.add_argument("--max-len", type=int, default=128)
     parser.add_argument("--max-queue", type=int, default=None)
+    parser.add_argument("--adapters", type=int, default=0,
+                        help="publish this many toy LoRA adapters and "
+                             "add a Zipf-weighted multi-tenant profile "
+                             "to the scenario mix")
+    parser.add_argument("--adapter-rank", type=int, default=8)
+    parser.add_argument("--zipf-s", type=float, default=1.1,
+                        help="Zipf exponent for adapter popularity")
     parser.add_argument("--controller", action="store_true",
                         help="run the SLO controller over the fleet "
                              "(serve/controller.py defaults)")
@@ -397,9 +454,22 @@ def main(argv=None) -> int:
 
     bundle = get_model(args.model, dtype=jnp.float32)
     params = bundle.init(bundle.config, jax.random.key(args.seed))
+    adapter_kw = ({"max_adapters": args.adapters + 1,
+                   "adapter_rank": args.adapter_rank}
+                  if args.adapters > 0 else {})
     fleet = local_fleet(bundle, params, args.replicas,
                         n_slots=args.slots, page_size=args.page_size,
-                        max_len=args.max_len, max_queue=args.max_queue)
+                        max_len=args.max_len, max_queue=args.max_queue,
+                        **adapter_kw)
+    if args.adapters > 0:
+        from ..models.lora import lora_bundle
+
+        lb = lora_bundle(bundle, rank=args.adapter_rank)
+        for i in range(args.adapters):
+            lp = lb.init(lb.config, jax.random.key(1000 + i))["lora"]
+            fleet.publish_adapter(
+                jax.tree.map(lambda x: x * 0.02, lp),
+                name=f"tenant-{i + 1}")
     controller = None
     if args.controller:
         from .controller import Controller
@@ -409,6 +479,10 @@ def main(argv=None) -> int:
     scenarios = default_scenarios(max_len=args.max_len,
                                   page_size=args.page_size, vocab=vocab,
                                   deadline_s=args.deadline, seed=args.seed)
+    if args.adapters > 0:
+        scenarios.append(adapter_mix_scenario(
+            max_len=args.max_len, n_adapters=args.adapters,
+            zipf_s=args.zipf_s, deadline_s=args.deadline))
     if args.trace:
         with open(args.trace) as fp:
             arrivals = trace_arrivals(

@@ -62,19 +62,30 @@ from ..utils.heartbeat import HeartbeatMonitor, HeartbeatWriter
 from .scheduler import RefusalError, Request, RequestResult
 
 
-def prefix_affinity_key(prompt_ids, page_size: int) -> Optional[bytes]:
+def prefix_affinity_key(prompt_ids, page_size: int,
+                        adapter_id: int = 0) -> Optional[bytes]:
     """Content hash of the prompt's page-aligned PROPER prefix — the
     exact tokens a :class:`PrefixCache` could serve from shared pages
     (full pages only, and at least one token always recomputes, mirroring
     ``PrefixCache.match``). None when the prompt owns no full cacheable
     page: affinity has nothing to win there, so routing degrades to
     least-loaded. Stable across processes and engine configs — it sees
-    only (prompt, page_size), never prefill mode or kv dtype."""
+    only (prompt, page_size, adapter), never prefill mode or kv dtype.
+
+    The adapter id extends the key because cached pages are namespaced
+    per adapter slot: the same prefix under two tenants shares NOTHING,
+    so steering them to one replica wins nothing. Adapter 0 keys are
+    bitwise-unchanged from the pre-multi-LoRA key (base traffic keeps
+    its affinity assignments across an upgrade)."""
     n_full = (len(prompt_ids) - 1) // page_size
     if n_full < 1:
         return None
     arr = np.asarray(prompt_ids[:n_full * page_size], np.int64)
-    return hashlib.blake2b(arr.tobytes(), digest_size=8).digest()
+    h = hashlib.blake2b(digest_size=8)
+    if adapter_id:
+        h.update(np.int64(adapter_id).tobytes())
+    h.update(arr.tobytes())
+    return h.digest()
 
 
 def rendezvous_order(key: bytes, names) -> list:
@@ -271,6 +282,20 @@ class Router:
                     f" — a mixed-precision fleet breaks routing identity "
                     f"(the same request would sample different tokens per "
                     f"replica) and the all-or-nothing publish contract")
+        adapter_cfgs = {
+            (None if getattr(r.engine, "adapter_pool", None) is None
+             else (r.engine.adapter_pool.max_adapters,
+                   r.engine.adapter_pool.rank,
+                   r.engine.adapter_pool.alpha,
+                   r.engine.adapter_pool.targets))
+            for r in replicas}
+        if len(adapter_cfgs) != 1:
+            raise ValueError(
+                f"replicas disagree on adapter pool config "
+                f"({sorted(map(str, adapter_cfgs))}) — a tenant's slot id "
+                f"must mean the same weights on every replica, or "
+                f"resubmitting a fenced request would decode under a "
+                f"different adapter (or refuse outright)")
         self.replicas: dict[str, Replica] = {r.name: r for r in replicas}
         self.page_size = page_sizes.pop()
         self.kv_dtype = getattr(replicas[0].engine, "kv_dtype", None)
@@ -290,7 +315,8 @@ class Router:
                          "spillovers": 0, "fenced": 0, "resubmitted": 0,
                          "resubmit_exhausted": 0, "replicas_added": 0,
                          "replicas_removed": 0, "generation_swaps": 0,
-                         "param_publishes": 0, "refused": {}}
+                         "param_publishes": 0, "adapter_publish_calls": 0,
+                         "refused": {}}
         # the control plane's degradation-ladder knobs (serve/controller
         # sets them; anything may): ``min_priority`` sheds submits below
         # that class with a 429 before routing even starts, and
@@ -315,7 +341,8 @@ class Router:
         live = self._routable(now, exclude)
         if not live:
             return [], False
-        key = prefix_affinity_key(request.prompt_ids, self.page_size)
+        key = prefix_affinity_key(request.prompt_ids, self.page_size,
+                                  adapter_id=request.adapter_id)
         by_load = sorted(live, key=lambda r: replica_load(r.engine.stats()))
         if key is None:
             return by_load, False
@@ -689,6 +716,66 @@ class Router:
         self.counters["param_publishes"] += published
         return published
 
+    def publish_adapter(self, adapter_params, *, name: Optional[str] = None,
+                        slot: Optional[int] = None,
+                        replica: Optional[str] = None,
+                        force: bool = False) -> int:
+        """Fleet-wide adapter insert (a tenant's trained LoRA reaching
+        every replica's pool). ``name`` labels the ADAPTER (matching
+        ``ServeEngine.publish_adapter``); ``replica`` restricts to one
+        replica by its name. Same all-or-nothing discipline as
+        ``publish_params``: every target's in-flight state is checked
+        before any pool is touched, so a busy replica refuses the WHOLE
+        publish — a tenant visible on half the fleet would turn routing
+        spillover into unknown_adapter refusals.
+
+        Returns the slot id the adapter landed in. The constructor pins
+        identical pool configs fleet-wide and this facade is the only
+        fleet-level insert path, so separate pools allocate in lockstep;
+        if they ever diverge the mismatch raises loudly rather than
+        letting one slot id mean two tenants."""
+        if replica is not None and replica not in self.replicas:
+            raise ValueError(f"no replica named {replica!r}")
+        targets = ([self.replicas[replica]] if replica is not None
+                   else [r for r in self.replicas.values()
+                         if r.state == "live"])
+        if not targets:
+            raise RuntimeError("publish_adapter: no live replica")
+        if not force:
+            busy = [r.name for r in targets if r.engine.has_work]
+            if busy:
+                raise RuntimeError(
+                    f"publish_adapter refused: replicas {busy} have "
+                    f"in-flight work and a partial publish would leave "
+                    f"the adapter visible on only part of the fleet — "
+                    f"drain first, or pass force=True to accept "
+                    f"mid-stream inserts fleet-wide")
+        seen: dict = {}
+        slot_id: Optional[int] = None
+        for target in targets:
+            programs = target.engine.programs
+            if id(programs) in seen:
+                # the shared pool already took the insert — only this
+                # replica's own prefix-cache namespace still needs
+                # dropping for the recycled slot id
+                sched = getattr(target.engine, "scheduler", None)
+                if sched is not None and sched.cache:
+                    sched.cache.drop_namespace(seen[id(programs)])
+                continue
+            sid = target.engine.publish_adapter(adapter_params, name=name,
+                                                slot=slot, force=force)
+            seen[id(programs)] = sid
+            if slot_id is None:
+                slot_id = sid
+            elif sid != slot_id:
+                raise RuntimeError(
+                    f"adapter pools diverged: replica {target.name!r} "
+                    f"allocated slot {sid}, expected {slot_id} — the "
+                    f"fleet's slot ids no longer agree; re-publish with "
+                    f"an explicit slot= after resolving the drift")
+        self.counters["adapter_publish_calls"] += 1
+        return slot_id
+
     # ---- the engine-shaped surface -----------------------------------------
     @property
     def has_work(self) -> bool:
@@ -747,6 +834,8 @@ class Router:
         per, agg = {}, {k: 0 for k in self._SUM_KEYS}
         refused: dict = {}
         depths: dict = {}
+        adapter_requests: dict = {}
+        pools: dict = {}
         now = self.clock()
         for name, replica in self.replicas.items():
             s = replica.engine.stats() if replica.state != "dead" else {}
@@ -756,6 +845,14 @@ class Router:
                 refused[reason] = refused.get(reason, 0) + n
             for prio, n in s.get("queue_depth_by_priority", {}).items():
                 depths[prio] = depths.get(prio, 0) + n
+            for aid, n in s.get("adapter_requests", {}).items():
+                adapter_requests[aid] = adapter_requests.get(aid, 0) + n
+            # pool gauges dedupe by pool object: a share_programs fleet
+            # has ONE pool behind every replica, and summing it per
+            # replica would overstate capacity n_replicas-fold
+            pool = getattr(replica.engine, "adapter_pool", None)
+            if pool is not None and replica.state != "dead":
+                pools[id(pool)] = pool
             per[name] = {
                 "state": replica.state,
                 "wedged": replica.wedged,
@@ -771,8 +868,30 @@ class Router:
             refused[reason] = refused.get(reason, 0) + n
         n_slots = max(1, self.n_slots)
         drafted = agg["spec_tokens_drafted"]
+        adapter_agg: dict = {}
+        if pools:
+            vals = list(pools.values())
+            capacity = sum(p.capacity for p in vals)
+            live = sum(p.n_live for p in vals)
+            adapter_agg = {
+                "adapter_slots": sum(p.max_adapters for p in vals),
+                "adapter_capacity": capacity,
+                "adapters_live": live,
+                "adapters_free": sum(p.n_free for p in vals),
+                "adapter_occupancy": (round(live / capacity, 3)
+                                      if capacity else 0.0),
+                "adapter_inserts": sum(p.stats["inserts"] for p in vals),
+                "adapter_updates": sum(p.stats["updates"] for p in vals),
+                "adapter_evictions": sum(p.stats["evictions"]
+                                         for p in vals),
+                "adapter_lru_evictions": sum(p.stats["lru_evictions"]
+                                             for p in vals),
+            }
+        if adapter_requests or pools:
+            adapter_agg["adapter_requests"] = adapter_requests
         return {
             **agg,
+            **adapter_agg,
             "refused": refused,
             "router": True,
             # the router's own iteration count doubles as the fleet-level
@@ -825,12 +944,16 @@ def local_fleet(bundle, params, n_replicas: int = 2, *,
 
     programs = None
     if share_programs:
+        adapter_kw = {k: engine_kw[k]
+                      for k in ("max_adapters", "adapter_rank",
+                                "adapter_alpha", "adapter_targets")
+                      if k in engine_kw}
         programs = ModelPrograms(
             bundle, params, plan=engine_kw.get("plan"),
             shard_kv=engine_kw.get("shard_kv", False),
             attend_impl=engine_kw.get("attend_impl", "auto"),
             kv_dtype=engine_kw.get("kv_dtype"),
-            weight_dtype=engine_kw.get("weight_dtype"))
+            weight_dtype=engine_kw.get("weight_dtype"), **adapter_kw)
     replicas = []
     for i in range(n_replicas):
         engine = ServeEngine(bundle, params, programs=programs, **engine_kw)

@@ -110,6 +110,11 @@ class Request:
     eos_id: Optional[int] = None
     priority: int = 0
     deadline_s: Optional[float] = None
+    # which pooled LoRA adapter decodes this request: 0 is the zero
+    # adapter (base model, always servable); any other id must be LIVE
+    # in the engine's AdapterPool at submit or the request is refused
+    # ("unknown_adapter") — admission never blocks on adapter loads
+    adapter_id: int = 0
     request_id: Optional[int] = None  # assigned at submit
 
 
@@ -226,10 +231,45 @@ class PrefixCache:
         self.pool = pool
         self.page_size = pool.page_size
         self.root = _PrefixNode(None, (), None)
+        # cached k/v depends on the ADAPTER that produced it: any target
+        # projection shifts every layer's hidden states, so a page
+        # computed under adapter 3 must never serve a prompt decoding
+        # under adapter 5. Namespacing the tree roots by adapter_id is
+        # the whole fix — ``root`` stays the base-model (adapter-0)
+        # namespace so adapter-free deployments see the old tree shape.
+        self._roots: dict[int, _PrefixNode] = {0: self.root}
         self._tick = itertools.count(1)
         self.n_pages = 0
 
-    def match(self, tokens: list, allow_partial: bool):
+    def _root_for(self, ns: int) -> _PrefixNode:
+        root = self._roots.get(ns)
+        if root is None:
+            root = self._roots[ns] = _PrefixNode(None, (), None)
+        return root
+
+    def drop_namespace(self, ns: int) -> int:
+        """Free every page registered under adapter namespace ``ns`` —
+        called when an adapter slot is recycled by a NEW insert: the
+        slot id survives but the weights changed, so cached k/v computed
+        under the old tenant would silently corrupt the new one's
+        prompts. Returns the number of pages dropped."""
+        root = self._roots.get(ns)
+        if root is None:
+            return 0
+        dropped = 0
+        stack = list(root.children.values())
+        while stack:
+            node = stack.pop()
+            stack.extend(node.children.values())
+            self.pool.free([node.page])
+            self.n_pages -= 1
+            dropped += 1
+        root.children = {}
+        if ns != 0:
+            del self._roots[ns]
+        return dropped
+
+    def match(self, tokens: list, allow_partial: bool, ns: int = 0):
         """Longest chain of registered pages covering a PROPER prefix of
         ``tokens`` (at least one token is always left to recompute — the
         last position's logits must come from a live forward). Returns
@@ -238,7 +278,7 @@ class PrefixCache:
         remaining tokens — the CoW candidate."""
         page = self.page_size
         tick = next(self._tick)
-        node, full, pos = self.root, [], 0
+        node, full, pos = self._root_for(ns), [], 0
         while pos + page <= len(tokens) - 1:
             child = node.children.get(tuple(tokens[pos:pos + page]))
             if child is None:
@@ -263,14 +303,14 @@ class PrefixCache:
                 partial[0].last_used = tick
         return full, partial
 
-    def register(self, tokens: list, pages: list) -> None:
+    def register(self, tokens: list, pages: list, ns: int = 0) -> None:
         """Insert every FULL page of ``tokens`` (page i holds
         tokens[i*page:(i+1)*page], physical id pages[i]); the cache takes
         one pool reference per page it newly adopts. Existing nodes with
         the same content win — duplicates are not double-registered."""
         page = self.page_size
         tick = next(self._tick)
-        node, pos, i = self.root, 0, 0
+        node, pos, i = self._root_for(ns), 0, 0
         while pos + page <= len(tokens):
             key = tuple(tokens[pos:pos + page])
             child = node.children.get(key)
@@ -287,7 +327,7 @@ class PrefixCache:
         evictions would orphan reachable children into leaked refs).
         Returns False when the cache is empty."""
         best, best_key, best_parent = None, None, None
-        stack = [self.root]
+        stack = list(self._roots.values())
         while stack:
             node = stack.pop()
             for key, child in node.children.items():
@@ -315,7 +355,8 @@ class Scheduler:
                  prefix_cache: bool = True,
                  allow_partial_share: bool = False,
                  max_queue: Optional[int] = None,
-                 admission_headroom=None, spec_lookahead: int = 0):
+                 admission_headroom=None, spec_lookahead: int = 0,
+                 adapter_pool=None):
         if n_slots < 1:
             raise ValueError(f"n_slots must be >= 1, got {n_slots}")
         if max_queue is not None and max_queue < 1:
@@ -355,6 +396,15 @@ class Scheduler:
             raise ValueError(f"spec_lookahead must be >= 0, got "
                              f"{spec_lookahead}")
         self.spec_lookahead = spec_lookahead
+        # shared AdapterPool (serve/adapters.py) when the engine serves
+        # pooled LoRA adapters; refcounts track requests INSIDE this
+        # scheduler (queued or seated): retained at every entry point
+        # (submit/requeue/adopt), released at every exit (finish,
+        # deadline, release_slot, drain_queue) — preemption and
+        # admission move a request WITHIN the scheduler and touch
+        # nothing. The disagg pair shares one pool, so a handoff's
+        # release-then-retain is net-neutral on the tenant's count.
+        self.adapter_pool = adapter_pool
         self.stats = {"admission_blocked": 0, "admitted": 0, "finished": 0,
                       "preempted": 0, "prefix_hits": 0,
                       "prefix_tokens_shared": 0, "cow_forks": 0,
@@ -366,7 +416,20 @@ class Scheduler:
                       # for the decode rate itself
                       "deadline_missed_queued": 0,
                       "deadline_missed_running": 0,
-                      "spec_lookahead_clamped": 0, "refused": {}}
+                      "spec_lookahead_clamped": 0, "refused": {},
+                      # requests submitted per adapter slot (keyed by
+                      # adapter_id) — the per-tenant demand signal the
+                      # router aggregates fleet-wide
+                      "adapter_requests": {}}
+
+    # ---- adapter refcounts -------------------------------------------------
+    def _adapter_retain(self, request: Request) -> None:
+        if self.adapter_pool is not None:
+            self.adapter_pool.retain(int(request.adapter_id))
+
+    def _adapter_release(self, request: Request) -> None:
+        if self.adapter_pool is not None:
+            self.adapter_pool.release(int(request.adapter_id))
 
     # ---- refusals / queue order --------------------------------------------
     def refuse(self, reason: str, message: str, *, http_status: int = 400,
@@ -407,6 +470,7 @@ class Scheduler:
         whose transfer crashed or timed out mid-flight."""
         self._submit_times[entry.request.request_id] = submitted_at
         self._queue_insert(entry, front=True)
+        self._adapter_retain(entry.request)
 
     def requeue(self, request: Request, generated=(), *,
                 first_token_at: float = 0.0,
@@ -431,6 +495,7 @@ class Scheduler:
             self._clock() if submitted_at is None else submitted_at)
         self._queue_insert(_QueueEntry(request, list(generated),
                                        first_token_at), front=front)
+        self._adapter_retain(request)
         return request.request_id
 
     def drain_queue(self) -> list[tuple[_QueueEntry, float]]:
@@ -444,6 +509,7 @@ class Scheduler:
         out = []
         while self.queue:
             entry = self.queue.pop(0)
+            self._adapter_release(entry.request)
             out.append((entry,
                         self._submit_times.pop(entry.request.request_id)))
         return out
@@ -534,6 +600,26 @@ class Scheduler:
         if request.deadline_s is not None and request.deadline_s <= 0:
             self.refuse("bad_params", f"deadline_s must be > 0, got "
                         f"{request.deadline_s}")
+        aid = request.adapter_id
+        if isinstance(aid, bool) or not isinstance(aid, (int, np.integer)):
+            self.refuse("bad_params",
+                        f"adapter_id must be an int, got {aid!r}")
+        if aid != 0:
+            # refuse UNKNOWN adapters at submit (not mid-flight): the
+            # pool never loads on demand, so an id that is not live now
+            # could only ever decode garbage from a recycled slot
+            if self.adapter_pool is None:
+                self.refuse(
+                    "unknown_adapter",
+                    f"adapter_id {aid} but this engine serves no adapter "
+                    f"pool (constructed with max_adapters=None)")
+            if not self.adapter_pool.is_live(int(aid)):
+                self.refuse(
+                    "unknown_adapter",
+                    f"adapter_id {aid} is not resident in the adapter "
+                    f"pool (live: {self.adapter_pool.live_slots()}) — "
+                    f"publish the adapter first",
+                    http_status=404)
         total = n + request.max_new_tokens
         if total > self.max_len:
             self.refuse(
@@ -556,6 +642,9 @@ class Scheduler:
                                       request_id=next(self._ids))
         self._submit_times[request.request_id] = self._clock()
         self._queue_insert(_QueueEntry(request))
+        self._adapter_retain(request)
+        counts = self.stats["adapter_requests"]
+        counts[int(aid)] = counts.get(int(aid), 0) + 1
         return request.request_id
 
     def try_admit(self) -> list[Admission]:
@@ -580,7 +669,8 @@ class Scheduler:
             # decode program after the prompt is back (bitwise recompute)
             tokens = list(req.prompt_ids)
             full, partial = ([], None) if self.cache is None else \
-                self.cache.match(tokens, self.allow_partial_share)
+                self.cache.match(tokens, self.allow_partial_share,
+                                 ns=int(req.adapter_id))
             k_full = len(full)
             shared_len = k_full * page + (partial[1] if partial else 0)
             n_priv = pages_for_tokens(len(tokens), page) - k_full
@@ -662,7 +752,8 @@ class Scheduler:
                 n_full = n_prompt // self.pool.page_size
                 self.cache.register(list(slot.request.prompt_ids[:n_full
                                          * self.pool.page_size]),
-                                    slot.pages[:n_full])
+                                    slot.pages[:n_full],
+                                    ns=int(slot.request.adapter_id))
 
     # ---- growth + preemption ----------------------------------------------
     def preempt(self, slot_idx: int) -> None:
@@ -773,6 +864,7 @@ class Scheduler:
         self.pool.free(slot.pages)
         self.slots[slot_idx] = None
         self.stats["finished"] += 1
+        self._adapter_release(req)
         return RequestResult(
             request_id=req.request_id, prompt_ids=list(req.prompt_ids),
             generated_ids=list(slot.generated), finish_reason=finished,
@@ -812,6 +904,7 @@ class Scheduler:
         results = []
         for entry in [e for e in self.queue if expired(e.request)]:
             self.queue.remove(entry)
+            self._adapter_release(entry.request)
             results.append(self._deadline_result(
                 entry.request, entry.generated, now, entry.first_token_at,
                 now, where="queued"))
@@ -819,6 +912,7 @@ class Scheduler:
             if slot is not None and expired(slot.request):
                 self.pool.free(slot.pages)
                 self.slots[i] = None
+                self._adapter_release(slot.request)
                 results.append(self._deadline_result(
                     slot.request, slot.generated, slot.admitted_at,
                     slot.first_token_at, now, where="running"))
@@ -835,6 +929,7 @@ class Scheduler:
         assert slot is not None and not slot.prefilling, \
             f"release_slot on idle/prefilling slot {slot_idx}"
         self.slots[slot_idx] = None
+        self._adapter_release(slot.request)
         return slot, self._submit_times.pop(slot.request.request_id)
 
     def adopt(self, *, request: Request, pages: list, cache_len: int,
@@ -864,6 +959,7 @@ class Scheduler:
             shared_len=0, resumed=resumed,
             replay_pos=(0 if resumed else max(0, len(generated) - 1)),
             first_token_at=first_token_at)
+        self._adapter_retain(request)
         self.stats["admitted"] += 1
         return slot_idx
 
@@ -909,6 +1005,9 @@ class Scheduler:
             "top_ks": np.zeros(s, np.int32),
             "top_ps": np.ones(s, np.float32),
             "actives": np.zeros(s, bool),
+            # per-slot adapter ids: idle lanes decode under the zero
+            # adapter (slot 0's stack rows are zeros — an exact +0)
+            "adapters": np.zeros(s, np.int32),
         }
         for i, slot in enumerate(self.slots):
             if slot is None or slot.prefilling:
@@ -924,4 +1023,5 @@ class Scheduler:
             out["top_ks"][i] = req.top_k
             out["top_ps"][i] = req.top_p
             out["actives"][i] = True
+            out["adapters"][i] = req.adapter_id
         return out

@@ -583,6 +583,50 @@ def run_preflight(trainer, *, global_batch: int, seq_length: int,
             f" MiB fp32 / {w_by_dtype['bf16'] / 2**20:.2f} MiB bf16; no "
             f"int8 leaf-selection rule for this family (serve/weights.py)")
 
+    # adapter-pool column (serve/adapters.py): the multi-LoRA pool is a
+    # fixed device-resident stack sized at CONSTRUCTION — (max_adapters,
+    # rank, targets) prices it exactly, and a tenant insert/republish
+    # moves one adapter's factors, never the pool. Rows use the default
+    # serving pool shape so the numbers pin arithmetically; scale
+    # linearly in max_adapters and rank for other shapes. Priced for
+    # families with the grouped-GEMM lora decode path (llama); others
+    # would refuse at engine construction.
+    from ..models.registry import family_module
+    try:
+        fam_mod = family_module(getattr(serve_bundle, "family", ""))
+    except KeyError:
+        fam_mod = None
+    if hasattr(fam_mod, "_lora_sort"):
+        from ..serve.adapters import (DEFAULT_TARGETS, adapter_nbytes,
+                                      adapter_pool_bytes)
+        pool_slots, pool_rank = 8, 8
+        per_adapter = adapter_nbytes(cfg, rank=pool_rank,
+                                     targets=DEFAULT_TARGETS,
+                                     bundle=serve_bundle)
+        pool_total = adapter_pool_bytes(cfg, max_adapters=pool_slots,
+                                        rank=pool_rank,
+                                        targets=DEFAULT_TARGETS,
+                                        bundle=serve_bundle)
+        report["serve_adapters"] = {
+            "max_adapters": pool_slots,
+            "rank": pool_rank,
+            "targets": list(DEFAULT_TARGETS),
+            "bytes_per_adapter": per_adapter,
+            "pool_bytes": pool_total,
+            "publish_payload_bytes": per_adapter,
+            "pool_vs_fp32_weights": round(pool_total
+                                          / w_by_dtype["fp32"], 4),
+        }
+        LOGGER.info(
+            f"serve adapter pricing: pool {pool_total / 2**20:.2f} MiB "
+            f"at (max_adapters={pool_slots}, rank={pool_rank}, "
+            f"targets={','.join(DEFAULT_TARGETS)}) — "
+            f"{pool_total / w_by_dtype['fp32']:.3f}x of the fp32 params "
+            f"for {pool_slots - 1} co-resident tenants; a tenant "
+            f"insert/republish moves {per_adapter / 2**10:.1f} KiB "
+            f"(vs {w_by_dtype['fp32'] / 2**20:.2f} MiB for a full "
+            f"publish_params), retrace-free either way")
+
     if target_device is None and jax.default_backend() != "tpu":
         target_device = "v5p"  # the 405B recipe's stated target pod
     comm = comm_roofline(trainer, global_batch=global_batch,

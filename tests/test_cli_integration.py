@@ -464,6 +464,30 @@ def test_preflight_budget_and_lowering(eight_devices):
                         weight_dtype="int8")
     assert w_eng.weight_bytes() == wb["int8"]
 
+    # adapter-pool rows (serve/adapters.py): the multi-LoRA pool priced
+    # at the nominal serving shape (8 slots, rank 8, wq+wv) — fp32
+    # factors A [L, e, r] + B [L, r, fan_out] per target, so the bytes
+    # pin arithmetically from the config; the publish payload is ONE
+    # adapter's factors (the consolidation lever vs a full publish)
+    sa = rep["serve_adapters"]
+    hq = dcfg.num_heads * dcfg.head_size
+    hkv = dcfg.num_kv_heads * dcfg.head_size
+    e, l, r = dcfg.hidden_size, dcfg.num_layers, 8
+    per = 4 * l * ((e * r + r * hq) + (e * r + r * hkv))
+    assert sa["max_adapters"] == 8 and sa["rank"] == 8
+    assert sa["targets"] == ["wq", "wv"]
+    assert sa["bytes_per_adapter"] == per
+    assert sa["pool_bytes"] == 8 * per
+    assert sa["publish_payload_bytes"] == per
+    assert sa["pool_vs_fp32_weights"] == round(8 * per / wb["fp32"], 4)
+    # ...and the analytic rows match what a pooled engine reports
+    a_eng = ServeEngine(bundle, bundle.init(dcfg, jax.random.key(0)),
+                        n_slots=2, page_size=16, max_len=64,
+                        max_adapters=8, adapter_rank=8)
+    a_rep = a_eng.adapter_report()
+    assert a_rep["bytes_per_adapter"] == per
+    assert a_rep["pool_bytes"] == 8 * per
+
     # colocation pricing under QLoRA (post/loop.py): the engine's merged
     # copy is priced at ITS weight_dtype — quantized base + fp adapters
     # in the trainer + an fp teacher all priced in one report

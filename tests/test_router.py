@@ -60,17 +60,28 @@ def test_affinity_key_is_page_aligned_proper_prefix():
 
 def test_affinity_key_sees_only_prompt_and_page_size():
     """The stability satellite, at the source: the key is a pure
-    function of (prompt, page_size) — engine config (chunked vs bucket
-    prefill, int8 kv_dtype) cannot appear in it because it is never an
-    input. Content-hashed, so stable across processes too."""
+    function of (prompt, page_size, adapter) — engine config (chunked vs
+    bucket prefill, int8 kv_dtype) cannot appear in it because it is
+    never an input. Content-hashed, so stable across processes too."""
     import inspect
 
     sig = inspect.signature(prefix_affinity_key)
-    assert list(sig.parameters) == ["prompt_ids", "page_size"]
+    assert list(sig.parameters) == ["prompt_ids", "page_size",
+                                    "adapter_id"]
+    assert sig.parameters["adapter_id"].default == 0
     # content hash, not Python hash(): a known digest pins cross-process
     # stability (PYTHONHASHSEED cannot move this)
     assert prefix_affinity_key(list(range(8)), 4).hex() == \
         prefix_affinity_key(tuple(range(8)), 4).hex()
+    # adapter 0 keys are bitwise the pre-multi-LoRA keys (base traffic
+    # keeps its affinity assignments across an upgrade); tenants fork
+    # the keyspace because cached pages are namespaced per adapter slot
+    assert prefix_affinity_key(list(range(8)), 4, adapter_id=0) == \
+        prefix_affinity_key(list(range(8)), 4)
+    assert prefix_affinity_key(list(range(8)), 4, adapter_id=1) != \
+        prefix_affinity_key(list(range(8)), 4)
+    assert prefix_affinity_key(list(range(8)), 4, adapter_id=1) != \
+        prefix_affinity_key(list(range(8)), 4, adapter_id=2)
 
 
 def test_rendezvous_fencing_moves_only_the_fenced_keys():
