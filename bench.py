@@ -552,6 +552,16 @@ def run_decode_check(only: str = None) -> None:
       control in-rung, plus a raw wire microbench across a REAL process
       boundary (subprocess echo endpoint, payload sha256 must match,
       MiB/s recorded).
+    - tiered_prefix8 (queued sweep rung): 8 requests alternating two
+      96-token prefixes on a one-chain pool, tiered engine (host-RAM
+      spill + restore, serve/tiering.py) vs the no-tier
+      eviction-recompute control in-rung — prefill calls saved, restore
+      hits, direct 6-page spill->restore round-trip latency + bytes.
+    - directory_pull2 (queued sweep rung): 2-replica fleet where the
+      warm replica drains and the cold sibling pulls the committed
+      prefix pages through the router's directory over the handoff wire
+      vs the cold re-prefill control in-rung — dst prefill calls, pull
+      hits, TTFT both ways.
 
     ``only``: comma-separated rung names (sweep-queue children select the
     new rungs explicitly; the default ladder set keeps its PR-6 cost).
@@ -1099,6 +1109,157 @@ def run_decode_check(only: str = None) -> None:
                              == caches_before),
         }
         out["value"] = out.get("value") or 0.0
+        _emit({**out, "partial": True})
+
+    if "tiered_prefix8" in rungs:
+        # tiered KV (serve/tiering.py): 8 requests alternating between
+        # two 96-token prefixes on a pool that holds only ONE committed
+        # chain at a time — every switch evicts the cold chain. The
+        # CONTROL (no host tier) pays eviction-recompute: the evicted
+        # prefix re-prefills from HBM-scratch. The tiered engine spills
+        # evicted pages to host RAM and restores them (scatter + seat)
+        # when the prefix comes back; chunked prefill (prefill_chunk=16)
+        # makes the avoided work visible as prefill-call counts. The
+        # tier is the only new variable. detail also prices one direct
+        # spill->restore round-trip (gather/put/take/scatter of a
+        # 6-page chain) — the per-restore latency and bytes.
+        import dataclasses
+
+        pre_a = [3 + (i % 200) for i in range(96)]
+        pre_b = [7 + (i % 190) for i in range(96)]
+        tier_reqs = [Request(
+            prompt_ids=(pre_a if i % 2 else pre_b) + [10 + i],
+            max_new_tokens=16, seed=i) for i in range(8)]
+
+        def tier_workload(host_tier_bytes):
+            eng = ServeEngine(bundle, params, n_slots=1, page_size=16,
+                              n_pages=12, max_len=128, prefill_chunk=16,
+                              host_tier_bytes=host_tier_bytes)
+            generate_many(eng, [Request(prompt_ids=pre_a + [7],
+                                        max_new_tokens=4),
+                                Request(prompt_ids=pre_b + [9],
+                                        max_new_tokens=4)])  # warm+commit
+            eng.decode_steps = eng.decode_tokens = 0
+            pc0 = eng.programs.prefill_calls
+            t0 = time.perf_counter()
+            results = generate_many(
+                eng, [dataclasses.replace(r, request_id=None)
+                      for r in tier_reqs], max_iterations=5000)
+            stats = throughput_stats(results, time.perf_counter() - t0,
+                                     eng)
+            toks = {tuple(r.prompt_ids): list(r.generated_ids)
+                    for r in results}
+            return eng, stats, eng.programs.prefill_calls - pc0, toks
+
+        t_eng, t_stats, t_pc, t_toks = tier_workload(1 << 22)
+        _, c_stats, c_pc, c_toks = tier_workload(None)
+        ts = t_eng.stats()  # before the microbench touches the counters
+        # direct round-trip microbench: one committed 6-page chain
+        # through the tier, host copy both ways
+        rt_pages = list(range(1, 7))
+        rt_ns, rt_bytes = 5, 0
+        t0 = time.perf_counter()
+        for i in range(rt_ns):
+            payload = t_eng.gather_pages(rt_pages)
+            t_eng.host_tier.put(("bench", i), payload, pages=len(rt_pages))
+            rec = t_eng.host_tier.take(("bench", i))
+            t_eng.scatter_pages(rt_pages, rec.payload)
+            rt_bytes = rec.nbytes
+        jax.block_until_ready(t_eng.pages)
+        rt_ms = 1000 * (time.perf_counter() - t0) / rt_ns
+        out["tiered_prefix8"] = {
+            **t_stats,
+            "prefill_calls": t_pc,
+            "restore_hits": ts["restore_hits"],
+            "restore_misses": ts["restore_misses"],
+            "spilled_pages": ts["spilled_pages"],
+            "host_tier_bytes": ts["host_tier_bytes"],
+            "tier_bytes_restored": ts["tier_bytes_restored"],
+            "control_no_tier": {
+                "tokens_per_s": c_stats["tokens_per_s"],
+                "prefill_calls": c_pc},
+            "prefill_calls_saved": c_pc - t_pc,
+            "restore_roundtrip_ms_6pages": round(rt_ms, 3),
+            "restore_roundtrip_bytes": rt_bytes,
+            "tokens_identical": t_toks == c_toks,
+        }
+        out["value"] = t_stats["tokens_per_s"]
+        _emit({**out, "partial": True})
+
+    if "directory_pull2" in rungs:
+        # fleet prefix directory (serve/tiering.py pull_prefix via
+        # serve/router.py): 2 replicas with INDEPENDENT programs (the
+        # prefill-call counters must be per-replica), r-warm serves a
+        # 96-token shared prefix then DRAINS — the next request for that
+        # prefix must route to the cold sibling, whose affinity miss
+        # consults the router's directory and pulls the committed pages
+        # over the handoff wire instead of re-prefilling them. The
+        # CONTROL is the identical fleet with nothing warmed (plain cold
+        # re-prefill on the same replica) — the pull is the only new
+        # variable. Chunked prefill makes the saved forwards countable.
+        from distributed_training_guide_tpu.serve.router import local_fleet
+
+        dir_prefix = [3 + (i % 200) for i in range(96)]
+        fleet_kw = dict(n_slots=2, page_size=16, max_len=128,
+                        prefill_chunk=16, host_tier_bytes=1 << 22,
+                        share_programs=False)
+
+        def pull_leg(warm):
+            fleet = local_fleet(bundle, params, 2, **fleet_kw)
+            generate_many(fleet, [Request(prompt_ids=dir_prefix + [7],
+                                          max_new_tokens=4)])
+            fleet.step()  # publish stats -> directory refresh
+            warm_names = [n for n, (_, keys) in fleet._directory.items()
+                          if keys]
+            if warm:
+                fleet.replicas[warm_names[0]].drain()
+            else:
+                # control: drop the directory so the pull cannot fire,
+                # and drain the SAME replica so routing is identical
+                fleet._directory.clear()
+                fleet.replicas[warm_names[0]].drain()
+                fleet._refresh_directory = lambda: None
+            pc0 = {n: r.engine.programs.prefill_calls
+                   for n, r in fleet.replicas.items()}
+            t0 = time.perf_counter()
+            results = generate_many(
+                fleet, [Request(prompt_ids=dir_prefix + [8],
+                                max_new_tokens=24, seed=1)],
+                max_iterations=5000)
+            wall = time.perf_counter() - t0
+            dst = [n for n, r in fleet.replicas.items()
+                   if not r.draining][0]
+            return {
+                "tokens_per_s": round(
+                    sum(len(r.generated_ids) for r in results)
+                    / max(wall, 1e-9), 1),
+                "ttft_s": round(results[0].ttft_s, 4),
+                "dst_prefill_calls": (
+                    fleet.replicas[dst].engine.programs.prefill_calls
+                    - pc0[dst]),
+                "directory_pulls": fleet.counters["directory_pulls"],
+                "directory_pull_hits": fleet.counters[
+                    "directory_pull_hits"],
+                "tokens": [list(r.generated_ids) for r in results],
+            }
+
+        pull = pull_leg(warm=True)
+        ctl = pull_leg(warm=False)
+        out["directory_pull2"] = {
+            "tokens_per_s": pull["tokens_per_s"],
+            "ttft_s": pull["ttft_s"],
+            "dst_prefill_calls": pull["dst_prefill_calls"],
+            "directory_pulls": pull["directory_pulls"],
+            "directory_pull_hits": pull["directory_pull_hits"],
+            "control_cold_reprefill": {
+                "tokens_per_s": ctl["tokens_per_s"],
+                "ttft_s": ctl["ttft_s"],
+                "dst_prefill_calls": ctl["dst_prefill_calls"]},
+            "prefill_calls_saved": (ctl["dst_prefill_calls"]
+                                    - pull["dst_prefill_calls"]),
+            "tokens_identical": pull["tokens"] == ctl["tokens"],
+        }
+        out["value"] = pull["tokens_per_s"]
         _emit({**out, "partial": True})
 
     if "disagg_prefill192_decode4" in rungs:
@@ -1954,6 +2115,14 @@ SWEEP_QUEUE = [
     # jit caches pinned flat across the churn.
     dict(name="multilora_slots8", decode_rungs="multilora_slots8"),
     dict(name="multilora_publish", decode_rungs="multilora_publish"),
+    # tiered-KV rungs (serve/tiering.py; queued ahead of the fence
+    # entries per the one-new-variable policy, controls in-rung).
+    # tiered_prefix8 = host-RAM spill/restore vs eviction-recompute on
+    # a one-chain pool; directory_pull2 = the fleet prefix directory's
+    # warm-sibling page pull vs cold re-prefill. Both record the
+    # prefill calls saved — the unit the tier exists to avoid.
+    dict(name="tiered_prefix8", decode_rungs="tiered_prefix8"),
+    dict(name="directory_pull2", decode_rungs="directory_pull2"),
     # LAST on purpose: fence_every=4 dispatches 4 steps ahead, the exact
     # pattern this pool's documented failure mode punishes — its first
     # attempt (2026-07-31 03:50) stalled and the pool went down with it.

@@ -205,7 +205,13 @@ class AdapterPool:
         self._tick = 0                               # LRU clock
         self._last_used = [0] * max_adapters
         self.stats = {"inserts": 0, "updates": 0, "evictions": 0,
-                      "lru_evictions": 0}
+                      "lru_evictions": 0, "spill_evictions": 0}
+        # optional spill hook (serve/tiering.py): called as
+        # ``on_evict(slot, name)`` BEFORE an LRU victim's slot is
+        # recycled, while its stack rows are still the victim's — the
+        # host-tier spill that turns eviction-past-max_adapters into a
+        # re-insert instead of a fleet republish
+        self.on_evict = None
 
     @property
     def scale(self) -> float:
@@ -264,6 +270,10 @@ class AdapterPool:
             if not idle:
                 return None
             slot = min(idle, key=lambda s: self._last_used[s])
+            victim_name = self._names[slot]
+            if self.on_evict is not None and victim_name is not None:
+                self.on_evict(slot, victim_name)
+                self.stats["spill_evictions"] += 1
             del self._names[slot]
             self.stats["evictions"] += 1
             self.stats["lru_evictions"] += 1

@@ -72,6 +72,7 @@ from .kv_pages import (check_kv_page_geometry, kv_page_bytes, PagePool,
                        pages_for_tokens, pool_nbytes)
 from .scheduler import Admission, Request, RequestResult, Scheduler
 from .spec import new_spec_counters
+from .tiering import HostTier, cache_prefix_keys, restore_prefixes
 from .transport import encode_frame, gather_payload, scatter_payload
 
 TRANSPORTS = ("same_host", "cross_host")
@@ -484,7 +485,8 @@ class DisaggEngine:
                  programs: Optional[ModelPrograms] = None,
                  max_adapters: Optional[int] = None, adapter_rank: int = 8,
                  adapter_alpha: float = 16.0,
-                 adapter_targets=DEFAULT_TARGETS):
+                 adapter_targets=DEFAULT_TARGETS,
+                 host_tier_bytes: Optional[int] = None):
         if n_prefill_slots < 1:
             raise ValueError(f"n_prefill_slots must be >= 1, got "
                              f"{n_prefill_slots}")
@@ -606,6 +608,28 @@ class DisaggEngine:
                           and prefill_sched.cache is not None else False),
             spec_lookahead=drafter.k if drafter else 0,
             adapter_pool=self.adapter_pool)
+        # ONE host tier serves both halves (it is host RAM — there is no
+        # per-pool ownership to respect, only per-pool GATHER sources):
+        # a decode-side preemption spills from the decode pool, a prefix
+        # eviction spills from whichever pool backs the cache, and the
+        # facade's restore seats a preempted sequence back into the
+        # DECODE pool without a re-prefill. Cross-host the two gathers
+        # read different page dicts; same-host they are the same one.
+        self.host_tier: Optional[HostTier] = None
+        if host_tier_bytes is not None:
+            self.host_tier = HostTier(host_tier_bytes)
+            gather_prefill = (
+                lambda ids: gather_payload(self.pages, list(ids)))
+            gather_decode = (
+                lambda ids: gather_payload(self.decode_pages, list(ids)))
+            prefill_sched.attach_tier(self.host_tier, gather_prefill)
+            decode_sched.attach_tier(self.host_tier, gather_decode)
+            if prefill_sched.cache is not None:
+                # same-host the decode scheduler shares this cache object
+                prefill_sched.cache.attach_tier(self.host_tier,
+                                                gather_prefill)
+            self.programs.attach_host_tier(self.host_tier)
+
         self.prefill = PrefillEngine(
             self.programs, self.pages, prefill_sched, self.handoff,
             prefill_chunk=prefill_chunk, prefill_buckets=prefill_buckets)
@@ -766,6 +790,17 @@ class DisaggEngine:
         what generic front-end code means by "the" scheduler."""
         return self.prefill.sched
 
+    def _tier_alloc_prefill(self, n: int):
+        """Prefill-pool allocation for a prefix restore. Same-host the
+        pool is shared with decode growth, so keep one page of headroom
+        per active decode slot (the monolith's restore discipline);
+        cross-host the pools are separate and no headroom applies."""
+        headroom = (0 if self.transport == "cross_host"
+                    else len(self.decode.sched.active_indices()))
+        if self.pool.n_free < n + headroom:
+            return None
+        return self.pool.alloc(n)
+
     def _expire_in_transit(self) -> list[RequestResult]:
         """Deadline expiry for sequences sitting IN the handoff queue —
         neither scheduler owns them, so the facade evicts (frees pages,
@@ -790,6 +825,48 @@ class DisaggEngine:
                 finished_at=now, first_token_at=h.first_token_at))
         return results
 
+    def _restore_decode_queued(self) -> int:
+        """Seat host-spilled preempted sequences straight back into the
+        DECODE scheduler: a decode preemption spilled its live pages and
+        routed the entry to the prefill queue (the recompute path); when
+        its tier record survives, the facade takes the entry off the
+        prefill queue and adopts it decode-side with its pages scattered
+        back — no re-prefill, replay_pos intact. Strict FIFO: stops at
+        the first queue head without a record (or without decode room),
+        so a restore never jumps an earlier admission."""
+        tier, p, d = self.host_tier, self.prefill.sched, self.decode.sched
+        restored = 0
+        while p.queue:
+            rid = p.queue[0].request.request_id
+            rec = tier.get(("seq", rid))
+            if rec is None or None not in d.slots:
+                break
+            headroom = len(d.active_indices())
+            if d.pool.n_free < rec.pages + headroom:
+                break
+            page_ids = d.pool.alloc(rec.pages)
+            if page_ids is None:
+                break
+            taken = p.take_queued(rid)
+            if taken is None:
+                d.pool.free(page_ids)
+                break
+            entry, submitted_at = taken
+            self.decode_pages.update(scatter_payload(
+                self.decode_pages, page_ids, rec.payload))
+            m = rec.meta
+            d.adopt(request=entry.request, pages=page_ids,
+                    cache_len=m["cache_len"],
+                    generated=list(m["generated"]),
+                    submitted_at=submitted_at,
+                    admitted_at=m["admitted_at"],
+                    first_token_at=entry.first_token_at, resumed=True,
+                    replay_pos=m["replay_pos"])
+            tier.take(("seq", rid))
+            self.decode._dev = None
+            restored += 1
+        return restored
+
     def step(self) -> list[RequestResult]:
         """One iteration of the PAIR: prefill engine advances prompts
         (admissions + chunks, emitting handoffs), the facade expires
@@ -803,6 +880,18 @@ class DisaggEngine:
                 "before swap_generation would decode old-policy k/v "
                 "under the new weights; run the swap first")
         self.stats_seq += 1
+        if self.host_tier is not None:
+            self._restore_decode_queued()
+            p = self.prefill.sched
+            if p.queue and p.cache is not None:
+                head = p.queue[0].request
+                restore_prefixes(
+                    p.cache, self.host_tier, list(head.prompt_ids),
+                    ns=int(getattr(head, "adapter_id", 0) or 0),
+                    alloc=self._tier_alloc_prefill,
+                    scatter=lambda ids, payload: self.pages.update(
+                        scatter_payload(self.pages, ids, payload)),
+                    free=self.pool.free)
         finished = self.prefill.step()
         finished.extend(self._expire_in_transit())
         decoded, preempted = self.decode.step()
@@ -865,10 +954,14 @@ class DisaggEngine:
             "prefilling_slots": len(p.prefilling_indices()),
             "active_slots": len(d.active_indices()),
             "n_prefill_slots": self.n_prefill_slots,
+            "prefill_calls": self.programs.prefill_calls,
+            "prefix_keys": (cache_prefix_keys(p.cache)
+                            if p.cache is not None else []),
             # pool metrics read the DECODE pool (the serving-capacity
             # currency); same-host that IS the one shared pool, and the
             # cache pages live in whichever pool backs the prefill side
             **derived_pool_metrics(
+                tier=self.host_tier,
                 pool=self.decode_pool,
                 cached_pages=0 if cross else p.cache_pages_held(),
                 n_slots=self.n_slots,
@@ -906,6 +999,6 @@ class DisaggEngine:
                 pool=self.decode_pool,
                 cached_pages=self.prefill.sched.cache_pages_held(),
                 n_slots=self.n_slots, max_pages=self.max_pages,
-                pool_bytes=pool_bytes),
+                pool_bytes=pool_bytes, tier=self.host_tier),
             "transport": self.transport,
         }

@@ -284,6 +284,8 @@ def new_generation(old, *, params=None, **overrides):
                   max_queue=old.prefill.sched.max_queue,
                   speculate=old.decode.drafter,
                   transport=old.transport,
+                  host_tier_bytes=(old.host_tier.budget_bytes
+                                   if old.host_tier is not None else None),
                   programs=old.programs, **pool_kw)
         kw.update(overrides)
         new = DisaggEngine(old.bundle, old.programs.params, **kw)
@@ -296,6 +298,8 @@ def new_generation(old, *, params=None, **overrides):
                   prefix_cache=old.scheduler.cache is not None,
                   max_queue=old.scheduler.max_queue,
                   speculate=old.drafter,
+                  host_tier_bytes=(old.host_tier.budget_bytes
+                                   if old.host_tier is not None else None),
                   programs=old.programs)
         kw.update(overrides)
         new = ServeEngine(old.bundle, old.programs.params, **kw)
@@ -355,7 +359,8 @@ def _swap_generation_locked(old, new, force_replay: bool):
     t0 = time.perf_counter()
     stats = {"seated": 0, "requeued": 0, "evicted": 0, "pages_moved": 0,
              "bytes_moved": 0, "payload_dropped": 0, "cache_dropped": 0,
-             "queued_moved": 0}
+             "queued_moved": 0, "tier_records_carried": 0,
+             "tier_records_dropped": 0}
     old.drain()
     with_payload = _payload_compatible(old, new) and not force_replay
     disagg = isinstance(old, DisaggEngine)
@@ -441,6 +446,25 @@ def _swap_generation_locked(old, new, force_replay: bool):
     seat_sched.ensure_ids_above(max_id + 1)
     if queue_sched is not seat_sched:
         queue_sched.ensure_ids_above(max_id + 1)
+
+    # ---- carry or drop the host tier explicitly ----------------------------
+    # Spilled payloads are raw pool bytes: they carry to the new
+    # generation exactly when a gathered payload could seat there (same
+    # page geometry, unsharded, no weight publish in between — carried
+    # old-policy k/v under new weights would mix policies like a seated
+    # payload would). The _drain_cache spills above ride along, so a
+    # compatible swap starts with its warm prefixes parked host-side.
+    old_tier = getattr(old, "host_tier", None)
+    new_tier = getattr(new, "host_tier", None)
+    if old_tier is not None and len(old_tier):
+        if new_tier is not None and with_payload:
+            carried, dropped = new_tier.carry_from(old_tier)
+            stats["tier_records_carried"] = carried
+            stats["tier_records_dropped"] += dropped
+        else:
+            stats["tier_records_dropped"] += len(old_tier)
+            for key in old_tier.keys():
+                old_tier.drop(key)
     stats["swap_s"] = round(time.perf_counter() - t0, 4)
     return results, stats
 

@@ -72,6 +72,9 @@ from .kv_pages import (check_kv_page_geometry, commit_prefill, copy_pages,
                        PagePool, pages_for_tokens, pool_nbytes)
 from .scheduler import Admission, Request, RequestResult, Scheduler
 from .spec import Drafter, NgramDrafter, new_spec_counters
+from .tiering import (HostTier, cache_prefix_keys, make_gather,
+                      restore_prefixes, restore_queued)
+from .transport import gather_payload, scatter_payload
 from .weights import (params_nbytes, quantized_param_shardings,
                       store_weights, weight_bytes_by_dtype,
                       weight_dtype_name)
@@ -132,7 +135,8 @@ def derived_pool_metrics(*, pool: PagePool, cached_pages: int, n_slots: int,
                          admitted: int, prefix_hits: int,
                          lat: "LatencyMeter",
                          bytes_per_page: int = 0,
-                         pool_dtype: str = "fp32") -> dict:
+                         pool_dtype: str = "fp32",
+                         tier: Optional[HostTier] = None) -> dict:
     """The derived stats() tail both engines expose (api.py's
     throughput_stats and /healthz index these keys on either).
     ``pages_cached_bytes`` sits next to the hit rate so cache pressure is
@@ -141,9 +145,18 @@ def derived_pool_metrics(*, pool: PagePool, cached_pages: int, n_slots: int,
     (high hit rate, high churn) no longer looks healthy on /healthz.
     ``pool_dtype`` + ``bytes_per_page`` surface the quantization lever in
     bytes (scales included), so a kv_dtype="int8" capacity gain is a
-    number on /healthz, not a vibe."""
+    number on /healthz, not a vibe. The host-tier gauges
+    (``host_tier_bytes`` / ``spilled_pages`` / ``restore_hits`` /
+    ``restore_misses``) are always present — zeros without a tier — so
+    /healthz and the router's fleet aggregation see one schema whether
+    or not a replica spills."""
     held = pool.capacity - pool.n_free
+    tier_tail = tier.gauges() if tier is not None else {
+        "host_tier_bytes": 0, "host_tier_budget_bytes": 0,
+        "host_tier_records": 0, "spilled_pages": 0,
+        "restore_hits": 0, "restore_misses": 0}
     return {
+        **tier_tail,
         "n_slots": n_slots,
         "pool_dtype": pool_dtype,
         "bytes_per_page": bytes_per_page,
@@ -339,6 +352,7 @@ def run_bucket_prefill(programs: "ModelPrograms", pages: dict,
                          f"bucket {buckets[-1]}")
     ids = np.zeros((1, bucket), np.int32)
     ids[0, :n] = tokens
+    programs.prefill_calls += 1
     logit, kd, vd = programs.prefill_for(bucket)(
         programs.params, jnp.asarray(ids), jnp.asarray(n - 1),
         *programs.lora_call_args([adm.request.adapter_id]))
@@ -380,6 +394,7 @@ def advance_prefill_chunks(programs: "ModelPrograms", pages: dict,
         budget -= chunk
         ids = np.zeros((1, chunk), np.int32)
         ids[0, :real] = adm.tokens[start:start + real]
+        programs.prefill_calls += 1
         logit, pages["k"], pages["v"] = programs.chunk_for(chunk)(
             programs.params, pages["k"], pages["v"],
             jnp.asarray(ids), jnp.asarray([start], jnp.int32),
@@ -570,18 +585,30 @@ def drop_stale_pending(sched: Scheduler, pending: dict) -> None:
 
 def build_kv_report(programs: "ModelPrograms", *, page_size: int,
                     pool: PagePool, cached_pages: int, n_slots: int,
-                    max_pages: int, pool_bytes: int) -> dict:
+                    max_pages: int, pool_bytes: int,
+                    tier: Optional[HostTier] = None) -> dict:
     """The preflight-style byte table for one engine's pool. Priced at
     the pool's OWN kv_dtype (scale bytes included under int8), with the
     fp32 per-page cost alongside so the quantization gain is a ratio the
-    reader can check against ``pool_bytes``."""
+    reader can check against ``pool_bytes``. With a host tier attached
+    the report grows its rows: budget, occupancy, resident spilled
+    pages, and the page capacity the budget buys at this pool's
+    per-page cost — the second storage tier in the same byte table."""
     kv_dtype = programs.kv_dtype
     per_page = kv_page_bytes(programs.config, page_size=page_size,
                              kv_dtype=kv_dtype)
     per_page_fp32 = kv_page_bytes(programs.config, page_size=page_size,
                                   kv_dtype="fp32")
     shards = (int(programs.mesh.shape["tp"]) if programs.shard_kv else 1)
+    tier_rows = {} if tier is None else {
+        "host_tier_budget_bytes": tier.budget_bytes,
+        "host_tier_bytes": tier.bytes_used,
+        "host_tier_spilled_pages": tier.spilled_pages,
+        "host_tier_page_capacity": (tier.budget_bytes // per_page
+                                    if per_page else 0),
+    }
     return {
+        **tier_rows,
         "page_size": page_size,
         "pool_dtype": kv_dtype,
         "n_pages": pool.n_pages,
@@ -816,6 +843,16 @@ class ModelPrograms:
         self._swap_in_flight = False
         self._snapshot_fn = None
         self._requant_fn = None
+        # prefill FORWARD count (bucketed prefills + chunk forwards both
+        # land here) — the zero-prefill pin for tier restores and fleet
+        # directory pulls: a restored/pulled context must seat without
+        # moving this counter beyond what its warm-cache control moves it
+        self.prefill_calls = 0
+        # host tier for ADAPTER spills (serve/tiering.py): attached by
+        # the owning engine — with shared programs the LAST attached
+        # tier hosts the pool's spills (the AdapterPool is fleet-shared
+        # there anyway)
+        self._host_tier = None
 
     # ---- weight publishing (the post-training seam) ------------------------
     @contextlib.contextmanager
@@ -1027,6 +1064,54 @@ class ModelPrograms:
             self.adapter_stacks, adapter_params,
             jnp.asarray(slot, jnp.int32))
         self.adapter_publish_count += 1
+        return slot
+
+    def attach_host_tier(self, tier) -> None:
+        """Install the host tier on the ADAPTER eviction path: an
+        AdapterPool LRU eviction (a new insert past ``max_adapters``
+        recycling an idle tenant's slot) serializes the victim's A/B
+        leaves into the tier instead of discarding them, and
+        ``restore_adapter`` re-inserts on next reference — no fleet
+        republish of weights the host already held."""
+        self._host_tier = tier
+        if self.adapter_pool is not None:
+            self.adapter_pool.on_evict = self._spill_adapter
+
+    def _spill_adapter(self, slot: int, name) -> None:
+        """AdapterPool ``on_evict`` hook: gather the victim slot's rows
+        (fp32, bitwise) BEFORE the incoming insert overwrites them."""
+        if self._host_tier is None or self.adapter_stacks is None:
+            return
+        payload = {f"{t}.{leaf}": np.asarray(pair[leaf][:, slot])
+                   for t, pair in self.adapter_stacks.items()
+                   for leaf in ("a", "b")}
+        self._host_tier.put(("adapter", name), payload, pages=0,
+                            meta={"slot": int(slot)})
+
+    def restore_adapter(self, name) -> Optional[int]:
+        """Re-insert a spilled tenant from the host tier into a (possibly
+        newly LRU-recycled) slot, through the same compiled insert as a
+        publish — the stacks rows land bitwise what the spill gathered.
+        Returns the new slot id, or None when the tier holds no record
+        for ``name`` (or allocation is impossible: every slot live with
+        in-flight requests)."""
+        if self.adapter_pool is None or self._host_tier is None:
+            return None
+        # peek-and-hold BEFORE alloc: the alloc below may LRU-evict some
+        # other tenant, whose cascade spill could push THIS record out of
+        # the byte budget — the held reference keeps the payload alive
+        rec = self._host_tier.get(("adapter", name))
+        if rec is None:
+            return None
+        slot = self.adapter_pool.alloc(name)
+        if slot is None:
+            return None
+        self._host_tier.take(("adapter", name))
+        payload = {t: {leaf: jnp.asarray(rec.payload[f"{t}.{leaf}"])
+                       for leaf in ("a", "b")}
+                   for t in self.adapter_stacks}
+        self.adapter_stacks = self._insert_fn(
+            self.adapter_stacks, payload, jnp.asarray(slot, jnp.int32))
         return slot
 
     def jit_cache_sizes(self) -> dict:
@@ -1299,7 +1384,8 @@ class ServeEngine:
                  speculate=None, spec_k: int = 4, kv_dtype=None,
                  weight_dtype=None, max_adapters: Optional[int] = None,
                  adapter_rank: int = 8, adapter_alpha: float = 16.0,
-                 adapter_targets=DEFAULT_TARGETS):
+                 adapter_targets=DEFAULT_TARGETS,
+                 host_tier_bytes: Optional[int] = None):
         self.drafter = resolve_drafter(speculate, spec_k=spec_k,
                                        n_slots=n_slots)
         self.spec = new_spec_counters()
@@ -1364,6 +1450,20 @@ class ServeEngine:
             max_model_len=self.max_model_len)
 
         self.pages = self.programs.init_device_pages(n_pages, page_size)
+
+        # host-RAM KV tier (serve/tiering.py): spilled prefix pages and
+        # preempted sequences park here instead of being recomputed.
+        # Spilled pages FREE their HBM slots, so the base pool identity
+        # (free + held + cached == capacity) is unchanged — the tier
+        # audits its own byte ledger separately.
+        self.host_tier: Optional[HostTier] = None
+        if host_tier_bytes is not None:
+            self.host_tier = HostTier(host_tier_bytes)
+            gather = make_gather(self)
+            self.scheduler.attach_tier(self.host_tier, gather)
+            if self.scheduler.cache is not None:
+                self.scheduler.cache.attach_tier(self.host_tier, gather)
+            self.programs.attach_host_tier(self.host_tier)
 
         # chunked-prefill state per slot + the device-resident steady
         # decode arrays (None = rebuild from the scheduler next decode)
@@ -1583,6 +1683,24 @@ class ServeEngine:
             self._dev = None
             drop_stale_pending(sched, self._pending)
             finished.extend(expired)
+        if self.host_tier is not None:
+            # restore AHEAD of admission: a queued request whose pages
+            # sit in the host tier seats by scatter (bitwise, replay_pos
+            # intact) instead of re-prefilling, and a queue head whose
+            # prefix chain was spilled gets its pages re-seated in the
+            # cache so the ordinary shared-prefix admission path finds
+            # them. Both paths allocate from the SAME free list admission
+            # uses, so the audit identity is untouched.
+            if restore_queued(sched, self.host_tier, self.scatter_pages,
+                              self._tier_alloc):
+                self._dev = None
+            if sched.queue and sched.cache is not None:
+                head = sched.queue[0].request
+                restore_prefixes(
+                    sched.cache, self.host_tier, list(head.prompt_ids),
+                    ns=int(getattr(head, "adapter_id", 0) or 0),
+                    alloc=self._tier_alloc, scatter=self.scatter_pages,
+                    free=sched.pool.free)
         admissions = sched.try_admit()
         for adm in admissions:
             self._dev = None
@@ -1624,6 +1742,43 @@ class ServeEngine:
         self._lat.note(finished)
         return finished
 
+    # ---- host tier plumbing ------------------------------------------------
+    def gather_pages(self, page_ids) -> dict:
+        """Bitwise host copy of the given pages, every pool leaf (int8
+        payload AND scale rows) — the tier's and the wire's unit."""
+        return gather_payload(self.pages, list(page_ids))
+
+    def scatter_pages(self, page_ids, payload) -> None:
+        """Seat a gathered payload back into this engine's pool at the
+        given (freshly allocated) page ids. Functional pool update, so
+        the device decode arrays must rebuild."""
+        out = scatter_payload(self.pages, list(page_ids), payload)
+        for name in out:
+            self.pages[name] = out[name]
+        self._dev = None
+
+    def _tier_alloc(self, n: int):
+        """Allocate ``n`` pages for a restore, refusing unless the free
+        list keeps one page of growth headroom per active decode slot —
+        a restore must never force-preempt the running batch it is
+        trying to hide under."""
+        sched = self.scheduler
+        headroom = len(sched.active_indices())
+        if sched.pool.n_free < n + headroom:
+            return None
+        return sched.pool.alloc(n)
+
+    def restore_adapter(self, name: str):
+        """Re-seat a host-spilled adapter's A/B rows into the device
+        stacks (satellite: spill past max_adapters without a fleet
+        republish). Legal while serving: AdapterPool.alloc only recycles
+        refcount-0 slots, and the recycled slot's prefix namespace is
+        dropped exactly as publish_adapter would."""
+        slot_id = self.programs.restore_adapter(name)
+        if slot_id is not None and self.scheduler.cache is not None:
+            self.scheduler.cache.drop_namespace(slot_id)
+        return slot_id
+
     # ---- metrics (host-side only — safe from any thread) -------------------
     def partial_tokens(self) -> dict:
         """request_id -> tokens generated so far, for every LIVE sequence
@@ -1653,7 +1808,13 @@ class ServeEngine:
             "queue_depth_by_priority": sched.queue_depth_by_priority(),
             "active_slots": len(sched.active_indices()),
             "prefilling_slots": len(sched.prefilling_indices()),
+            "prefill_calls": self.programs.prefill_calls,
+            # committed prefix keys for the router's fleet directory —
+            # read lock-free from the same snapshot, fenced by stats_seq
+            "prefix_keys": (cache_prefix_keys(sched.cache)
+                            if sched.cache is not None else []),
             **derived_pool_metrics(
+                tier=self.host_tier,
                 pool=sched.pool, cached_pages=sched.cache_pages_held(),
                 n_slots=self.n_slots, decode_steps=self.decode_steps,
                 decode_tokens=self.decode_tokens,
@@ -1678,7 +1839,7 @@ class ServeEngine:
             pool=self.scheduler.pool,
             cached_pages=self.scheduler.cache_pages_held(),
             n_slots=self.n_slots, max_pages=self.max_pages,
-            pool_bytes=self.kv_cache_bytes())
+            pool_bytes=self.kv_cache_bytes(), tier=self.host_tier)
 
     def weight_report(self) -> dict:
         """The preflight-style byte table for this engine's weights."""

@@ -316,6 +316,38 @@ class PagePool:
                 self._free_set.add(p)
 
 
+def pool_audit(pool: "PagePool", holder_maps, *, tier=None) -> None:
+    """The per-iteration capacity identity, extended for the host tier.
+
+    ``holder_maps``: iterables of ``{page: n_refs}`` — one map per
+    holder class (slot tables, prefix cache, in-flight handoffs).
+    Asserts each page's refcount equals its holder count, no held page
+    sits on the free list, and
+
+        free + distinct held pages == capacity
+
+    Spilled pages FREE their HBM slots at spill time, so tiering leaves
+    this identity unchanged; the tier's own ledger (``bytes_used ==
+    sum(record bytes) <= budget``, ``spilled_pages == sum(record
+    pages)``) audits separately via ``tier.audit()`` when one is
+    attached. Raises ``AssertionError`` naming the first imbalance."""
+    held: dict = {}
+    for m in holder_maps:
+        for p, n in m.items():
+            held[p] = held.get(p, 0) + n
+    for p, n in held.items():
+        assert pool.refcount(p) == n, \
+            f"page {p}: {n} holders but refcount {pool.refcount(p)} " \
+            f"({pool.describe(p)})"
+        assert p not in pool._free_set, \
+            f"held page {p} on the free list ({pool.describe(p)})"
+    assert pool.n_free + len(held) == pool.capacity, (
+        f"capacity audit failed: free={pool.n_free} + "
+        f"held={len(held)} != capacity={pool.capacity}")
+    if tier is not None:
+        tier.audit()
+
+
 def paged_attend(q, k_new, v_new, k_pages, v_pages, tables, lengths, *,
                  window=None, scale=None, softcap=None, impl: str = "auto",
                  n_valid=None):

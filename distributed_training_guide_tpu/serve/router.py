@@ -60,6 +60,7 @@ import numpy as np
 from ..utils import faults
 from ..utils.heartbeat import HeartbeatMonitor, HeartbeatWriter
 from .scheduler import RefusalError, Request, RequestResult
+from .tiering import prefix_digest, pull_prefix
 
 
 def prefix_affinity_key(prompt_ids, page_size: int,
@@ -80,12 +81,9 @@ def prefix_affinity_key(prompt_ids, page_size: int,
     n_full = (len(prompt_ids) - 1) // page_size
     if n_full < 1:
         return None
-    arr = np.asarray(prompt_ids[:n_full * page_size], np.int64)
-    h = hashlib.blake2b(digest_size=8)
-    if adapter_id:
-        h.update(np.int64(adapter_id).tobytes())
-    h.update(arr.tobytes())
-    return h.digest()
+    # delegates to the tiering module's digest so the fleet directory's
+    # cache-exported keys and the router's request keys agree bitwise
+    return prefix_digest(prompt_ids[:n_full * page_size], adapter_id)
 
 
 def rendezvous_order(key: bytes, names) -> list:
@@ -316,7 +314,19 @@ class Router:
                          "resubmit_exhausted": 0, "replicas_added": 0,
                          "replicas_removed": 0, "generation_swaps": 0,
                          "param_publishes": 0, "adapter_publish_calls": 0,
+                         "directory_pulls": 0, "directory_pull_hits": 0,
+                         "directory_pull_failures": 0,
                          "refused": {}}
+        # fleet prefix directory: replica name -> (stats_seq, frozenset
+        # of committed prefix-key hex digests). Fed only from the
+        # replicas' lock-free stats() snapshots (refreshed in step()
+        # when a snapshot's stats_seq advances — the same staleness
+        # fence /healthz pollers use), dropped on fence/removal. An
+        # entry can lag the cache by one step; both failure modes are
+        # benign — a stale hit becomes a failed pull (= plain miss), a
+        # stale miss just re-prefills as before.
+        self._directory: dict[str, tuple[int, frozenset]] = {}
+        self._xfer_ids = itertools.count(1)
         # the control plane's degradation-ladder knobs (serve/controller
         # sets them; anything may): ``min_priority`` sheds submits below
         # that class with a 429 before routing even starts, and
@@ -387,6 +397,7 @@ class Router:
                 raise               # a request no replica could ever run
             record.replica, record.engine_rid = replica.name, erid
             self._by_engine[(replica.name, erid)] = record.rid
+            self._maybe_pull_prefix(replica, record.request)
             self.counters["routed"] += 1
             if used_affinity and i == 0:
                 self.counters["affinity_routed"] += 1
@@ -404,6 +415,70 @@ class Router:
                 detail={**last_429.detail,
                         "retry_after_s": self.retry_after_floor_s})
         raise last_429
+
+    def _maybe_pull_prefix(self, replica: Replica,
+                           request: Request) -> None:
+        """Directory-guided prefix pull: the request just landed on
+        ``replica``; if its page-aligned prefix key is absent from that
+        replica's directory entry but present on a live sibling, move
+        the cached pages over the wire BEFORE the replica's next step
+        prefills — a directory hit on a cold replica then seats the
+        prefix with zero prefill forward passes. Every failure mode
+        (wire fault, allocation loss, stale directory) ends as an
+        ordinary cache miss: the request re-prefills exactly as it
+        would have without a directory."""
+        key = prefix_affinity_key(request.prompt_ids, self.page_size,
+                                  adapter_id=request.adapter_id)
+        if key is None or not hasattr(replica.engine, "scatter_pages"):
+            return
+        hexkey = key.hex()
+        _, local_keys = self._directory.get(replica.name, (0, frozenset()))
+        if hexkey in local_keys:
+            return
+        for name, (_, keys) in self._directory.items():
+            if name == replica.name or hexkey not in keys:
+                continue
+            src = self.replicas.get(name)
+            if src is None or src.state != "live" \
+                    or not hasattr(src.engine, "gather_pages"):
+                continue
+            self.counters["directory_pulls"] += 1
+            try:
+                out = pull_prefix(src.engine, replica.engine,
+                                  list(request.prompt_ids),
+                                  adapter_id=request.adapter_id,
+                                  xfer_id=next(self._xfer_ids))
+            except Exception:
+                out = {"ok": False}
+            if out.get("ok") and out.get("pages", 0) > 0:
+                self.counters["directory_pull_hits"] += 1
+            elif not out.get("ok"):
+                self.counters["directory_pull_failures"] += 1
+            return
+
+    def _refresh_directory(self) -> None:
+        """Fold each live replica's advertised prefix keys into the
+        directory, fenced by ``stats_seq``: a snapshot that has not
+        advanced since the last fold is skipped (nothing new), and a
+        raced walk (empty keys at an advanced seq) keeps the previous
+        entry rather than blanking a replica that still holds pages."""
+        for name, replica in self.replicas.items():
+            if replica.state != "live":
+                continue
+            try:
+                s = replica.engine.stats()
+            except Exception:
+                continue
+            seq = s.get("stats_seq", 0)
+            prev_seq, prev_keys = self._directory.get(name,
+                                                      (-1, frozenset()))
+            if seq <= prev_seq:
+                continue
+            keys = s.get("prefix_keys", None)
+            if keys:
+                self._directory[name] = (seq, frozenset(keys))
+            elif keys is not None and not prev_keys:
+                self._directory[name] = (seq, frozenset())
 
     def submit(self, request: Request) -> int:
         now = self.clock()
@@ -452,6 +527,9 @@ class Router:
         in-flight requests to the resubmission backlog."""
         replica.state = "fenced"
         self.counters["fenced"] += 1
+        # a fenced replica's cached pages are unreachable — advertising
+        # them would turn every directory hit into a failed pull
+        self._directory.pop(replica.name, None)
         self._resubmit_in_flight(replica)
 
     def _exhaust(self, record: _RouteRecord,
@@ -572,6 +650,7 @@ class Router:
                 # fence it (resubmitting its work) and keep serving
                 self._fence(replica)
         self._tap_tokens()
+        self._refresh_directory()
         finished.extend(self._drain_backlog(self.clock()))
         self._last_step_at = self.clock()
         return finished
@@ -623,6 +702,7 @@ class Router:
                 f"its in-flight work would have nowhere to resubmit")
         replica = self.replicas[name]
         replica.drain()
+        self._directory.pop(name, None)
         self._resubmit_in_flight(replica)
         replica.state = "removed"
         del self.replicas[name]
@@ -823,7 +903,9 @@ class Router:
         "queued", "active_slots", "prefilling_slots", "pages_capacity",
         "pages_free", "pages_held", "pages_cached", "decode_steps",
         "decode_tokens", "spec_steps", "spec_tokens_drafted",
-        "spec_tokens_accepted", "spec_tokens_rejected")
+        "spec_tokens_accepted", "spec_tokens_rejected",
+        "host_tier_bytes", "host_tier_budget_bytes", "spilled_pages",
+        "restore_hits", "restore_misses", "prefill_calls")
 
     def stats(self) -> dict:
         """Fleet aggregate + per-replica health, all host-side (each
@@ -910,6 +992,9 @@ class Router:
                             for r in self.replicas.values()),
             "in_flight": len(self._records),
             "backlog": len(self._backlog),
+            "directory_replicas": len(self._directory),
+            "directory_keys": sum(len(keys)
+                                  for _, keys in self._directory.values()),
             "pool_occupancy": (
                 round(agg["pages_held"] / agg["pages_capacity"], 3)
                 if agg["pages_capacity"] else 0.0),

@@ -240,6 +240,18 @@ class PrefixCache:
         self._roots: dict[int, _PrefixNode] = {0: self.root}
         self._tick = itertools.count(1)
         self.n_pages = 0
+        # host-tier spill hooks (serve/tiering.py, duck-typed so this
+        # module stays import-free of it): with a tier attached,
+        # eviction GATHERS the page's bytes before freeing it instead
+        # of discarding them
+        self._tier = None
+        self._gather = None
+
+    def attach_tier(self, tier, gather) -> None:
+        """Install a host tier: ``gather(page_ids) -> payload`` reads
+        the engine's live pool (the engine owns the device handle)."""
+        self._tier = tier
+        self._gather = gather
 
     def _root_for(self, ns: int) -> _PrefixNode:
         root = self._roots.get(ns)
@@ -303,6 +315,78 @@ class PrefixCache:
                 partial[0].last_used = tick
         return full, partial
 
+    def chain_depth(self, tokens: list, ns: int = 0) -> int:
+        """Full-page chain length resident in HBM for ``tokens`` —
+        ``match`` without the side effects (no LRU touch, no partial
+        scan); the restore/pull paths use it to find where the HBM
+        chain ends and the tier/sibling chain must take over."""
+        page = self.page_size
+        node = self._roots.get(ns)
+        if node is None:
+            return 0
+        depth = pos = 0
+        while pos + page <= len(tokens) - 1:
+            child = node.children.get(tuple(tokens[pos:pos + page]))
+            if child is None:
+                break
+            depth += 1
+            node, pos = child, pos + page
+        return depth
+
+    def chain_pages(self, tokens: list, ns: int = 0) -> list:
+        """Physical page ids of the resident chain for ``tokens``, in
+        depth order — what a directory pull gathers at the SOURCE. Pure
+        read: no references move, no LRU touch."""
+        page = self.page_size
+        node = self._roots.get(ns)
+        if node is None:
+            return []
+        out, pos = [], 0
+        while pos + page <= len(tokens) - 1:
+            child = node.children.get(tuple(tokens[pos:pos + page]))
+            if child is None:
+                break
+            out.append(child.page)
+            node, pos = child, pos + page
+        return out
+
+    def insert_page(self, tokens: list, page_id: int, ns: int = 0) -> bool:
+        """Seat one already-allocated page as the chain node covering
+        ``tokens`` (whose length must be a page multiple; the node owns
+        the LAST page worth). The cache takes over the CALLER'S pool
+        reference — no share — so the caller must free the page iff
+        this returns False (missing ancestor, or the node already
+        resident)."""
+        page = self.page_size
+        if not tokens or len(tokens) % page:
+            return False
+        node, pos = self._root_for(ns), 0
+        while pos + page < len(tokens):
+            child = node.children.get(tuple(tokens[pos:pos + page]))
+            if child is None:
+                return False
+            node, pos = child, pos + page
+        key = tuple(tokens[pos:pos + page])
+        if key in node.children:
+            return False
+        child = _PrefixNode(page_id, key, node)
+        child.last_used = next(self._tick)
+        node.children[key] = child
+        self.n_pages += 1
+        return True
+
+    def _chain_key(self, node: _PrefixNode) -> tuple:
+        """(namespace, cumulative token tuple) for a node — the spill
+        key ``restore_prefixes`` reconstructs from a prompt."""
+        segs = []
+        n = node
+        while n.parent is not None:
+            segs.append(n.tokens)
+            n = n.parent
+        full = tuple(int(t) for seg in reversed(segs) for t in seg)
+        ns = next((k for k, r in self._roots.items() if r is n), 0)
+        return ns, full
+
     def register(self, tokens: list, pages: list, ns: int = 0) -> None:
         """Insert every FULL page of ``tokens`` (page i holds
         tokens[i*page:(i+1)*page], physical id pages[i]); the cache takes
@@ -337,6 +421,16 @@ class PrefixCache:
                     best, best_key, best_parent = child, key, node
         if best is None:
             return False
+        if self._tier is not None and self._gather is not None:
+            # spill instead of discard: gather the page's bytes (every
+            # pool leaf, scales included) into the host tier keyed by
+            # the chain's cumulative content — the HBM slot still frees
+            # below, so the pool identity is untouched and a later
+            # restore re-allocates and scatters bitwise
+            ns, full = self._chain_key(best)
+            self._tier.put(("prefix", ns, full),
+                           self._gather([best.page]), pages=1,
+                           meta={"ns": ns})
         del best_parent.children[best_key]
         self.pool.free([best.page])
         self.n_pages -= 1
@@ -405,6 +499,14 @@ class Scheduler:
         # nothing. The disagg pair shares one pool, so a handoff's
         # release-then-retain is net-neutral on the tenant's count.
         self.adapter_pool = adapter_pool
+        # host-tier spill hooks (serve/tiering.py, duck-typed): with a
+        # tier attached, PREEMPTION spills the victim's live pages
+        # instead of discarding them, so re-admission is scatter-and-
+        # seat (engine-side restore_queued) rather than re-prefill +
+        # replay. Spilled or not, the requeue below still happens — the
+        # recompute path stays the universal fallback.
+        self._tier = None
+        self._tier_gather = None
         self.stats = {"admission_blocked": 0, "admitted": 0, "finished": 0,
                       "preempted": 0, "prefix_hits": 0,
                       "prefix_tokens_shared": 0, "cow_forks": 0,
@@ -421,6 +523,13 @@ class Scheduler:
                       # adapter_id) — the per-tenant demand signal the
                       # router aggregates fleet-wide
                       "adapter_requests": {}}
+
+    def attach_tier(self, tier, gather) -> None:
+        """Install the host tier on THIS scheduler's preemption path
+        (the prefix cache has its own ``attach_tier`` — disaggregated
+        pairs gather from different pools on each side)."""
+        self._tier = tier
+        self._tier_gather = gather
 
     # ---- adapter refcounts -------------------------------------------------
     def _adapter_retain(self, request: Request) -> None:
@@ -719,6 +828,14 @@ class Scheduler:
                 self.stats["prefix_hits"] += 1
                 self.stats["prefix_tokens_shared"] += shared_len
             self.queue.pop(0)
+            if self._tier is not None and entry.generated:
+                # recompute admission won over a pending restore (its
+                # allocation kept failing, or the share-aware grant here
+                # was simply cheaper): the spilled record is stale now —
+                # drop it and count the miss. The replay that follows is
+                # still bitwise; only the recompute savings are lost.
+                if self._tier.drop(("seq", req.request_id)):
+                    self._tier.note_miss()
             self.slots[slot_idx] = _Slot(
                 request=req, pages=shared_pages + priv,
                 generated=list(entry.generated), cache_len=shared_len,
@@ -764,6 +881,21 @@ class Scheduler:
         running sequence is ever corrupted."""
         slot = self.slots[slot_idx]
         assert slot is not None, f"preempting idle slot {slot_idx}"
+        if (self._tier is not None and self._tier_gather is not None
+                and not slot.prefilling and slot.generated):
+            # spill the LIVE context before the references drop: exactly
+            # the pages cache_len occupies (cache_len == prompt +
+            # replay_pos for a decoding slot — a victim preempted
+            # mid-replay spills its partial rebuild, and replay_pos in
+            # the record makes the restore seat exact)
+            n_pages = pages_for_tokens(slot.cache_len, self.pool.page_size)
+            self._tier.put(
+                ("seq", slot.request.request_id),
+                self._tier_gather(slot.pages[:n_pages]), pages=n_pages,
+                meta={"cache_len": slot.cache_len,
+                      "generated": list(slot.generated),
+                      "replay_pos": slot.replay_pos,
+                      "admitted_at": slot.admitted_at})
         self.pool.free(slot.pages)
         self.slots[slot_idx] = None
         self._queue_insert(_QueueEntry(slot.request, list(slot.generated),
@@ -905,6 +1037,9 @@ class Scheduler:
         for entry in [e for e in self.queue if expired(e.request)]:
             self.queue.remove(entry)
             self._adapter_release(entry.request)
+            if self._tier is not None:
+                # an expired entry's spilled pages will never restore
+                self._tier.drop(("seq", entry.request.request_id))
             results.append(self._deadline_result(
                 entry.request, entry.generated, now, entry.first_token_at,
                 now, where="queued"))
@@ -932,10 +1067,24 @@ class Scheduler:
         self._adapter_release(slot.request)
         return slot, self._submit_times.pop(slot.request.request_id)
 
+    def take_queued(self, request_id: int) \
+            -> Optional[tuple[_QueueEntry, float]]:
+        """Remove and return (entry, submitted_at) for a queued request
+        — the restore path's counterpart to ``release_slot``: the entry
+        leaves the queue WITHOUT a result because it is about to be
+        seated directly via ``adopt`` (which re-retains the adapter and
+        re-records the submit time). None when not queued."""
+        for i, entry in enumerate(self.queue):
+            if entry.request.request_id == request_id:
+                self.queue.pop(i)
+                self._adapter_release(entry.request)
+                return entry, self._submit_times.pop(request_id)
+        return None
+
     def adopt(self, *, request: Request, pages: list, cache_len: int,
               generated: list, submitted_at: float, admitted_at: float,
-              first_token_at: float = 0.0, resumed: bool = False) \
-            -> Optional[int]:
+              first_token_at: float = 0.0, resumed: bool = False,
+              replay_pos: Optional[int] = None) -> Optional[int]:
         """Seat a handed-off sequence (pages already committed elsewhere —
         the prefill engine, or the previous engine generation) into a free
         slot, taking over its page references. Returns the slot index, or
@@ -946,7 +1095,10 @@ class Scheduler:
         generated token's — so the next decode consumes its NEWEST token
         (replay_pos at the end: a mid-stream generation-swap seat that
         replayed from 0 would scatter old tokens' k/v at fresh
-        positions)."""
+        positions). An explicit ``replay_pos`` overrides both defaults —
+        a tier restore (serve/tiering.py) seats the sequence at the
+        EXACT position its preemption recorded (the victim may itself
+        have been mid-replay, so neither 0 nor the end is right)."""
         slot_idx = next((i for i, s in enumerate(self.slots) if s is None),
                         None)
         if slot_idx is None:
@@ -957,7 +1109,8 @@ class Scheduler:
             cache_len=cache_len, admitted_at=admitted_at,
             seq=next(self._seq), target_len=cache_len, prefilling=False,
             shared_len=0, resumed=resumed,
-            replay_pos=(0 if resumed else max(0, len(generated) - 1)),
+            replay_pos=(replay_pos if replay_pos is not None
+                        else (0 if resumed else max(0, len(generated) - 1))),
             first_token_at=first_token_at)
         self._adapter_retain(request)
         self.stats["admitted"] += 1

@@ -109,6 +109,12 @@ def _leaf(pages: dict, name: str):
     return getattr(leaf, part) if part else leaf
 
 
+def payload_nbytes(payload: dict) -> int:
+    """Total host bytes of a gathered payload — the unit the host tier
+    budgets in (serve/tiering.py) and the wire-cost row preflight prices."""
+    return sum(int(np.asarray(v).nbytes) for v in payload.values())
+
+
 def gather_payload(pages: dict, page_ids: list[int]) -> dict[str, np.ndarray]:
     """Device-to-host: one sequence's pages out of every pool leaf —
     ``{leaf_name: [L, n, page, kvh, hd(|1)]}`` host arrays in logical
@@ -304,6 +310,13 @@ class ReceiverThread(threading.Thread):
         self.inbox: queue_mod.SimpleQueue = queue_mod.SimpleQueue()
 
     def run(self) -> None:
+        try:
+            self._run()
+        except OSError:
+            return      # socket closed under us mid-exchange (a per-pull
+            #             channel torn down while the injected stall slept)
+
+    def _run(self) -> None:
         while True:
             pre = _read_exact(self.sock, _PRE.size)
             if pre is None:

@@ -499,6 +499,26 @@ def run_preflight(trainer, *, global_batch: int, seq_length: int,
         "handoff_wire_bytes_by_kv_dtype": slot_by_dtype,
         "handoff_wire_int8_vs_fp32": int8_ratio,
     })
+    # tiered KV (serve/tiering.py): the host tier holds spilled pool
+    # payloads at the pool's storage dtype, so one preempted sequence (or
+    # one prefix chain of the same length) parks bytes_per_slot of host
+    # RAM per spilled slot — the row that sizes ``host_tier_bytes``
+    # (budget // bytes_per_spilled_slot = resumable sequences). A fleet
+    # directory pull moves those same bytes ONCE over the wire instead of
+    # re-prefilling: re-prefill at the training context costs
+    # ~2 * active_params * seq_length FLOPs, so the ratio row is the
+    # FLOPs a hit saves per wire byte it spends.
+    active_params = trainer.bundle.num_active_params()
+    reprefill_flops = 2 * active_params * seq_length
+    report["serve_kv"].update({
+        "host_tier_bytes_per_spilled_slot_at_seq": per_slot,
+        "host_tier_bytes_per_spilled_slot_by_kv_dtype": slot_by_dtype,
+        "host_tier_slots_per_gib": max(1, (1 << 30) // per_slot),
+        "directory_pull_wire_bytes_at_seq": per_slot,
+        "reprefill_flops_at_seq": reprefill_flops,
+        "reprefill_flops_per_pull_byte": round(
+            reprefill_flops / per_slot, 2),
+    })
     # speculative decoding (serve/spec.py): decode's OTHER traffic is the
     # weight read — every spec-off token pays the full per-chip param
     # bytes. A verify step amortizes one weight pass over the accepted

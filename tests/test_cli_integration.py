@@ -439,6 +439,23 @@ def test_preflight_budget_and_lowering(eight_devices):
                           * (dcfg.head_size + 4))
     assert sk["bytes_per_slot_by_kv_dtype"]["int8"] == 4 * by["int8"]
     assert sk["int8_bytes_vs_fp32"] <= 0.55
+    # tiered-KV rows (serve/tiering.py): one spilled slot parks exactly
+    # the per-slot pool bytes host-side (by dtype — the int8 row ships
+    # its scales), a directory pull moves those same bytes once over the
+    # wire, and the FLOPs-per-pull-byte ratio prices the pull against
+    # re-prefilling at the training context
+    assert sk["host_tier_bytes_per_spilled_slot_at_seq"] == \
+        sk["bytes_per_slot_at_seq"]
+    assert sk["host_tier_bytes_per_spilled_slot_by_kv_dtype"] == \
+        sk["bytes_per_slot_by_kv_dtype"]
+    assert sk["host_tier_slots_per_gib"] == \
+        (1 << 30) // sk["bytes_per_slot_at_seq"]
+    assert sk["directory_pull_wire_bytes_at_seq"] == \
+        sk["bytes_per_slot_at_seq"]
+    assert sk["reprefill_flops_at_seq"] == \
+        2 * bundle.num_active_params() * 64
+    assert sk["reprefill_flops_per_pull_byte"] == round(
+        sk["reprefill_flops_at_seq"] / sk["bytes_per_slot_at_seq"], 2)
 
     # weight_dtype rows (serve/weights.py): STORAGE bytes per dtype —
     # the int8 row includes the per-block fp32 scales, same rule as the
