@@ -119,8 +119,17 @@ def throughput_stats(results: list[RequestResult],
         "spec_steps": es["spec_steps"],
         "spec_tokens_drafted": es["spec_tokens_drafted"],
         "spec_tokens_accepted": es["spec_tokens_accepted"],
-        "spec_acceptance_rate": es["spec_acceptance_rate"],
+        # absent (not 0.0) when nothing was drafted: a zero here reads
+        # as "0% acceptance" on a dashboard that never speculated
+        **({"spec_acceptance_rate": es["spec_acceptance_rate"]}
+           if "spec_acceptance_rate" in es else {}),
         "decode_tokens_per_step": es["decode_tokens_per_step"],
+        # fused-horizon amortization (engine.derived_pool_metrics):
+        # host round-trips per emitted token is THE serve-plane CPU wall
+        "decode_horizon": es.get("decode_horizon", 1),
+        "host_dispatches": es.get("host_dispatches", 0),
+        "tokens_per_dispatch": es.get("tokens_per_dispatch", 0.0),
+        "horizon_effective": es.get("horizon_effective", 0.0),
     }
 
 

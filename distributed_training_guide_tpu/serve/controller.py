@@ -95,6 +95,18 @@ class SLO:
     # spec posture
     spec_off_occupancy: float = 0.75
     spec_on_occupancy: float = 0.25
+    # decode-horizon posture: the controller widens every live replica's
+    # fused decode horizon to ``horizon_max`` under batch pressure (high
+    # occupancy amortizes host dispatches across K tokens) and snaps it
+    # back to 1 under streaming/deadline pressure (a K-horizon turns p99
+    # ITL into K·step — the DistServe goodput argument). horizon_max=1
+    # disables the knob. Distinct thresholds: the same hysteresis
+    # argument as spec posture. Replicas running a drafter are skipped
+    # (spec requires K=1); legal mid-stream because the horizon changes
+    # host observation granularity, never token values.
+    horizon_max: int = 1
+    horizon_grow_occupancy: float = 0.75
+    horizon_shrink_occupancy: float = 0.25
     # informational targets (reported, not actuated on directly)
     ttft_p99_s: Optional[float] = None
     itl_p99_s: Optional[float] = None
@@ -145,6 +157,7 @@ class Controller:
                          "shed_on": 0, "shed_off": 0,
                          "backpressure_on": 0, "backpressure_off": 0,
                          "spec_off": 0, "spec_on": 0,
+                         "horizon_grow": 0, "horizon_shrink": 0,
                          "rebalance_hints": 0}
         self._victim: Optional[str] = None
         self._overload_n = 0
@@ -156,6 +169,7 @@ class Controller:
         self._prev_misses = 0
         self._prev_finished = 0
         self._spec_on = True
+        self._horizon_wide = False
         self._last_hint: Optional[str] = None
 
     # ---- bookkeeping -------------------------------------------------------
@@ -236,6 +250,8 @@ class Controller:
 
         self._spec_posture(pool_occ, max(slot_occ, s.get(
             "decode_occupancy", 0.0)))
+        self._horizon_posture(max(pool_occ, slot_occ, s.get(
+            "decode_occupancy", 0.0)), d_miss)
         self._rebalance_hints(s)
 
         if self._overload_n >= self.hold_up:
@@ -389,6 +405,45 @@ class Controller:
                 continue
             before = getattr(rep.engine, "drafter", None) is not None
             after = fn(on)
+            changed = changed or (before != after)
+        return changed
+
+    def _horizon_posture(self, occ: float, d_miss: int) -> None:
+        """Actuate the fused decode horizon (see the SLO fields): wide
+        under sustained batch pressure, K=1 the moment deadline misses
+        appear or the batch thins. A miss snaps the horizon shut with no
+        hysteresis — a missed deadline is evidence the K·step ITL burst
+        already cost goodput."""
+        if self.slo.horizon_max <= 1:
+            return
+        if self._horizon_wide and (
+                d_miss > 0 or occ <= self.slo.horizon_shrink_occupancy):
+            changed = self._set_horizon(1)
+            self._horizon_wide = False
+            if changed:
+                self._note("horizon_shrink", None, occupancy=round(occ, 3),
+                           deadline_misses=d_miss)
+        elif not self._horizon_wide and d_miss == 0 \
+                and occ >= self.slo.horizon_grow_occupancy:
+            changed = self._set_horizon(self.slo.horizon_max)
+            self._horizon_wide = True
+            if changed:
+                self._note("horizon_grow", None, occupancy=round(occ, 3),
+                           horizon=self.slo.horizon_max)
+
+    def _set_horizon(self, k: int) -> bool:
+        changed = False
+        for rep in self.router.replicas.values():
+            if rep.state != "live":
+                continue
+            fn = getattr(rep.engine, "set_decode_horizon", None)
+            if fn is None:
+                continue
+            before = getattr(rep.engine, "decode_horizon", 1)
+            try:
+                after = fn(k)
+            except ValueError:
+                continue        # drafter attached: spec keeps this one K=1
             changed = changed or (before != after)
         return changed
 

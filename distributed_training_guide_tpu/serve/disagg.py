@@ -64,7 +64,8 @@ from .engine import (LatencyMeter, ModelPrograms, adapter_metrics,
                      advance_prefill_chunks, build_adapter_report,
                      build_kv_report, collect_partial_tokens,
                      default_prefill_buckets, derived_pool_metrics,
-                     drop_stale_pending, resolve_context_bounds,
+                     dispatch_horizon, drop_stale_pending, horizon_dev,
+                     process_horizon_block, resolve_context_bounds,
                      resolve_drafter, run_bucket_prefill,
                      run_decode_iteration, run_fork, spec_metrics,
                      validate_prefill_buckets)
@@ -385,7 +386,8 @@ class DecodeEngine:
     the caller for re-prefill — this engine cannot recompute a prompt."""
 
     def __init__(self, programs: ModelPrograms, pages: dict,
-                 sched: Scheduler, handoff: PageHandoff, drafter=None):
+                 sched: Scheduler, handoff: PageHandoff, drafter=None,
+                 decode_horizon: int = 1):
         self.programs = programs
         self.pages = pages
         self.sched = sched
@@ -396,8 +398,14 @@ class DecodeEngine:
         self.drafter = drafter
         self.spec = new_spec_counters()
         self._dev: Optional[dict] = None
+        # fused-horizon state: the knob and the dispatched-but-unbooked
+        # block (the double buffer — see ServeEngine.step)
+        self.decode_horizon = decode_horizon
+        self._inflight: Optional[dict] = None
         self.decode_steps = 0
         self.decode_tokens = 0
+        self.host_dispatches = 0
+        self.horizon_ksum = 0
 
     def _seat_handoffs(self) -> None:
         while self.handoff.pending and None in self.sched.slots:
@@ -413,12 +421,55 @@ class DecodeEngine:
                 resumed=h.resumed)
             self._dev = None
 
+    def _horizon_ready(self) -> bool:
+        """Mirror of ``ServeEngine._horizon_ready`` for the decode half:
+        horizon up, no drafter, nothing mid-replay."""
+        return (self.decode_horizon > 1 and self.drafter is None
+                and not any(self.sched.slots[i].replaying
+                            for i in self.sched.active_indices()))
+
+    def _note_dispatch(self, k: int) -> None:
+        self.host_dispatches += 1
+        self.horizon_ksum += k
+        self.decode_steps += k
+
     def step(self) -> tuple[list[RequestResult], list]:
-        """One decode iteration. Returns (finished, preempted_entries) —
-        preempted entries (request + generated suffix) must be requeued
-        on the prefill side by the caller."""
+        """One decode iteration — a fused, double-buffered K-step horizon
+        when ``decode_horizon > 1`` (the ServeEngine.step discipline:
+        steady state dispatches h before booking h−1; any boundary event
+        — a pending handoff to seat, a preemption requeue, a deadline
+        due — drains the pipeline first). Returns (finished,
+        preempted_entries) — preempted entries (request + generated
+        suffix) must be requeued on the prefill side by the caller."""
         finished = []
         sched = self.sched
+        if self._inflight is not None:
+            if (self._horizon_ready() and self._dev is not None
+                    and not self.handoff.pending and not sched.queue
+                    and not sched.deadline_due()
+                    and sched.active_indices()):
+                pending_k = self._inflight["k"]
+                cov = sched.reserve_horizon(
+                    pending_k + self.decode_horizon)
+                # budget clamp (see ServeEngine.step): a pending block
+                # that provably finishes every slot drains instead of
+                # burning an all-dead trailing horizon
+                k_new = min(cov - pending_k, self.decode_horizon,
+                            sched.max_remaining_budget() - pending_k)
+                if k_new >= 1:
+                    nxt = dispatch_horizon(self.programs, self.pages,
+                                           sched, self._dev, k_new)
+                    self._note_dispatch(k_new)
+                    fin, emitted = process_horizon_block(sched,
+                                                         self._inflight)
+                    self._inflight = nxt
+                    self.decode_tokens += emitted
+                    return fin, []
+            fin, emitted = process_horizon_block(sched, self._inflight)
+            self._inflight = None
+            self._dev = None
+            self.decode_tokens += emitted
+            finished.extend(fin)
         expired = sched.expire_deadlines()
         if expired:
             self._dev = None
@@ -433,17 +484,28 @@ class DecodeEngine:
         entries = sched.drain_queue()
 
         if sched.active_indices():
-            # the spec/plain dispatch is the monolith's, verbatim
-            # (engine.run_decode_iteration — replay pauses speculation,
-            # empty-draft iterations fall back to the plain program)
-            fin, emitted, self._dev = run_decode_iteration(
-                self.programs, self.pages, sched, self.drafter, self.spec,
-                self._dev)
-            self.decode_steps += 1
-            self.decode_tokens += emitted
-            finished.extend(fin)
-            if fin:
-                self._dev = None       # a slot left the batch
+            if self._horizon_ready():
+                k0 = max(1, min(sched.reserve_horizon(self.decode_horizon),
+                                self.decode_horizon,
+                                sched.max_remaining_budget()))
+                if self._dev is None or self._dev.get("kind") != "horizon":
+                    self._dev = horizon_dev(sched)
+                self._inflight = dispatch_horizon(
+                    self.programs, self.pages, sched, self._dev, k0)
+                self._note_dispatch(k0)
+            else:
+                # the spec/plain dispatch is the monolith's, verbatim
+                # (engine.run_decode_iteration — replay pauses
+                # speculation, empty-draft iterations fall back to the
+                # plain program)
+                fin, emitted, self._dev = run_decode_iteration(
+                    self.programs, self.pages, sched, self.drafter,
+                    self.spec, self._dev)
+                self._note_dispatch(1)
+                self.decode_tokens += emitted
+                finished.extend(fin)
+                if fin:
+                    self._dev = None       # a slot left the batch
         return finished, entries
 
 
@@ -486,7 +548,17 @@ class DisaggEngine:
                  max_adapters: Optional[int] = None, adapter_rank: int = 8,
                  adapter_alpha: float = 16.0,
                  adapter_targets=DEFAULT_TARGETS,
-                 host_tier_bytes: Optional[int] = None):
+                 host_tier_bytes: Optional[int] = None,
+                 decode_horizon: int = 1):
+        if decode_horizon < 1:
+            raise ValueError(f"decode_horizon must be >= 1, got "
+                             f"{decode_horizon}")
+        if decode_horizon > 1 and speculate is not None:
+            raise ValueError(
+                "speculative decoding requires decode_horizon=1 this "
+                "release: the verify program is already multi-token and "
+                "fusing it under a K-step horizon is named follow-on "
+                "work — pick one of speculate= or decode_horizon>1")
         if n_prefill_slots < 1:
             raise ValueError(f"n_prefill_slots must be >= 1, got "
                              f"{n_prefill_slots}")
@@ -593,6 +665,7 @@ class DisaggEngine:
                 None if transport == "cross_host"
                 else lambda: len(decode_sched.active_indices())),
             spec_lookahead=drafter.k if drafter else 0,
+            decode_horizon=decode_horizon,
             adapter_pool=self.adapter_pool)
         # the decode scheduler shares the prefill side's PrefixCache
         # object (or runs cache-less): growth under pressure must be able
@@ -607,6 +680,7 @@ class DisaggEngine:
                           if transport == "same_host"
                           and prefill_sched.cache is not None else False),
             spec_lookahead=drafter.k if drafter else 0,
+            decode_horizon=decode_horizon,
             adapter_pool=self.adapter_pool)
         # ONE host tier serves both halves (it is host RAM — there is no
         # per-pool ownership to respect, only per-pool GATHER sources):
@@ -635,7 +709,8 @@ class DisaggEngine:
             prefill_chunk=prefill_chunk, prefill_buckets=prefill_buckets)
         self.decode = DecodeEngine(self.programs, self.decode_pages,
                                    decode_sched, self.handoff,
-                                   drafter=drafter)
+                                   drafter=drafter,
+                                   decode_horizon=decode_horizon)
         self._lat = LatencyMeter()
         # see ServeEngine: per-iteration staleness sequence + the parked
         # drafter for the controller's spec on/off toggle
@@ -698,6 +773,12 @@ class DisaggEngine:
         spec-off identity makes the mid-stream toggle legal; no-op when
         built without ``speculate``). Returns whether spec is on."""
         dec = self.decode
+        if on and dec.decode_horizon > 1 and (
+                dec.drafter is not None or self._parked_drafter is not None):
+            raise ValueError(
+                "set_speculation(True) with decode_horizon="
+                f"{dec.decode_horizon}: speculative decoding requires "
+                "K=1 — shrink the horizon first (set_decode_horizon(1))")
         if on and dec.drafter is None and self._parked_drafter is not None:
             dec.drafter = self._parked_drafter
             self._parked_drafter = None
@@ -707,6 +788,31 @@ class DisaggEngine:
             dec.drafter = None
             dec._dev = None
         return dec.drafter is not None
+
+    def set_decode_horizon(self, k: int) -> int:
+        """Resize the decode-side fused horizon at an iteration boundary —
+        identical contract to ``ServeEngine.set_decode_horizon`` (the
+        horizon changes host observation granularity, never token values,
+        so the mid-stream toggle is legal; the in-flight block, if any,
+        books at its dispatched K). Returns the new horizon."""
+        if k < 1:
+            raise ValueError(f"decode_horizon must be >= 1, got {k}")
+        dec = self.decode
+        if k > 1 and (dec.drafter is not None
+                      or self._parked_drafter is not None):
+            raise ValueError(
+                f"set_decode_horizon({k}) with a drafter attached "
+                f"(on={dec.drafter is not None}): speculative decoding "
+                f"requires K=1 — set_speculation(False) does not drop the "
+                f"parked drafter, so this engine stays K=1")
+        dec.decode_horizon = k
+        dec.sched.decode_horizon = k
+        self.prefill.sched.decode_horizon = k
+        return k
+
+    @property
+    def decode_horizon(self) -> int:
+        return self.decode.decode_horizon
 
     def publish_params(self, new_params, *, force: bool = False) -> int:
         """Publish refreshed weights into the SHARED program cache (both
@@ -954,6 +1060,7 @@ class DisaggEngine:
             "prefilling_slots": len(p.prefilling_indices()),
             "active_slots": len(d.active_indices()),
             "n_prefill_slots": self.n_prefill_slots,
+            "decode_horizon": self.decode.decode_horizon,
             "prefill_calls": self.programs.prefill_calls,
             "prefix_keys": (cache_prefix_keys(p.cache)
                             if p.cache is not None else []),
@@ -967,6 +1074,8 @@ class DisaggEngine:
                 n_slots=self.n_slots,
                 decode_steps=self.decode.decode_steps,
                 decode_tokens=self.decode.decode_tokens,
+                host_dispatches=self.decode.host_dispatches,
+                horizon_ksum=self.decode.horizon_ksum,
                 admitted=p.stats.get("admitted", 0),
                 prefix_hits=s.get("prefix_hits", 0), lat=self._lat,
                 bytes_per_page=kv_page_bytes(self.config,
@@ -999,6 +1108,7 @@ class DisaggEngine:
                 pool=self.decode_pool,
                 cached_pages=self.prefill.sched.cache_pages_held(),
                 n_slots=self.n_slots, max_pages=self.max_pages,
-                pool_bytes=pool_bytes, tier=self.host_tier),
+                pool_bytes=pool_bytes, tier=self.host_tier,
+                decode_horizon=self.decode.decode_horizon),
             "transport": self.transport,
         }

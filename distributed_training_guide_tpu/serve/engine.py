@@ -69,7 +69,7 @@ from .adapters import (AdapterPool, DEFAULT_TARGETS, ZERO_ADAPTER,
                        init_adapter_stacks, validate_adapter_params)
 from .kv_pages import (check_kv_page_geometry, commit_prefill, copy_pages,
                        init_pages, kv_dtype_name, kv_page_bytes, make_attend,
-                       PagePool, pages_for_tokens, pool_nbytes)
+                       PagePool, pages_for_tokens, pool_nbytes, TRASH_PAGE)
 from .scheduler import Admission, Request, RequestResult, Scheduler
 from .spec import Drafter, NgramDrafter, new_spec_counters
 from .tiering import (HostTier, cache_prefix_keys, make_gather,
@@ -87,27 +87,40 @@ def _sample_tokens(logits, seeds, positions, temps, top_ks, top_ps):
     filters run in sorted space (one descending sort), the draw is
     categorical over the surviving set, and the sampled rank maps back to
     a vocab id through the sort order — no threshold/tie ambiguity.
+
+    All-greedy batches skip the sampler entirely via a runtime cond: the
+    vocab sort + threefry draw dominate a small decode step, and the
+    greedy branch returns exactly the argmax that the temp<=0 lanes of
+    the full branch would select — identical tokens, one branch executed.
     """
     s, v = logits.shape
     greedy = jnp.argmax(logits, axis=-1)
-    keys = jax.vmap(lambda sd, p: jax.random.fold_in(jax.random.key(sd), p))(
-        seeds, positions)
-    scaled = logits / jnp.maximum(temps, 1e-6)[:, None]
-    order = jnp.argsort(-scaled, axis=-1)                  # [S, V] vocab ids
-    sorted_desc = jnp.take_along_axis(scaled, order, axis=-1)
-    neg_inf = jnp.finfo(jnp.float32).min
-    # top-k: keep ranks < k (k <= 0 disables)
-    k_eff = jnp.where(top_ks > 0, top_ks, v).clip(1, v)
-    ranks = jnp.broadcast_to(jnp.arange(v)[None, :], (s, v))
-    kept = jnp.where(ranks < k_eff[:, None], sorted_desc, neg_inf)
-    # top-p on the k-filtered distribution: keep the smallest prefix whose
-    # cumulative prob reaches top_p (the first rank always survives)
-    probs = jax.nn.softmax(kept, axis=-1)
-    cum = jnp.cumsum(probs, axis=-1)
-    kept = jnp.where(cum - probs < top_ps[:, None], kept, neg_inf)
-    idx = jax.vmap(jax.random.categorical)(keys, kept)     # rank per slot
-    sampled = jnp.take_along_axis(order, idx[:, None], axis=-1)[:, 0]
-    return jnp.where(temps > 0, sampled, greedy).astype(jnp.int32)
+
+    def _stochastic(logits, greedy, seeds, positions, temps, top_ks, top_ps):
+        keys = jax.vmap(lambda sd, p: jax.random.fold_in(jax.random.key(sd), p))(
+            seeds, positions)
+        scaled = logits / jnp.maximum(temps, 1e-6)[:, None]
+        order = jnp.argsort(-scaled, axis=-1)              # [S, V] vocab ids
+        sorted_desc = jnp.take_along_axis(scaled, order, axis=-1)
+        neg_inf = jnp.finfo(jnp.float32).min
+        # top-k: keep ranks < k (k <= 0 disables)
+        k_eff = jnp.where(top_ks > 0, top_ks, v).clip(1, v)
+        ranks = jnp.broadcast_to(jnp.arange(v)[None, :], (s, v))
+        kept = jnp.where(ranks < k_eff[:, None], sorted_desc, neg_inf)
+        # top-p on the k-filtered distribution: keep the smallest prefix
+        # whose cumulative prob reaches top_p (rank 0 always survives)
+        probs = jax.nn.softmax(kept, axis=-1)
+        cum = jnp.cumsum(probs, axis=-1)
+        kept = jnp.where(cum - probs < top_ps[:, None], kept, neg_inf)
+        idx = jax.vmap(jax.random.categorical)(keys, kept)  # rank per slot
+        sampled = jnp.take_along_axis(order, idx[:, None], axis=-1)[:, 0]
+        return jnp.where(temps > 0, sampled, greedy)
+
+    out = jax.lax.cond(
+        jnp.any(temps > 0), _stochastic,
+        lambda logits, greedy, *_: greedy,
+        logits, greedy, seeds, positions, temps, top_ks, top_ps)
+    return out.astype(jnp.int32)
 
 
 def resolve_context_bounds(config, max_len: Optional[int],
@@ -136,7 +149,9 @@ def derived_pool_metrics(*, pool: PagePool, cached_pages: int, n_slots: int,
                          lat: "LatencyMeter",
                          bytes_per_page: int = 0,
                          pool_dtype: str = "fp32",
-                         tier: Optional[HostTier] = None) -> dict:
+                         tier: Optional[HostTier] = None,
+                         host_dispatches: int = 0,
+                         horizon_ksum: int = 0) -> dict:
     """The derived stats() tail both engines expose (api.py's
     throughput_stats and /healthz index these keys on either).
     ``pages_cached_bytes`` sits next to the hit rate so cache pressure is
@@ -174,6 +189,19 @@ def derived_pool_metrics(*, pool: PagePool, cached_pages: int, n_slots: int,
         "decode_occupancy": (round(
             decode_tokens / (decode_steps * n_slots), 3)
             if decode_steps else 0.0),
+        # dispatch amortization (the decode-horizon lever): one host
+        # dispatch per decode at K=1, one per K fused device steps with a
+        # horizon. ``horizon_ksum`` is the raw sum of realized horizon
+        # lengths (summable fleet-wide — the router re-derives the means
+        # from the sums); ``horizon_effective`` is the mean realized K
+        # AFTER reservation shortening, so a pool too tight to ever grant
+        # the requested horizon shows up as effective << requested
+        "host_dispatches": host_dispatches,
+        "horizon_ksum": horizon_ksum,
+        "tokens_per_dispatch": (round(decode_tokens / host_dispatches, 3)
+                                if host_dispatches else 0.0),
+        "horizon_effective": (round(horizon_ksum / host_dispatches, 3)
+                              if host_dispatches else 0.0),
         "ttft_s_avg": lat.ttft_avg(),
         "itl_s_avg": lat.itl_avg(),
     }
@@ -184,18 +212,24 @@ def spec_metrics(spec: dict, *, decode_steps: int, decode_tokens: int,
     """The speculation tail of stats(): drafted/accepted/rejected
     counters, the acceptance rate, and tokens-per-iteration (the
     weight-read amortization actually achieved — spec-off it is the
-    decode occupancy in tokens, spec-on it can exceed the slot count)."""
+    decode occupancy in tokens, spec-on it can exceed the slot count).
+
+    ``spec_acceptance_rate`` is OMITTED until something was drafted: a
+    0.0 placeholder reads as "0% acceptance" on /healthz when the truth
+    is "no speculation has run yet" — consumers use ``.get`` and treat
+    the missing key as not-yet-measured."""
     drafted = spec["tokens_drafted"]
     out = {
         "spec_steps": spec["spec_steps"],
         "spec_tokens_drafted": drafted,
         "spec_tokens_accepted": spec["tokens_accepted"],
         "spec_tokens_rejected": spec["tokens_rejected"],
-        "spec_acceptance_rate": (round(spec["tokens_accepted"] / drafted, 3)
-                                 if drafted else 0.0),
         "decode_tokens_per_step": (round(decode_tokens / decode_steps, 3)
                                    if decode_steps else 0.0),
     }
+    if drafted:
+        out["spec_acceptance_rate"] = round(
+            spec["tokens_accepted"] / drafted, 3)
     if drafter is not None:
         out.update(drafter.stats())
     return out
@@ -571,6 +605,80 @@ def run_decode_iteration(programs: "ModelPrograms", pages: dict,
     return finished, len(active), dev
 
 
+def horizon_dev(sched: Scheduler) -> dict:
+    """Device-resident arrays for the fused K-step decode horizon (kind
+    "horizon"): the plain decode set plus the per-slot live/budget/eos
+    lanes the in-device masking consumes. Built at a horizon boundary
+    (host and device state agree there); between boundaries the horizon
+    program itself carries tokens/lengths/live/budgets forward ON DEVICE
+    — the host never reads them back."""
+    return {"kind": "horizon",
+            **{key: jnp.asarray(v)
+               for key, v in sched.decode_arrays().items()}}
+
+
+def dispatch_horizon(programs: "ModelPrograms", pages: dict,
+                     sched: Scheduler, dev: dict, k: int) -> dict:
+    """Dispatch ONE fused K-step horizon — no host synchronization: jax's
+    async dispatch returns futures, and the only blocking read is the
+    ``np.asarray`` in :func:`process_horizon_block`, which the engine
+    runs AFTER dispatching the next horizon (the double buffer: the
+    device computes horizon h while the host books horizon h−1).
+
+    The block tables re-upload every dispatch — they are host-owned and
+    may have grown via ``reserve_horizon`` since the last one — while
+    tokens/lengths/live/budgets stay device-resident (the previous
+    horizon's outputs feed this one's inputs without readback). A slot
+    that finished inside a still-unprocessed block is DEAD on device
+    (its live lane went False in that block's scan), so its stale table
+    row is masked to the trash page in-program and its freed pages may
+    be re-issued to a later admission without corruption.
+
+    Returns the in-flight record ``process_horizon_block`` consumes:
+    the ``[n_slots, k]`` token-block future, the realized k, and the
+    (slot, request_id) pairs active at dispatch."""
+    tables = np.zeros((sched.n_slots, sched.max_pages), np.int32)
+    active = []
+    for i in sched.active_indices():
+        tables[i] = sched.table_row(i)
+        active.append((i, sched.slots[i].request.request_id))
+    dev["tables"] = jnp.asarray(tables)
+    (block, dev["tokens"], dev["lengths"], dev["actives"], dev["budgets"],
+     pages["k"], pages["v"]) = programs.horizon_for(k)(
+        programs.params, pages["k"], pages["v"],
+        dev["tokens"], dev["lengths"], dev["tables"], dev["seeds"],
+        dev["temps"], dev["top_ks"], dev["top_ps"], dev["actives"],
+        dev["budgets"], dev["eos_ids"],
+        *programs.lora_call_args(dev["adapters"]))
+    return {"block": block, "k": k, "active": active}
+
+
+def process_horizon_block(sched: Scheduler, inflight: dict) \
+        -> tuple[list, int]:
+    """Book one finished horizon's ``[n_slots, k]`` token block: the ONE
+    blocking device read per horizon. Per slot, tokens record in order
+    through the same ``record_token`` the K=1 path uses and stop at the
+    first finish — record_token's eos-then-budget rule is exactly the
+    scan's live-mask update, so the host stops precisely where the
+    device lane died (everything past it is masked zeros). A slot that
+    already finished in an EARLIER block (or was evicted at a boundary)
+    is skipped by request-id match. Returns (finished, tokens_emitted)."""
+    block = np.asarray(inflight["block"])
+    finished, emitted = [], 0
+    for slot_idx, rid in inflight["active"]:
+        slot = sched.slots[slot_idx]
+        if slot is None or slot.request.request_id != rid:
+            continue
+        for j in range(inflight["k"]):
+            res = sched.record_token(slot_idx, int(block[slot_idx, j]),
+                                     from_decode=True)
+            emitted += 1
+            if res is not None:
+                finished.append(res)
+                break
+    return finished, emitted
+
+
 def drop_stale_pending(sched: Scheduler, pending: dict) -> None:
     """Preemption or deadline expiry may have evicted a mid-prefill
     slot; its chunk state must go with it (a preempted slot will be
@@ -586,7 +694,8 @@ def drop_stale_pending(sched: Scheduler, pending: dict) -> None:
 def build_kv_report(programs: "ModelPrograms", *, page_size: int,
                     pool: PagePool, cached_pages: int, n_slots: int,
                     max_pages: int, pool_bytes: int,
-                    tier: Optional[HostTier] = None) -> dict:
+                    tier: Optional[HostTier] = None,
+                    decode_horizon: int = 1) -> dict:
     """The preflight-style byte table for one engine's pool. Priced at
     the pool's OWN kv_dtype (scale bytes included under int8), with the
     fp32 per-page cost alongside so the quantization gain is a ratio the
@@ -623,6 +732,13 @@ def build_kv_report(programs: "ModelPrograms", *, page_size: int,
         "dense_equivalent_bytes": kv_page_bytes(
             programs.config, page_size=page_size,
             n_pages=n_slots * max_pages, kv_dtype=kv_dtype),
+        # decode-horizon pricing: one host round-trip per K fused device
+        # steps instead of per step, reading back a [n_slots, K] int32
+        # block instead of [n_slots] — K× fewer dispatches for K× the
+        # (tiny) readback payload
+        "decode_horizon": decode_horizon,
+        "horizon_block_bytes": n_slots * decode_horizon * 4,
+        "dispatches_per_step": round(1 / decode_horizon, 4),
     }
 
 
@@ -818,6 +934,7 @@ class ModelPrograms:
         self._prefill_fns = {}
         self._chunk_fns = {}
         self._verify_fns = {}
+        self._horizon_fns = {}
         # one jit wrapper; each prefill bucket's [L, Pb, ...] shape gets its
         # own cached executable automatically
         self._commit_fn = jax.jit(commit_impl, donate_argnums=(0, 1),
@@ -1133,6 +1250,8 @@ class ModelPrograms:
             sizes[f"chunk_{t}"] = fn._cache_size()
         for key, fn in self._verify_fns.items():
             sizes[f"verify_{key}"] = fn._cache_size()
+        for k, fn in self._horizon_fns.items():
+            sizes[f"horizon_{k}"] = fn._cache_size()
         return sizes
 
     # ---- state placement ---------------------------------------------------
@@ -1194,6 +1313,71 @@ class ModelPrograms:
         # decode run round-trips nothing but the sampled ids to the host
         return nxt, jnp.where(actives, lengths + 1, lengths), \
             cache["k"], cache["v"]
+
+    def horizon_for(self, k: int):
+        """The fused K-step decode program (``decode_horizon=K``): ONE
+        compiled ``lax.scan`` of K decode iterations, so a steady decode
+        pays one host dispatch — and one ``[n_slots, K]`` int32 readback
+        — per K tokens per slot instead of per token.
+
+        Each scan step IS ``_decode`` with the live mask threaded
+        through: a lane goes dead mid-horizon exactly where the host's
+        ``record_token`` would finish it (EOS first — ``eos_ids >= 0``
+        guards the no-eos case — then budget exhaustion), after which
+        its block table masks to the trash page (its scatters AND
+        attends route to page 0), its emitted tokens mask to 0, and its
+        length/budget freeze. Sampling keys are position-keyed
+        (``fold_in(seed, absolute position)``), so the K-step stream is
+        token-identical to K single steps BY CONSTRUCTION — the horizon
+        changes when the host observes tokens, never which tokens exist.
+
+        The scan carries the kv pools; the per-step stacked output is
+        only the ``[K, n_slots]`` token block — the cache avals stay
+        pool-shaped in and out (the HLO pin tests/test_multistep.py
+        checks), so fusing K steps costs zero extra pool memory.
+
+        Returns ``(block [n_slots, K], tokens, lengths, live, budgets,
+        k_pages, v_pages)`` — everything after the block is next
+        horizon's device-resident input."""
+        if k < 1:
+            raise ValueError(f"decode horizon must be >= 1, got {k}")
+        if k not in self._horizon_fns:
+            def fn(params, kp, vp, tokens, lengths, tables, seeds, temps,
+                   top_ks, top_ps, live, budgets, eos_ids, *lora_args):
+                def step(carry, _):
+                    kp, vp, tok, lens, live, budg = carry
+                    eff_tables = jnp.where(live[:, None], tables,
+                                           TRASH_PAGE)
+                    attend = self.make_attend(eff_tables, lens)
+                    logits, cache = self.mod.paged_decode_step(
+                        self.config, params, tok[:, None], lens,
+                        {"k": kp, "v": vp}, attend,
+                        **({"lora": self._lora_ctx(lora_args)}
+                           if lora_args else {}))
+                    nxt = _sample_tokens(logits.astype(jnp.float32),
+                                         seeds, lens + 1, temps, top_ks,
+                                         top_ps)
+                    nxt = jnp.where(live, nxt, 0)
+                    new_budg = jnp.where(live, budg - 1, budg)
+                    hit_eos = jnp.where(eos_ids >= 0, nxt == eos_ids,
+                                        False)
+                    new_live = live & ~hit_eos & (new_budg > 0)
+                    new_lens = jnp.where(live, lens + 1, lens)
+                    return (cache["k"], cache["v"], nxt, new_lens,
+                            new_live, new_budg), nxt
+
+                (kp, vp, tok, lens, live, budg), toks = jax.lax.scan(
+                    step, (kp, vp, tokens, lengths, live, budgets),
+                    None, length=k)
+                return toks.T, tok, lens, live, budg, kp, vp
+
+            kv_out = ((self._repl,) * 5
+                      + (self._kv_sharding, self._kv_sharding)
+                      if self.shard_kv else None)
+            self._horizon_fns[k] = jax.jit(
+                fn, donate_argnums=(1, 2),
+                **({"out_shardings": kv_out} if kv_out else {}))
+        return self._horizon_fns[k]
 
     def prefill_for(self, bucket: int):
         if bucket not in self._prefill_fns:
@@ -1385,7 +1569,19 @@ class ServeEngine:
                  weight_dtype=None, max_adapters: Optional[int] = None,
                  adapter_rank: int = 8, adapter_alpha: float = 16.0,
                  adapter_targets=DEFAULT_TARGETS,
-                 host_tier_bytes: Optional[int] = None):
+                 host_tier_bytes: Optional[int] = None,
+                 decode_horizon: int = 1):
+        if decode_horizon < 1:
+            raise ValueError(f"decode_horizon must be >= 1, got "
+                             f"{decode_horizon}")
+        if decode_horizon > 1 and speculate is not None:
+            raise ValueError(
+                f"speculate={speculate!r} with decode_horizon="
+                f"{decode_horizon}: speculative decoding requires K=1 "
+                f"this release — the verify program is already "
+                f"multi-token, and fusing it under a horizon is named "
+                f"follow-on work. Drop one of the two knobs.")
+        self.decode_horizon = decode_horizon
         self.drafter = resolve_drafter(speculate, spec_k=spec_k,
                                        n_slots=n_slots)
         self.spec = new_spec_counters()
@@ -1441,7 +1637,8 @@ class ServeEngine:
             # admission headroom scales to the k in-flight speculated
             # tokens a verify step can scatter per running decode
             spec_lookahead=self.drafter.k if self.drafter else 0,
-            adapter_pool=self.adapter_pool)
+            adapter_pool=self.adapter_pool,
+            decode_horizon=decode_horizon)
         if prefill_buckets is None:
             prefill_buckets = default_prefill_buckets(self.max_pages,
                                                       page_size)
@@ -1469,11 +1666,17 @@ class ServeEngine:
         # decode arrays (None = rebuild from the scheduler next decode)
         self._pending: dict[int, Admission] = {}
         self._dev: Optional[dict] = None
+        # the dispatched-but-unprocessed horizon block (decode_horizon >
+        # 1): the double buffer's slot — the device computes horizon h
+        # while the host books h−1 (see dispatch_horizon)
+        self._inflight: Optional[dict] = None
         self.draining = False
         # decode throughput + latency counters (api.py metrics; all
         # host-side — see stats())
         self.decode_steps = 0
         self.decode_tokens = 0
+        self.host_dispatches = 0
+        self.horizon_ksum = 0
         self._lat = LatencyMeter()
         # monotone per-ITERATION sequence number surfaced in stats(): a
         # poller seeing the same value twice knows the snapshot is stale
@@ -1555,6 +1758,11 @@ class ServeEngine:
         (``spec_lookahead``) stays at the drafter's k even while parked
         — conservative, and it means re-enabling never over-admits.
         Returns whether speculation is on after the call."""
+        if on and self.decode_horizon > 1:
+            raise ValueError(
+                f"set_speculation(True) with decode_horizon="
+                f"{self.decode_horizon}: speculative decoding requires "
+                f"K=1 this release — set_decode_horizon(1) first")
         if on and self.drafter is None and self._parked_drafter is not None:
             self.drafter = self._parked_drafter
             self._parked_drafter = None
@@ -1564,6 +1772,31 @@ class ServeEngine:
             self.drafter = None
             self._dev = None
         return self.drafter is not None
+
+    def set_decode_horizon(self, k: int) -> int:
+        """Set the fused-decode horizon at an iteration boundary — the
+        controller's dispatch-amortization actuation (K grows under
+        batch/throughput pressure, shrinks to 1 under streaming/deadline
+        pressure: a K-horizon emits tokens in K-bursts, so per-token p99
+        ITL rises toward K·step even while throughput improves). Legal
+        mid-stream BECAUSE the horizon is observation granularity, not
+        semantics: position-keyed sampling makes the K-step stream
+        token-identical to K single steps, so in-flight sequences
+        continue bitwise across the change. Any in-flight block finishes
+        booking under its own dispatched K; admission margins follow the
+        new K immediately. Returns the horizon now in force."""
+        if k < 1:
+            raise ValueError(f"decode_horizon must be >= 1, got {k}")
+        if k > 1 and (self.drafter is not None
+                      or self._parked_drafter is not None):
+            raise ValueError(
+                f"set_decode_horizon({k}) on an engine built with a "
+                f"drafter: speculative decoding requires K=1 this "
+                f"release — the verify program is already multi-token, "
+                f"and fusing it under a horizon is named follow-on work")
+        self.decode_horizon = k
+        self.scheduler.decode_horizon = k
+        return self.decode_horizon
 
     def publish_params(self, new_params, *, force: bool = False) -> int:
         """Publish refreshed weights into the shared program cache
@@ -1660,13 +1893,54 @@ class ServeEngine:
             return None
         return self._sample_first(adm, logit)
 
+    def _horizon_ready(self) -> bool:
+        """Whether the active batch may run a fused K-step horizon: the
+        knob is up, no drafter (spec stays K=1 this release), and no
+        slot is mid-replay (a post-preemption replay must rewrite k/v
+        through the SAME single-token program that wrote it)."""
+        sched = self.scheduler
+        return (self.decode_horizon > 1 and self.drafter is None
+                and not any(sched.slots[i].replaying
+                            for i in sched.active_indices()))
+
+    def _pipeline_steady(self) -> bool:
+        """Whether the NEXT horizon may dispatch before the pending one
+        is booked — i.e. no scheduler event can need the host state the
+        pending block carries: nothing queued (admission), no prefill in
+        flight, and no deadline due (expiry stays a boundary event).
+        Finishes hiding in the pending block are fine: their lanes are
+        already dead on device, and booking them after the dispatch
+        frees their pages for the NEXT boundary."""
+        sched = self.scheduler
+        return (not sched.queue and not self._pending
+                and not sched.prefilling_indices()
+                and not sched.deadline_due())
+
+    def _note_dispatch(self, k: int) -> None:
+        self.host_dispatches += 1
+        self.horizon_ksum += k
+        self.decode_steps += k
+
     def step(self) -> list[RequestResult]:
         """One scheduler iteration: expire deadlines (clean eviction at
         the boundary), grow running decodes (preempting the cheapest on
         true exhaustion), admit whatever now fits (sharing cached
         prefixes), advance prefill work (whole-bucket, or one
         chunk-budget's worth), then ONE batched decode over the decoding
-        slots. Returns finished requests."""
+        slots — a single step at decode_horizon=1, a fused K-step
+        horizon program otherwise. Returns finished requests.
+
+        With a horizon the dispatch is DOUBLE-BUFFERED: in the steady
+        state (nothing queued, no prefill, no deadline due) this method
+        dispatches horizon h first and only then blocks on h−1's token
+        block to book it — the device computes h while the host runs
+        record_token/EOS/streaming bookkeeping for h−1, so host work
+        overlaps device compute instead of serializing with it. Any
+        scheduler event (admission, prefill, deadline, preemption,
+        replay, a horizon the pool can't pre-reserve) DRAINS the
+        pipeline first: the block books synchronously and the boundary
+        runs on authoritative host state. Finished results therefore
+        surface at most one step after their tokens were computed."""
         if getattr(self, "_publish_pending_swap", False):
             raise RuntimeError(
                 "new_generation(params=...) already published the next "
@@ -1678,6 +1952,36 @@ class ServeEngine:
         self.stats_seq += 1
         finished = []
         sched = self.scheduler
+        if self._inflight is not None:
+            if (self._horizon_ready() and self._pipeline_steady()
+                    and self._dev is not None and sched.active_indices()):
+                pending_k = self._inflight["k"]
+                cov = sched.reserve_horizon(
+                    pending_k + self.decode_horizon)
+                # clamp by the largest remaining budget MINUS the steps
+                # already in flight: when the pending block provably
+                # finishes every slot, k_new drops below 1 and we drain
+                # instead of burning an all-dead trailing horizon
+                k_new = min(cov - pending_k, self.decode_horizon,
+                            sched.max_remaining_budget() - pending_k)
+                if k_new >= 1:
+                    nxt = dispatch_horizon(self.programs, self.pages,
+                                           sched, self._dev, k_new)
+                    self._note_dispatch(k_new)
+                    fin, emitted = process_horizon_block(sched,
+                                                         self._inflight)
+                    self._inflight = nxt
+                    self.decode_tokens += emitted
+                    self._lat.note(fin)
+                    return fin
+            # drain: a boundary event needs host state the pending block
+            # still holds — book it now, rebuild device arrays after the
+            # boundary runs
+            fin, emitted = process_horizon_block(sched, self._inflight)
+            self._inflight = None
+            self._dev = None
+            self.decode_tokens += emitted
+            finished.extend(fin)
         expired = sched.expire_deadlines()
         if expired:
             self._dev = None
@@ -1731,14 +2035,31 @@ class ServeEngine:
                 drop_stale_pending(sched, self._pending)
 
         if sched.active_indices():
-            fin, emitted, self._dev = run_decode_iteration(
-                self.programs, self.pages, sched, self.drafter, self.spec,
-                self._dev)
-            self.decode_steps += 1
-            self.decode_tokens += emitted
-            finished.extend(fin)
-            if fin:
-                self._dev = None       # a slot left the batch
+            if self._horizon_ready():
+                # grow_for_decode already guaranteed every slot's next
+                # write (preempt discipline), so coverage is >= 1; the
+                # reservation only decides how much of K the pool grants,
+                # and the budget clamp keeps the final horizon of a
+                # batch from running steps past every slot's max_new
+                k0 = max(1, min(sched.reserve_horizon(self.decode_horizon),
+                                self.decode_horizon,
+                                sched.max_remaining_budget()))
+                if self._dev is None or self._dev.get("kind") != "horizon":
+                    self._dev = horizon_dev(sched)
+                self._inflight = dispatch_horizon(self.programs, self.pages,
+                                                  sched, self._dev, k0)
+                self._note_dispatch(k0)
+                # no blocking read here: the block books next step (or at
+                # the next drain) — the first half of the double buffer
+            else:
+                fin, emitted, self._dev = run_decode_iteration(
+                    self.programs, self.pages, sched, self.drafter,
+                    self.spec, self._dev)
+                self._note_dispatch(1)
+                self.decode_tokens += emitted
+                finished.extend(fin)
+                if fin:
+                    self._dev = None       # a slot left the batch
         self._lat.note(finished)
         return finished
 
@@ -1802,6 +2123,7 @@ class ServeEngine:
             **s,
             "stats_seq": self.stats_seq,
             "preemptions": s.get("preempted", 0),
+            "decode_horizon": self.decode_horizon,
             "draining": self.draining,
             "max_queue": sched.max_queue,
             "queued": len(sched.queue),
@@ -1818,6 +2140,8 @@ class ServeEngine:
                 pool=sched.pool, cached_pages=sched.cache_pages_held(),
                 n_slots=self.n_slots, decode_steps=self.decode_steps,
                 decode_tokens=self.decode_tokens,
+                host_dispatches=self.host_dispatches,
+                horizon_ksum=self.horizon_ksum,
                 admitted=s.get("admitted", 0),
                 prefix_hits=s.get("prefix_hits", 0), lat=self._lat,
                 bytes_per_page=kv_page_bytes(self.config,
@@ -1839,7 +2163,8 @@ class ServeEngine:
             pool=self.scheduler.pool,
             cached_pages=self.scheduler.cache_pages_held(),
             n_slots=self.n_slots, max_pages=self.max_pages,
-            pool_bytes=self.kv_cache_bytes(), tier=self.host_tier)
+            pool_bytes=self.kv_cache_bytes(), tier=self.host_tier,
+            decode_horizon=self.decode_horizon)
 
     def weight_report(self) -> dict:
         """The preflight-style byte table for this engine's weights."""

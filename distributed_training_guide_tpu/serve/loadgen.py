@@ -250,6 +250,14 @@ class LoadReport:
     ttft_p99_s: float = 0.0
     itl_p50_s: float = 0.0
     itl_p99_s: float = 0.0
+    # per-token tap accounting (run_open_loop stamps every token the
+    # moment its stream first shows it): ``itl_samples`` counts the
+    # measured inter-token gaps behind the ITL tails, and
+    # ``token_burst_max`` is the largest single-tap token batch any one
+    # request emitted — under a K-step decode horizon this reads K, and
+    # the p99 ITL reads the K·step burst a per-request MEAN would hide
+    itl_samples: int = 0
+    token_burst_max: int = 0
     refusal_rate: float = 0.0
     refused_by_reason: dict = dataclasses.field(default_factory=dict)
     spillovers: int = 0
@@ -272,12 +280,20 @@ def percentile(values, q: float) -> float:
 
 def summarize(schedule, results, refusals, wall_s, *,
               engine_stats: Optional[dict] = None,
-              timed_out: bool = False, iterations: int = 0) -> LoadReport:
+              timed_out: bool = False, iterations: int = 0,
+              itl_gaps: Optional[list] = None,
+              token_burst_max: int = 0) -> LoadReport:
     """Fold raw driver output into a LoadReport. ``results`` maps
     request id -> RequestResult, ``refusals`` is [(offset, reason)].
-    TTFT/ITL read the RequestResult accounting directly — measured from
+    TTFT reads the RequestResult accounting directly — measured from
     FIRST client submit even across resubmission hops (the router
-    threads the original timestamp through)."""
+    threads the original timestamp through). ITL comes from
+    ``itl_gaps`` — the per-token tap timestamps run_open_loop records —
+    when provided; the RequestResult per-request MEAN is only the
+    fallback for callers with no tap stream. The distinction is the
+    honest-ITL satellite: a fused K-step horizon leaves the mean
+    untouched while every K-th gap is K·step — only per-token samples
+    put that burst into p99."""
     rep = LoadReport(offered=len(schedule),
                      submitted=len(schedule) - len(refusals),
                      refused=len(refusals), wall_s=round(wall_s, 4),
@@ -310,8 +326,12 @@ def summarize(schedule, results, refusals, wall_s, *,
         rep.refusal_rate = round(rep.refused / rep.offered, 3)
     rep.ttft_p50_s = round(percentile(ttfts, 0.50), 4)
     rep.ttft_p99_s = round(percentile(ttfts, 0.99), 4)
+    if itl_gaps is not None:
+        itls = itl_gaps
+        rep.itl_samples = len(itl_gaps)
     rep.itl_p50_s = round(percentile(itls, 0.50), 4)
     rep.itl_p99_s = round(percentile(itls, 0.99), 4)
+    rep.token_burst_max = token_burst_max
     if engine_stats:
         rep.spillovers = engine_stats.get("spillovers", 0)
     return rep
@@ -341,6 +361,13 @@ def run_open_loop(engine, schedule: list[tuple[float, Request]], *,
     t0 = clock()
     results: dict[int, object] = {}
     refusals: list[tuple[float, str]] = []
+    # per-token arrival stamps (the honest-ITL tap): one timestamp per
+    # token per request, stamped the iteration its stream first shows
+    # it — a K-token burst shares one stamp, so K−1 gaps read ~0 and
+    # the gap before the burst reads the full horizon latency
+    tok_times: dict[int, list] = {}
+    token_burst_max = 0
+    can_tap = hasattr(engine, "partial_tokens")
     next_i = 0
     iterations = 0
     timed_out = False
@@ -361,8 +388,25 @@ def run_open_loop(engine, schedule: list[tuple[float, Request]], *,
         if controller is not None:
             controller.step()
         if engine.has_work:
-            for res in engine.step():
+            stepped = engine.step()
+            for res in stepped:
                 results[res.request_id] = res
+            if can_tap:
+                t_tap = clock() - t0
+                for rid, toks in engine.partial_tokens().items():
+                    times = tok_times.setdefault(rid, [])
+                    new = len(toks) - len(times)
+                    if new > 0:
+                        token_burst_max = max(token_burst_max, new)
+                        times.extend([t_tap] * new)
+                # a finished request leaves partial_tokens() the same
+                # iteration it completes: stamp its final block here
+                for res in stepped:
+                    times = tok_times.setdefault(res.request_id, [])
+                    new = len(res.generated_ids) - len(times)
+                    if new > 0:
+                        token_burst_max = max(token_burst_max, new)
+                        times.extend([t_tap] * new)
         elif next_i >= len(schedule):
             break
         else:
@@ -375,9 +419,15 @@ def run_open_loop(engine, schedule: list[tuple[float, Request]], *,
             break
     finished = {rid: res for rid, res in results.items() if res is not None}
     stats = engine.stats() if hasattr(engine, "stats") else None
+    itl_gaps = None
+    if can_tap:
+        itl_gaps = []
+        for times in tok_times.values():
+            itl_gaps.extend(b - a for a, b in zip(times, times[1:]))
     return summarize(schedule, finished, refusals, clock() - t0,
                      engine_stats=stats, timed_out=timed_out,
-                     iterations=iterations)
+                     iterations=iterations, itl_gaps=itl_gaps,
+                     token_burst_max=token_burst_max)
 
 
 def saturation_sweep(engine_factory, rates, *, duration_s: float,

@@ -905,7 +905,11 @@ class Router:
         "decode_tokens", "spec_steps", "spec_tokens_drafted",
         "spec_tokens_accepted", "spec_tokens_rejected",
         "host_tier_bytes", "host_tier_budget_bytes", "spilled_pages",
-        "restore_hits", "restore_misses", "prefill_calls")
+        "restore_hits", "restore_misses", "prefill_calls",
+        # fused-horizon raw counters: RAW SUMS cross replica boundaries
+        # (the per-replica ratios do not), so the fleet-level
+        # tokens_per_dispatch/horizon_effective re-derive from these
+        "host_dispatches", "horizon_ksum")
 
     def stats(self) -> dict:
         """Fleet aggregate + per-replica health, all host-side (each
@@ -1005,9 +1009,18 @@ class Router:
             "decode_tokens_per_step": (
                 round(agg["decode_tokens"] / agg["decode_steps"], 3)
                 if agg["decode_steps"] else 0.0),
-            "spec_acceptance_rate": (
-                round(agg["spec_tokens_accepted"] / drafted, 3)
-                if drafted else 0.0),
+            "tokens_per_dispatch": (
+                round(agg["decode_tokens"] / agg["host_dispatches"], 3)
+                if agg["host_dispatches"] else 0.0),
+            "horizon_effective": (
+                round(agg["horizon_ksum"] / agg["host_dispatches"], 3)
+                if agg["host_dispatches"] else 0.0),
+            # omitted entirely when nothing was drafted fleet-wide (same
+            # contract as engine.spec_metrics: 0.0 would read as "0%
+            # acceptance" on a fleet that never speculated)
+            **({"spec_acceptance_rate":
+                round(agg["spec_tokens_accepted"] / drafted, 3)}
+               if drafted else {}),
             **{k: v for k, v in self.counters.items() if k != "refused"},
             "replicas": per,
         }

@@ -450,7 +450,7 @@ class Scheduler:
                  allow_partial_share: bool = False,
                  max_queue: Optional[int] = None,
                  admission_headroom=None, spec_lookahead: int = 0,
-                 adapter_pool=None):
+                 adapter_pool=None, decode_horizon: int = 1):
         if n_slots < 1:
             raise ValueError(f"n_slots must be >= 1, got {n_slots}")
         if max_queue is not None and max_queue < 1:
@@ -490,6 +490,16 @@ class Scheduler:
             raise ValueError(f"spec_lookahead must be >= 0, got "
                              f"{spec_lookahead}")
         self.spec_lookahead = spec_lookahead
+        # fused-decode horizon (serve/engine.py decode_horizon=K): the
+        # engine runs K decode iterations per host dispatch, so every
+        # running decode can consume K positions' worth of pages between
+        # two scheduling boundaries — admission margins scale to it
+        # exactly like spec_lookahead. Mutable: the controller's
+        # set_decode_horizon actuation updates it at a boundary.
+        if decode_horizon < 1:
+            raise ValueError(f"decode_horizon must be >= 1, got "
+                             f"{decode_horizon}")
+        self.decode_horizon = decode_horizon
         # shared AdapterPool (serve/adapters.py) when the engine serves
         # pooled LoRA adapters; refcounts track requests INSIDE this
         # scheduler (queued or seated): retained at every entry point
@@ -798,9 +808,11 @@ class Scheduler:
             # trade one prompt's admission for immediate preemption churn
             # (decodes running in a sibling scheduler count via the hook).
             # Under speculation each decode can consume 1 + spec_lookahead
-            # positions per iteration, so the margin scales to the pages
+            # positions per iteration, and under a K-step horizon K
+            # positions per BOUNDARY, so the margin scales to the pages
             # that worth of tokens can claim.
-            per_decode = pages_for_tokens(1 + self.spec_lookahead, page)
+            per_decode = pages_for_tokens(
+                self.decode_horizon + self.spec_lookahead, page)
             headroom = (len(self.active_indices()) + (
                 self._headroom_fn() if self._headroom_fn else 0)) * per_decode
             priv = self._alloc(n_priv, headroom=headroom)
@@ -963,6 +975,58 @@ class Scheduler:
             slot.pages.extend(got)
         return extra
 
+    def reserve_horizon(self, want: int) -> int:
+        """Worst-case page reservation for a fused decode horizon: extend
+        every active slot's pages to cover up to ``want`` decode writes
+        past its current cache_len, so the K-step device loop NEVER
+        needs a mid-horizon host allocation. Opportunistic like
+        ``ensure_lookahead`` — allocation failure (after cache-eviction
+        pressure) SHORTENS the horizon instead of preempting; the
+        mandatory single next write stays ``grow_for_decode``'s job with
+        its refuse-or-preempt discipline.
+
+        Returns the number of writes covered for EVERY active slot — the
+        horizon the engine may run unattended. A slot whose own
+        remaining budget ``r < want`` only needs ``r`` pages' worth (its
+        lane goes dead in-device after r tokens), so a nearly-finished
+        request never clamps the batch's horizon below what its budget
+        already guarantees. Pages granted for a horizon that later
+        shortens simply arrive early — the next horizon's writes land in
+        them (no un-grow, same as speculation's lookahead)."""
+        if want < 1:
+            raise ValueError(f"horizon must be >= 1, got {want}")
+        page = self.pool.page_size
+        covered = want
+        for slot_idx in self.active_indices():
+            slot = self.slots[slot_idx]
+            r = max(1, slot.request.max_new_tokens - len(slot.generated))
+            need = min(want, r)
+            while (slot.cache_len + need - 1) // page >= len(slot.pages):
+                got = self._alloc(1)
+                if got is None:
+                    break
+                slot.pages.extend(got)
+            can = len(slot.pages) * page - slot.cache_len
+            if can >= r:
+                continue            # budget dies before the pages run out
+            covered = min(covered, can)
+        return max(0, min(covered, want))
+
+    def max_remaining_budget(self) -> int:
+        """The largest remaining token budget over active slots — the
+        horizon length past which EVERY device lane is provably dead
+        (budgets only shrink; eos can only finish a lane sooner). The
+        engine clamps its fused horizon to this so it never dispatches
+        steps no slot can use (the all-dead trailing dispatch would
+        otherwise burn a full horizon of device time at the end of
+        every batch)."""
+        rem = 0
+        for slot_idx in self.active_indices():
+            slot = self.slots[slot_idx]
+            rem = max(rem,
+                      slot.request.max_new_tokens - len(slot.generated))
+        return rem
+
     # ---- decode bookkeeping ------------------------------------------------
     def record_token(self, slot_idx: int, token: int, *,
                      from_decode: bool) -> Optional[RequestResult]:
@@ -1052,6 +1116,22 @@ class Scheduler:
                     slot.request, slot.generated, slot.admitted_at,
                     slot.first_token_at, now, where="running"))
         return results
+
+    def deadline_due(self, now: Optional[float] = None) -> bool:
+        """Whether ANY queued or running request is past its deadline —
+        the cheap probe the pipelined horizon path runs between
+        dispatches: False means ``expire_deadlines`` would be a no-op,
+        so the pipeline may keep flowing without draining; True forces
+        the drain-and-expire boundary (deadline eviction stays an
+        orderly horizon-boundary event, never a mid-horizon abort)."""
+        now = self._clock() if now is None else now
+        reqs = itertools.chain(
+            (e.request for e in self.queue),
+            (s.request for s in self.slots if s is not None))
+        return any(
+            req.deadline_s is not None
+            and now - self._submit_times[req.request_id] > req.deadline_s
+            for req in reqs)
 
     # ---- page handoff (disaggregated serving seam) -------------------------
     def release_slot(self, slot_idx: int) -> tuple[_Slot, float]:
@@ -1161,6 +1241,13 @@ class Scheduler:
             # per-slot adapter ids: idle lanes decode under the zero
             # adapter (slot 0's stack rows are zeros — an exact +0)
             "adapters": np.zeros(s, np.int32),
+            # the fused-horizon lanes (serve/engine.py horizon_for): the
+            # in-device live mask finishes a lane exactly where
+            # record_token would — eos_ids is -1 for "no eos" (vocab id
+            # 0 is a legal eos), budgets is the remaining max_new_tokens
+            # allowance. The K=1 program ignores both.
+            "eos_ids": np.full(s, -1, np.int32),
+            "budgets": np.zeros(s, np.int32),
         }
         for i, slot in enumerate(self.slots):
             if slot is None or slot.prefilling:
@@ -1177,4 +1264,7 @@ class Scheduler:
             out["top_ps"][i] = req.top_p
             out["actives"][i] = True
             out["adapters"][i] = req.adapter_id
+            out["eos_ids"][i] = -1 if req.eos_id is None else req.eos_id
+            out["budgets"][i] = max(
+                0, req.max_new_tokens - len(slot.generated))
         return out

@@ -566,6 +566,27 @@ def run_preflight(trainer, *, global_batch: int, seq_length: int,
         f"{_amortized(0.7) / 2**20:.0f} MiB/token at 0.7 acceptance "
         f"({_amortized(1.0) / 2**20:.0f} at full)")
 
+    # decode horizons (serve/engine.py horizon_for): the spec rows
+    # amortize the WEIGHT read per token; decode_horizon=K amortizes the
+    # HOST round-trip — one dispatch + one [n_slots, K] int32 readback
+    # per K steps instead of per step. The device-side KV/weight traffic
+    # above is UNCHANGED (the horizon is the same per-step program under
+    # a scan); what K buys is dispatches/step = 1/K, and what it costs
+    # is worst-case page pre-reservation per active slot per horizon
+    # (reserve_horizon grants a SHORTER horizon on pressure — never a
+    # mid-horizon host allocation) plus a K-burst emission shape the
+    # loadgen's itl_p99 prices.
+    horizon_k = 8
+    report["serve_kv"].update({
+        "decode_horizon_nominal": horizon_k,
+        "horizon_dispatches_per_step": round(1 / horizon_k, 4),
+        "horizon_block_bytes_per_slot": horizon_k * 4,
+        # pages a K-horizon may need per slot beyond its committed
+        # length, at the worst page phase (len % page == page - 1)
+        "horizon_reserve_pages_worst_case":
+            -(-(page_size - 1 + horizon_k) // page_size),
+    })
+
     # weight_dtype column (serve/weights.py): the params are the decode
     # step's OTHER byte stream, and with int8 KV they are the largest
     # remaining HBM tenant. Rows are STORAGE bytes per dtype — int8
